@@ -1,0 +1,84 @@
+"""DeepFM: FM plus a deep MLP head over concatenated embedding rows.
+
+The reference's stretch config (BASELINE.json:11 — "FM + 3-layer MLP on
+Criteo-1TB … a new JAX nn head"; it does NOT exist in the reference,
+SURVEY.md §0.1). Architecture follows Guo et al., *DeepFM* (IJCAI 2017):
+the FM component and the deep component SHARE the embedding table V; the
+deep input is the concatenation of the nnz gathered rows; the final score
+is ``y_fm + y_deep``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fm_spark_tpu.models import base
+from fm_spark_tpu.ops import fm as fm_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepFMSpec(base.ModelSpec):
+    """DeepFM hyperparameters.
+
+    ``num_fields`` fixes the slot count (the MLP input is num_fields·rank);
+    ``mlp_dims`` are the hidden widths of the 3-layer head.
+    """
+
+    num_fields: int = 0
+    mlp_dims: tuple = (400, 400, 400)
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.num_fields <= 0:
+            raise ValueError("DeepFMSpec requires num_fields > 0")
+
+    def init(self, rng: jax.Array) -> dict:
+        k_emb, *k_mlp = jax.random.split(rng, 2 + len(self.mlp_dims))
+        params = base.init_linear_terms(rng, self)
+        params["v"] = (
+            jax.random.normal(
+                k_emb, (self.num_features, self.rank), dtype=jnp.float32
+            )
+            * self.init_std
+        ).astype(self.pdtype)
+        dims = (self.num_fields * self.rank, *self.mlp_dims, 1)
+        layers = []
+        for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+            # He init for the relu stack; output layer included (d_out=1).
+            scale = jnp.sqrt(2.0 / d_in)
+            kw = k_mlp[i] if i < len(k_mlp) else jax.random.fold_in(rng, i)
+            layers.append(
+                {
+                    "kernel": jax.random.normal(kw, (d_in, d_out), jnp.float32)
+                    * scale,
+                    "bias": jnp.zeros((d_out,), jnp.float32),
+                }
+            )
+        params["mlp"] = layers
+        return params
+
+    def scores(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        cd = self.cdtype
+        vals_c = vals.astype(cd)
+        # One shared gather: both the FM term and the deep head consume the
+        # same value-scaled rows (padded slots with val == 0 contribute
+        # nothing to either component).
+        xv = params["v"][ids].astype(cd) * vals_c[..., None]   # [B, nnz, k]
+        y_fm = fm_ops.fm_interaction_from_xv(xv)
+        if self.use_linear:
+            y_fm = y_fm + jnp.sum(params["w"][ids].astype(cd) * vals_c, axis=1)
+        if self.use_bias:
+            y_fm = y_fm + params["w0"].astype(cd)
+        h = xv.reshape(xv.shape[0], -1)                   # [B, nnz*k]
+        n_hidden = len(self.mlp_dims)
+        for li, layer in enumerate(params["mlp"]):
+            h = h @ layer["kernel"].astype(cd) + layer["bias"].astype(cd)
+            if li < n_hidden:
+                h = jax.nn.relu(h)
+        return y_fm + h[:, 0]
+
+    def predict(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        return base.predict_from_scores(self, self.scores(params, ids, vals))

@@ -1,0 +1,52 @@
+"""Field-aware FM model family (reference config 4, BASELINE.json:10).
+
+V is ``[n, F, k]``: one latent vector per (feature, field) pair; the
+interaction uses the opposite slot's field (SURVEY.md §2 row 6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fm_spark_tpu.models import base
+from fm_spark_tpu.ops import ffm as ffm_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class FFMSpec(base.ModelSpec):
+    """FFM hyperparameters. ``num_fields`` is the fixed slot count (nnz)."""
+
+    num_fields: int = 0
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.num_fields <= 0:
+            raise ValueError("FFMSpec requires num_fields > 0")
+
+    def init(self, rng: jax.Array) -> dict:
+        params = base.init_linear_terms(rng, self)
+        params["v"] = (
+            jax.random.normal(
+                rng,
+                (self.num_features, self.num_fields, self.rank),
+                dtype=jnp.float32,
+            )
+            * self.init_std
+        ).astype(self.pdtype)
+        return params
+
+    def scores(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        return ffm_ops.ffm_scores(
+            params["w0"] if self.use_bias else jnp.zeros((), jnp.float32),
+            params["w"] if self.use_linear else jnp.zeros_like(params["w"]),
+            params["v"],
+            ids,
+            vals,
+            compute_dtype=self.cdtype,
+        )
+
+    def predict(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        return base.predict_from_scores(self, self.scores(params, ids, vals))

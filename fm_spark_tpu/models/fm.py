@@ -1,0 +1,50 @@
+"""The order-2 factorization machine model family.
+
+Parity target: the reference's ``FMModel`` — holds (w0, w, V), predicts via
+the O(k·nnz) identity, initializes V ~ N(0, initStd²) and w = 0, w0 = 0
+(SURVEY.md §2 rows 1-2, §3.1). The ``dim=(k0,k1,k2)`` triple of the
+reference's ``train()`` maps to (use_bias, use_linear, rank).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fm_spark_tpu.models import base
+from fm_spark_tpu.ops import fm as fm_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class FMSpec(base.ModelSpec):
+    """FM hyperparameters; see :class:`~fm_spark_tpu.models.base.ModelSpec`."""
+
+    def init(self, rng: jax.Array) -> dict:
+        """V ~ N(0, init_std²), w = 0, w0 = 0 — the reference's init."""
+        params = base.init_linear_terms(rng, self)
+        params["v"] = (
+            jax.random.normal(rng, (self.num_features, self.rank), dtype=jnp.float32)
+            * self.init_std
+        ).astype(self.pdtype)
+        return params
+
+    def scores(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        """Raw batched scores; bias/linear terms gated by dim=(k0,k1,·).
+
+        Gating happens by omitting the term from the graph entirely, so the
+        gradient w.r.t. a disabled term is exactly zero (the reference
+        simply never updates those weights).
+        """
+        return fm_ops.fm_scores(
+            params["w0"] if self.use_bias else jnp.zeros((), jnp.float32),
+            params["w"] if self.use_linear else jnp.zeros_like(params["w"]),
+            params["v"],
+            ids,
+            vals,
+            self.cdtype,
+        )
+
+    def predict(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        return base.predict_from_scores(self, self.scores(params, ids, vals))

@@ -1,0 +1,94 @@
+"""Final-model save/load — parity with ``FMModel.save/load``.
+
+The reference durably saves only the final model (weights + metadata;
+SURVEY.md §3.4-§3.5 — mid-training fault tolerance is Spark lineage, and
+the rebuild's richer story lives in :mod:`fm_spark_tpu.checkpoint`). Format
+here: a directory with ``spec.json`` (model family + hyperparams) and
+``params.npz`` (flat arrays). The format is self-describing so a model can
+be reloaded without knowing its family in advance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+
+
+_FAMILIES = {}
+
+
+def _family_name(spec) -> str:
+    return type(spec).__name__
+
+
+def _register_families():
+    # Deferred import to avoid a cycle models.io <-> models.__init__.
+    from fm_spark_tpu.models.fm import FMSpec
+    from fm_spark_tpu.models.ffm import FFMSpec
+    from fm_spark_tpu.models.deepfm import DeepFMSpec
+
+    _FAMILIES.update(FMSpec=FMSpec, FFMSpec=FFMSpec, DeepFMSpec=DeepFMSpec)
+
+
+def save_model(path: str, spec, params: dict) -> None:
+    """Write spec.json + params.npz under ``path`` (a directory)."""
+    os.makedirs(path, exist_ok=True)
+    meta = {"family": _family_name(spec), "spec": dataclasses.asdict(spec)}
+    # JSON can't hold inf; the regression clip defaults are ±inf.
+    for key in ("min_target", "max_target"):
+        if key in meta["spec"] and not np.isfinite(meta["spec"][key]):
+            meta["spec"][key] = None
+    flat = {}
+    dtypes = {}
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    for keypath, leaf in leaves:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        arr = np.asarray(leaf)
+        dtypes[name] = str(arr.dtype) if arr.dtype.kind != "V" else str(leaf.dtype)
+        if arr.dtype.kind == "V":
+            # npz can't store ml_dtypes (bfloat16 → raw '|V2', unloadable);
+            # widen to float32 for storage and restore the dtype on load.
+            arr = np.asarray(jax.numpy.asarray(leaf).astype(jax.numpy.float32))
+        flat[name] = arr
+    meta["param_dtypes"] = dtypes
+    with open(os.path.join(path, "spec.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    np.savez(os.path.join(path, "params.npz"), **flat)
+
+
+def load_model(path: str):
+    """Read back ``(spec, params)`` written by :func:`save_model`."""
+    _register_families()
+    with open(os.path.join(path, "spec.json")) as f:
+        meta = json.load(f)
+    spec_kwargs = dict(meta["spec"])
+    import math
+
+    if spec_kwargs.get("min_target") is None:
+        spec_kwargs["min_target"] = -math.inf
+    if spec_kwargs.get("max_target") is None:
+        spec_kwargs["max_target"] = math.inf
+    if "mlp_dims" in spec_kwargs:
+        spec_kwargs["mlp_dims"] = tuple(spec_kwargs["mlp_dims"])
+    spec = _FAMILIES[meta["family"]](**spec_kwargs)
+    with np.load(os.path.join(path, "params.npz")) as npz:
+        flat = {k: npz[k] for k in npz.files}
+    # Rebuild the nested pytree from an example structure.
+    example = jax.eval_shape(spec.init, jax.random.key(0))
+    leaves_with_path = jax.tree_util.tree_leaves_with_path(example)
+    treedef = jax.tree_util.tree_structure(example)
+    dtypes = meta.get("param_dtypes", {})
+    ordered = []
+    for keypath, _ in leaves_with_path:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        arr = jax.numpy.asarray(flat[name])
+        want = dtypes.get(name)
+        if want and str(arr.dtype) != want:
+            arr = arr.astype(want)
+        ordered.append(arr)
+    params = jax.tree_util.tree_unflatten(treedef, ordered)
+    return spec, params

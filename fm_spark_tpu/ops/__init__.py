@@ -1,0 +1,20 @@
+"""TPU compute kernels: the FM/FFM forward-backward math.
+
+This package is the rebuild of the reference's per-example
+``computeGradient`` hot loop (BASELINE.json:5 — "the order-2 pairwise
+interaction term and its latent-factor gradient"), lifted from a per-example
+Scala loop into batched, jit-compiled JAX over gathered embedding rows.
+"""
+
+from fm_spark_tpu.ops.fm import (  # noqa: F401
+    fm_scores,
+    fm_partial_terms,
+    fm_scores_from_partials,
+    fm_scores_dense,
+)
+from fm_spark_tpu.ops.ffm import ffm_scores, ffm_scores_dense  # noqa: F401
+from fm_spark_tpu.ops.losses import (  # noqa: F401
+    logistic_loss,
+    squared_loss,
+    loss_fn,
+)

@@ -1,0 +1,99 @@
+"""Field-aware factorization machine (FFM) interaction math.
+
+Rebuild of the reference's FFM capability ("field-aware latent factors →
+batched matmul", BASELINE.json:10; SURVEY.md §2 row 6). Each feature i
+carries one latent vector *per field*: ``V ∈ R^{n × F × k}``, and the
+pairwise term uses the opposite field's vector:
+
+    ŷ_ffm = Σ_{i<j} <v[i, field(j)], v[j, field(i)]> x_i x_j
+
+On CTR data with fixed-slot encoding (Criteo/Avazu: one feature per field
+per example) ``field(slot j) = j``, so after gathering rows the whole
+pairwise term is one batched contraction over ``k`` of a ``[B, nnz, nnz, k]``
+tensor against its slot-transpose — dense MXU work, no per-pair loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ffm_scores(
+    w0: jax.Array,
+    w: jax.Array,
+    v: jax.Array,
+    ids: jax.Array,
+    vals: jax.Array,
+    fields: jax.Array | None = None,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Batched FFM raw scores.
+
+    Args:
+      w0: scalar bias.
+      w: ``[n]`` linear weights.
+      v: ``[n, F, k]`` field-aware factor table.
+      ids: ``[B, nnz]`` feature ids.
+      vals: ``[B, nnz]`` values (0 ⇒ padded slot).
+      fields: ``[nnz]`` int32 field id of each slot; defaults to
+        ``arange(nnz)`` (slot == field, the CTR fixed-slot encoding).
+
+    Returns:
+      ``[B]`` raw scores.
+    """
+    nnz = ids.shape[1]
+    num_fields = v.shape[1]
+    if fields is None:
+        if nnz != num_fields:
+            raise ValueError(
+                f"default slot==field layout needs nnz ({nnz}) == F "
+                f"({num_fields}); pass an explicit `fields` vector otherwise"
+            )
+        fields = jnp.arange(nnz, dtype=jnp.int32)
+    else:
+        fields = jnp.asarray(fields, jnp.int32)
+        if fields.shape != (nnz,):
+            raise ValueError(f"fields must have shape ({nnz},), got {fields.shape}")
+    vals = vals.astype(compute_dtype)
+    rows = v[ids].astype(compute_dtype)                   # [B, nnz, F, k]
+    # Select, for each slot pair (i, j), v[id_i, field(j)]. mode='clip' so an
+    # out-of-range field id can never produce NaN fill values.
+    sel = jnp.take(rows, fields, axis=2, mode="clip")     # [B, i, j, k]
+    sel = sel * vals[:, :, None, None]                    # fold in x_i
+    # A[b,i,j] = <v[id_i, f_j], v[id_j, f_i]> x_i x_j  (symmetric)
+    a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)   # [B, nnz, nnz]
+    diag = jnp.trace(a, axis1=1, axis2=2)
+    pairwise = 0.5 * (jnp.sum(a, axis=(1, 2)) - diag)
+    linear = jnp.sum(w[ids].astype(compute_dtype) * vals, axis=1)
+    return w0.astype(compute_dtype) + linear + pairwise
+
+
+def ffm_scores_dense(w0, w, v, ids, vals, fields=None):
+    """Explicit per-pair FFM — test oracle only (tiny nnz).
+
+    Python double loop over slot pairs; literal form of the FFM definition
+    for property-testing :func:`ffm_scores`.
+    """
+    import numpy as np
+
+    ids = np.asarray(ids)
+    vals = np.asarray(vals)
+    w0 = float(np.asarray(w0))
+    w = np.asarray(w)
+    v = np.asarray(v)
+    b, nnz = ids.shape
+    if fields is None:
+        fields = np.arange(nnz)
+    out = np.zeros((b,), dtype=np.float64)
+    for bi in range(b):
+        y = w0
+        for i in range(nnz):
+            y += w[ids[bi, i]] * vals[bi, i]
+        for i in range(nnz):
+            for j in range(i + 1, nnz):
+                vi = v[ids[bi, i], fields[j]]
+                vj = v[ids[bi, j], fields[i]]
+                y += float(vi @ vj) * vals[bi, i] * vals[bi, j]
+        out[bi] = y
+    return jnp.asarray(out, dtype=jnp.float32)

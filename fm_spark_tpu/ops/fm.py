@@ -1,0 +1,163 @@
+"""Order-2 factorization-machine forward math on gathered embedding rows.
+
+This is the TPU-native rebuild of the reference's ``computeGradient`` hot
+loop — "the order-2 pairwise interaction term and its latent-factor
+gradient" (BASELINE.json:5). The reference (Rainbowboys/fm_spark, spark-libFM
+lineage; see SURVEY.md §2 row 2) computes, per example, with a double loop
+over ``nnz × k``::
+
+    s_f   = sum_i v[i,f] * x_i
+    y_hat = w0 + sum_i w[i] x_i + 0.5 * sum_f (s_f^2 - sum_i v[i,f]^2 x_i^2)
+
+and the analytic latent-factor gradient ``x_i (s_f - v[i,f] x_i)``.
+
+Here the sparse one-hot vectors become gathered embedding rows so the
+interaction term compiles to a dense ``(k × nnz)`` contraction in XLA
+(BASELINE.json:5), the batch dimension is vmapped away by construction
+(everything is written batched), and the backward pass is ``jax.grad`` of
+this forward — which XLA turns into exactly the analytic rule plus a
+scatter-add into the table (SURVEY.md §7 step 1: start with ``jax.grad``;
+hand-write ``custom_vjp``/Pallas only if profiles demand).
+
+Input encoding (fixed-nnz batches, SURVEY.md §7):
+
+- ``ids``:  int32  ``[B, nnz]`` — hashed feature ids (one per active field),
+- ``vals``: float32 ``[B, nnz]`` — feature values (1.0 for one-hot),
+- padding: use ``vals == 0`` for absent features; every term below is
+  multiplied by ``vals`` so zero-valued slots contribute nothing, exactly
+  like absent coordinates of the reference's SparseVector.
+
+The module also exposes the *partial-sum* decomposition used for row-sharded
+embedding tables (SURVEY.md §2 parallelism table): both the linear term and
+every ``s_f`` are linear reductions over features, so a shard that owns a
+row range computes masked partial sums and a ``psum`` over the feature mesh
+axis reconstructs the exact full-table forward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _gather_rows(table: jax.Array, ids: jax.Array, compute_dtype) -> jax.Array:
+    """Gather rows of ``table`` at ``ids`` and cast to the compute dtype.
+
+    The table may be stored in bf16 (the first perf lever, SURVEY.md §7
+    step 8) while accumulation happens in ``compute_dtype`` (fp32).
+    """
+    return table[ids].astype(compute_dtype)
+
+
+def fm_interaction_from_xv(xv: jax.Array) -> jax.Array:
+    """Order-2 interaction from value-scaled gathered rows ``xv [B,nnz,k]``.
+
+    ``0.5 · Σ_f (s_f² − Σ_i (v_{i,f} x_i)²)`` with ``s = Σ_i xv_i``. Split
+    out so DeepFM can share one gather between the FM term and its MLP head.
+    """
+    s = jnp.sum(xv, axis=1)                              # [B, k]
+    sum_sq = jnp.sum(xv * xv, axis=(1, 2))               # [B]
+    return 0.5 * (jnp.sum(s * s, axis=1) - sum_sq)
+
+
+def fm_scores(
+    w0: jax.Array,
+    w: jax.Array,
+    v: jax.Array,
+    ids: jax.Array,
+    vals: jax.Array,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Batched FM raw scores via the O(k·nnz) identity.
+
+    Args:
+      w0: scalar bias.
+      w: ``[n]`` linear weights.
+      v: ``[n, k]`` latent-factor table.
+      ids: ``[B, nnz]`` int32 feature ids.
+      vals: ``[B, nnz]`` feature values (0 ⇒ padded/absent slot).
+      compute_dtype: accumulation dtype (tables may be bf16).
+
+    Returns:
+      ``[B]`` raw (pre-link) scores ŷ.
+    """
+    vals = vals.astype(compute_dtype)
+    xv = _gather_rows(v, ids, compute_dtype) * vals[..., None]   # [B, nnz, k]
+    linear = jnp.sum(_gather_rows(w, ids, compute_dtype) * vals, axis=1)
+    return w0.astype(compute_dtype) + linear + fm_interaction_from_xv(xv)
+
+
+def fm_partial_terms(
+    w: jax.Array,
+    v_shard: jax.Array,
+    ids: jax.Array,
+    vals: jax.Array,
+    row_start: int | jax.Array,
+    num_rows: int,
+    compute_dtype=jnp.float32,
+):
+    """Shard-local partial sums for a row-sharded FM table.
+
+    The shard owns global rows ``[row_start, row_start + num_rows)`` of both
+    the linear weights and the factor table. Ids outside the shard are
+    masked to contribute zero; since every per-feature term is linear in the
+    gathered row, ``psum`` of these partials over the feature axis equals
+    the unsharded forward exactly (SURVEY.md §5 "long-context" note: same
+    partial-sum pattern ring-attention uses, with no softmax correction).
+
+    Args:
+      w: ``[num_rows]`` shard of linear weights.
+      v_shard: ``[num_rows, k]`` shard of the factor table.
+      ids: ``[B, nnz]`` GLOBAL feature ids.
+      vals: ``[B, nnz]``.
+      row_start: first global row owned by this shard.
+      num_rows: rows owned by this shard.
+
+    Returns:
+      ``(linear_partial [B], s_partial [B, k], sum_sq_partial [B])``.
+    """
+    vals = vals.astype(compute_dtype)
+    local = ids - row_start
+    in_shard = (local >= 0) & (local < num_rows)
+    safe = jnp.where(in_shard, local, 0)
+    mask = in_shard.astype(compute_dtype)
+    mvals = vals * mask                                   # zero out foreign ids
+    rows = _gather_rows(v_shard, safe, compute_dtype)     # [B, nnz, k]
+    xv = rows * mvals[..., None]
+    s_partial = jnp.sum(xv, axis=1)
+    sum_sq_partial = jnp.sum(xv * xv, axis=(1, 2))
+    linear_partial = jnp.sum(_gather_rows(w, safe, compute_dtype) * mvals, axis=1)
+    return linear_partial, s_partial, sum_sq_partial
+
+
+def fm_scores_from_partials(w0, linear, s, sum_sq, compute_dtype=jnp.float32):
+    """Combine (psum'd) partial terms into raw scores.
+
+    ``s`` must be the FULL ``s_f = Σ_i v[i,f] x_i`` (i.e. after ``psum`` over
+    the feature axis) because the interaction squares it; ``linear`` and
+    ``sum_sq`` are plain sums so psum-before or after is equivalent.
+    """
+    interaction = 0.5 * (jnp.sum(s * s, axis=-1) - sum_sq)
+    return w0.astype(compute_dtype) + linear + interaction
+
+
+def fm_scores_dense(w0, w, v, x):
+    """Brute-force O(n²) FM on dense inputs — float64 test oracle only.
+
+    Literal transcription of Rendle's definition
+    ``ŷ = w0 + Σ_i w_i x_i + Σ_{i<j} <v_i, v_j> x_i x_j`` used to
+    property-test :func:`fm_scores` (SURVEY.md §4: golden-value tests the
+    reference lineage never had). Runs in numpy float64 so the oracle is
+    exact relative to fp32 kernel rounding.
+    """
+    import numpy as np
+
+    x = np.asarray(x, np.float64)
+    w = np.asarray(w, np.float64)
+    v = np.asarray(v, np.float64)
+    linear = x @ w
+    xv = x[:, :, None] * v[None, :, :]                    # [B, n, k]
+    gram = np.einsum("bik,bjk->bij", xv, xv)              # [B, n, n]
+    iu = np.triu(np.ones((x.shape[1],) * 2), k=1)
+    pairwise = np.sum(gram * iu, axis=(1, 2))
+    return float(np.asarray(w0)) + linear + pairwise

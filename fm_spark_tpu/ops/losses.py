@@ -1,0 +1,42 @@
+"""Loss functions matching the reference's task switch.
+
+The reference trains either logistic loss (classification) or squared loss
+(regression) over raw FM scores (SURVEY.md §2 row 2: "logistic or squared
+loss"; §0.2 lists the loss inventory as a verification item). Labels are
+{0, 1} for classification and real-valued for regression.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def logistic_loss(scores: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example binary cross-entropy with logits, labels in {0,1}.
+
+    Numerically stable form: ``softplus(s) - y*s = log(1+e^s) - y*s``.
+    """
+    return jnp.logaddexp(0.0, scores) - labels * scores
+
+
+def squared_loss(scores: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example 0.5·(ŷ − y)² so dL/dŷ = (ŷ − y), the lineage's rule."""
+    d = scores - labels
+    return 0.5 * d * d
+
+
+_LOSSES = {
+    "logistic": logistic_loss,
+    "squared": squared_loss,
+}
+
+
+def loss_fn(name: str):
+    """Look up a per-example loss by name ('logistic' | 'squared')."""
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown loss {name!r}; available: {sorted(_LOSSES)}"
+        ) from None

@@ -1,0 +1,35 @@
+"""Test fixture: simulate an 8-device TPU mesh on CPU.
+
+The Spark idiom `local[*]` — whole cluster as threads in one JVM, same code
+path as a real cluster — maps to XLA's forced host-device count (SURVEY.md
+§4): 8 fake CPU devices exercise the identical shard_map/psum code path as a
+real v5e-8. Must run before jax initializes, hence env vars at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_debug_nans", False)  # enabled per-test where useful
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs[:8]

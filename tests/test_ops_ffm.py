@@ -1,0 +1,51 @@
+"""FFM kernel property tests: batched contraction vs explicit pair loop."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu.ops import ffm as ffm_ops
+
+
+def _problem(rng, b=8, n=30, nf=5, k=4, nnz=5):
+    w0 = jnp.float32(rng.normal())
+    w = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(n, nf, k)) * 0.3, jnp.float32)
+    ids = np.stack([rng.choice(n, size=nnz, replace=False) for _ in range(b)])
+    vals = rng.normal(size=(b, nnz)).astype(np.float32)
+    return w0, w, v, jnp.asarray(ids, jnp.int32), jnp.asarray(vals)
+
+
+def test_ffm_vs_pair_loop(rng):
+    w0, w, v, ids, vals = _problem(rng)
+    fast = ffm_ops.ffm_scores(w0, w, v, ids, vals)
+    slow = ffm_ops.ffm_scores_dense(w0, w, v, ids, vals)
+    np.testing.assert_allclose(fast, slow, rtol=2e-4, atol=2e-4)
+
+
+def test_ffm_custom_fields(rng):
+    # Two slots sharing a field: field layout [0, 0, 1, 2, 3].
+    w0, w, v, ids, vals = _problem(rng, nf=4)
+    fields = jnp.asarray([0, 0, 1, 2, 3], jnp.int32)
+    fast = ffm_ops.ffm_scores(w0, w, v, ids, vals, fields=fields)
+    slow = ffm_ops.ffm_scores_dense(w0, w, v, ids, vals, fields=np.asarray(fields))
+    np.testing.assert_allclose(fast, slow, rtol=2e-4, atol=2e-4)
+
+
+def test_ffm_padded_slot_contributes_nothing(rng):
+    w0, w, v, ids, vals = _problem(rng)
+    vals = vals.at[:, -1].set(0.0)
+    full = ffm_ops.ffm_scores(w0, w, v, ids, vals)
+    # Swapping the padded slot's id must not change anything.
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 1) % w.shape[0])
+    again = ffm_ops.ffm_scores(w0, w, v, ids2, vals)
+    np.testing.assert_allclose(full, again, rtol=1e-6, atol=1e-6)
+
+
+def test_ffm_nnz_field_mismatch_raises(rng):
+    # Regression: used to silently produce NaN via out-of-range jnp.take fill.
+    w0, w, v, ids, vals = _problem(rng, nf=3, nnz=5)
+    with pytest.raises(ValueError, match="nnz"):
+        ffm_ops.ffm_scores(w0, w, v, ids, vals)
+    with pytest.raises(ValueError, match="shape"):
+        ffm_ops.ffm_scores(w0, w, v, ids, vals, fields=jnp.zeros((2,), jnp.int32))

@@ -1,0 +1,128 @@
+"""Property tests for the FM kernel (SURVEY.md §4 golden-value idiom):
+
+1. O(k·nnz) identity vs brute-force O(n²) pairwise sum on random inputs.
+2. jax.grad of the kernel vs numerical finite differences.
+3. Partial-sum (row-sharded) decomposition vs the unsharded forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu.ops import fm as fm_ops
+from fm_spark_tpu.ops import losses
+
+
+def _random_problem(rng, b=16, n=50, k=8, nnz=5, pad=False):
+    w0 = jnp.float32(rng.normal())
+    w = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(n, k)) * 0.3, jnp.float32)
+    # Distinct ids per example (matches one-hot: a feature appears once).
+    ids = np.stack([rng.choice(n, size=nnz, replace=False) for _ in range(b)])
+    vals = rng.normal(size=(b, nnz)).astype(np.float32)
+    if pad:
+        vals[:, -1] = 0.0  # padded slot must contribute nothing
+    return w0, w, v, jnp.asarray(ids, jnp.int32), jnp.asarray(vals)
+
+
+def _densify(ids, vals, n):
+    b, nnz = ids.shape
+    x = np.zeros((b, n), np.float32)
+    for i in range(b):
+        for j in range(nnz):
+            x[i, ids[i, j]] += vals[i, j]
+    return jnp.asarray(x)
+
+
+@pytest.mark.parametrize("pad", [False, True])
+def test_fm_scores_vs_bruteforce(rng, pad):
+    w0, w, v, ids, vals = _random_problem(rng, pad=pad)
+    fast = fm_ops.fm_scores(w0, w, v, ids, vals)
+    dense = fm_ops.fm_scores_dense(w0, w, v, _densify(ids, vals, w.shape[0]))
+    # fp32 kernel vs float64 oracle: the s²−Σv²x² identity cancels, so
+    # tolerance is set by fp32 rounding of the intermediate magnitudes.
+    np.testing.assert_allclose(fast, dense, rtol=1e-3, atol=5e-3)
+
+
+def test_fm_grad_vs_finite_differences(rng):
+    w0, w, v, ids, vals = _random_problem(rng, b=4, n=20, k=3, nnz=4)
+    labels = jnp.asarray(rng.integers(0, 2, size=(4,)), jnp.float32)
+
+    def loss(params):
+        s = fm_ops.fm_scores(params["w0"], params["w"], params["v"], ids, vals)
+        return jnp.mean(losses.logistic_loss(s, labels))
+
+    params = {"w0": w0, "w": w, "v": v}
+    grads = jax.grad(loss)(params)
+
+    # eps large enough that fp32 rounding of the loss (~1e-7 abs) divided by
+    # 2·eps stays well under tolerance; truncation error is O(eps²) ≈ 1e-5.
+    eps = 1e-2
+    # Spot-check a handful of coordinates of each param against central diffs.
+    flat_v = np.asarray(v)
+    touched = np.unique(np.asarray(ids))[:3]
+    for i in touched:
+        for f in range(3):
+            vp = flat_v.copy(); vp[i, f] += eps
+            vm = flat_v.copy(); vm[i, f] -= eps
+            num = (
+                loss({"w0": w0, "w": w, "v": jnp.asarray(vp)})
+                - loss({"w0": w0, "w": w, "v": jnp.asarray(vm)})
+            ) / (2 * eps)
+            np.testing.assert_allclose(grads["v"][i, f], num, rtol=2e-2, atol=1e-4)
+    num_w0 = (
+        loss({"w0": w0 + eps, "w": w, "v": v})
+        - loss({"w0": w0 - eps, "w": w, "v": v})
+    ) / (2 * eps)
+    np.testing.assert_allclose(grads["w0"], num_w0, rtol=1e-3, atol=1e-5)
+
+
+def test_partial_terms_reconstruct_full_forward(rng):
+    """Masked shard partials summed over shards == unsharded forward."""
+    w0, w, v, ids, vals = _random_problem(rng, b=8, n=48, k=4, nnz=6)
+    n = w.shape[0]
+    shards = 4
+    rows_per = n // shards
+    lin = jnp.zeros((8,))
+    s = jnp.zeros((8, 4))
+    sq = jnp.zeros((8,))
+    for si in range(shards):
+        lo = si * rows_per
+        lp, sp, qp = fm_ops.fm_partial_terms(
+            w[lo : lo + rows_per], v[lo : lo + rows_per], ids, vals, lo, rows_per
+        )
+        lin, s, sq = lin + lp, s + sp, sq + qp
+    combined = fm_ops.fm_scores_from_partials(w0, lin, s, sq)
+    full = fm_ops.fm_scores(w0, w, v, ids, vals)
+    np.testing.assert_allclose(combined, full, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_table_fp32_accum_close(rng):
+    w0, w, v, ids, vals = _random_problem(rng, b=32, n=64, k=16, nnz=8)
+    exact = fm_ops.fm_scores(w0, w, v, ids, vals)
+    approx = fm_ops.fm_scores(
+        w0, w.astype(jnp.bfloat16), v.astype(jnp.bfloat16), ids, vals
+    )
+    assert approx.dtype == jnp.float32
+    np.testing.assert_allclose(exact, approx, rtol=0.05, atol=0.05)
+
+
+def test_loss_fn_lookup():
+    assert losses.loss_fn("logistic") is losses.logistic_loss
+    with pytest.raises(ValueError):
+        losses.loss_fn("hinge")
+
+
+def test_logistic_loss_matches_stable_bce(rng):
+    # Moderate logits: the naive -y·log(p) form is accurate here, while at
+    # |s| ≳ 17 it saturates in fp32 — exactly why we use the stable form.
+    s = jnp.asarray(rng.normal(size=(100,)) * 3, jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(100,)), jnp.float32)
+    ours = losses.logistic_loss(s, y)
+    p = jax.nn.sigmoid(s)
+    ref = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-3)
+    # And the stable form stays finite where the naive one wouldn't.
+    extreme = losses.logistic_loss(jnp.asarray([80.0, -80.0]), jnp.asarray([0.0, 1.0]))
+    assert bool(jnp.all(jnp.isfinite(extreme)))
