@@ -1,0 +1,148 @@
+"""Reference-API compatibility: ``FMWithSGD.train`` / ``FMModel``.
+
+Argument-for-argument parity with the reference's L5 entry point
+(SURVEY.md §1: ``FMWithSGD.train(input, task, numIterations, stepSize,
+miniBatchFraction, dim, regParam, initStd): FMModel`` and instance
+``run(input)``), so a user of the reference can move over without
+relearning the API. ``input`` is the fixed-nnz triple ``(ids, vals,
+labels)`` instead of an RDD[LabeledPoint]; everything else keeps the
+reference's names and semantics: ``dim=(k0, k1, k2)`` → (use bias, use
+linear, rank), ``regParam=(r0, r1, r2)`` per-group L2, ``initStd`` for the
+factor init, 1-based ``stepSize/√iter`` SGD, and regression min/max target
+clipping learned from the data.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from fm_spark_tpu import models
+from fm_spark_tpu.data.pipeline import Batches, iterate_once
+from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+
+class FMModel:
+    """Trained model handle: predict / save / load, like the reference's."""
+
+    def __init__(self, spec, params):
+        self.spec = spec
+        self.params = params
+
+    def predict(self, ids, vals):
+        """Predictions for a batch: sigmoid probability or clipped value."""
+        import jax.numpy as jnp
+
+        return np.asarray(
+            self.spec.predict(self.params, jnp.asarray(ids), jnp.asarray(vals))
+        )
+
+    def save(self, path: str) -> None:
+        models.save_model(path, self.spec, self.params)
+
+    @classmethod
+    def load(cls, path: str) -> "FMModel":
+        spec, params = models.load_model(path)
+        return cls(spec, params)
+
+
+class FMWithSGD:
+    """Minibatch-SGD FM training — the reference's entry-point class."""
+
+    def __init__(
+        self,
+        task: str = "classification",
+        numIterations: int = 100,
+        stepSize: float = 0.1,
+        miniBatchFraction: float = 1.0,
+        dim: tuple = (True, True, 8),
+        regParam: tuple = (0.0, 0.0, 0.0),
+        initStd: float = 0.01,
+        seed: int = 0,
+    ):
+        self.task = task
+        self.numIterations = numIterations
+        self.stepSize = stepSize
+        self.miniBatchFraction = miniBatchFraction
+        self.dim = dim
+        self.regParam = regParam
+        self.initStd = initStd
+        self.seed = seed
+
+    def run(self, input) -> FMModel:
+        """Train on ``input = (ids, vals, labels)`` and return the model."""
+        ids, vals, labels = input
+        ids = np.asarray(ids, np.int32)
+        vals = np.asarray(vals, np.float32)
+        labels = np.asarray(labels, np.float32)
+        k0, k1, k2 = self.dim
+        r0, r1, r2 = self.regParam
+        num_features = int(ids.max()) + 1
+        spec_kwargs = dict(
+            num_features=num_features,
+            rank=int(k2),
+            task=self.task,
+            loss="logistic" if self.task == "classification" else "squared",
+            use_bias=bool(k0),
+            use_linear=bool(k1),
+            init_std=self.initStd,
+        )
+        if self.task == "regression":
+            spec_kwargs["min_target"] = float(labels.min())
+            spec_kwargs["max_target"] = float(labels.max())
+        spec = models.FMSpec(**spec_kwargs)
+        batch_size = max(1, int(math.ceil(self.miniBatchFraction * ids.shape[0])))
+        config = TrainConfig(
+            num_steps=self.numIterations,
+            batch_size=batch_size,
+            learning_rate=self.stepSize,
+            lr_schedule="inv_sqrt",
+            optimizer="sgd",
+            reg_bias=r0,
+            reg_linear=r1,
+            reg_factors=r2,
+            seed=self.seed,
+            log_every=max(self.numIterations // 10, 1),
+        )
+        trainer = FMTrainer(spec, config)
+        trainer.fit(Batches(ids, vals, labels, batch_size, seed=self.seed))
+        return FMModel(spec, trainer.params)
+
+    @staticmethod
+    def train(
+        input,
+        task: str = "classification",
+        numIterations: int = 100,
+        stepSize: float = 0.1,
+        miniBatchFraction: float = 1.0,
+        dim: tuple = (True, True, 8),
+        regParam: tuple = (0.0, 0.0, 0.0),
+        initStd: float = 0.01,
+        seed: int = 0,
+    ) -> FMModel:
+        """Static overload matching the reference object's ``train``."""
+        return FMWithSGD(
+            task, numIterations, stepSize, miniBatchFraction, dim, regParam,
+            initStd, seed,
+        ).run(input)
+
+
+def evaluate(model: FMModel, input, batch_size: int = 8192) -> dict:
+    """AUC/logloss/RMSE of a model on ``(ids, vals, labels)``."""
+    from fm_spark_tpu.train import make_eval_step
+    from fm_spark_tpu.utils import metrics as metrics_lib
+    import jax.numpy as jnp
+
+    ids, vals, labels = input
+    step = make_eval_step(model.spec)
+    mstate = metrics_lib.init_metrics()
+    for bids, bvals, blabels, bw in iterate_once(
+        np.asarray(ids, np.int32), np.asarray(vals, np.float32),
+        np.asarray(labels, np.float32), batch_size
+    ):
+        mstate = step(
+            model.params, mstate, jnp.asarray(bids), jnp.asarray(bvals),
+            jnp.asarray(blabels), jnp.asarray(bw),
+        )
+    return {k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()}

@@ -1,0 +1,15 @@
+"""Data layer: hashing, dataset parsers, packed binary format, loader.
+
+The reference's L2 is ``RDD[LabeledPoint]`` with sparse one-hot vectors fed
+by ``MLUtils.loadLibSVMFile`` plus an upstream hashing step for Criteo/Avazu
+(SURVEY.md §2 row 7, §3.3). Here the canonical in-memory encoding is the
+fixed-nnz triple ``(ids int32 [N, nnz], vals float32 [N, nnz], labels
+float32 [N])`` — the shape the kernels and XLA want.
+"""
+
+from fm_spark_tpu.data.synthetic import synthetic_ctr  # noqa: F401
+from fm_spark_tpu.data.pipeline import (  # noqa: F401
+    Batches,
+    iterate_once,
+    train_test_split,
+)

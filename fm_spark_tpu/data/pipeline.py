@@ -1,0 +1,121 @@
+"""In-memory batch pipeline with deterministic, checkpointable iteration.
+
+Replaces the reference's per-iteration ``data.sample(miniBatchFraction)``
+over RDD partitions (SURVEY.md §3.1) with epoch-shuffled fixed-size batches:
+deterministic from (seed, epoch, step) so a resumed run reproduces the exact
+remaining batch sequence (SURVEY.md §5 "deterministic data-pipeline resume").
+Large-scale disk-backed loading lives in :mod:`fm_spark_tpu.data.packed`;
+this class handles arrays that fit in host RAM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def train_test_split(ids, vals, labels, test_fraction=0.2, seed=0):
+    """Deterministic shuffled split (the lineage's example-driver idiom)."""
+    n = ids.shape[0]
+    perm = np.random.default_rng(seed).permutation(n)
+    cut = int(n * (1.0 - test_fraction))
+    tr, te = perm[:cut], perm[cut:]
+    return (ids[tr], vals[tr], labels[tr]), (ids[te], vals[te], labels[te])
+
+
+class Batches:
+    """Epoch-shuffling minibatch iterator over fixed-nnz arrays.
+
+    State is ``(epoch, index)``; :meth:`state` / :meth:`restore` give exact
+    resume. The final partial batch of an epoch is padded to full size with
+    ``weight=0`` examples so jit never sees a new shape.
+    """
+
+    def __init__(self, ids, vals, labels, batch_size: int, seed: int = 0,
+                 drop_remainder: bool = False):
+        self.ids = np.ascontiguousarray(ids)
+        self.vals = np.ascontiguousarray(vals)
+        self.labels = np.ascontiguousarray(labels)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.drop_remainder = drop_remainder
+        self.epoch = 0
+        self.index = 0
+        self._perm = None
+
+    @property
+    def num_examples(self):
+        return self.ids.shape[0]
+
+    def _epoch_perm(self):
+        if self._perm is None:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            self._perm = rng.permutation(self.num_examples)
+        return self._perm
+
+    def state(self) -> dict:
+        return {"epoch": self.epoch, "index": self.index, "seed": self.seed}
+
+    def restore(self, state: dict) -> None:
+        if int(state["seed"]) != self.seed:
+            raise ValueError("restoring pipeline state with a different seed")
+        self.epoch = int(state["epoch"])
+        self.index = int(state["index"])
+        self._perm = None
+
+    def next_batch(self):
+        """Return ``(ids, vals, labels, weights)``, advancing the cursor."""
+        n, b = self.num_examples, self.batch_size
+        perm = self._epoch_perm()
+        start = self.index
+        end = start + b
+        if end <= n:
+            sel = perm[start:end]
+            weights = np.ones((b,), np.float32)
+            self.index = end
+        elif self.drop_remainder or start >= n:
+            # Roll to the next epoch and take a full batch from it.
+            self.epoch += 1
+            self.index = 0
+            self._perm = None
+            return self.next_batch()
+        else:
+            sel = perm[start:n]
+            pad = b - sel.shape[0]
+            weights = np.concatenate(
+                [np.ones(sel.shape[0], np.float32), np.zeros(pad, np.float32)]
+            )
+            sel = np.concatenate([sel, np.zeros(pad, np.int64)])
+            self.epoch += 1
+            self.index = 0
+            self._perm = None
+        return self.ids[sel], self.vals[sel], self.labels[sel], weights
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+def iterate_once(ids, vals, labels, batch_size: int):
+    """One ordered, finite pass over the data — for evaluation.
+
+    The final partial batch is zero-padded with ``weight=0`` so jit sees a
+    single batch shape.
+    """
+    n = ids.shape[0]
+    for start in range(0, n, batch_size):
+        end = min(start + batch_size, n)
+        b = end - start
+        if b == batch_size:
+            yield ids[start:end], vals[start:end], labels[start:end], np.ones(
+                (batch_size,), np.float32
+            )
+        else:
+            pad = batch_size - b
+            yield (
+                np.concatenate([ids[start:end], np.zeros((pad,) + ids.shape[1:], ids.dtype)]),
+                np.concatenate([vals[start:end], np.zeros((pad,) + vals.shape[1:], vals.dtype)]),
+                np.concatenate([labels[start:end], np.zeros((pad,), labels.dtype)]),
+                np.concatenate([np.ones((b,), np.float32), np.zeros((pad,), np.float32)]),
+            )

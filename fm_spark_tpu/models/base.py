@@ -27,7 +27,7 @@ class ModelSpec:
     num_features: int
     rank: int
     task: str = "classification"          # 'classification' | 'regression'
-    loss: str = "logistic"                # 'logistic' | 'squared'
+    loss: str | None = None               # 'logistic' | 'squared'; None ⇒ by task
     use_bias: bool = True                 # dim k0
     use_linear: bool = True               # dim k1
     init_std: float = 0.01
@@ -39,10 +39,22 @@ class ModelSpec:
     def __post_init__(self):
         if self.task not in ("classification", "regression"):
             raise ValueError(f"unknown task {self.task!r}")
-        # Fail at construction, not first training step.
+        # The reference's task switch ties the loss to the task; keep that
+        # as the default and fail at construction, not first training step.
+        if self.loss is None:
+            object.__setattr__(
+                self,
+                "loss",
+                "logistic" if self.task == "classification" else "squared",
+            )
         from fm_spark_tpu.ops import losses
 
         losses.loss_fn(self.loss)
+        if self.task == "regression" and self.loss == "logistic":
+            raise ValueError(
+                "logistic loss expects {0,1} labels; use loss='squared' "
+                "(or leave loss unset) for task='regression'"
+            )
 
     @property
     def pdtype(self):

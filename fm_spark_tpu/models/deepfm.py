@@ -46,10 +46,12 @@ class DeepFMSpec(base.ModelSpec):
         ).astype(self.pdtype)
         dims = (self.num_fields * self.rank, *self.mlp_dims, 1)
         layers = []
+        # split(rng, 2 + len(mlp_dims)) left exactly one key per layer in
+        # k_mlp (len(mlp_dims) hidden + 1 output).
         for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
             # He init for the relu stack; output layer included (d_out=1).
             scale = jnp.sqrt(2.0 / d_in)
-            kw = k_mlp[i] if i < len(k_mlp) else jax.random.fold_in(rng, i)
+            kw = k_mlp[i]
             layers.append(
                 {
                     "kernel": jax.random.normal(kw, (d_in, d_out), jnp.float32)
@@ -61,6 +63,11 @@ class DeepFMSpec(base.ModelSpec):
         return params
 
     def scores(self, params: dict, ids: jax.Array, vals: jax.Array) -> jax.Array:
+        if ids.shape[1] != self.num_fields:
+            raise ValueError(
+                f"batch has nnz={ids.shape[1]} slots but the MLP input was "
+                f"sized for num_fields={self.num_fields}"
+            )
         cd = self.cdtype
         vals_c = vals.astype(cd)
         # One shared gather: both the FM term and the deep head consume the

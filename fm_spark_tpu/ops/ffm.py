@@ -55,6 +55,10 @@ def ffm_scores(
         fields = jnp.asarray(fields, jnp.int32)
         if fields.shape != (nnz,):
             raise ValueError(f"fields must have shape ({nnz},), got {fields.shape}")
+        if not isinstance(fields, jax.core.Tracer) and int(fields.max()) >= num_fields:
+            raise ValueError(
+                f"field id {int(fields.max())} out of range for F={num_fields}"
+            )
     vals = vals.astype(compute_dtype)
     rows = v[ids].astype(compute_dtype)                   # [B, nnz, F, k]
     # Select, for each slot pair (i, j), v[id_i, field(j)]. mode='clip' so an

@@ -1,0 +1,30 @@
+"""Distributed execution: device meshes, sharding strategies, psum steps.
+
+This package is the TPU-native replacement for the reference's entire
+distributed runtime (SURVEY.md §1 L1, §5 "Distributed communication
+backend"): Spark's driver-mediated per-iteration ``treeAggregate`` reduce
+and ``TorrentBroadcast`` weight redistribution become ``jax.lax.psum`` over
+a device mesh inside one compiled step — collectives ride ICI within a
+slice (DCN across slices), parameters stay resident on device, and the
+broadcast disappears entirely.
+
+Two strategies (SURVEY.md §2 parallelism table):
+
+- ``dp`` — data parallel, the reference's one true strategy: batch sharded
+  over the ``data`` axis, model replicated, gradients psum'd (the
+  ``treeAggregate`` equivalent). Works for every model family.
+- ``row`` — feature/row-sharded embeddings over the ``feat`` axis composed
+  with data parallelism over ``data`` (the scale-out path for 10M-feature
+  tables, BASELINE.json:9): each shard computes masked partial sums
+  (linear, s_f) for its rows, one psum over ``feat`` reconstructs the exact
+  forward, and backward touches only shard-local rows.
+"""
+
+from fm_spark_tpu.parallel.mesh import make_mesh  # noqa: F401
+from fm_spark_tpu.parallel.step import (  # noqa: F401
+    param_specs,
+    shard_params,
+    shard_batch,
+    make_parallel_train_step,
+    make_parallel_eval_step,
+)

@@ -1,0 +1,40 @@
+"""Device-mesh construction.
+
+A 2D mesh ``(data, feat)``: batch parallelism over ``data``, row-sharded
+feature tables over ``feat``. Pure DP is ``feat=1``; pure model sharding is
+``data=1``. On a v5e-8 slice the axes map onto the 2D ICI torus; in tests
+the same code runs over 8 XLA host devices (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_feat: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``(data, feat)`` mesh.
+
+    Args:
+      n_data: devices along the batch axis; defaults to
+        ``len(devices) // n_feat`` (use everything).
+      n_feat: devices along the feature/row-shard axis.
+      devices: explicit device list (defaults to ``jax.devices()``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        if len(devices) % n_feat:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by n_feat={n_feat}"
+            )
+        n_data = len(devices) // n_feat
+    need = n_data * n_feat
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_data, n_feat)
+    return Mesh(grid, ("data", "feat"))

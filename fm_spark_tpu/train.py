@@ -1,0 +1,184 @@
+"""FMTrainer: the jit-compiled on-device training loop.
+
+This replaces the reference's L4/L5 (SURVEY.md §1, §3.1):
+``FMWithSGD.run`` → ``GradientDescent.runMiniBatchSGD`` with one Spark job
+per SGD iteration (broadcast weights → sample → treeAggregate gradients →
+driver update). Here the entire step — forward, backward, regularization,
+optimizer update — is ONE compiled XLA program with parameters resident on
+device; the host only feeds batches and reads metrics. The reference's
+update rule is preserved as the default:
+
+    weights ← weights − (stepSize/√iter) · (grad + reg · weights)
+
+with the ``regParam`` triple applied per group (bias / linear / factors),
+matching MLlib's ``Updater`` semantics (SURVEY.md §0.2, §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fm_spark_tpu.ops import losses as losses_lib
+from fm_spark_tpu.utils import metrics as metrics_lib
+from fm_spark_tpu.utils.logging import MetricsLogger
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters (reference ``train()`` args + rebuild knobs)."""
+
+    num_steps: int = 100                   # numIterations
+    batch_size: int = 1024
+    learning_rate: float = 0.1             # stepSize
+    lr_schedule: str = "inv_sqrt"          # stepSize/√iter | 'constant'
+    optimizer: str = "sgd"                 # 'sgd' | 'adam' | 'adagrad'
+    reg_bias: float = 0.0                  # regParam triple (r0, r1, r2)
+    reg_linear: float = 0.0
+    reg_factors: float = 0.0
+    seed: int = 0
+    log_every: int = 100
+    eval_every: int = 0                    # 0 = only at the end
+    metrics_path: str | None = None
+
+
+def _group_reg(config: TrainConfig):
+    """Per-group L2 added to the gradient, like MLlib's squared-L2 Updater."""
+    reg = {
+        "w0": config.reg_bias,
+        "w": config.reg_linear,
+        "v": config.reg_factors,
+        "mlp": config.reg_factors,
+    }
+
+    def add_reg(grads, params):
+        def one(path, g, p):
+            top = path[0]
+            key = getattr(top, "key", getattr(top, "idx", top))
+            r = reg.get(str(key), 0.0)
+            return g if r == 0.0 else g + r * p.astype(g.dtype)
+
+        return jax.tree_util.tree_map_with_path(one, grads, params)
+
+    return add_reg
+
+
+def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    if config.lr_schedule == "inv_sqrt":
+        # iteration is 1-based in the reference: lr_i = stepSize / sqrt(i).
+        schedule = lambda count: config.learning_rate / jnp.sqrt(count + 1.0)
+    elif config.lr_schedule == "constant":
+        schedule = config.learning_rate
+    else:
+        raise ValueError(f"unknown lr_schedule {config.lr_schedule!r}")
+    if config.optimizer == "sgd":
+        return optax.sgd(schedule)
+    if config.optimizer == "adam":
+        return optax.adam(schedule)
+    if config.optimizer == "adagrad":
+        return optax.adagrad(schedule)
+    raise ValueError(f"unknown optimizer {config.optimizer!r}")
+
+
+def make_train_step(spec, config: TrainConfig, optimizer=None):
+    """Build the jit-compiled single-device train step.
+
+    Returns ``step(params, opt_state, ids, vals, labels, weights) →
+    (params, opt_state, metrics_dict)`` with donated params/opt_state.
+    """
+    optimizer = optimizer or make_optimizer(config)
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    add_reg = _group_reg(config)
+
+    def step(params, opt_state, ids, vals, labels, weights):
+        def loss_f(p):
+            scores = spec.scores(p, ids, vals)
+            per = per_example_loss(scores, labels) * weights
+            return jnp.sum(per) / jnp.maximum(jnp.sum(weights), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_f)(params)
+        grads = add_reg(grads, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_eval_step(spec):
+    """Build the jit-compiled metrics-accumulation step."""
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+
+    def step(params, mstate, ids, vals, labels, weights):
+        scores = spec.scores(params, ids, vals)
+        per = per_example_loss(scores, labels)
+        return metrics_lib.update_metrics(mstate, scores, labels, per, weights)
+
+    return jax.jit(step)
+
+
+class FMTrainer:
+    """End-to-end trainer: the rebuild's ``FMWithSGD`` equivalent.
+
+    Usage::
+
+        trainer = FMTrainer(spec, TrainConfig(num_steps=1000, ...))
+        params = trainer.fit(train_batches)
+        metrics = trainer.evaluate(eval_batches)
+    """
+
+    def __init__(self, spec, config: TrainConfig, n_chips: int = 1):
+        self.spec = spec
+        self.config = config
+        self.optimizer = make_optimizer(config)
+        self._train_step = make_train_step(spec, config, self.optimizer)
+        self._eval_step = make_eval_step(spec)
+        self.params = spec.init(jax.random.key(config.seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self.step_count = 0
+        self.logger = MetricsLogger(path=config.metrics_path, n_chips=n_chips)
+        self.loss_history: list[float] = []
+
+    def fit(self, batches: Iterable, num_steps: int | None = None):
+        """Run the training loop; ``batches`` yields (ids, vals, labels, w)."""
+        total = num_steps if num_steps is not None else self.config.num_steps
+        log_every = max(self.config.log_every, 1)
+        it = iter(batches)
+        for _ in range(total):
+            ids, vals, labels, weights = next(it)
+            self.params, self.opt_state, m = self._train_step(
+                self.params, self.opt_state,
+                jnp.asarray(ids), jnp.asarray(vals),
+                jnp.asarray(labels), jnp.asarray(weights),
+            )
+            self.step_count += 1
+            if self.step_count % log_every == 0 or self.step_count == total:
+                loss = float(m["loss"])
+                self.loss_history.append(loss)
+                self.logger.log(
+                    self.step_count,
+                    samples=log_every * len(labels),
+                    loss=loss,
+                    grad_norm=float(m["grad_norm"]),
+                )
+        return self.params
+
+    def evaluate(self, batches: Iterable, max_batches: int | None = None) -> dict:
+        """Stream eval batches through the on-device accumulators."""
+        mstate = metrics_lib.init_metrics()
+        for i, (ids, vals, labels, weights) in enumerate(batches):
+            if max_batches is not None and i >= max_batches:
+                break
+            mstate = self._eval_step(
+                self.params, mstate,
+                jnp.asarray(ids), jnp.asarray(vals),
+                jnp.asarray(labels), jnp.asarray(weights),
+            )
+        return {k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()}

@@ -1,0 +1,9 @@
+"""Cross-cutting utilities: metrics, structured logging, profiling."""
+
+from fm_spark_tpu.utils.metrics import (  # noqa: F401
+    MetricsState,
+    init_metrics,
+    update_metrics,
+    finalize_metrics,
+)
+from fm_spark_tpu.utils.logging import MetricsLogger  # noqa: F401

@@ -1,0 +1,50 @@
+"""Structured per-step metrics logging.
+
+The reference logs per-iteration loss via log4j and relies on the Spark UI
+for counters (SURVEY.md §5). Rebuild: JSONL records to stdout and/or a file
+— {step, loss, auc, samples_per_sec_per_chip, grad_norm, ...} — cheap to
+parse, diffable, and the driver's bench harness consumes one-line JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+class MetricsLogger:
+    """Writes one JSON object per line; tracks wall-clock samples/sec."""
+
+    def __init__(self, path: str | None = None, stream=None, n_chips: int = 1):
+        self._fh = open(path, "a") if path else None
+        self._stream = stream if stream is not None else sys.stdout
+        self._n_chips = max(n_chips, 1)
+        self._t0 = None
+        self._samples = 0
+
+    def log(self, step: int, samples: int = 0, **metrics) -> dict:
+        now = time.perf_counter()
+        record = {"step": step, "ts": time.time()}
+        if samples:
+            if self._t0 is not None:
+                dt = now - self._t0
+                rate = self._samples / dt if dt > 0 else 0.0
+                record["samples_per_sec"] = round(rate, 2)
+                record["samples_per_sec_per_chip"] = round(rate / self._n_chips, 2)
+            self._t0 = now
+            self._samples = samples
+        for k, v in metrics.items():
+            record[k] = float(v) if hasattr(v, "__float__") else v
+        line = json.dumps(record)
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return record
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

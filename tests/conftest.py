@@ -8,7 +8,11 @@ real v5e-8. Must run before jax initializes, hence env vars at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even though the session env pins JAX_PLATFORMS=axon (real TPU):
+# tests need the 8-fake-device mesh and deterministic CPU numerics. Plugins
+# (jaxtyping) import jax before this conftest, so setting the env var alone
+# is not enough — jax.config.update works at any point before backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,7 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_debug_nans", False)  # enabled per-test where useful
+assert len(jax.devices()) >= 8, (
+    "conftest failed to get 8 fake CPU devices — was the XLA backend "
+    "initialized before conftest import?"
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

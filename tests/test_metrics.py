@@ -1,0 +1,87 @@
+"""Streaming histogram AUC vs exact rank-based AUC; logloss accumulation."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fm_spark_tpu.utils import metrics as m
+from fm_spark_tpu.ops import losses
+
+
+def _exact_auc(scores, labels):
+    """O(n log n) rank AUC with midrank ties — the sklearn definition."""
+    order = np.argsort(scores, kind="mergesort")
+    s = np.asarray(scores)[order]
+    y = np.asarray(labels)[order]
+    # Midranks.
+    ranks = np.empty_like(s, dtype=np.float64)
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and s[j + 1] == s[i]:
+            j += 1
+        ranks[i : j + 1] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    pos = y > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_histogram_auc_matches_exact(rng):
+    scores = rng.normal(size=(5000,)).astype(np.float32) * 2
+    labels = (rng.random(5000) < 1 / (1 + np.exp(-scores))).astype(np.float32)
+    state = m.init_metrics()
+    per = losses.logistic_loss(jnp.asarray(scores), jnp.asarray(labels))
+    state = m.update_metrics(state, jnp.asarray(scores), jnp.asarray(labels), per)
+    out = m.finalize_metrics(state)
+    exact = _exact_auc(scores, labels)
+    assert abs(float(out["auc"]) - exact) < 2e-3
+    np.testing.assert_allclose(float(out["logloss"]), float(jnp.mean(per)), rtol=1e-5)
+    assert float(out["count"]) == 5000
+
+
+def test_auc_streaming_invariance(rng):
+    """Folding in one batch or many must give the identical histogram AUC."""
+    scores = rng.normal(size=(1000,)).astype(np.float32)
+    labels = rng.integers(0, 2, 1000).astype(np.float32)
+    per = np.zeros(1000, np.float32)
+    one = m.update_metrics(
+        m.init_metrics(), jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(per)
+    )
+    many = m.init_metrics()
+    for i in range(0, 1000, 100):
+        sl = slice(i, i + 100)
+        many = m.update_metrics(
+            many, jnp.asarray(scores[sl]), jnp.asarray(labels[sl]),
+            jnp.asarray(per[sl]),
+        )
+    np.testing.assert_allclose(
+        float(m.finalize_metrics(one)["auc"]), float(m.finalize_metrics(many)["auc"])
+    )
+
+
+def test_weighted_examples_ignored(rng):
+    scores = rng.normal(size=(200,)).astype(np.float32)
+    labels = rng.integers(0, 2, 200).astype(np.float32)
+    per = np.ones(200, np.float32)
+    w = np.ones(200, np.float32)
+    w[100:] = 0.0
+    masked = m.update_metrics(
+        m.init_metrics(), jnp.asarray(scores), jnp.asarray(labels),
+        jnp.asarray(per), jnp.asarray(w),
+    )
+    half = m.update_metrics(
+        m.init_metrics(), jnp.asarray(scores[:100]), jnp.asarray(labels[:100]),
+        jnp.asarray(per[:100]),
+    )
+    a, b = m.finalize_metrics(masked), m.finalize_metrics(half)
+    np.testing.assert_allclose(float(a["auc"]), float(b["auc"]))
+    np.testing.assert_allclose(float(a["count"]), 100)
+
+
+def test_degenerate_single_class():
+    scores = jnp.asarray([0.1, 0.2, 0.3])
+    labels = jnp.ones((3,))
+    state = m.update_metrics(
+        m.init_metrics(), scores, labels, jnp.zeros((3,))
+    )
+    assert float(m.finalize_metrics(state)["auc"]) == 0.5  # defined fallback

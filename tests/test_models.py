@@ -134,3 +134,19 @@ def test_bf16_save_load_roundtrip(tmp_path, rng):
 def test_bad_loss_fails_at_construction():
     with pytest.raises(ValueError):
         models.FMSpec(num_features=10, rank=2, loss="logloss")
+
+
+def test_regression_derives_squared_loss():
+    spec = models.FMSpec(num_features=10, rank=2, task="regression")
+    assert spec.loss == "squared"
+    assert models.FMSpec(num_features=10, rank=2).loss == "logistic"
+    with pytest.raises(ValueError, match="squared"):
+        models.FMSpec(num_features=10, rank=2, task="regression", loss="logistic")
+
+
+def test_deepfm_slot_mismatch_raises(rng):
+    spec = models.DeepFMSpec(num_features=30, rank=2, num_fields=5, mlp_dims=(4, 4, 4))
+    params = spec.init(jax.random.key(0))
+    ids, vals = _batch(rng, 30, nnz=6)
+    with pytest.raises(ValueError, match="num_fields"):
+        spec.scores(params, ids, vals)

@@ -49,3 +49,10 @@ def test_ffm_nnz_field_mismatch_raises(rng):
         ffm_ops.ffm_scores(w0, w, v, ids, vals)
     with pytest.raises(ValueError, match="shape"):
         ffm_ops.ffm_scores(w0, w, v, ids, vals, fields=jnp.zeros((2,), jnp.int32))
+
+
+def test_ffm_out_of_range_field_raises(rng):
+    w0, w, v, ids, vals = _problem(rng, nf=4)
+    with pytest.raises(ValueError, match="out of range"):
+        ffm_ops.ffm_scores(w0, w, v, ids, vals,
+                           fields=jnp.asarray([0, 1, 99, 2, 3], jnp.int32))

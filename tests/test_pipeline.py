@@ -1,0 +1,72 @@
+"""Batch pipeline: determinism, resume, epoch coverage, padding."""
+
+import numpy as np
+
+from fm_spark_tpu.data import Batches, iterate_once, synthetic_ctr, train_test_split
+
+
+def _data(n=103, nnz=4, f=40):
+    return synthetic_ctr(n, f, nnz, seed=3)
+
+
+def test_epoch_covers_every_example_once():
+    ids, vals, labels = _data()
+    b = Batches(ids, vals, labels, batch_size=20, seed=1)
+    seen = []
+    # 103 examples → 6 batches (last padded with 17 zero-weight slots).
+    for _ in range(6):
+        bi, bv, bl, bw = b.next_batch()
+        order = np.flatnonzero(bw > 0)
+        seen.extend(bi[order][:, 0].tolist())
+    assert len(seen) == 103
+    assert b.epoch == 1 and b.index == 0
+
+
+def test_determinism_and_resume():
+    ids, vals, labels = _data()
+    b1 = Batches(ids, vals, labels, batch_size=16, seed=7)
+    for _ in range(3):
+        b1.next_batch()
+    state = b1.state()
+    want = [b1.next_batch() for _ in range(4)]
+    b2 = Batches(ids, vals, labels, batch_size=16, seed=7)
+    b2.restore(state)
+    got = [b2.next_batch() for _ in range(4)]
+    for (a_ids, a_vals, a_l, a_w), (c_ids, c_vals, c_l, c_w) in zip(want, got):
+        np.testing.assert_array_equal(a_ids, c_ids)
+        np.testing.assert_array_equal(a_l, c_l)
+        np.testing.assert_array_equal(a_w, c_w)
+
+
+def test_restore_wrong_seed_raises():
+    ids, vals, labels = _data()
+    b = Batches(ids, vals, labels, batch_size=16, seed=1)
+    import pytest
+
+    with pytest.raises(ValueError):
+        b.restore({"epoch": 0, "index": 0, "seed": 2})
+
+
+def test_epochs_reshuffle():
+    ids, vals, labels = _data(n=64)
+    b = Batches(ids, vals, labels, batch_size=64, seed=0)
+    e0 = b.next_batch()[0].copy()
+    e1 = b.next_batch()[0].copy()
+    assert not np.array_equal(e0, e1)
+    assert set(map(tuple, e0)) == set(map(tuple, e1))  # same examples
+
+
+def test_iterate_once_padding():
+    ids, vals, labels = _data(n=50)
+    batches = list(iterate_once(ids, vals, labels, 16))
+    assert len(batches) == 4
+    assert all(b[0].shape[0] == 16 for b in batches)
+    total = sum(int(b[3].sum()) for b in batches)
+    assert total == 50
+
+
+def test_train_test_split_disjoint_and_total():
+    ids, vals, labels = _data(n=100)
+    (tr_i, _, tr_l), (te_i, _, te_l) = train_test_split(ids, vals, labels, 0.25, seed=0)
+    assert tr_i.shape[0] == 75 and te_i.shape[0] == 25
+    assert tr_l.shape[0] + te_l.shape[0] == 100
