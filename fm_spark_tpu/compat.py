@@ -130,19 +130,14 @@ class FMWithSGD:
 
 def evaluate(model: FMModel, input, batch_size: int = 8192) -> dict:
     """AUC/logloss/RMSE of a model on ``(ids, vals, labels)``."""
-    from fm_spark_tpu.train import make_eval_step
-    from fm_spark_tpu.utils import metrics as metrics_lib
-    import jax.numpy as jnp
+    from fm_spark_tpu.train import evaluate_params
 
     ids, vals, labels = input
-    step = make_eval_step(model.spec)
-    mstate = metrics_lib.init_metrics()
-    for bids, bvals, blabels, bw in iterate_once(
-        np.asarray(ids, np.int32), np.asarray(vals, np.float32),
-        np.asarray(labels, np.float32), batch_size
-    ):
-        mstate = step(
-            model.params, mstate, jnp.asarray(bids), jnp.asarray(bvals),
-            jnp.asarray(blabels), jnp.asarray(bw),
-        )
-    return {k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()}
+    return evaluate_params(
+        model.spec,
+        model.params,
+        iterate_once(
+            np.asarray(ids, np.int32), np.asarray(vals, np.float32),
+            np.asarray(labels, np.float32), batch_size,
+        ),
+    )

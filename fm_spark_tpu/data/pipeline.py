@@ -36,6 +36,14 @@ class Batches:
         self.vals = np.ascontiguousarray(vals)
         self.labels = np.ascontiguousarray(labels)
         self.batch_size = int(batch_size)
+        if self.ids.shape[0] == 0:
+            raise ValueError("empty dataset")
+        if drop_remainder and self.ids.shape[0] < self.batch_size:
+            raise ValueError(
+                f"batch_size={batch_size} exceeds dataset size "
+                f"{self.ids.shape[0]} with drop_remainder=True — no batch "
+                "can ever be produced"
+            )
         self.seed = int(seed)
         self.drop_remainder = drop_remainder
         self.epoch = 0

@@ -30,8 +30,14 @@ def _register_families():
     from fm_spark_tpu.models.fm import FMSpec
     from fm_spark_tpu.models.ffm import FFMSpec
     from fm_spark_tpu.models.deepfm import DeepFMSpec
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
 
-    _FAMILIES.update(FMSpec=FMSpec, FFMSpec=FFMSpec, DeepFMSpec=DeepFMSpec)
+    _FAMILIES.update(
+        FMSpec=FMSpec,
+        FFMSpec=FFMSpec,
+        DeepFMSpec=DeepFMSpec,
+        FieldFMSpec=FieldFMSpec,
+    )
 
 
 def save_model(path: str, spec, params: dict) -> None:

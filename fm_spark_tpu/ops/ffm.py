@@ -55,9 +55,12 @@ def ffm_scores(
         fields = jnp.asarray(fields, jnp.int32)
         if fields.shape != (nnz,):
             raise ValueError(f"fields must have shape ({nnz},), got {fields.shape}")
-        if not isinstance(fields, jax.core.Tracer) and int(fields.max()) >= num_fields:
+        if not isinstance(fields, jax.core.Tracer) and (
+            int(fields.max()) >= num_fields or int(fields.min()) < 0
+        ):
             raise ValueError(
-                f"field id {int(fields.max())} out of range for F={num_fields}"
+                f"field ids must be in [0, {num_fields}); got range "
+                f"[{int(fields.min())}, {int(fields.max())}]"
             )
     vals = vals.astype(compute_dtype)
     rows = v[ids].astype(compute_dtype)                   # [B, nnz, F, k]

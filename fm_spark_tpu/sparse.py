@@ -1,0 +1,212 @@
+"""Fused sparse-SGD train step: scatter-add updates, no dense gradient.
+
+Why this exists (SURVEY.md §6 feasibility math): at Criteo scale the FM
+table is 10M × 64 (2.6 GB fp32). The generic ``jax.grad`` + optax path
+materializes a *dense* gradient table every step — ~8 GB of HBM traffic for
+a parameter update that only touches ``batch × nnz ≤ 5M`` rows. For plain
+SGD (the reference's optimizer) the update is a pure scatter-add, so this
+step computes the analytic per-row gradients — exactly the reference's
+``computeGradient`` rule, ``x_i(s_f − v_{i,f}x_i)`` per BASELINE.json:5 —
+and applies them in place with ``.at[ids].add``:
+
+    HBM traffic/step ≈ gather(B·nnz·k) + scatter(2·B·nnz·k)  ≪  3·n·k.
+
+Semantics vs the dense path:
+- reg == 0: bitwise-equal math (same sums, same schedule), verified in
+  tests/test_sparse.py.
+- reg > 0: L2 decay is applied *lazily* — only rows touched by the batch
+  decay, scaled by nothing (the standard lazy-regularization trade-off in
+  sparse FM/FTRL training). Exactness with the reference's global decay is
+  therefore approximate; use the dense path when that matters.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from fm_spark_tpu.ops import losses as losses_lib
+from fm_spark_tpu.train import TrainConfig
+
+
+def make_field_sparse_sgd_body(spec, config: TrainConfig):
+    """Unjitted fused-step body for :class:`FieldFMSpec` (see the jitted
+    wrapper :func:`make_field_sparse_sgd_step`); exposed separately so
+    callers (bench, training loops) can roll many steps into one
+    ``lax.fori_loop`` program and amortize dispatch overhead."""
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
+
+    if type(spec) is not FieldFMSpec:
+        raise ValueError("expected a FieldFMSpec")
+    if config.optimizer != "sgd":
+        raise ValueError("sparse step implements plain SGD only")
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    F = spec.num_fields
+
+    if config.lr_schedule == "inv_sqrt":
+        lr_at = lambda i: config.learning_rate / jnp.sqrt(i.astype(jnp.float32) + 1.0)
+    else:
+        lr_at = lambda i: jnp.float32(config.learning_rate)
+
+    k = spec.rank
+
+    def step(params, step_idx, ids, vals, labels, weights):
+        w0 = params["w0"]
+        vals_c = vals.astype(cd)
+        rows = spec.gather_rows(params, ids)            # F × [B, width]
+        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        s = sum(xvs)                                    # [B, k]
+        sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
+        scores = 0.5 * (jnp.sum(s * s, axis=1) - sum_sq)
+        if spec.use_linear:
+            if spec.fused_linear:
+                lins = [r[:, k] for r in rows]
+            else:
+                lins = [params["w"][f][ids[:, f]].astype(cd) for f in range(F)]
+            scores = scores + sum(
+                l * vals_c[:, f] for f, l in enumerate(lins)
+            )
+        if spec.use_bias:
+            scores = scores + w0.astype(cd)
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        loss, dscores = jax.value_and_grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        def factor_grad(f):
+            g = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+            if config.reg_factors:
+                g = g + config.reg_factors * rows[f][:, :k] * touched[:, None]
+            return g
+
+        def linear_grad(f):
+            g = dscores * vals_c[:, f]
+            if config.reg_linear:
+                g = g + config.reg_linear * lins[f] * touched
+            return g
+
+        if spec.fused_linear:
+            # ONE scatter per field: interaction grads in cols [:k], the
+            # linear grad in col k (zeroed if the linear term is disabled).
+            new_vw = []
+            for f in range(F):
+                g_lin = (
+                    linear_grad(f)[:, None]
+                    if spec.use_linear
+                    else jnp.zeros((dscores.shape[0], 1), cd)
+                )
+                g_full = jnp.concatenate([factor_grad(f), g_lin], axis=1)
+                new_vw.append(
+                    params["vw"][f]
+                    .at[ids[:, f]]
+                    .add((-lr * g_full).astype(spec.pdtype))
+                )
+            out = {"w0": w0, "vw": new_vw}
+        else:
+            new_v = [
+                params["v"][f]
+                .at[ids[:, f]]
+                .add((-lr * factor_grad(f)).astype(spec.pdtype))
+                for f in range(F)
+            ]
+            new_w = (
+                [
+                    params["w"][f]
+                    .at[ids[:, f]]
+                    .add((-lr * linear_grad(f)).astype(spec.pdtype))
+                    for f in range(F)
+                ]
+                if spec.use_linear
+                else params["w"]
+            )
+            out = {"w0": w0, "w": new_w, "v": new_v}
+        if spec.use_bias:
+            out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
+        return out, loss
+
+    return step
+
+
+def make_field_sparse_sgd_step(spec, config: TrainConfig):
+    """Jitted fused sparse-SGD step for :class:`FieldFMSpec` — the CTR fast
+    path. Per-field small-table gathers/scatters (see field_fm.py for the
+    measured rationale); same semantics as :func:`make_sparse_sgd_step`.
+    Tables are donated so updates are in-place in HBM."""
+    return jax.jit(
+        make_field_sparse_sgd_body(spec, config), donate_argnums=(0,)
+    )
+
+
+def make_sparse_sgd_step(spec, config: TrainConfig):
+    """Build the fused sparse-SGD step for the plain-FM family.
+
+    Returns ``step(params, step_idx, ids, vals, labels, weights) → (params,
+    loss)``. Only ``optimizer='sgd'`` semantics (no momentum state); the
+    learning-rate schedule matches :func:`fm_spark_tpu.train.make_optimizer`.
+    """
+    from fm_spark_tpu.models.fm import FMSpec
+
+    if type(spec) is not FMSpec:
+        raise ValueError("sparse step supports the plain FM family only")
+    if config.optimizer != "sgd":
+        raise ValueError("sparse step implements plain SGD only")
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+
+    if config.lr_schedule == "inv_sqrt":
+        lr_at = lambda i: config.learning_rate / jnp.sqrt(i.astype(jnp.float32) + 1.0)
+    else:
+        lr_at = lambda i: jnp.float32(config.learning_rate)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(params, step_idx, ids, vals, labels, weights):
+        w0, w, v = params["w0"], params["w"], params["v"]
+        vals_c = vals.astype(cd)
+        rows = v[ids].astype(cd)                       # [B, nnz, k]
+        xv = rows * vals_c[..., None]
+        s = jnp.sum(xv, axis=1)                        # [B, k]
+        sum_sq = jnp.sum(xv * xv, axis=(1, 2))
+        scores = 0.5 * (jnp.sum(s * s, axis=1) - sum_sq)
+        if spec.use_linear:
+            scores = scores + jnp.sum(w[ids].astype(cd) * vals_c, axis=1)
+        if spec.use_bias:
+            scores = scores + w0.astype(cd)
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        loss, dscores = jax.value_and_grad(batch_loss)(scores)
+
+        # The reference's analytic rule (BASELINE.json:5):
+        #   ∂ŷ/∂v[i,f] = x_i (s_f − v[i,f] x_i);  ∂ŷ/∂w[i] = x_i.
+        g_rows = dscores[:, None, None] * vals_c[..., None] * (s[:, None, :] - xv)
+        lr = lr_at(step_idx)
+        if config.reg_factors:
+            # Lazy L2: decay only the gathered rows.
+            g_rows = g_rows + config.reg_factors * rows * (
+                weights[:, None, None] > 0
+            )
+        v = v.at[ids].add((-lr * g_rows).astype(v.dtype))
+        if spec.use_linear:
+            g_w = dscores[:, None] * vals_c
+            if config.reg_linear:
+                g_w = g_w + config.reg_linear * w[ids].astype(cd) * (
+                    weights[:, None] > 0
+                )
+            w = w.at[ids].add((-lr * g_w).astype(w.dtype))
+        if spec.use_bias:
+            g_w0 = jnp.sum(dscores) + config.reg_bias * w0
+            w0 = w0 - lr * g_w0
+        return {"w0": w0, "w": w, "v": v}, loss
+
+    return step

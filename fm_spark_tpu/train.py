@@ -47,8 +47,16 @@ class TrainConfig:
 
 
 def _group_reg(config: TrainConfig):
-    """Per-group L2 added to the gradient, like MLlib's squared-L2 Updater."""
-    reg = {
+    """Per-group L2 added to the gradient, like MLlib's squared-L2 Updater.
+
+    Groups: w0 → reg_bias, w → reg_linear, v/mlp → reg_factors. The fused
+    ``vw`` tables of FieldFMSpec get a per-COLUMN vector (factor columns →
+    reg_factors, the last linear column → reg_linear). Unknown groups are
+    an error — silently unregularized parameters are worse than a crash.
+    """
+    import numpy as np
+
+    known = {
         "w0": config.reg_bias,
         "w": config.reg_linear,
         "v": config.reg_factors,
@@ -58,8 +66,16 @@ def _group_reg(config: TrainConfig):
     def add_reg(grads, params):
         def one(path, g, p):
             top = path[0]
-            key = getattr(top, "key", getattr(top, "idx", top))
-            r = reg.get(str(key), 0.0)
+            key = str(getattr(top, "key", getattr(top, "idx", top)))
+            if key == "vw":
+                if config.reg_factors == 0.0 and config.reg_linear == 0.0:
+                    return g
+                r = np.full((p.shape[-1],), config.reg_factors, np.float32)
+                r[-1] = config.reg_linear
+                return g + jnp.asarray(r) * p.astype(g.dtype)
+            if key not in known:
+                raise ValueError(f"no regularization group for param {key!r}")
+            r = known[key]
             return g if r == 0.0 else g + r * p.astype(g.dtype)
 
         return jax.tree_util.tree_map_with_path(one, grads, params)
@@ -113,15 +129,43 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
 
 
 def make_eval_step(spec):
-    """Build the jit-compiled metrics-accumulation step."""
+    """Build the jit-compiled metrics-accumulation step.
+
+    RMSE is computed from the model's actual PREDICTIONS (regression clip
+    applied, matching ``FMModel.predict``), while AUC/logloss use the raw
+    scores.
+    """
+    from fm_spark_tpu.models import base as model_base
+
     per_example_loss = losses_lib.loss_fn(spec.loss)
 
     def step(params, mstate, ids, vals, labels, weights):
         scores = spec.scores(params, ids, vals)
         per = per_example_loss(scores, labels)
-        return metrics_lib.update_metrics(mstate, scores, labels, per, weights)
+        preds = model_base.predict_from_scores(spec, scores)
+        return metrics_lib.update_metrics(
+            mstate, scores, labels, per, weights, predictions=preds
+        )
 
     return jax.jit(step)
+
+
+def evaluate_params(spec, params, batches, max_batches: int | None = None) -> dict:
+    """Stream ``(ids, vals, labels, weights)`` batches → finalized metrics.
+
+    Shared by :meth:`FMTrainer.evaluate` and :func:`fm_spark_tpu.compat
+    .evaluate`.
+    """
+    step = make_eval_step(spec)
+    mstate = metrics_lib.init_metrics()
+    for i, (ids, vals, labels, weights) in enumerate(batches):
+        if max_batches is not None and i >= max_batches:
+            break
+        mstate = step(
+            params, mstate, jnp.asarray(ids), jnp.asarray(vals),
+            jnp.asarray(labels), jnp.asarray(weights),
+        )
+    return {k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()}
 
 
 class FMTrainer:
@@ -151,34 +195,35 @@ class FMTrainer:
         total = num_steps if num_steps is not None else self.config.num_steps
         log_every = max(self.config.log_every, 1)
         it = iter(batches)
-        for _ in range(total):
-            ids, vals, labels, weights = next(it)
+        steps_since_log = 0
+        for step_i in range(total):
+            try:
+                ids, vals, labels, weights = next(it)
+            except StopIteration:
+                raise ValueError(
+                    f"batch iterable exhausted after {step_i} of {total} "
+                    "steps; pass an epoch-cycling iterator (data.Batches) "
+                    "or lower num_steps"
+                ) from None
             self.params, self.opt_state, m = self._train_step(
                 self.params, self.opt_state,
                 jnp.asarray(ids), jnp.asarray(vals),
                 jnp.asarray(labels), jnp.asarray(weights),
             )
             self.step_count += 1
-            if self.step_count % log_every == 0 or self.step_count == total:
+            steps_since_log += 1
+            if self.step_count % log_every == 0 or step_i == total - 1:
                 loss = float(m["loss"])
                 self.loss_history.append(loss)
                 self.logger.log(
                     self.step_count,
-                    samples=log_every * len(labels),
+                    samples=steps_since_log * len(labels),
                     loss=loss,
                     grad_norm=float(m["grad_norm"]),
                 )
+                steps_since_log = 0
         return self.params
 
     def evaluate(self, batches: Iterable, max_batches: int | None = None) -> dict:
         """Stream eval batches through the on-device accumulators."""
-        mstate = metrics_lib.init_metrics()
-        for i, (ids, vals, labels, weights) in enumerate(batches):
-            if max_batches is not None and i >= max_batches:
-                break
-            mstate = self._eval_step(
-                self.params, mstate,
-                jnp.asarray(ids), jnp.asarray(vals),
-                jnp.asarray(labels), jnp.asarray(weights),
-            )
-        return {k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()}
+        return evaluate_params(self.spec, self.params, batches, max_batches)

@@ -53,15 +53,20 @@ def update_metrics(
     labels: jax.Array,
     per_example_loss: jax.Array,
     weights: jax.Array | None = None,
+    predictions: jax.Array | None = None,
 ) -> MetricsState:
     """Fold a batch of raw scores into the accumulators (jit/psum friendly).
 
     ``weights`` masks padded examples (0 ⇒ ignore), enabling fixed-shape
-    final batches.
+    final batches. ``predictions`` (default: the raw scores) feeds the
+    squared-error accumulator, so regression RMSE reflects the clipped
+    outputs the model actually serves.
     """
     bins = state.pos_hist.shape[0]
     if weights is None:
         weights = jnp.ones_like(labels)
+    if predictions is None:
+        predictions = scores
     w = weights.astype(jnp.float32)
     p = jax.nn.sigmoid(scores)
     idx = jnp.clip((p * bins).astype(jnp.int32), 0, bins - 1)
@@ -69,7 +74,7 @@ def update_metrics(
     is_neg = (labels <= 0.5).astype(jnp.float32) * w
     pos_hist = state.pos_hist.at[idx].add(is_pos)
     neg_hist = state.neg_hist.at[idx].add(is_neg)
-    err = (scores - labels) * w
+    err = (predictions - labels) * w
     return MetricsState(
         pos_hist=pos_hist,
         neg_hist=neg_hist,

@@ -53,6 +53,9 @@ def test_ffm_nnz_field_mismatch_raises(rng):
 
 def test_ffm_out_of_range_field_raises(rng):
     w0, w, v, ids, vals = _problem(rng, nf=4)
-    with pytest.raises(ValueError, match="out of range"):
+    with pytest.raises(ValueError, match="must be in"):
         ffm_ops.ffm_scores(w0, w, v, ids, vals,
                            fields=jnp.asarray([0, 1, 99, 2, 3], jnp.int32))
+    with pytest.raises(ValueError, match="must be in"):
+        ffm_ops.ffm_scores(w0, w, v, ids, vals,
+                           fields=jnp.asarray([0, -1, 2, 3, 1], jnp.int32))

@@ -70,3 +70,12 @@ def test_train_test_split_disjoint_and_total():
     (tr_i, _, tr_l), (te_i, _, te_l) = train_test_split(ids, vals, labels, 0.25, seed=0)
     assert tr_i.shape[0] == 75 and te_i.shape[0] == 25
     assert tr_l.shape[0] + te_l.shape[0] == 100
+
+
+def test_batches_rejects_impossible_config():
+    import pytest
+    ids, vals, labels = _data(n=10)
+    with pytest.raises(ValueError, match="exceeds dataset"):
+        Batches(ids, vals, labels, batch_size=64, drop_remainder=True)
+    with pytest.raises(ValueError, match="empty"):
+        Batches(ids[:0], vals[:0], labels[:0], batch_size=4)
