@@ -1,0 +1,202 @@
+"""Mid-training checkpoint/resume and preemption handling.
+
+The reference inherits fault tolerance from Spark (SURVEY.md §3.5, §5):
+lost partitions recompute from RDD lineage, and the model is only durably
+saved at the end. TPU jobs are gang-scheduled — there is no partial-worker
+survival — so the TPU-native strategy is **checkpoint-restart** (SURVEY.md
+§5 "Failure detection"): frequent async orbax checkpoints of the full
+training state {params, optimizer state, step, data-pipeline cursor}, plus
+a preemption signal handler that writes a final checkpoint on SIGTERM.
+
+A resumed run is bit-deterministic with an uninterrupted one: the data
+pipeline's (seed, epoch, index) cursor is saved alongside the arrays, and
+``Batches.restore`` replays the exact remaining batch sequence
+(data/pipeline.py). The kill-and-resume integration test asserts exactly
+this loss-curve continuity (tests/test_checkpoint.py).
+
+Final-model export (the reference's ``FMModel.save``) is separate and
+lighter: :mod:`fm_spark_tpu.models.io`.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    """Orbax-backed training-state checkpointer.
+
+    Saves are asynchronous by default (the next train step overlaps the
+    write). ``save_every`` gives steady-state cadence; :meth:`save` with
+    ``force=True`` writes regardless (used for the preemption flush and
+    the final step).
+
+    Usage::
+
+        ckpt = Checkpointer(dir, save_every=1000)
+        restored = ckpt.restore(params, opt_state)   # None on fresh start
+        ...
+        ckpt.maybe_save(step, params, opt_state, pipeline_state)
+        ...
+        ckpt.close()
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        save_every: int = 1000,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+    ):
+        # orbax requires absolute paths; with async saves a relative path
+        # fails in a background thread, long after training moved on.
+        self.directory = os.path.abspath(str(directory))
+        self.save_every = int(save_every)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def maybe_save(self, step: int, params, opt_state,
+                   pipeline_state: dict | None = None,
+                   extra: dict | None = None) -> bool:
+        """Save iff ``step`` is on the cadence. Returns whether it saved."""
+        if self.save_every <= 0 or step % self.save_every != 0:
+            return False
+        return self.save(step, params, opt_state, pipeline_state, extra)
+
+    def save(self, step: int, params, opt_state,
+             pipeline_state: dict | None = None,
+             extra: dict | None = None, force: bool = False) -> bool:
+        meta: dict[str, Any] = {"pipeline": pipeline_state, "extra": extra}
+        try:
+            return self._mgr.save(
+                int(step),
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(
+                        {"params": params, "opt_state": opt_state}
+                    ),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+                force=force,
+            )
+        except ocp.checkpoint_manager.StepAlreadyExistsError:
+            # A cadence save already committed this step; training state at
+            # a given step is unique, so the existing checkpoint IS this one.
+            return True
+
+    def restore(self, params_example, opt_state_example,
+                step: int | None = None):
+        """Restore the latest (or given) step.
+
+        The examples pin the pytree structure so optax NamedTuple states
+        come back as the right types, not dicts. Returns ``None`` if no
+        checkpoint exists, else a dict with keys ``params, opt_state,
+        step, pipeline, extra``.
+        """
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            return None
+        example = {"params": params_example, "opt_state": opt_state_example}
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(example),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        meta = restored.meta or {}
+        return {
+            "params": restored.state["params"],
+            "opt_state": restored.state["opt_state"],
+            "step": step,
+            "pipeline": meta.get("pipeline"),
+            "extra": meta.get("extra"),
+        }
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has committed."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+class PreemptionGuard:
+    """Preemption signal → flag; the training loop flushes a checkpoint.
+
+    TPU preemption arrives as SIGTERM with a grace window (SURVEY.md §5),
+    so SIGTERM is the default; pass ``signals=(signal.SIGTERM,
+    signal.SIGINT)`` to also catch Ctrl-C. Installing the guard makes
+    ``should_stop`` flip instead of the process dying mid-write;
+    ``FMTrainer.fit`` checks it once per step and performs an orderly
+    save-and-return. Signal handlers only work in the main thread;
+    elsewhere the guard degrades to an always-False flag.
+
+    Also usable directly::
+
+        with PreemptionGuard() as guard:
+            for step in ...:
+                if guard.should_stop: break
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._flag = threading.Event()
+        self._previous: dict[int, Any] = {}
+        self._installed = False
+
+    @property
+    def should_stop(self) -> bool:
+        return self._flag.is_set()
+
+    def _handler(self, signum, frame):
+        self._flag.set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for sig in self._signals:
+                self._previous[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for sig, prev in self._previous.items():
+                signal.signal(sig, prev)
+            self._previous.clear()
+            self._installed = False
+        return None
+
+
+def resume_or_init(trainer, checkpointer: Checkpointer, batches=None) -> int:
+    """Restore trainer (+ pipeline) state from the latest checkpoint.
+
+    Mutates ``trainer.params/opt_state/step_count`` and (if given and
+    checkpointed) ``batches``'s cursor. Returns the restored step, or 0 on
+    a fresh start.
+    """
+    restored = checkpointer.restore(trainer.params, trainer.opt_state)
+    if restored is None:
+        return 0
+    trainer.params = restored["params"]
+    trainer.opt_state = restored["opt_state"]
+    trainer.step_count = restored["step"]
+    if batches is not None and restored["pipeline"] is not None:
+        batches.restore(restored["pipeline"])
+    extra = restored.get("extra") or {}
+    if "loss_history" in extra:
+        trainer.loss_history = list(extra["loss_history"])
+    return restored["step"]

@@ -190,13 +190,52 @@ class FMTrainer:
         self.logger = MetricsLogger(path=config.metrics_path, n_chips=n_chips)
         self.loss_history: list[float] = []
 
-    def fit(self, batches: Iterable, num_steps: int | None = None):
-        """Run the training loop; ``batches`` yields (ids, vals, labels, w)."""
+    def fit(self, batches: Iterable, num_steps: int | None = None,
+            checkpointer=None, preemption_guard=None):
+        """Run the training loop; ``batches`` yields (ids, vals, labels, w).
+
+        With a :class:`fm_spark_tpu.checkpoint.Checkpointer`, training
+        state (params, optimizer state, step, pipeline cursor) is saved on
+        the checkpointer's cadence, the run resumes from the latest saved
+        step automatically, and a ``PreemptionGuard`` (if given) turns
+        SIGTERM into an orderly flush-and-return (SURVEY.md §5).
+        """
         total = num_steps if num_steps is not None else self.config.num_steps
         log_every = max(self.config.log_every, 1)
+        start = 0
+        if checkpointer is not None:
+            from fm_spark_tpu import checkpoint as ckpt_lib
+
+            if not (hasattr(batches, "state") and hasattr(batches, "restore")):
+                raise ValueError(
+                    "checkpointed training needs a resumable batch source "
+                    "with state()/restore() (e.g. data.Batches); a plain "
+                    "iterator would silently replay data after resume"
+                )
+            # With a checkpointer, num_steps is a GLOBAL step target: a
+            # resumed run continues toward it (and a finished run is a
+            # no-op). Without one, fit() runs num_steps more steps.
+            start = ckpt_lib.resume_or_init(self, checkpointer, batches=batches)
+
+        def save(force=False):
+            if checkpointer is None:
+                return
+            # Snapshot mutable fields: async saves serialize in a background
+            # thread while the loop keeps appending to loss_history.
+            args = (self.step_count, self.params, self.opt_state,
+                    batches.state(), {"loss_history": list(self.loss_history)})
+            if force:
+                checkpointer.save(*args, force=True)
+                checkpointer.wait()
+            else:
+                checkpointer.maybe_save(*args)
+
         it = iter(batches)
         steps_since_log = 0
-        for step_i in range(total):
+        for step_i in range(start, total):
+            if preemption_guard is not None and preemption_guard.should_stop:
+                save(force=True)
+                return self.params
             try:
                 ids, vals, labels, weights = next(it)
             except StopIteration:
@@ -222,6 +261,8 @@ class FMTrainer:
                     grad_norm=float(m["grad_norm"]),
                 )
                 steps_since_log = 0
+            save()
+        save(force=True)
         return self.params
 
     def evaluate(self, batches: Iterable, max_batches: int | None = None) -> dict:
