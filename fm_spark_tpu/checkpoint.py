@@ -68,11 +68,16 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def due(self, step: int) -> bool:
+        """Is ``step`` on the save cadence? (Cheap; check before building
+        state snapshots.)"""
+        return self.save_every > 0 and step % self.save_every == 0
+
     def maybe_save(self, step: int, params, opt_state,
                    pipeline_state: dict | None = None,
                    extra: dict | None = None) -> bool:
         """Save iff ``step`` is on the cadence. Returns whether it saved."""
-        if self.save_every <= 0 or step % self.save_every != 0:
+        if not self.due(step):
             return False
         return self.save(step, params, opt_state, pipeline_state, extra)
 

@@ -13,3 +13,9 @@ from fm_spark_tpu.data.pipeline import (  # noqa: F401
     iterate_once,
     train_test_split,
 )
+from fm_spark_tpu.data.packed import (  # noqa: F401
+    PackedBatches,
+    PackedDataset,
+    PackedWriter,
+)
+from fm_spark_tpu.data.libsvm import load_libsvm, save_libsvm  # noqa: F401

@@ -1,0 +1,124 @@
+"""Criteo click-logs: TSV → hashed packed binary (configs 2/3/5).
+
+Format: ``label \\t i1..i13 \\t c1..c26`` — 13 integer count features, 26
+categorical hex tokens, empty fields = missing (SURVEY.md §6: 39 nnz per
+sample). Preprocessing is the one-time batch job of SURVEY.md §7 step 4:
+stream the text, hash every field (data/hashing.py semantics), write the
+packed format (data/packed.py); training never sees text. The native
+parser (fasthash.cpp) is the fast path; ``parse_lines`` is the pure-Python
+oracle the tests compare it against.
+
+Since vals are identically 1.0 (pure one-hot, SURVEY.md §2 #7), the packed
+dataset is written with ``store_vals=False``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fm_spark_tpu.data import hashing
+from fm_spark_tpu.data.packed import PackedWriter
+
+NUM_INT = 13
+NUM_CAT = 26
+NUM_FIELDS = NUM_INT + NUM_CAT
+
+
+def parse_lines(lines: list[bytes], bucket: int, per_field: bool = True):
+    """Pure-Python Criteo parser — the semantic spec for fm_parse_criteo.
+
+    Returns (ids[N,39] int32, labels[N] int8). Malformed lines (wrong
+    column count) raise — garbage in the id space is worse than a crash.
+    """
+    n = len(lines)
+    ids = np.empty((n, NUM_FIELDS), np.int32)
+    labels = np.empty(n, np.int8)
+    for r, line in enumerate(lines):
+        cols = line.rstrip(b"\n").split(b"\t")
+        if len(cols) != NUM_FIELDS + 1:
+            raise ValueError(
+                f"criteo line has {len(cols)} columns, want {NUM_FIELDS + 1}"
+            )
+        labels[r] = 1 if int(cols[0]) > 0 else 0  # non-integer label raises
+        for f in range(NUM_INT):
+            tok = cols[1 + f]
+            if tok == b"":
+                key = (1 << 40) + 1  # MISS_KEY (hashing.py)
+            elif tok.startswith(b"-"):
+                key = 1 << 40  # NEG_KEY
+            else:
+                key = int(np.floor(np.log1p(float(int(tok))) ** 2))
+            ids[r, f] = hashing.hash_int_u64_spec(f, key, bucket, per_field)
+        for f in range(NUM_INT, NUM_FIELDS):
+            ids[r, f] = hashing.hash_token(f, cols[1 + f], bucket, per_field)
+    return ids, labels
+
+
+def preprocess(src_paths, out_dir: str, bucket: int, per_field: bool = True,
+               chunk_bytes: int = 1 << 24, use_native: bool = True) -> int:
+    """Stream Criteo TSV file(s) → packed dataset. Returns example count.
+
+    Chunked reads never split a line across a parse call: the native
+    parser reports consumed bytes, and the tail is prepended to the next
+    chunk.
+    """
+    from fm_spark_tpu import native
+
+    if isinstance(src_paths, str):
+        src_paths = [src_paths]
+    go_native = use_native and native.available()
+    with PackedWriter(out_dir, NUM_FIELDS, store_vals=False) as w:
+        for path in src_paths:
+            with open(path, "rb") as f:
+                tail = b""
+                while True:
+                    chunk = f.read(chunk_bytes)
+                    if not chunk and not tail:
+                        break
+                    buf = tail + chunk
+                    if not chunk:
+                        # Flush a final unterminated line, if any.
+                        if not buf.endswith(b"\n"):
+                            buf += b"\n"
+                        tail = b""
+                    if go_native:
+                        ids, labels, consumed = native.parse_criteo_chunk(
+                            buf, bucket, per_field
+                        )
+                        tail = buf[consumed:] if chunk else b""
+                    else:
+                        nl = buf.rfind(b"\n")
+                        complete, tail = buf[: nl + 1], buf[nl + 1:]
+                        if not chunk:
+                            tail = b""
+                        lines = complete.splitlines()
+                        ids, labels = parse_lines(lines, bucket, per_field)
+                    if ids.shape[0]:
+                        w.append(ids, labels)
+                    if not chunk:
+                        break
+        count = w.num_examples
+    return count
+
+
+def synthesize_tsv(path: str, num_examples: int, seed: int = 0,
+                   vocab_per_field: int = 1000, missing_rate: float = 0.05):
+    """Write a Criteo-shaped synthetic TSV (tests/benches; no real data in
+    the image). Token and count distributions are Zipf-skewed like the real
+    logs."""
+    rng = np.random.default_rng(seed)
+    with open(path, "wb") as f:
+        for _ in range(num_examples):
+            cols = [b"1" if rng.random() < 0.25 else b"0"]
+            for _f in range(NUM_INT):
+                if rng.random() < missing_rate:
+                    cols.append(b"")
+                else:
+                    cols.append(str(int(rng.zipf(1.5)) - 1).encode())
+            for _f in range(NUM_CAT):
+                if rng.random() < missing_rate:
+                    cols.append(b"")
+                else:
+                    tok = int(rng.zipf(1.3)) % vocab_per_field
+                    cols.append(f"{tok:08x}".encode())
+            f.write(b"\t".join(cols) + b"\n")

@@ -1,0 +1,77 @@
+"""libSVM text format ↔ fixed-nnz arrays.
+
+The reference ingests ``MLUtils.loadLibSVMFile`` → RDD[LabeledPoint] with
+sparse vectors (SURVEY.md §3.3). The TPU-native representation is fixed-nnz
+``(ids[N,S], vals[N,S], labels[N])``: rows with fewer than S non-zeros are
+padded with ``val=0`` entries (a zero value contributes nothing to any FM
+term — ops/fm.py), rows with more raise by default (truncation is opt-in,
+silent data loss is not).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_libsvm(path: str, max_nnz: int | None = None,
+                truncate: bool = False, zero_based: bool = False):
+    """Parse a libSVM file → ``(ids[N,S] int32, vals[N,S] f32, labels[N] f32)``.
+
+    ``max_nnz`` fixes S (default: the file's max row nnz). One-based
+    indices (the libSVM convention) are shifted to zero-based unless
+    ``zero_based``.
+    """
+    rows: list[tuple[float, list[int], list[float]]] = []
+    widest = 0
+    with open(path, "rb") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split(b"#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            try:
+                label = float(parts[0])
+                idx, val = [], []
+                for p in parts[1:]:
+                    i, v = p.split(b":")
+                    idx.append(int(i) - (0 if zero_based else 1))
+                    val.append(float(v))
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: bad libsvm line") from e
+            if idx and min(idx) < 0:
+                raise ValueError(
+                    f"{path}:{lineno}: negative feature index — file is "
+                    "probably zero-based; pass zero_based=True"
+                )
+            widest = max(widest, len(idx))
+            rows.append((label, idx, val))
+    S = max_nnz if max_nnz is not None else max(widest, 1)
+    if widest > S and not truncate:
+        raise ValueError(
+            f"row with {widest} non-zeros exceeds max_nnz={S}; pass "
+            "truncate=True to drop overflow features"
+        )
+    n = len(rows)
+    ids = np.zeros((n, S), np.int32)
+    vals = np.zeros((n, S), np.float32)
+    labels = np.empty(n, np.float32)
+    for r, (label, idx, val) in enumerate(rows):
+        labels[r] = label
+        k = min(len(idx), S)
+        ids[r, :k] = idx[:k]
+        vals[r, :k] = val[:k]
+    return ids, vals, labels
+
+
+def save_libsvm(path: str, ids: np.ndarray, vals: np.ndarray,
+                labels: np.ndarray, zero_based: bool = False) -> None:
+    """Write fixed-nnz arrays as libSVM text (zero-val entries dropped)."""
+    off = 0 if zero_based else 1
+    with open(path, "w") as f:
+        for r in range(ids.shape[0]):
+            lab = labels[r]
+            parts = [f"{lab:.9g}"]
+            for s in range(ids.shape[1]):
+                if vals[r, s] != 0.0:
+                    parts.append(f"{int(ids[r, s]) + off}:{vals[r, s]:.9g}")
+            f.write(" ".join(parts) + "\n")

@@ -1,0 +1,252 @@
+"""Packed binary dataset: the at-rate disk format behind the input pipeline.
+
+SURVEY.md §7 hard part #1: the north-star config needs ~1.25M parsed
+samples/s/chip — text parsing in the hot path is impossible, so
+preprocessing is a one-time batch job (data/criteo.py etc.) writing this
+format, and the training-time loader is a memory-mapped read with zero
+parsing. Layout (a directory):
+
+    meta.json    {"num_examples", "num_fields", "store_vals", "version"}
+    ids.bin      int32 [N, F]   hashed feature ids
+    vals.bin     float32 [N, F] (absent when store_vals=false — pure
+                 one-hot data synthesizes 1.0s at batch time, halving IO)
+    labels.bin   int8 [N]
+
+``PackedBatches`` is the training iterator: chunk-shuffled (shuffle chunk
+order and intra-chunk order per epoch — an out-of-core Fisher-Yates
+approximation that touches disk sequentially per chunk), per-host sharded
+(each host owns a contiguous example range, the grain/tf.data idiom for
+SPMD input: hosts feed disjoint data, SURVEY.md §2 DP row), and exactly
+resumable via ``state()/restore()`` like :class:`~fm_spark_tpu.data
+.pipeline.Batches`, so orbax checkpoints capture the cursor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+_VERSION = 1
+
+
+class PackedWriter:
+    """Append-only writer for the packed format (one-time preprocessing)."""
+
+    def __init__(self, path: str, num_fields: int, store_vals: bool = True):
+        self.path = path
+        self.num_fields = int(num_fields)
+        self.store_vals = bool(store_vals)
+        os.makedirs(path, exist_ok=True)
+        self._ids = open(os.path.join(path, "ids.bin"), "wb")
+        self._vals = (
+            open(os.path.join(path, "vals.bin"), "wb") if store_vals else None
+        )
+        self._labels = open(os.path.join(path, "labels.bin"), "wb")
+        self.num_examples = 0
+        self._closed = False
+
+    def append(self, ids: np.ndarray, labels: np.ndarray,
+               vals: np.ndarray | None = None) -> None:
+        ids = np.ascontiguousarray(ids, np.int32)
+        labels = np.ascontiguousarray(labels, np.int8)
+        if ids.ndim != 2 or ids.shape[1] != self.num_fields:
+            raise ValueError(
+                f"ids must be [N, {self.num_fields}], got {ids.shape}"
+            )
+        if labels.shape != (ids.shape[0],):
+            raise ValueError("labels must be [N] matching ids")
+        self._ids.write(ids.tobytes())
+        self._labels.write(labels.tobytes())
+        if self.store_vals:
+            if vals is None:
+                vals = np.ones(ids.shape, np.float32)
+            vals = np.ascontiguousarray(vals, np.float32)
+            if vals.shape != ids.shape:
+                raise ValueError("vals must match ids shape")
+            self._vals.write(vals.tobytes())
+        elif vals is not None and not np.all(vals == 1.0):
+            raise ValueError("store_vals=False but non-unit vals given")
+        self.num_examples += ids.shape[0]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._ids.close()
+        self._labels.close()
+        if self._vals is not None:
+            self._vals.close()
+        with open(os.path.join(self.path, "meta.json"), "w") as f:
+            json.dump(
+                {
+                    "num_examples": self.num_examples,
+                    "num_fields": self.num_fields,
+                    "store_vals": self.store_vals,
+                    "version": _VERSION,
+                },
+                f,
+            )
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PackedDataset:
+    """Memory-mapped view of a packed directory (zero-copy until sliced)."""
+
+    def __init__(self, path: str):
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        if meta["version"] != _VERSION:
+            raise ValueError(f"unknown packed version {meta['version']}")
+        self.path = path
+        self.num_examples = int(meta["num_examples"])
+        self.num_fields = int(meta["num_fields"])
+        self.store_vals = bool(meta["store_vals"])
+        if self.num_examples == 0:
+            raise ValueError(
+                f"packed dataset at {path} is empty (preprocessing wrote "
+                "zero examples)"
+            )
+        shape = (self.num_examples, self.num_fields)
+        self.ids = np.memmap(os.path.join(path, "ids.bin"), np.int32,
+                             "r", shape=shape)
+        self.vals = (
+            np.memmap(os.path.join(path, "vals.bin"), np.float32, "r",
+                      shape=shape)
+            if self.store_vals else None
+        )
+        self.labels = np.memmap(os.path.join(path, "labels.bin"), np.int8,
+                                "r", shape=(self.num_examples,))
+
+    def __len__(self):
+        return self.num_examples
+
+    def slice(self, sel) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Materialize (ids, vals, labels) for an index array/slice."""
+        ids = np.asarray(self.ids[sel])
+        vals = (
+            np.asarray(self.vals[sel])
+            if self.vals is not None
+            else np.ones(ids.shape, np.float32)
+        )
+        return ids, vals, np.asarray(self.labels[sel], np.float32)
+
+
+class PackedBatches:
+    """Chunk-shuffled, per-host-sharded, resumable batch iterator.
+
+    Yields ``(ids, vals, labels, weights)`` with fixed shapes; the final
+    partial batch of an epoch is padded with weight-0 examples. Batch
+    sequence is a pure function of (seed, host_index, epoch, index) —
+    resume replays exactly (SURVEY.md §5).
+    """
+
+    def __init__(self, dataset: PackedDataset, batch_size: int,
+                 seed: int = 0, shuffle: bool = True,
+                 chunk_size: int = 1 << 18,
+                 host_index: int = 0, num_hosts: int = 1,
+                 drop_remainder: bool = False):
+        if not (0 <= host_index < num_hosts):
+            raise ValueError(f"host_index {host_index} not in [0,{num_hosts})")
+        self.ds = dataset
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.chunk_size = int(chunk_size)
+        self.drop_remainder = bool(drop_remainder)
+        # Contiguous per-host range: sequential disk reads per host.
+        per_host = dataset.num_examples // num_hosts
+        if per_host == 0:
+            raise ValueError("fewer examples than hosts")
+        self.lo = host_index * per_host
+        self.hi = (
+            dataset.num_examples if host_index == num_hosts - 1
+            else self.lo + per_host
+        )
+        self.epoch = 0
+        self.index = 0  # examples consumed within the epoch
+        self._order = None
+        if self.drop_remainder and (self.hi - self.lo) < self.batch_size:
+            raise ValueError("batch_size exceeds per-host examples with "
+                             "drop_remainder=True")
+
+    @property
+    def num_examples(self):
+        return self.hi - self.lo
+
+    def _epoch_order(self) -> np.ndarray:
+        """Permutation of this host's range for the current epoch."""
+        if self._order is not None:
+            return self._order
+        n = self.num_examples
+        if not self.shuffle:
+            self._order = np.arange(self.lo, self.hi)
+            return self._order
+        rng = np.random.default_rng((self.seed, self.epoch, self.lo))
+        n_chunks = max(1, (n + self.chunk_size - 1) // self.chunk_size)
+        chunk_order = rng.permutation(n_chunks)
+        parts = []
+        for c in chunk_order:
+            s = c * self.chunk_size
+            e = min(s + self.chunk_size, n)
+            parts.append(self.lo + s + rng.permutation(e - s))
+        self._order = np.concatenate(parts)
+        return self._order
+
+    def state(self) -> dict:
+        return {"epoch": self.epoch, "index": self.index, "seed": self.seed,
+                "lo": self.lo, "hi": self.hi, "shuffle": self.shuffle,
+                "chunk_size": self.chunk_size}
+
+    def restore(self, state: dict) -> None:
+        # Everything the epoch order is a function of must match, or the
+        # resumed sequence silently diverges from the saved one.
+        for key, have in [("seed", self.seed), ("lo", self.lo),
+                          ("hi", self.hi), ("shuffle", self.shuffle),
+                          ("chunk_size", self.chunk_size)]:
+            if key in state and state[key] != have:
+                raise ValueError(
+                    f"restoring pipeline state with a different {key} "
+                    f"(saved {state[key]!r}, current {have!r})"
+                )
+        self.epoch = int(state["epoch"])
+        self.index = int(state["index"])
+        self._order = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n, b = self.num_examples, self.batch_size
+        order = self._epoch_order()
+        start, end = self.index, self.index + b
+        if end <= n:
+            sel = order[start:end]
+            weights = np.ones((b,), np.float32)
+            self.index = end
+        elif self.drop_remainder or start >= n:
+            self.epoch += 1
+            self.index = 0
+            self._order = None
+            return self.__next__()
+        else:
+            sel = order[start:n]
+            pad = b - sel.shape[0]
+            weights = np.concatenate(
+                [np.ones(sel.shape[0], np.float32), np.zeros(pad, np.float32)]
+            )
+            sel = np.concatenate([sel, np.full(pad, self.lo, np.int64)])
+            self.epoch += 1
+            self.index = 0
+            self._order = None
+        # memmap fancy-indexing wants sorted offsets for locality; sorting
+        # would undo the shuffle, and chunk-local order is already close.
+        ids, vals, labels = self.ds.slice(sel)
+        return ids, vals, labels, weights

@@ -1,0 +1,165 @@
+"""ctypes bindings for the native preprocessing kernels (fasthash.cpp).
+
+Compiles the shared library on first use (g++ is in the image; pybind11 is
+not, so the binding layer is plain ctypes over flat numpy buffers). Every
+function has a pure-numpy fallback in :mod:`fm_spark_tpu.data.hashing`
+with bit-identical output; ``available()`` says which path you're on, and
+nothing in the package *requires* the native path — it is a throughput
+lever for the one-time text→packed preprocessing job (SURVEY.md §7 hard
+part #1), not a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fasthash.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "libfmfast.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+
+def _build() -> str | None:
+    """Compile the .so next to the source if stale/missing. Returns error."""
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return None
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            return f"g++ failed: {proc.stderr[-500:]}"
+        return None
+    except Exception as e:  # g++ missing, read-only dir, ...
+        return f"{type(e).__name__}: {e}"
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        _build_error = _build()
+        if _build_error is not None:
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.fm_murmur3_32.restype = ctypes.c_uint32
+        lib.fm_murmur3_32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+        ]
+        lib.fm_hash_bytes_batch.restype = None
+        lib.fm_hash_bytes_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int, ctypes.c_void_p,
+        ]
+        lib.fm_hash_u64_batch.restype = None
+        lib.fm_hash_u64_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int, ctypes.c_void_p,
+        ]
+        lib.fm_parse_criteo.restype = ctypes.c_int64
+        lib.fm_parse_criteo.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library compiled and loaded on this machine."""
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    _load()
+    return _build_error
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        from fm_spark_tpu.data import hashing
+
+        return hashing.murmur3_32(data, seed)
+    return int(lib.fm_murmur3_32(data, len(data), seed))
+
+
+def hash_tokens_batch(tokens: list[bytes], fields: np.ndarray, bucket: int,
+                      per_field: bool = True) -> np.ndarray:
+    """Native batch token hashing; falls back to the numpy reference."""
+    lib = _load()
+    if lib is None:
+        from fm_spark_tpu.data import hashing
+
+        return hashing.hash_tokens_batch(tokens, fields, bucket, per_field)
+    buf = b"".join(tokens)
+    offsets = np.zeros(len(tokens) + 1, np.int64)
+    np.cumsum([len(t) for t in tokens], out=offsets[1:])
+    fields32 = np.ascontiguousarray(fields, np.int32)
+    out = np.empty(len(tokens), np.int64)
+    lib.fm_hash_bytes_batch(
+        buf, offsets.ctypes.data, len(tokens), fields32.ctypes.data,
+        bucket, int(per_field), out.ctypes.data,
+    )
+    return out
+
+
+def hash_u64_batch(keys: np.ndarray, fields: np.ndarray, bucket: int,
+                   per_field: bool = True) -> np.ndarray:
+    lib = _load()
+    keys = np.ascontiguousarray(keys, np.uint64)
+    fields32 = np.ascontiguousarray(fields, np.int32)
+    if lib is None:
+        from fm_spark_tpu.data import hashing
+
+        h = hashing.murmur3_u64(keys, fields32.astype(np.uint32)) % np.uint32(bucket)
+        out = h.astype(np.int64)
+        if per_field:
+            out += fields32.astype(np.int64) * bucket
+        return out
+    out = np.empty(keys.shape[0], np.int64)
+    lib.fm_hash_u64_batch(
+        keys.ctypes.data, keys.shape[0], fields32.ctypes.data, bucket,
+        int(per_field), out.ctypes.data,
+    )
+    return out
+
+
+CRITEO_FIELDS = 39
+
+
+def parse_criteo_chunk(chunk: bytes, bucket: int, per_field: bool = True,
+                       max_rows: int | None = None):
+    """Parse a chunk of Criteo TSV → (ids[N,39] int32, labels[N] int8,
+    consumed_bytes). Only complete lines are consumed; feed the remainder
+    back with the next chunk. Requires the native library (the Python
+    fallback lives in data/criteo.py)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    if max_rows is None:
+        max_rows = chunk.count(b"\n")
+    ids = np.empty((max_rows, CRITEO_FIELDS), np.int32)
+    labels = np.empty(max_rows, np.int8)
+    consumed = ctypes.c_int64(0)
+    bad_pos = ctypes.c_int64(-1)
+    n = lib.fm_parse_criteo(
+        chunk, len(chunk), bucket, int(per_field), max_rows,
+        ids.ctypes.data, labels.ctypes.data, ctypes.byref(consumed),
+        ctypes.byref(bad_pos),
+    )
+    if bad_pos.value >= 0:
+        lineno = chunk[: bad_pos.value].count(b"\n") + 1
+        snippet = chunk[bad_pos.value: bad_pos.value + 60]
+        raise ValueError(
+            f"malformed criteo line (chunk line {lineno}): {snippet!r}"
+        )
+    return ids[:n], labels[:n], int(consumed.value)

@@ -220,6 +220,8 @@ class FMTrainer:
         def save(force=False):
             if checkpointer is None:
                 return
+            if not force and not checkpointer.due(self.step_count):
+                return  # skip snapshot construction off-cadence
             # Snapshot mutable fields: async saves serialize in a background
             # thread while the loop keeps appending to loss_history.
             args = (self.step_count, self.params, self.opt_state,
@@ -228,7 +230,7 @@ class FMTrainer:
                 checkpointer.save(*args, force=True)
                 checkpointer.wait()
             else:
-                checkpointer.maybe_save(*args)
+                checkpointer.save(*args)
 
         it = iter(batches)
         steps_since_log = 0

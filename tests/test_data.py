@@ -1,0 +1,348 @@
+"""Data subsystem: hashing determinism, native==python, packed roundtrip,
+parsers, per-host sharding, resumable iteration (SURVEY.md §4 parity tests
++ §7 hard part #1)."""
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu import native
+from fm_spark_tpu.data import avazu, criteo, hashing, libsvm, movielens
+from fm_spark_tpu.data.packed import PackedBatches, PackedDataset, PackedWriter
+
+
+# ---------------------------------------------------------------- hashing
+
+def test_murmur3_known_vectors():
+    assert hashing.murmur3_32(b"", 0) == 0
+    assert hashing.murmur3_32(b"hello", 0) == 0x248BFA47
+    assert hashing.murmur3_32(b"hello, world", 0) == 0x149BBB7F
+    assert (
+        hashing.murmur3_32(
+            b"The quick brown fox jumps over the lazy dog", 0x9747B28C
+        )
+        == 0x2FA826CD
+    )
+
+
+def test_murmur3_u64_matches_bytes(rng):
+    keys = rng.integers(0, 2**63, 200, dtype=np.uint64)
+    vec = hashing.murmur3_u64(keys, seed=11)
+    for i in range(0, 200, 17):
+        assert int(vec[i]) == hashing.murmur3_32(keys[i].tobytes(), seed=11)
+
+
+def test_field_seeding_separates_fields():
+    a = hashing.hash_token(0, b"token", 1000, per_field=False)
+    b = hashing.hash_token(1, b"token", 1000, per_field=False)
+    assert a != b  # same token, different fields → independent ids
+
+
+def test_per_field_layout_ranges(rng):
+    bucket = 64
+    tokens = [bytes(rng.integers(0, 255, 8, dtype=np.uint8)) for _ in range(100)]
+    fields = rng.integers(0, 5, 100)
+    ids = hashing.hash_tokens_batch(tokens, fields, bucket, per_field=True)
+    assert np.all(ids // bucket == fields)
+
+
+def test_hash_int_features_matches_scalar_spec(rng):
+    vals = rng.integers(-3, 10_000, (50, 4))
+    fields = np.tile(np.arange(4), (50, 1))
+    missing = rng.random((50, 4)) < 0.1
+    ids = hashing.hash_int_features(vals, fields, 97, missing=missing)
+    for r in range(0, 50, 7):
+        for f in range(4):
+            if missing[r, f]:
+                key = (1 << 40) + 1
+            elif vals[r, f] < 0:
+                key = 1 << 40
+            else:
+                key = int(np.floor(np.log1p(float(vals[r, f])) ** 2))
+            assert ids[r, f] == hashing.hash_int_u64_spec(f, key, 97)
+
+
+# ----------------------------------------------------------------- native
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason=f"native build failed: {native.build_error()}"
+)
+
+
+@needs_native
+def test_native_murmur_matches_python(rng):
+    for n in [0, 1, 2, 3, 4, 5, 7, 8, 13, 64]:
+        data = bytes(rng.integers(0, 255, n, dtype=np.uint8))
+        assert native.murmur3_32(data, 42) == hashing.murmur3_32(data, 42)
+
+
+@needs_native
+def test_native_token_batch_matches_python(rng):
+    tokens = [
+        bytes(rng.integers(0, 255, int(rng.integers(0, 20)), dtype=np.uint8))
+        for _ in range(500)
+    ]
+    fields = rng.integers(0, 39, 500)
+    for per_field in (True, False):
+        got = native.hash_tokens_batch(tokens, fields, 1_000_000, per_field)
+        want = hashing.hash_tokens_batch(tokens, fields, 1_000_000, per_field)
+        np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_native_u64_batch_matches_python(rng):
+    keys = rng.integers(0, 2**62, 300, dtype=np.uint64)
+    fields = rng.integers(0, 39, 300)
+    got = native.hash_u64_batch(keys, fields, 12345)
+    h = hashing.murmur3_u64(keys, fields.astype(np.uint32)) % np.uint32(12345)
+    want = h.astype(np.int64) + fields * 12345
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_native_criteo_parser_matches_python_oracle(tmp_path, rng):
+    path = str(tmp_path / "criteo.tsv")
+    criteo.synthesize_tsv(path, 200, seed=5)
+    raw = open(path, "rb").read()
+    ids_n, labels_n, consumed = native.parse_criteo_chunk(raw, 4096)
+    assert consumed == len(raw)
+    ids_p, labels_p = criteo.parse_lines(raw.splitlines(True), 4096)
+    np.testing.assert_array_equal(ids_n, ids_p)
+    np.testing.assert_array_equal(labels_n, labels_p)
+
+
+@needs_native
+def test_native_criteo_parser_rejects_malformed():
+    good = b"1" + b"\t1" * 13 + b"\tcafe" * 26 + b"\n"
+    for bad in [
+        b"1\t5\tabc\n",                                   # wrong column count
+        good.replace(b"\t1\t", b"\txy\t", 1),             # non-digit count
+        b"" + good[1:],                                   # empty label
+        good[:-1] + b"\textra\n",                         # extra column
+    ]:
+        with pytest.raises(ValueError, match="malformed"):
+            native.parse_criteo_chunk(bad, 4096)
+        with pytest.raises(ValueError):
+            criteo.parse_lines(bad.splitlines(True), 4096)
+
+
+def test_packed_batches_restore_different_chunking_raises(tmp_path):
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    b1 = PackedBatches(ds, 32, seed=1, chunk_size=128)
+    state = b1.state()
+    b2 = PackedBatches(ds, 32, seed=1, chunk_size=256)
+    with pytest.raises(ValueError, match="chunk_size"):
+        b2.restore(state)
+    b3 = PackedBatches(ds, 32, seed=1, chunk_size=128, shuffle=False)
+    with pytest.raises(ValueError, match="shuffle"):
+        b3.restore(state)
+
+
+def test_empty_packed_dataset_clear_error(tmp_path):
+    with PackedWriter(str(tmp_path / "e"), 4):
+        pass
+    with pytest.raises(ValueError, match="empty"):
+        PackedDataset(str(tmp_path / "e"))
+
+
+@needs_native
+def test_native_criteo_parser_partial_chunk(tmp_path):
+    path = str(tmp_path / "criteo.tsv")
+    criteo.synthesize_tsv(path, 10, seed=1)
+    raw = open(path, "rb").read()
+    cut = len(raw) - 25  # mid-line split
+    ids, labels, consumed = native.parse_criteo_chunk(raw[:cut], 4096)
+    assert consumed <= cut and ids.shape[0] == labels.shape[0] == 9
+    # feeding the tail completes the stream
+    ids2, _, c2 = native.parse_criteo_chunk(raw[consumed:], 4096)
+    assert ids.shape[0] + ids2.shape[0] == 10
+
+
+# ----------------------------------------------------------------- packed
+
+def _write_packed(tmp_path, n=1000, f=7, store_vals=True, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 5000, (n, f)).astype(np.int32)
+    vals = (
+        rng.random((n, f)).astype(np.float32)
+        if store_vals else np.ones((n, f), np.float32)
+    )
+    labels = rng.integers(0, 2, n).astype(np.int8)
+    with PackedWriter(str(tmp_path / "ds"), f, store_vals=store_vals) as w:
+        w.append(ids[:400], labels[:400], vals[:400])
+        w.append(ids[400:], labels[400:], vals[400:])
+    return ids, vals, labels
+
+
+@pytest.mark.parametrize("store_vals", [True, False])
+def test_packed_roundtrip(tmp_path, store_vals):
+    ids, vals, labels = _write_packed(tmp_path, store_vals=store_vals)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    assert len(ds) == 1000
+    gi, gv, gl = ds.slice(slice(None))
+    np.testing.assert_array_equal(gi, ids)
+    np.testing.assert_array_equal(gv, vals)
+    np.testing.assert_array_equal(gl, labels.astype(np.float32))
+
+
+def test_packed_writer_validates(tmp_path):
+    w = PackedWriter(str(tmp_path / "bad"), 4)
+    with pytest.raises(ValueError):
+        w.append(np.zeros((2, 3), np.int32), np.zeros(2, np.int8))
+    with pytest.raises(ValueError):
+        w.append(np.zeros((2, 4), np.int32), np.zeros(3, np.int8))
+    w.close()
+
+
+def test_packed_batches_cover_epoch(tmp_path):
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    b = PackedBatches(ds, 128, seed=3, chunk_size=256)
+    seen = []
+    total_w = 0.0
+    while b.epoch == 0:
+        ids, vals, labels, w = next(b)
+        assert ids.shape == (128, 7)
+        total_w += w.sum()
+        if b.epoch == 0 or b.index == 0:
+            seen.append((ids, w))
+    assert total_w == 1000  # every example exactly once (padding weight 0)
+
+
+def test_packed_batches_resume_exact(tmp_path):
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    b1 = PackedBatches(ds, 64, seed=9, chunk_size=128)
+    for _ in range(10):
+        next(b1)
+    state = b1.state()
+    want = [next(b1) for _ in range(8)]
+    b2 = PackedBatches(ds, 64, seed=9, chunk_size=128)
+    b2.restore(state)
+    got = [next(b2) for _ in range(8)]
+    for (wi, wv, wl, ww), (gi, gv, gl, gw) in zip(want, got):
+        np.testing.assert_array_equal(wi, gi)
+        np.testing.assert_array_equal(wl, gl)
+
+
+def test_packed_batches_host_shards_disjoint(tmp_path):
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    ranges = []
+    for h in range(4):
+        b = PackedBatches(ds, 32, host_index=h, num_hosts=4)
+        ranges.append(set(range(b.lo, b.hi)))
+    assert set().union(*ranges) == set(range(1000))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not ranges[i] & ranges[j]
+
+
+def test_packed_batches_wrong_restore_raises(tmp_path):
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    b = PackedBatches(ds, 32, seed=1)
+    with pytest.raises(ValueError):
+        b.restore({"epoch": 0, "index": 0, "seed": 2, "lo": b.lo, "hi": b.hi})
+    b2 = PackedBatches(ds, 32, seed=1, host_index=1, num_hosts=2)
+    with pytest.raises(ValueError):
+        b2.restore(b.state())
+
+
+# ---------------------------------------------------------------- parsers
+
+def test_criteo_preprocess_python_vs_native(tmp_path):
+    src = str(tmp_path / "c.tsv")
+    criteo.synthesize_tsv(src, 300, seed=2)
+    n1 = criteo.preprocess(src, str(tmp_path / "py"), 4096, use_native=False,
+                           chunk_bytes=4096)
+    ds_py = PackedDataset(str(tmp_path / "py"))
+    assert n1 == 300 and len(ds_py) == 300
+    if native.available():
+        n2 = criteo.preprocess(src, str(tmp_path / "nat"), 4096,
+                               use_native=True, chunk_bytes=4096)
+        ds_nat = PackedDataset(str(tmp_path / "nat"))
+        assert n2 == 300
+        np.testing.assert_array_equal(
+            np.asarray(ds_py.ids), np.asarray(ds_nat.ids)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ds_py.labels), np.asarray(ds_nat.labels)
+        )
+
+
+def test_avazu_preprocess(tmp_path):
+    src = str(tmp_path / "a.csv")
+    avazu.synthesize_csv(src, 150, seed=4)
+    n = avazu.preprocess(src, str(tmp_path / "av"), 2048)
+    ds = PackedDataset(str(tmp_path / "av"))
+    assert n == 150 and ds.num_fields == avazu.NUM_FIELDS
+    ids, vals, labels = ds.slice(slice(None))
+    assert np.all(vals == 1.0)
+    assert np.all((ids // 2048) == np.arange(avazu.NUM_FIELDS))
+    assert set(np.unique(labels)) <= {0.0, 1.0}
+
+
+def test_movielens_load(tmp_path):
+    src = str(tmp_path / "u.data")
+    movielens.synthesize_ratings(src, num_users=50, num_items=80,
+                                 num_ratings=600, seed=6)
+    (ids, vals, labels), meta = movielens.load_ratings(src)
+    assert ids.shape == (600, 2) and meta["num_features"] <= 130
+    assert np.all(ids[:, 0] < meta["num_users"])
+    assert np.all(ids[:, 1] >= meta["num_users"])
+    assert set(np.unique(labels)) <= {0.0, 1.0}
+    (_, _, reg_labels), _ = movielens.load_ratings(src, task="regression")
+    assert reg_labels.min() >= 1.0 and reg_labels.max() <= 5.0
+
+
+def test_libsvm_roundtrip(tmp_path, rng):
+    n, s = 40, 6
+    ids = np.sort(rng.integers(0, 100, (n, s)), axis=1).astype(np.int32)
+    vals = rng.random((n, s)).astype(np.float32)
+    vals[rng.random((n, s)) < 0.3] = 0.0  # variable nnz
+    labels = rng.integers(0, 2, n).astype(np.float32)
+    path = str(tmp_path / "d.svm")
+    libsvm.save_libsvm(path, ids, vals, labels)
+    gi, gv, gl = libsvm.load_libsvm(path, max_nnz=s)
+    np.testing.assert_array_equal(gl, labels)
+    # entries with val 0 were dropped on write; compare as sets per row
+    for r in range(n):
+        want = {(int(i), round(float(v), 5)) for i, v in zip(ids[r], vals[r]) if v != 0}
+        got = {(int(i), round(float(v), 5)) for i, v in zip(gi[r], gv[r]) if v != 0}
+        assert want == got
+
+
+def test_libsvm_overflow_raises(tmp_path):
+    path = str(tmp_path / "d.svm")
+    with open(path, "w") as f:
+        f.write("1 1:1 2:1 3:1\n0 1:1\n")
+    with pytest.raises(ValueError):
+        libsvm.load_libsvm(path, max_nnz=2)
+    ids, vals, _ = libsvm.load_libsvm(path, max_nnz=2, truncate=True)
+    assert ids.shape == (2, 2)
+
+
+def test_packed_end_to_end_training(tmp_path):
+    """Criteo TSV → packed → PackedBatches → FMTrainer: the full L2 path."""
+    import jax
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    src = str(tmp_path / "c.tsv")
+    criteo.synthesize_tsv(src, 600, seed=8)
+    bucket = 512
+    criteo.preprocess(src, str(tmp_path / "pk"), bucket)
+    ds = PackedDataset(str(tmp_path / "pk"))
+    spec = models.FieldFMSpec(
+        num_features=criteo.NUM_FIELDS * bucket, rank=4,
+        num_fields=criteo.NUM_FIELDS, bucket=bucket, init_std=0.01,
+    )
+    config = TrainConfig(num_steps=30, batch_size=128, learning_rate=0.1,
+                         optimizer="adagrad", lr_schedule="constant",
+                         log_every=30)
+    trainer = FMTrainer(spec, config)
+    batches = PackedBatches(ds, 128, seed=1)
+    trainer.fit(batches)
+    assert np.isfinite(trainer.loss_history[-1])
