@@ -1,0 +1,7 @@
+"""``python -m fm_spark_tpu`` → the CLI (see :mod:`fm_spark_tpu.cli`)."""
+
+import sys
+
+from fm_spark_tpu.cli import main
+
+sys.exit(main())

@@ -47,8 +47,22 @@ class FMModel:
         return cls(spec, params)
 
 
-class FMWithSGD:
-    """Minibatch-SGD FM training — the reference's entry-point class."""
+def _coerce_input(input, task):
+    """(ids, vals, labels) arrays + the spec kwargs every entry point shares."""
+    ids, vals, labels = input
+    ids = np.asarray(ids, np.int32)
+    vals = np.asarray(vals, np.float32)
+    labels = np.asarray(labels, np.float32)
+    spec_kwargs = dict(num_features=int(ids.max()) + 1, task=task)
+    if task == "regression":
+        spec_kwargs["min_target"] = float(labels.min())
+        spec_kwargs["max_target"] = float(labels.max())
+    return ids, vals, labels, spec_kwargs
+
+
+class _SGDEntryPoint:
+    """Shared minibatch-SGD driver for the reference-named entry points;
+    subclasses supply the model family via :meth:`_build_spec`."""
 
     def __init__(
         self,
@@ -70,28 +84,22 @@ class FMWithSGD:
         self.initStd = initStd
         self.seed = seed
 
+    def _build_spec(self, spec_kwargs, ids):
+        raise NotImplementedError
+
     def run(self, input) -> FMModel:
         """Train on ``input = (ids, vals, labels)`` and return the model."""
-        ids, vals, labels = input
-        ids = np.asarray(ids, np.int32)
-        vals = np.asarray(vals, np.float32)
-        labels = np.asarray(labels, np.float32)
+        ids, vals, labels, spec_kwargs = _coerce_input(input, self.task)
         k0, k1, k2 = self.dim
         r0, r1, r2 = self.regParam
-        num_features = int(ids.max()) + 1
-        spec_kwargs = dict(
-            num_features=num_features,
+        spec_kwargs.update(
             rank=int(k2),
-            task=self.task,
             loss="logistic" if self.task == "classification" else "squared",
             use_bias=bool(k0),
             use_linear=bool(k1),
             init_std=self.initStd,
         )
-        if self.task == "regression":
-            spec_kwargs["min_target"] = float(labels.min())
-            spec_kwargs["max_target"] = float(labels.max())
-        spec = models.FMSpec(**spec_kwargs)
+        spec = self._build_spec(spec_kwargs, ids)
         batch_size = max(1, int(math.ceil(self.miniBatchFraction * ids.shape[0])))
         config = TrainConfig(
             num_steps=self.numIterations,
@@ -109,6 +117,13 @@ class FMWithSGD:
         trainer.fit(Batches(ids, vals, labels, batch_size, seed=self.seed))
         return FMModel(spec, trainer.params)
 
+
+class FMWithSGD(_SGDEntryPoint):
+    """Minibatch-SGD FM training — the reference's entry-point class."""
+
+    def _build_spec(self, spec_kwargs, ids):
+        return models.FMSpec(**spec_kwargs)
+
     @staticmethod
     def train(
         input,
@@ -123,6 +138,101 @@ class FMWithSGD:
     ) -> FMModel:
         """Static overload matching the reference object's ``train``."""
         return FMWithSGD(
+            task, numIterations, stepSize, miniBatchFraction, dim, regParam,
+            initStd, seed,
+        ).run(input)
+
+
+class FMWithLBFGS:
+    """Full-batch L-BFGS FM training — the reference's second optimizer
+    (SURVEY.md §2 row 5): MLlib-style ``numCorrections`` history and
+    ``convergenceTol`` relative-decrease stopping over the same model."""
+
+    def __init__(
+        self,
+        task: str = "classification",
+        numIterations: int = 100,
+        numCorrections: int = 10,
+        convergenceTol: float = 1e-6,
+        dim: tuple = (True, True, 8),
+        regParam: tuple = (0.0, 0.0, 0.0),
+        initStd: float = 0.01,
+        seed: int = 0,
+    ):
+        self.task = task
+        self.numIterations = numIterations
+        self.numCorrections = numCorrections
+        self.convergenceTol = convergenceTol
+        self.dim = dim
+        self.regParam = regParam
+        self.initStd = initStd
+        self.seed = seed
+
+    def run(self, input) -> FMModel:
+        import jax
+
+        from fm_spark_tpu.lbfgs import fit_lbfgs
+
+        ids, vals, labels, spec_kwargs = _coerce_input(input, self.task)
+        k0, k1, k2 = self.dim
+        r0, r1, r2 = self.regParam
+        spec_kwargs.update(
+            rank=int(k2),
+            use_bias=bool(k0),
+            use_linear=bool(k1),
+            init_std=self.initStd,
+        )
+        spec = models.FMSpec(**spec_kwargs)
+        config = TrainConfig(reg_bias=r0, reg_linear=r1, reg_factors=r2)
+        params, _ = fit_lbfgs(
+            spec, spec.init(jax.random.key(self.seed)), ids, vals, labels,
+            config=config,
+            num_iterations=self.numIterations,
+            num_corrections=self.numCorrections,
+            convergence_tol=self.convergenceTol,
+        )
+        return FMModel(spec, params)
+
+    @staticmethod
+    def train(
+        input,
+        task: str = "classification",
+        numIterations: int = 100,
+        numCorrections: int = 10,
+        convergenceTol: float = 1e-6,
+        dim: tuple = (True, True, 8),
+        regParam: tuple = (0.0, 0.0, 0.0),
+        initStd: float = 0.01,
+        seed: int = 0,
+    ) -> FMModel:
+        """Static overload matching the reference object's ``train``."""
+        return FMWithLBFGS(
+            task, numIterations, numCorrections, convergenceTol, dim,
+            regParam, initStd, seed,
+        ).run(input)
+
+
+class FFMWithSGD(_SGDEntryPoint):
+    """Field-aware FM training entry point (reference config 4,
+    BASELINE.json:10); same argument surface as :class:`FMWithSGD`."""
+
+    def _build_spec(self, spec_kwargs, ids):
+        return models.FFMSpec(num_fields=int(ids.shape[1]), **spec_kwargs)
+
+    @staticmethod
+    def train(
+        input,
+        task: str = "classification",
+        numIterations: int = 100,
+        stepSize: float = 0.1,
+        miniBatchFraction: float = 1.0,
+        dim: tuple = (True, True, 4),
+        regParam: tuple = (0.0, 0.0, 0.0),
+        initStd: float = 0.01,
+        seed: int = 0,
+    ) -> FMModel:
+        """Static overload matching the reference object's ``train``."""
+        return FFMWithSGD(
             task, numIterations, stepSize, miniBatchFraction, dim, regParam,
             initStd, seed,
         ).run(input)

@@ -1,0 +1,158 @@
+"""The five benchmark run configs (BASELINE.json:7-11) as dataclasses.
+
+The reference has no config framework — hyperparameters are ``train()``
+arguments and cluster settings live in SparkConf (SURVEY.md §5 "Config /
+flag system"). The rebuild keeps that spirit: one frozen dataclass per
+benchmark config, a flat registry, and ``dataclasses.replace``-style CLI
+overrides (:mod:`fm_spark_tpu.cli`). No config-library dependency.
+
+Registry names map to the BASELINE table (SURVEY.md §6):
+
+- ``movielens_fm_r8``   — config 1: FM rank-8, MovieLens-100K, logistic
+  loss; the CPU-quality anchor.
+- ``criteo_kaggle_fm_r32`` — config 2: FM rank-32, Criteo-Kaggle 45M,
+  ~1M hashed features, data-parallel psum.
+- ``criteo1tb_fm_r64``  — config 3: FM rank-64, Criteo-1TB, ~10M hashed
+  features, field-partitioned tables (the bench.py headline layout) with
+  the row-sharded strategy as the scale-out path.
+- ``avazu_ffm_r16``     — config 4: FFM rank-16, Avazu CTR.
+- ``criteo1tb_deepfm``  — config 5 (stretch): DeepFM, FM + 3-layer MLP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fm_spark_tpu import models
+from fm_spark_tpu.train import TrainConfig
+
+_TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainConfig)}
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """One benchmark run: model family + shapes + data + training recipe."""
+
+    name: str
+    description: str
+    model: str                      # 'fm' | 'field_fm' | 'ffm' | 'deepfm'
+    dataset: str                    # 'movielens' | 'criteo' | 'avazu' | 'synthetic'
+    rank: int
+    num_fields: int                 # fixed nnz slot count
+    bucket: int = 0                 # per-field hash buckets; 0 ⇒ dense ids,
+                                    # num_features supplied by the data
+    strategy: str = "single"        # 'single' | 'dp' | 'row' | 'field_sparse'
+    task: str = "classification"
+    loss: str | None = None
+    param_dtype: str = "float32"
+    mlp_dims: tuple = (400, 400, 400)
+    # Training recipe (TrainConfig subset).
+    num_steps: int = 1000
+    batch_size: int = 8192
+    learning_rate: float = 0.1
+    lr_schedule: str = "inv_sqrt"
+    optimizer: str = "sgd"
+    reg_bias: float = 0.0
+    reg_linear: float = 0.0
+    reg_factors: float = 1e-6
+    seed: int = 0
+
+    @property
+    def num_features(self) -> int:
+        if self.bucket <= 0:
+            raise ValueError(
+                f"config {self.name!r} takes num_features from the data; "
+                "pass it to spec(num_features=...)"
+            )
+        return self.num_fields * self.bucket
+
+    def spec(self, num_features: int | None = None) -> models.ModelSpec:
+        """Build the model spec; ``num_features`` overrides the hashed size
+        (required for dense-id datasets like MovieLens)."""
+        n = num_features if num_features is not None else self.num_features
+        common = dict(
+            num_features=n, rank=self.rank, task=self.task, loss=self.loss,
+            init_std=0.01, param_dtype=self.param_dtype,
+        )
+        if self.model == "fm":
+            return models.FMSpec(**common)
+        if self.model == "field_fm":
+            if num_features is not None and num_features != self.num_features:
+                raise ValueError("field_fm shapes are fixed by num_fields*bucket")
+            return models.FieldFMSpec(
+                **common, num_fields=self.num_fields, bucket=self.bucket
+            )
+        if self.model == "ffm":
+            return models.FFMSpec(**common, num_fields=self.num_fields)
+        if self.model == "deepfm":
+            return models.DeepFMSpec(
+                **common, num_fields=self.num_fields, mlp_dims=self.mlp_dims
+            )
+        raise ValueError(f"unknown model family {self.model!r}")
+
+    def train_config(self, **overrides) -> TrainConfig:
+        base = {k: getattr(self, k) for k in _TRAIN_FIELDS if hasattr(self, k)}
+        base.update({k: v for k, v in overrides.items() if v is not None})
+        return TrainConfig(**base)
+
+
+CONFIGS = {
+    c.name: c
+    for c in [
+        RunConfig(
+            name="movielens_fm_r8",
+            description="Config 1 (BASELINE.json:7): FM rank-8, MovieLens-100K,"
+            " logistic loss; quality anchor vs the Spark local[*] CPU baseline.",
+            model="fm", dataset="movielens", rank=8, num_fields=2,
+            strategy="single", num_steps=2000, batch_size=4096,
+            learning_rate=0.05, reg_factors=1e-4, reg_linear=1e-5,
+        ),
+        RunConfig(
+            name="criteo_kaggle_fm_r32",
+            description="Config 2 (BASELINE.json:8): FM rank-32, Criteo-Kaggle"
+            " 45M, 39×32768 ≈ 1.28M per-field hashed features, data-parallel"
+            " psum over the mesh.",
+            model="fm", dataset="criteo", rank=32, num_fields=39,
+            bucket=1 << 15, strategy="dp", num_steps=100_000,
+            batch_size=16384, learning_rate=0.05, lr_schedule="constant",
+        ),
+        RunConfig(
+            name="criteo1tb_fm_r64",
+            description="Config 3 (BASELINE.json:9): FM rank-64, Criteo-1TB,"
+            " 39×262144 ≈ 10.2M hashed features; field-partitioned tables"
+            " (bench.py headline) via the fused sparse-SGD step; 'row' is the"
+            " multi-chip scale-out strategy.",
+            model="field_fm", dataset="criteo", rank=64, num_fields=39,
+            bucket=1 << 18, strategy="field_sparse", num_steps=1_000_000,
+            batch_size=1 << 17, learning_rate=0.05, lr_schedule="constant",
+        ),
+        RunConfig(
+            name="avazu_ffm_r16",
+            description="Config 4 (BASELINE.json:10): FFM rank-16, Avazu CTR,"
+            " 23 fields (avazu.py), per-field hashed.",
+            model="ffm", dataset="avazu", rank=16, num_fields=23,
+            bucket=1 << 14, strategy="single", num_steps=100_000,
+            batch_size=8192, learning_rate=0.05, lr_schedule="constant",
+        ),
+        RunConfig(
+            name="criteo1tb_deepfm",
+            description="Config 5, stretch (BASELINE.json:11): DeepFM — FM"
+            " rank-16 + 3-layer 400-wide MLP on Criteo shapes.",
+            model="deepfm", dataset="criteo", rank=16, num_fields=39,
+            bucket=1 << 18, strategy="dp", num_steps=1_000_000,
+            batch_size=16384, learning_rate=1e-3, lr_schedule="constant",
+            optimizer="adam",
+        ),
+    ]
+}
+
+
+def get_config(name: str, **overrides) -> RunConfig:
+    """Look up a registered config, optionally overriding fields."""
+    if name not in CONFIGS:
+        raise KeyError(
+            f"unknown config {name!r}; available: {sorted(CONFIGS)}"
+        )
+    cfg = CONFIGS[name]
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

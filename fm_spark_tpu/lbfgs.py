@@ -1,0 +1,127 @@
+"""Full-batch L-BFGS training — the reference's second optimizer.
+
+The lineage ships an ``FMWithLBFGS`` next to ``FMWithSGD`` (SURVEY.md §2
+row 5, §0.2 checklist), built on MLlib's ``LBFGS`` optimizer: full-batch
+gradients, ``numCorrections`` history pairs, ``convergenceTol`` stopping.
+Rebuild: ``optax.lbfgs`` (memory_size = numCorrections, zoom linesearch)
+with the whole optimization as ONE compiled ``lax.while_loop`` program —
+no per-iteration host round-trip, the TPU-native answer to MLlib's
+driver-mediated aggregate-per-iteration loop (SURVEY.md §3.1).
+
+L2 regularization enters the *objective* (MLlib's squaredL2Updater-style
+``loss + (r/2)·‖θ‖²``, with the (r0, r1, r2) triple applied per group),
+not the gradient post-hoc — L-BFGS needs objective and gradient consistent
+for its linesearch and curvature pairs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fm_spark_tpu.ops import losses as losses_lib
+from fm_spark_tpu.train import TrainConfig
+
+
+def make_objective(spec, config: TrainConfig, ids, vals, labels, weights):
+    """Full-batch regularized objective ``f(params) -> scalar``."""
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    wsum = jnp.maximum(jnp.sum(weights), 1.0)
+    reg_of = {"w0": config.reg_bias, "w": config.reg_linear,
+              "v": config.reg_factors, "mlp": config.reg_factors,
+              "vw": config.reg_factors}
+
+    def objective(params):
+        scores = spec.scores(params, ids, vals)
+        data_loss = jnp.sum(per_example_loss(scores, labels) * weights) / wsum
+
+        def one(path, p):
+            top = str(getattr(path[0], "key", getattr(path[0], "idx", path[0])))
+            r = reg_of.get(top)
+            if r is None:
+                raise ValueError(f"no regularization group for param {top!r}")
+            if r == 0.0:
+                return jnp.zeros((), jnp.float32)
+            return 0.5 * r * jnp.sum(jnp.square(p.astype(jnp.float32)))
+
+        reg = sum(
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map_with_path(one, params)
+            )
+        )
+        return data_loss + reg
+
+    return objective
+
+
+def fit_lbfgs(
+    spec,
+    params,
+    ids,
+    vals,
+    labels,
+    weights=None,
+    *,
+    config: TrainConfig | None = None,
+    num_iterations: int = 100,
+    num_corrections: int = 10,
+    convergence_tol: float = 1e-6,
+):
+    """Minimize the full-batch objective from ``params``; returns
+    ``(params, info)`` where info has the final loss, gradient norm, and
+    iteration count. Stops at ``num_iterations`` or when the relative
+    objective decrease falls below ``convergence_tol`` (MLlib semantics).
+    """
+    config = config or TrainConfig()
+    ids = jnp.asarray(ids)
+    vals = jnp.asarray(vals)
+    labels = jnp.asarray(labels)
+    weights = (
+        jnp.ones(labels.shape, jnp.float32)
+        if weights is None
+        else jnp.asarray(weights)
+    )
+    objective = make_objective(spec, config, ids, vals, labels, weights)
+    opt = optax.lbfgs(memory_size=num_corrections)
+
+    value_and_grad = optax.value_and_grad_from_state(objective)
+
+    # carry = (params, state, i, prev, cur) with prev/cur the objective at
+    # the params of the previous/current iterate — ``cur`` is f(params)
+    # BEFORE this body's update, so consecutive bodies see consecutive
+    # objective values and the relative-decrease test is meaningful.
+    def cond(carry):
+        _, _, i, prev, cur = carry
+        rel = jnp.where(
+            jnp.isfinite(prev),
+            jnp.abs(prev - cur) / jnp.maximum(jnp.abs(prev), 1e-12),
+            jnp.inf,
+        )
+        return jnp.logical_and(i < num_iterations,
+                               jnp.logical_or(i < 1, rel > convergence_tol))
+
+    def body(carry):
+        params, state, i, _, cur = carry
+        value, grad = value_and_grad(params, state=state)
+        updates, state = opt.update(
+            grad, state, params, value=value, grad=grad, value_fn=objective
+        )
+        params = optax.apply_updates(params, updates)
+        return params, state, i + 1, cur, value
+
+    @jax.jit
+    def run(params):
+        state = opt.init(params)
+        carry = (params, state, jnp.int32(0), jnp.float32(jnp.inf),
+                 jnp.float32(jnp.inf))
+        params, state, i, _, _ = jax.lax.while_loop(cond, body, carry)
+        value, grad = jax.value_and_grad(objective)(params)
+        return params, {
+            "loss": value,
+            "grad_norm": optax.global_norm(grad),
+            "iterations": i,
+        }
+
+    params, info = run(params)
+    return params, {k: float(v) for k, v in info.items()}

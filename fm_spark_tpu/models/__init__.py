@@ -13,6 +13,7 @@ from fm_spark_tpu.models.ffm import FFMSpec  # noqa: F401
 from fm_spark_tpu.models.deepfm import DeepFMSpec  # noqa: F401
 from fm_spark_tpu.models.field_fm import FieldFMSpec  # noqa: F401
 from fm_spark_tpu.models.io import save_model, load_model  # noqa: F401
+from fm_spark_tpu.models.libfm_io import save_libfm, load_libfm  # noqa: F401
 
 
 def build(spec):

@@ -1,0 +1,108 @@
+"""libFM text model format — import/export with the reference lineage.
+
+The spark-libFM family descends from Rendle's libFM, whose ``--save_model``
+text format is the de-facto interchange for FM weights (SURVEY.md §5
+"Checkpoint / resume": an import/export path for the reference's
+final-model format, so models can be cross-validated between the reference
+and this framework). Layout (sections present iff dim k0/k1/k2 enable
+them)::
+
+    #global bias W0
+    <w0>
+    #unary interactions Wj
+    <one weight per line, feature-major>
+    #pairwise interactions Vj,f
+    <k space-separated factors per line, feature-major>
+
+Export flattens FieldFM layouts to the plain [n, k] table first; import
+always yields a flat :class:`~fm_spark_tpu.models.fm.FMSpec`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_BIAS_HDR = "#global bias W0"
+_UNARY_HDR = "#unary interactions Wj"
+_PAIR_HDR = "#pairwise interactions Vj,f"
+
+
+def save_libfm(path: str, spec, params: dict) -> None:
+    """Write ``params`` in libFM text format (sections per dim triple)."""
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
+    from fm_spark_tpu.models.fm import FMSpec
+
+    if isinstance(spec, FieldFMSpec):
+        params = spec.to_flat_params(params)
+    elif not isinstance(spec, FMSpec):
+        # FFM's [n, F, k] factors and DeepFM's MLP have no libFM
+        # representation — refusing beats silently dropping weights.
+        raise ValueError(
+            f"libFM format holds plain FM models only, not "
+            f"{type(spec).__name__}"
+        )
+    w0 = float(np.asarray(params["w0"]))
+    w = np.asarray(params["w"], np.float64)
+    v = np.asarray(params["v"], np.float64)
+    with open(path, "w") as f:
+        if spec.use_bias:
+            f.write(f"{_BIAS_HDR}\n{w0:.17g}\n")
+        if spec.use_linear:
+            f.write(_UNARY_HDR + "\n")
+            f.writelines(f"{x:.17g}\n" for x in w)
+        f.write(_PAIR_HDR + "\n")
+        for row in v:
+            f.write(" ".join(f"{x:.17g}" for x in row) + "\n")
+
+
+def load_libfm(path: str, task: str = "classification", **spec_kwargs):
+    """Read a libFM text model → ``(FMSpec, params)``.
+
+    ``spec_kwargs`` pass through to :class:`FMSpec` (e.g. regression
+    min/max clip). Missing sections → the corresponding dim flag off.
+    """
+    import jax.numpy as jnp
+
+    from fm_spark_tpu.models.fm import FMSpec
+
+    with open(path) as f:
+        lines = [ln.rstrip("\n") for ln in f]
+
+    sections: dict[str, list[str]] = {}
+    current = None
+    for ln in lines:
+        if ln.startswith("#"):
+            current = ln
+            sections[current] = []
+        elif current is not None and ln.strip():
+            sections[current].append(ln)
+
+    if _PAIR_HDR not in sections:
+        raise ValueError(f"{path}: missing {_PAIR_HDR!r} section")
+    v = np.asarray(
+        [[float(x) for x in ln.split()] for ln in sections[_PAIR_HDR]],
+        np.float32,
+    )
+    n, rank = v.shape
+    use_bias = _BIAS_HDR in sections
+    use_linear = _UNARY_HDR in sections
+    w0 = float(sections[_BIAS_HDR][0]) if use_bias else 0.0
+    if use_linear:
+        w = np.asarray([float(ln) for ln in sections[_UNARY_HDR]], np.float32)
+        if w.shape[0] != n:
+            raise ValueError(
+                f"{path}: {w.shape[0]} unary weights but {n} factor rows"
+            )
+    else:
+        w = np.zeros((n,), np.float32)
+
+    spec = FMSpec(
+        num_features=n, rank=rank, task=task,
+        use_bias=use_bias, use_linear=use_linear, **spec_kwargs,
+    )
+    params = {
+        "w0": jnp.asarray(w0, jnp.float32),
+        "w": jnp.asarray(w, spec.pdtype),
+        "v": jnp.asarray(v, spec.pdtype),
+    }
+    return spec, params

@@ -1,0 +1,89 @@
+"""FMWithLBFGS / fit_lbfgs: convergence, regularization, compat surface."""
+
+import jax
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.compat import FMWithLBFGS, FMWithSGD, evaluate
+from fm_spark_tpu.data import synthetic_ctr
+from fm_spark_tpu.lbfgs import fit_lbfgs
+from fm_spark_tpu.train import TrainConfig
+
+
+def test_lbfgs_drives_loss_down_on_planted_fm():
+    ids, vals, labels = synthetic_ctr(2000, 200, 4, rank=3, seed=1)
+    spec = models.FMSpec(num_features=200, rank=4, init_std=0.05)
+    params0 = spec.init(jax.random.key(0))
+    from fm_spark_tpu.lbfgs import make_objective
+
+    obj = make_objective(
+        spec, TrainConfig(),
+        np.asarray(ids), np.asarray(vals), np.asarray(labels),
+        np.ones(labels.shape, np.float32),
+    )
+    before = float(obj(params0))
+    params, info = fit_lbfgs(
+        spec, params0, ids, vals, labels, num_iterations=60,
+    )
+    assert info["loss"] < before - 0.05
+    assert np.isfinite(info["grad_norm"])
+    assert 1 <= info["iterations"] <= 60
+
+
+def test_lbfgs_convergence_tol_stops_early():
+    ids, vals, labels = synthetic_ctr(500, 100, 3, seed=2)
+    spec = models.FMSpec(num_features=100, rank=2, init_std=0.05)
+    params, info = fit_lbfgs(
+        spec, spec.init(jax.random.key(0)), ids, vals, labels,
+        num_iterations=500, convergence_tol=1e-2,
+    )
+    assert info["iterations"] < 500
+
+
+def test_lbfgs_regularization_shrinks_weights():
+    ids, vals, labels = synthetic_ctr(1000, 100, 3, seed=3)
+    spec = models.FMSpec(num_features=100, rank=3, init_std=0.05)
+    p0 = spec.init(jax.random.key(0))
+    free, _ = fit_lbfgs(spec, p0, ids, vals, labels, num_iterations=40)
+    reg, _ = fit_lbfgs(
+        spec, p0, ids, vals, labels, num_iterations=40,
+        config=TrainConfig(reg_linear=1.0, reg_factors=1.0),
+    )
+    assert float(np.square(reg["v"]).sum()) < float(np.square(free["v"]).sum())
+    assert float(np.square(reg["w"]).sum()) < float(np.square(free["w"]).sum())
+
+
+def test_compat_fmwithlbfgs_beats_chance_and_roughly_matches_sgd():
+    data = synthetic_ctr(3000, 150, 4, rank=3, seed=4)
+    m_lbfgs = FMWithLBFGS.train(
+        data, numIterations=50, dim=(True, True, 4), regParam=(0, 1e-4, 1e-4)
+    )
+    auc_lbfgs = evaluate(m_lbfgs, data)["auc"]
+    m_sgd = FMWithSGD.train(
+        data, numIterations=300, stepSize=0.5, miniBatchFraction=0.2,
+        dim=(True, True, 4),
+    )
+    auc_sgd = evaluate(m_sgd, data)["auc"]
+    assert auc_lbfgs > 0.65
+    assert auc_lbfgs > auc_sgd - 0.05  # same model class, same ballpark
+
+
+def test_compat_fmwithlbfgs_regression_clips():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 50, size=(400, 3)).astype(np.int32)
+    vals = np.ones(ids.shape, np.float32)
+    labels = rng.uniform(1.0, 5.0, 400).astype(np.float32)
+    model = FMWithLBFGS.train(
+        (ids, vals, labels), task="regression", numIterations=30
+    )
+    preds = model.predict(ids, vals)
+    assert preds.min() >= 1.0 - 1e-5
+    assert preds.max() <= 5.0 + 1e-5
+
+
+def test_dim_flags_respected():
+    data = synthetic_ctr(500, 80, 3, seed=5)
+    model = FMWithLBFGS.train(data, numIterations=10, dim=(False, False, 2))
+    assert float(np.asarray(model.params["w0"])) == 0.0
+    assert float(np.abs(np.asarray(model.params["w"])).max()) == 0.0
