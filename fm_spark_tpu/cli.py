@@ -42,13 +42,20 @@ def _field_local(ids: np.ndarray, bucket: int) -> np.ndarray:
     return ids - offs[None, :]
 
 
+def _is_packed_dir(path) -> bool:
+    import os
+
+    return bool(path) and os.path.isdir(path)
+
+
 def load_dataset(cfg, args) -> tuple:
     """Return ``(ids, vals, labels, num_features)`` per the config's dataset.
 
     ``--synthetic N`` works for every config (planted-FM CTR data shaped
     like the config); otherwise ``--data`` is interpreted by dataset kind:
-    movielens → ratings file, criteo/avazu → a packed dir written by
-    ``preprocess`` (or a raw text file, parsed in-memory), libsvm → text.
+    movielens → ratings file, criteo/avazu → a raw text file (parsed
+    in-memory; packed dirs stream via :class:`StreamingBatches` in
+    ``train`` instead of loading here), libsvm → text.
     """
     from fm_spark_tpu import data as data_lib
 
@@ -80,21 +87,21 @@ def load_dataset(cfg, args) -> tuple:
         return ids, vals, labels, meta["num_features"]
 
     if cfg.dataset in ("criteo", "avazu"):
-        import os
-
-        if os.path.isdir(args.data):  # packed dir from `preprocess`
-            ds = data_lib.PackedDataset(args.data)
-            ids, vals, labels = ds.slice(slice(None))
-        else:  # small raw text file: parse in memory
-            mod = __import__(
-                f"fm_spark_tpu.data.{cfg.dataset}", fromlist=["parse_lines"]
+        if _is_packed_dir(args.data):
+            raise SystemExit(
+                "packed dirs are streamed, not loaded whole; this path "
+                "handles text files (bug: caller should use StreamingBatches)"
             )
-            with open(args.data, "rb") as f:
-                lines = f.read().splitlines()
-            if cfg.dataset == "avazu" and lines and lines[0].startswith(b"id,"):
-                lines = lines[1:]
-            ids, labels = mod.parse_lines(lines, cfg.bucket, per_field=True)
-            vals = np.ones(ids.shape, np.float32)
+        # Small raw text file: parse in memory.
+        mod = __import__(
+            f"fm_spark_tpu.data.{cfg.dataset}", fromlist=["parse_lines"]
+        )
+        with open(args.data, "rb") as f:
+            lines = f.read().splitlines()
+        if cfg.dataset == "avazu" and lines and lines[0].startswith(b"id,"):
+            lines = lines[1:]
+        ids, labels = mod.parse_lines(lines, cfg.bucket, per_field=True)
+        vals = np.ones(ids.shape, np.float32)
         if cfg.model == "field_fm":
             ids = _field_local(ids, cfg.bucket)
         return ids, vals, labels, cfg.num_features
@@ -104,6 +111,38 @@ def load_dataset(cfg, args) -> tuple:
         return ids, vals, labels, num_features
 
     raise SystemExit(f"don't know how to load dataset kind {cfg.dataset!r}")
+
+
+class StreamingBatches:
+    """Resumable batch source over a packed dir, with optional conversion
+    of per-field-offset global ids to field-local ids (FieldFM layout).
+
+    Wraps :class:`fm_spark_tpu.data.PackedBatches` — memory-mapped,
+    chunk-shuffled, never materializes the dataset (a Criteo-1TB packed
+    dir is hundreds of GB; whole-array loading would OOM the host).
+    """
+
+    def __init__(self, packed, bucket: int = 0):
+        self._inner = packed
+        self._bucket = bucket
+
+    def next_batch(self):
+        ids, vals, labels, weights = next(self._inner)
+        if self._bucket:
+            ids = _field_local(ids, self._bucket)
+        return ids, vals, labels, weights
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+    def state(self) -> dict:
+        return self._inner.state()
+
+    def restore(self, state: dict) -> None:
+        self._inner.restore(state)
 
 
 # ----------------------------------------------------------------- train
@@ -122,35 +161,69 @@ def _resume(checkpointer, params, opt_state, batches):
 
 
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
-    """Training loop on the fused sparse-SGD step (FieldFMSpec fast path)."""
+    """Training loop on the fused sparse-SGD step (FieldFMSpec fast path).
+
+    On one device this is the single-chip fused step; with multiple
+    devices the field-sharded layout (parallel/field_step.py) is used —
+    tables partitioned over chips, all_to_all batch re-shard inside the
+    step.
+    """
     import jax
     import jax.numpy as jnp
 
-    from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+    n = jax.device_count()
+    canonical = spec.init(jax.random.key(tconfig.seed))
+    # Checkpoints always use the canonical per-field-list layout so a run
+    # can resume on a different device count (plain SGD has no optimizer
+    # state; an empty dict stands in for it).
+    canonical, _, start = _resume(checkpointer, canonical, {}, batches)
 
-    step = make_field_sparse_sgd_step(spec, tconfig)
-    params = spec.init(jax.random.key(tconfig.seed))
-    # Plain SGD has no optimizer state; checkpoint an empty dict for it.
-    params, _, start = _resume(checkpointer, params, {}, batches)
+    if n > 1:
+        if tconfig.batch_size % n:
+            raise SystemExit(
+                f"batch_size={tconfig.batch_size} must be divisible by the "
+                f"device count ({n}) for the field-sharded strategy"
+            )
+        from fm_spark_tpu.parallel import (
+            make_field_mesh, make_field_sharded_sgd_step, pad_field_batch,
+            shard_field_batch, shard_field_params, stack_field_params,
+            unstack_field_params,
+        )
+
+        mesh = make_field_mesh(n)
+        step = make_field_sharded_sgd_step(spec, tconfig, mesh)
+        params = shard_field_params(
+            stack_field_params(spec, canonical, n), mesh
+        )
+        prep = lambda b: shard_field_batch(
+            pad_field_batch(b, spec.num_fields, n), mesh
+        )
+        to_canonical = lambda p: unstack_field_params(spec, jax.device_get(p))
+    else:
+        from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+
+        step = make_field_sparse_sgd_step(spec, tconfig)
+        params = canonical
+        prep = lambda b: tuple(map(jnp.asarray, b))
+        to_canonical = lambda p: p
+
     log_every = max(tconfig.log_every, 1)
     since = 0
     for i in range(start, tconfig.num_steps):
-        ids, vals, labels, weights = batches.next_batch()
-        params, loss = step(
-            params, jnp.int32(i), jnp.asarray(ids), jnp.asarray(vals),
-            jnp.asarray(labels), jnp.asarray(weights),
-        )
-        since += len(labels)
+        batch = batches.next_batch()
+        params, loss = step(params, jnp.int32(i), *prep(batch))
+        since += len(batch[2])
         if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
             logger.log(i + 1, samples=since, loss=float(loss))
             since = 0
-        if checkpointer is not None:
-            checkpointer.maybe_save(i + 1, params, {}, batches.state())
+        if checkpointer is not None and checkpointer.due(i + 1):
+            checkpointer.save(i + 1, to_canonical(params), {},
+                              batches.state())
     if checkpointer is not None:
-        checkpointer.save(tconfig.num_steps, params, {}, batches.state(),
-                          force=True)
+        checkpointer.save(tconfig.num_steps, to_canonical(params), {},
+                          batches.state(), force=True)
         checkpointer.wait()
-    return params
+    return to_canonical(params)
 
 
 def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None):
@@ -209,17 +282,32 @@ def cmd_train(args) -> int:
         learning_rate=args.lr, strategy=args.strategy, seed=args.seed,
         optimizer=args.optimizer,
     )
-    ids, vals, labels, num_features = load_dataset(cfg, args)
-    spec = cfg.spec(num_features if cfg.bucket <= 0 else None)
-    (tr, te) = (
-        train_test_split(ids, vals, labels, args.test_fraction, seed=cfg.seed)
-        if args.test_fraction > 0
-        else ((ids, vals, labels), None)
-    )
     tconfig = cfg.train_config(
         log_every=args.log_every, metrics_path=args.metrics
     )
-    batches = Batches(*tr, tconfig.batch_size, seed=cfg.seed)
+
+    te = None
+    if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
+        # Large preprocessed data: stream from the memory-mapped packed
+        # dir; held-out evaluation is a separate `eval` invocation.
+        from fm_spark_tpu.data import PackedBatches, PackedDataset
+
+        spec = cfg.spec()
+        batches = StreamingBatches(
+            PackedBatches(PackedDataset(args.data), tconfig.batch_size,
+                          seed=cfg.seed),
+            bucket=cfg.bucket if cfg.model == "field_fm" else 0,
+        )
+    else:
+        ids, vals, labels, num_features = load_dataset(cfg, args)
+        spec = cfg.spec(num_features if cfg.bucket <= 0 else None)
+        (tr, te) = (
+            train_test_split(ids, vals, labels, args.test_fraction,
+                             seed=cfg.seed)
+            if args.test_fraction > 0
+            else ((ids, vals, labels), None)
+        )
+        batches = Batches(*tr, tconfig.batch_size, seed=cfg.seed)
 
     import contextlib
 
@@ -237,22 +325,25 @@ def cmd_train(args) -> int:
         _jax.profiler.trace(args.profile) if args.profile
         else contextlib.nullcontext()
     )
-    logger = MetricsLogger(path=tconfig.metrics_path,
-                           n_chips=_jax.device_count())
     strategy = cfg.strategy
     with profile_ctx:
         if strategy == "single":
             trainer = FMTrainer(spec, tconfig)
             trainer.fit(batches, checkpointer=checkpointer)
             params = trainer.params
-        elif strategy == "field_sparse":
-            params = _fit_field_sparse(spec, tconfig, batches, logger,
-                                       checkpointer)
-        elif strategy in ("dp", "row"):
-            params = _fit_parallel(spec, tconfig, batches, strategy, logger,
-                                   checkpointer)
         else:
-            raise SystemExit(f"unknown strategy {strategy!r}")
+            # FMTrainer logs through its own MetricsLogger; these loops
+            # need one built for them.
+            logger = MetricsLogger(path=tconfig.metrics_path,
+                                   n_chips=_jax.device_count())
+            if strategy == "field_sparse":
+                params = _fit_field_sparse(spec, tconfig, batches, logger,
+                                           checkpointer)
+            elif strategy in ("dp", "row"):
+                params = _fit_parallel(spec, tconfig, batches, strategy,
+                                       logger, checkpointer)
+            else:
+                raise SystemExit(f"unknown strategy {strategy!r}")
 
     if te is not None:
         from fm_spark_tpu.data import iterate_once
@@ -271,18 +362,38 @@ def cmd_train(args) -> int:
 
 
 def _load_for_model(args, spec):
-    """Load eval/predict data shaped for an already-trained model."""
-    from fm_spark_tpu import configs as configs_lib
+    """Load eval/predict data shaped for an already-trained model.
 
-    cfg_name = args.config
-    if cfg_name is None:
-        # Infer dataset kind from the spec family for the common cases.
-        cfg_name = {
-            "FieldFMSpec": "criteo1tb_fm_r64",
-            "FFMSpec": "avazu_ffm_r16",
-            "DeepFMSpec": "criteo1tb_deepfm",
-        }.get(type(spec).__name__, "movielens_fm_r8")
-    cfg = configs_lib.get_config(cfg_name)
+    ``--synthetic N`` derives shapes from the model's own spec (never a
+    config guess — mismatched shapes would silently clamp out-of-range
+    ids into the table edge and print meaningless metrics). ``--data``
+    needs ``--config`` to name the parser, and the config's feature
+    space must match the model's.
+    """
+    from fm_spark_tpu import configs as configs_lib
+    from fm_spark_tpu import data as data_lib
+
+    if args.synthetic:
+        nnz = getattr(spec, "num_fields", 0) or min(8, spec.num_features)
+        ids, vals, labels = data_lib.synthetic_ctr(
+            args.synthetic, spec.num_features, nnz, seed=1
+        )
+        if type(spec).__name__ == "FieldFMSpec":
+            ids = _field_local(ids, spec.bucket)
+        return ids, vals, labels
+
+    if args.config is None:
+        raise SystemExit(
+            "eval/predict with --data needs --config to name the dataset "
+            "loader (use --synthetic N for config-free smoke checks)"
+        )
+    cfg = configs_lib.get_config(args.config)
+    if cfg.bucket > 0 and cfg.num_features != spec.num_features:
+        raise SystemExit(
+            f"config {cfg.name!r} encodes {cfg.num_features} features but "
+            f"the model was trained with {spec.num_features}; ids would be "
+            "silently clamped — pass the config the model was trained with"
+        )
     ids, vals, labels, _ = load_dataset(cfg, args)
     return ids, vals, labels
 
@@ -422,9 +533,6 @@ def main(argv=None) -> int:
         except Exception:
             pass
     args = build_parser().parse_args(argv)
-    # eval/predict reuse --batch-size but argparse default handling differs
-    if getattr(args, "batch_size", None) is None:
-        args.batch_size = 8192
     return args.fn(args)
 
 

@@ -28,3 +28,12 @@ from fm_spark_tpu.parallel.step import (  # noqa: F401
     make_parallel_train_step,
     make_parallel_eval_step,
 )
+from fm_spark_tpu.parallel.field_step import (  # noqa: F401
+    make_field_mesh,
+    make_field_sharded_sgd_step,
+    pad_field_batch,
+    shard_field_batch,
+    shard_field_params,
+    stack_field_params,
+    unstack_field_params,
+)

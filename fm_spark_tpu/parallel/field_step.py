@@ -1,0 +1,233 @@
+"""Field-sharded fused sparse-SGD: the multi-chip layout of FieldFM.
+
+Single-chip measurements (PERF.md) show the FieldFM hot path is bound by
+per-index gather/scatter rate, not FLOPs or ICI. The scale-out that
+multiplies that rate is sharding the *fields* over the mesh: with F
+fields on n chips, each chip owns F/n sub-tables outright and performs
+only ``B·F/n`` index ops per step — an 8× index-rate multiplier on a
+v5e-8 (5 fields/chip at Criteo's 39).
+
+Step anatomy (one compiled program, two collectives):
+
+1. The host feeds each chip ``1/n`` of the batch (rows). One
+   ``all_to_all`` over ``feat`` re-shards it from row-sharded to
+   column(field)-sharded: ``[B/n, F_pad] → [B, F_pad/n]`` — the "batch
+   all-gather" lever from PERF.md; ids+vals ≈ 8·B·F bytes cross ICI,
+   the 10M-row tables never move. Labels/weights ride one small
+   ``all_gather``.
+2. Each chip gathers its fields' rows, forms partial interaction sums;
+   one ``psum`` of ``([B,k], [B], [B])`` reconstructs exact scores on
+   every chip (the linear-reduction identity, SURVEY.md §2).
+3. Every chip computes the same ``dscores`` from replicated scores, then
+   scatters updates into only its own tables — single-owner writes, so
+   no cross-chip reduction of table gradients exists at all. Compare the
+   reference, which tree-aggregates a full dense gradient every
+   iteration (SURVEY.md §3.1).
+
+Tables are uniquely owned per field, so this mesh is 1-D over ``feat``.
+Scaling the row capacity further (row-sharding *within* fields over a
+second axis) is the documented follow-on; index rate — the measured
+bottleneck — scales with this axis.
+
+Layout: per-field tables stacked into ``[F_pad, bucket, width]`` sharded
+``P('feat')``; ``F_pad`` rounds F up to the mesh size so chips own equal
+table counts. Padded fields carry zero tables and ``val=0`` batch
+columns, keeping them exactly inert through forward, backward, and the
+lazy-L2 decay. Math/update semantics are identical to the single-chip
+:func:`fm_spark_tpu.sparse.make_field_sparse_sgd_body`; equivalence is
+property-tested on the fake 8-device CPU mesh (tests/test_field_step.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fm_spark_tpu.ops import losses as losses_lib
+from fm_spark_tpu.train import TrainConfig
+
+
+def make_field_mesh(n_devices: int | None = None, devices=None):
+    """1-D ``feat`` mesh over the chips (field-sharded layout)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return jax.sharding.Mesh(np.asarray(devices), ("feat",))
+
+
+def padded_num_fields(num_fields: int, n_feat: int) -> int:
+    return -(-num_fields // n_feat) * n_feat
+
+
+def stack_field_params(spec, params, n_feat: int) -> dict:
+    """Per-field table list → ``{"w0", "vw": [F_pad, bucket, width]}``."""
+    if not spec.fused_linear:
+        raise ValueError("field-sharded step requires fused_linear=True")
+    f_pad = padded_num_fields(spec.num_fields, n_feat)
+    tables = list(params["vw"])
+    pad = f_pad - len(tables)
+    if pad:
+        tables += [jnp.zeros_like(tables[0])] * pad
+    return {"w0": params["w0"], "vw": jnp.stack(tables, axis=0)}
+
+
+def unstack_field_params(spec, stacked: dict) -> dict:
+    """Inverse of :func:`stack_field_params` (drops padding fields)."""
+    vw = stacked["vw"]
+    return {
+        "w0": stacked["w0"],
+        "vw": [vw[f] for f in range(spec.num_fields)],
+    }
+
+
+def pad_field_batch(batch, num_fields: int, n_feat: int):
+    """Zero-pad ``(ids, vals, labels, weights)`` to ``F_pad`` field slots."""
+    import numpy as np
+
+    ids, vals, labels, weights = batch
+    f_pad = padded_num_fields(num_fields, n_feat)
+    pad = f_pad - ids.shape[1]
+    if pad:
+        ids = np.concatenate(
+            [ids, np.zeros((ids.shape[0], pad), ids.dtype)], axis=1
+        )
+        vals = np.concatenate(
+            [vals, np.zeros((vals.shape[0], pad), vals.dtype)], axis=1
+        )
+    return ids, vals, labels, weights
+
+
+# Batch enters row-sharded over the chips; the step's all_to_all turns it
+# field-sharded on device.
+BATCH_SPECS = (P("feat", None), P("feat", None), P("feat"), P("feat"))
+PARAM_SPECS = {"w0": P(), "vw": P("feat", None, None)}
+
+
+def shard_field_params(stacked: dict, mesh) -> dict:
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, PARAM_SPECS[k]))
+        for k, v in stacked.items()
+    }
+
+
+def shard_field_batch(batch, mesh):
+    return tuple(
+        jax.device_put(jnp.asarray(x), NamedSharding(mesh, s))
+        for x, s in zip(batch, BATCH_SPECS)
+    )
+
+
+def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
+    """Unjitted ``(params, step_idx, ids, vals, labels, weights) →
+    (params, loss)`` over stacked/sharded inputs; same semantics as the
+    single-chip fused body. Exposed unjitted so callers can roll steps
+    into one ``fori_loop`` program (bench.py pattern)."""
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
+
+    if type(spec) is not FieldFMSpec:
+        raise ValueError("expected a FieldFMSpec")
+    if not spec.fused_linear:
+        raise ValueError("field-sharded step requires fused_linear=True")
+    if config.optimizer != "sgd":
+        raise ValueError("sparse step implements plain SGD only")
+    if set(mesh.axis_names) != {"feat"}:
+        raise ValueError(
+            "field-sharded step runs on a 1-D ('feat',) mesh — tables are "
+            "single-owner per field; see module docstring (use "
+            "make_field_mesh)"
+        )
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    k = spec.rank
+    n_feat = mesh.shape["feat"]
+    f_local = padded_num_fields(spec.num_fields, n_feat) // n_feat
+
+    if config.lr_schedule == "inv_sqrt":
+        lr_at = lambda i: config.learning_rate / jnp.sqrt(i.astype(jnp.float32) + 1.0)
+    else:
+        lr_at = lambda i: jnp.float32(config.learning_rate)
+
+    def local_step(params, step_idx, ids, vals, labels, weights):
+        # Local blocks in: vw [f_local, bucket, width]; ids/vals
+        # [B/n, F_pad]; labels/weights [B/n].
+        vw = params["vw"]
+        w0 = params["w0"]
+        # Row-sharded → field-sharded: [B/n, F_pad] → [B, f_local].
+        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
+                             tiled=True)
+        vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
+                              tiled=True)
+        labels = lax.all_gather(labels, "feat", tiled=True)
+        weights = lax.all_gather(weights, "feat", tiled=True)
+
+        vals_c = vals.astype(cd)
+        rows = [vw[f][ids[:, f]].astype(cd) for f in range(f_local)]
+        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        s_p = sum(xvs)
+        sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
+        lin_p = (
+            sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
+            if spec.use_linear
+            else jnp.zeros((ids.shape[0],), cd)
+        )
+        # The scores collective: [B,k] + 2·[B] per step; tables never move.
+        s = lax.psum(s_p, "feat")
+        sq = lax.psum(sq_p, "feat")
+        lin = lax.psum(lin_p, "feat")
+        scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
+        if spec.use_linear:
+            scores = scores + lin
+        if spec.use_bias:
+            scores = scores + w0.astype(cd)
+
+        # From here on every chip holds identical full-batch values.
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        loss, dscores = jax.value_and_grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        new_slices = []
+        for f in range(f_local):
+            g_v = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+            if config.reg_factors:
+                g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+            if spec.use_linear:
+                g_l = dscores * vals_c[:, f]
+                if config.reg_linear:
+                    g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+            else:
+                g_l = jnp.zeros_like(dscores)
+            g_full = jnp.concatenate([g_v, g_l[:, None]], axis=1)
+            new_slices.append(
+                vw[f].at[ids[:, f]].add((-lr * g_full).astype(spec.pdtype))
+            )
+        new_vw = jnp.stack(new_slices, axis=0)
+        out = {"w0": w0, "vw": new_vw}
+        if spec.use_bias:
+            # dscores is replicated — a plain sum is the global bias grad.
+            out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
+        return out, loss
+
+    return jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(PARAM_SPECS, P(), *BATCH_SPECS),
+        out_specs=(PARAM_SPECS, P()),
+        check_vma=False,
+    )
+
+
+def make_field_sharded_sgd_step(spec, config: TrainConfig, mesh):
+    """Jitted field-sharded fused sparse-SGD step; params donated."""
+    return jax.jit(
+        make_field_sharded_sgd_body(spec, config, mesh), donate_argnums=(0,)
+    )

@@ -1,0 +1,166 @@
+"""Field-sharded multi-chip step ≡ single-chip fused step (8-dev CPU mesh).
+
+The Spark-idiom simulation strategy (SURVEY.md §4): the identical
+shard_map/psum/all_to_all code path a real v5e-8 would run, on fake CPU
+devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.parallel import (
+    make_field_mesh,
+    make_field_sharded_sgd_step,
+    pad_field_batch,
+    shard_field_batch,
+    shard_field_params,
+    stack_field_params,
+    unstack_field_params,
+)
+from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+from fm_spark_tpu.train import TrainConfig
+
+
+def _make_batch(rng, b, f, bucket):
+    return (
+        rng.integers(0, bucket, size=(b, f)).astype(np.int32),
+        rng.uniform(0.5, 1.5, size=(b, f)).astype(np.float32),
+        rng.integers(0, 2, b).astype(np.float32),
+        np.ones((b,), np.float32),
+    )
+
+
+@pytest.mark.parametrize("n_feat,num_fields", [
+    (8, 5),   # fields pad 5 → 8, three chips own only padding
+    (4, 6),   # fields pad 6 → 8, uneven split of real fields
+    (2, 6),   # even split
+])
+def test_field_sharded_matches_single_chip(eight_devices, n_feat, num_fields):
+    bucket, rank, b = 32, 4, 64
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank,
+        num_fields=num_fields, bucket=bucket, init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.3, lr_schedule="inv_sqrt",
+                         optimizer="sgd", reg_factors=1e-3, reg_linear=1e-4,
+                         reg_bias=1e-4)
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+
+    params = spec.init(jax.random.key(0))
+    ref_params = jax.tree_util.tree_map(jnp.copy, params)
+
+    sharded = shard_field_params(
+        stack_field_params(spec, params, n_feat), mesh
+    )
+    step_sharded = make_field_sharded_sgd_step(spec, config, mesh)
+    step_single = make_field_sparse_sgd_step(spec, config)
+
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        batch = _make_batch(rng, b, num_fields, bucket)
+        sb = shard_field_batch(
+            pad_field_batch(batch, num_fields, n_feat), mesh
+        )
+        sharded, loss_sh = step_sharded(sharded, jnp.int32(i), *sb)
+        ref_params, loss_ref = step_single(
+            ref_params, jnp.int32(i), *map(jnp.asarray, batch)
+        )
+        np.testing.assert_allclose(
+            float(loss_sh), float(loss_ref), rtol=1e-5
+        )
+
+    got = unstack_field_params(spec, jax.device_get(sharded))
+    np.testing.assert_allclose(
+        float(got["w0"]), float(ref_params["w0"]), rtol=1e-5
+    )
+    for f in range(num_fields):
+        np.testing.assert_allclose(
+            np.asarray(got["vw"][f]), np.asarray(ref_params["vw"][f]),
+            rtol=2e-4, atol=1e-6,
+        )
+
+
+def test_weighted_batch_matches(eight_devices):
+    # Weight-0 padding rows (epoch tails) must behave identically sharded.
+    num_fields, bucket, rank, n_feat, b = 6, 16, 2, 4, 32
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank,
+        num_fields=num_fields, bucket=bucket, init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.2, optimizer="sgd")
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    params = spec.init(jax.random.key(2))
+    ref_params = jax.tree_util.tree_map(jnp.copy, params)
+    sharded = shard_field_params(
+        stack_field_params(spec, params, n_feat), mesh
+    )
+    step_sharded = make_field_sharded_sgd_step(spec, config, mesh)
+    step_single = make_field_sparse_sgd_step(spec, config)
+    rng = np.random.default_rng(3)
+    ids, vals, labels, weights = _make_batch(rng, b, num_fields, bucket)
+    weights[b // 2:] = 0.0
+    batch = (ids, vals, labels, weights)
+    sb = shard_field_batch(pad_field_batch(batch, num_fields, n_feat), mesh)
+    sharded, loss_sh = step_sharded(sharded, jnp.int32(0), *sb)
+    ref_params, loss_ref = step_single(
+        ref_params, jnp.int32(0), *map(jnp.asarray, batch)
+    )
+    np.testing.assert_allclose(float(loss_sh), float(loss_ref), rtol=1e-5)
+    got = unstack_field_params(spec, jax.device_get(sharded))
+    for f in range(num_fields):
+        np.testing.assert_allclose(
+            np.asarray(got["vw"][f]), np.asarray(ref_params["vw"][f]),
+            rtol=2e-4, atol=1e-6,
+        )
+
+
+def test_padded_fields_stay_zero(eight_devices):
+    num_fields, bucket, rank, n_feat = 5, 16, 2, 4
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank,
+        num_fields=num_fields, bucket=bucket, init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.5, optimizer="sgd",
+                         reg_factors=1e-2, reg_linear=1e-2)
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    sharded = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(1)), n_feat), mesh
+    )
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    rng = np.random.default_rng(1)
+    for i in range(3):
+        batch = pad_field_batch(
+            _make_batch(rng, 32, num_fields, bucket), num_fields, n_feat
+        )
+        sharded, _ = step(sharded, jnp.int32(i), *shard_field_batch(batch, mesh))
+    vw = np.asarray(jax.device_get(sharded["vw"]))
+    assert vw.shape[0] == 8  # 5 → padded to 8
+    np.testing.assert_array_equal(vw[num_fields:], 0.0)
+
+
+def test_stack_roundtrip():
+    spec = models.FieldFMSpec(
+        num_features=3 * 8, rank=2, num_fields=3, bucket=8
+    )
+    params = spec.init(jax.random.key(0))
+    stacked = stack_field_params(spec, params, n_feat=2)
+    assert stacked["vw"].shape == (4, 8, 3)
+    back = unstack_field_params(spec, stacked)
+    for f in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(back["vw"][f]), np.asarray(params["vw"][f])
+        )
+
+
+def test_requires_feat_mesh(eight_devices):
+    from fm_spark_tpu.parallel import make_mesh
+    from fm_spark_tpu.parallel.field_step import make_field_sharded_sgd_body
+
+    spec = models.FieldFMSpec(num_features=2 * 8, rank=2, num_fields=2,
+                              bucket=8)
+    mesh2d = make_mesh(2, 4, devices=eight_devices)
+    with pytest.raises(ValueError, match="1-D"):
+        make_field_sharded_sgd_body(spec, TrainConfig(optimizer="sgd"), mesh2d)
