@@ -23,6 +23,7 @@ execution completes; a device→host transfer of the loss is the reliable
 fence, and is what we use.
 """
 
+import argparse
 import json
 import sys
 import time
@@ -31,6 +32,19 @@ import numpy as np
 
 
 def main():
+    ap = argparse.ArgumentParser(
+        description="FM training throughput bench (variant knobs for "
+        "perf sweeps; defaults = the headline configuration)"
+    )
+    ap.add_argument("--param-dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--sparse-update", default="scatter_add",
+                    choices=["scatter_add", "dedup", "dedup_sr"])
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=1 << 17)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -41,17 +55,18 @@ def main():
 
     num_fields = 39
     bucket = 262_144
-    rank = 64
-    batch = 1 << 17          # 131072 samples/step
+    rank = args.rank
+    batch = args.batch
     steps_warmup = 3
-    steps_timed = 20
+    steps_timed = args.steps
 
     spec = models.FieldFMSpec(
         num_features=num_fields * bucket, rank=rank,
         num_fields=num_fields, bucket=bucket, init_std=0.01,
+        param_dtype=args.param_dtype,
     )
     config = TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                         optimizer="sgd")
+                         optimizer="sgd", sparse_update=args.sparse_update)
     body = make_field_sparse_sgd_body(spec, config)
 
     params = spec.init(jax.random.key(0))

@@ -113,6 +113,29 @@ def load_dataset(cfg, args) -> tuple:
     raise SystemExit(f"don't know how to load dataset kind {cfg.dataset!r}")
 
 
+def iter_packed_once(ds, batch_size: int, bucket: int = 0, row_range=None):
+    """One ordered, finite, fixed-shape pass over a packed dataset —
+    the streaming analog of :func:`fm_spark_tpu.data.iterate_once` for
+    evaluation/prediction (final partial batch zero-padded, weight 0)."""
+    lo, hi = row_range if row_range is not None else (0, len(ds))
+    for start in range(lo, hi, batch_size):
+        end = min(start + batch_size, hi)
+        ids, vals, labels = ds.slice(np.s_[start:end])
+        if bucket:
+            ids = _field_local(ids, bucket)
+        b = end - start
+        pad = batch_size - b
+        weights = np.ones((b,), np.float32)
+        if pad:
+            ids = np.concatenate([ids, np.zeros((pad,) + ids.shape[1:],
+                                                ids.dtype)])
+            vals = np.concatenate([vals, np.zeros((pad,) + vals.shape[1:],
+                                                  vals.dtype)])
+            labels = np.concatenate([labels, np.zeros((pad,), labels.dtype)])
+            weights = np.concatenate([weights, np.zeros((pad,), np.float32)])
+        yield ids, vals, labels, weights
+
+
 class StreamingBatches:
     """Resumable batch source over a packed dir, with optional conversion
     of per-field-offset global ids to field-local ids (FieldFM layout).
@@ -287,17 +310,27 @@ def cmd_train(args) -> int:
     )
 
     te = None
+    te_packed = None
     if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
         # Large preprocessed data: stream from the memory-mapped packed
-        # dir; held-out evaluation is a separate `eval` invocation.
+        # dir. --test-fraction holds out the file's TAIL rows (packed
+        # order is already shuffled at preprocess time).
         from fm_spark_tpu.data import PackedBatches, PackedDataset
 
         spec = cfg.spec()
-        batches = StreamingBatches(
-            PackedBatches(PackedDataset(args.data), tconfig.batch_size,
-                          seed=cfg.seed),
-            bucket=cfg.bucket if cfg.model == "field_fm" else 0,
+        ds = PackedDataset(args.data)
+        cut = (
+            max(1, int(len(ds) * (1.0 - args.test_fraction)))
+            if args.test_fraction > 0 else len(ds)
         )
+        bucket = cfg.bucket if cfg.model == "field_fm" else 0
+        batches = StreamingBatches(
+            PackedBatches(ds, tconfig.batch_size, seed=cfg.seed,
+                          row_range=(0, cut)),
+            bucket=bucket,
+        )
+        if cut < len(ds):
+            te_packed = (ds, (cut, len(ds)), bucket)
     else:
         ids, vals, labels, num_features = load_dataset(cfg, args)
         spec = cfg.spec(num_features if cfg.bucket <= 0 else None)
@@ -352,6 +385,14 @@ def cmd_train(args) -> int:
             spec, params, iterate_once(*te, tconfig.batch_size)
         )
         print(json.dumps({"eval": metrics}))
+    elif te_packed is not None:
+        ds, row_range, bucket = te_packed
+        metrics = evaluate_params(
+            spec, params,
+            iter_packed_once(ds, tconfig.batch_size, bucket=bucket,
+                             row_range=row_range),
+        )
+        print(json.dumps({"eval": metrics}))
     if args.model_out:
         models.save_model(args.model_out, spec, params)
         print(json.dumps({"saved": args.model_out}))
@@ -361,17 +402,19 @@ def cmd_train(args) -> int:
 # ------------------------------------------------------------ eval/predict
 
 
-def _load_for_model(args, spec):
-    """Load eval/predict data shaped for an already-trained model.
+def _batches_for_model(args, spec):
+    """One finite pass of eval/predict batches shaped for a trained model.
 
     ``--synthetic N`` derives shapes from the model's own spec (never a
     config guess — mismatched shapes would silently clamp out-of-range
     ids into the table edge and print meaningless metrics). ``--data``
-    needs ``--config`` to name the parser, and the config's feature
-    space must match the model's.
+    needs ``--config`` to name the parser (packed dirs stream; text
+    loads in memory), and the config's feature space must match the
+    model's.
     """
     from fm_spark_tpu import configs as configs_lib
     from fm_spark_tpu import data as data_lib
+    from fm_spark_tpu.data import iterate_once
 
     if args.synthetic:
         nnz = getattr(spec, "num_fields", 0) or min(8, spec.num_features)
@@ -380,7 +423,7 @@ def _load_for_model(args, spec):
         )
         if type(spec).__name__ == "FieldFMSpec":
             ids = _field_local(ids, spec.bucket)
-        return ids, vals, labels
+        return iterate_once(ids, vals, labels, args.batch_size)
 
     if args.config is None:
         raise SystemExit(
@@ -394,20 +437,20 @@ def _load_for_model(args, spec):
             f"the model was trained with {spec.num_features}; ids would be "
             "silently clamped — pass the config the model was trained with"
         )
+    if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
+        ds = data_lib.PackedDataset(args.data)
+        bucket = cfg.bucket if cfg.model == "field_fm" else 0
+        return iter_packed_once(ds, args.batch_size, bucket=bucket)
     ids, vals, labels, _ = load_dataset(cfg, args)
-    return ids, vals, labels
+    return iterate_once(ids, vals, labels, args.batch_size)
 
 
 def cmd_eval(args) -> int:
     from fm_spark_tpu import models
-    from fm_spark_tpu.data import iterate_once
     from fm_spark_tpu.train import evaluate_params
 
     spec, params = models.load_model(args.model)
-    ids, vals, labels = _load_for_model(args, spec)
-    metrics = evaluate_params(
-        spec, params, iterate_once(ids, vals, labels, args.batch_size)
-    )
+    metrics = evaluate_params(spec, params, _batches_for_model(args, spec))
     print(json.dumps(metrics))
     return 0
 
@@ -416,14 +459,11 @@ def cmd_predict(args) -> int:
     import jax.numpy as jnp
 
     from fm_spark_tpu import models
-    from fm_spark_tpu.data import iterate_once
 
     spec, params = models.load_model(args.model)
-    ids, vals, labels = _load_for_model(args, spec)
     out = sys.stdout if args.out in (None, "-") else open(args.out, "w")
     try:
-        for bids, bvals, _, w in iterate_once(ids, vals, labels,
-                                              args.batch_size):
+        for bids, bvals, _, w in _batches_for_model(args, spec):
             preds = np.asarray(
                 spec.predict(params, jnp.asarray(bids), jnp.asarray(bvals))
             )

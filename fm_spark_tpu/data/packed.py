@@ -152,7 +152,8 @@ class PackedBatches:
                  seed: int = 0, shuffle: bool = True,
                  chunk_size: int = 1 << 18,
                  host_index: int = 0, num_hosts: int = 1,
-                 drop_remainder: bool = False):
+                 drop_remainder: bool = False,
+                 row_range: tuple[int, int] | None = None):
         if not (0 <= host_index < num_hosts):
             raise ValueError(f"host_index {host_index} not in [0,{num_hosts})")
         self.ds = dataset
@@ -161,15 +162,20 @@ class PackedBatches:
         self.shuffle = bool(shuffle)
         self.chunk_size = int(chunk_size)
         self.drop_remainder = bool(drop_remainder)
-        # Contiguous per-host range: sequential disk reads per host.
-        per_host = dataset.num_examples // num_hosts
+        # Optional sub-range of the file (train/holdout splits), then a
+        # contiguous per-host range within it: sequential reads per host.
+        r_lo, r_hi = (0, dataset.num_examples) if row_range is None else (
+            int(row_range[0]), int(row_range[1])
+        )
+        if not (0 <= r_lo < r_hi <= dataset.num_examples):
+            raise ValueError(
+                f"row_range {row_range} out of [0, {dataset.num_examples}]"
+            )
+        per_host = (r_hi - r_lo) // num_hosts
         if per_host == 0:
             raise ValueError("fewer examples than hosts")
-        self.lo = host_index * per_host
-        self.hi = (
-            dataset.num_examples if host_index == num_hosts - 1
-            else self.lo + per_host
-        )
+        self.lo = r_lo + host_index * per_host
+        self.hi = r_hi if host_index == num_hosts - 1 else self.lo + per_host
         self.epoch = 0
         self.index = 0  # examples consumed within the epoch
         self._order = None

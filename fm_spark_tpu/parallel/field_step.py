@@ -135,6 +135,7 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         raise ValueError("field-sharded step requires fused_linear=True")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
+    sr_base_key = jax.random.key(config.seed + 0x5EED)
     if set(mesh.axis_names) != {"feat"}:
         raise ValueError(
             "field-sharded step runs on a 1-D ('feat',) mesh — tables are "
@@ -195,6 +196,8 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         lr = lr_at(step_idx)
         touched = weights > 0
 
+        from fm_spark_tpu.ops import scatter as scatter_lib
+
         new_slices = []
         for f in range(f_local):
             g_v = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
@@ -207,8 +210,17 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_full = jnp.concatenate([g_v, g_l[:, None]], axis=1)
+            if config.sparse_update == "dedup_sr":
+                # Decorrelate SR noise across (step, global field).
+                gf = lax.axis_index("feat") * f_local + f
+                key = scatter_lib.sr_key(sr_base_key, step_idx, gf)
+            else:
+                key = None
             new_slices.append(
-                vw[f].at[ids[:, f]].add((-lr * g_full).astype(spec.pdtype))
+                scatter_lib.apply_row_updates(
+                    vw[f], ids[:, f], -lr * g_full,
+                    mode=config.sparse_update, key=key, old_rows=rows[f],
+                )
             )
         new_vw = jnp.stack(new_slices, axis=0)
         out = {"w0": w0, "vw": new_vw}

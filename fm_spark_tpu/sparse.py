@@ -42,9 +42,12 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldFMSpec")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
+    if config.sparse_update != "scatter_add" and not spec.fused_linear:
+        raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
+    sr_base_key = jax.random.key(config.seed + 0x5EED)
 
     if config.lr_schedule == "inv_sqrt":
         lr_at = lambda i: config.learning_rate / jnp.sqrt(i.astype(jnp.float32) + 1.0)
@@ -94,8 +97,10 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             return g
 
         if spec.fused_linear:
-            # ONE scatter per field: interaction grads in cols [:k], the
+            # ONE row-update per field: interaction grads in cols [:k], the
             # linear grad in col k (zeroed if the linear term is disabled).
+            from fm_spark_tpu.ops import scatter as scatter_lib
+
             new_vw = []
             for f in range(F):
                 g_lin = (
@@ -104,10 +109,17 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                     else jnp.zeros((dscores.shape[0], 1), cd)
                 )
                 g_full = jnp.concatenate([factor_grad(f), g_lin], axis=1)
+                key = (
+                    scatter_lib.sr_key(sr_base_key, step_idx, f)
+                    if config.sparse_update == "dedup_sr"
+                    else None
+                )
                 new_vw.append(
-                    params["vw"][f]
-                    .at[ids[:, f]]
-                    .add((-lr * g_full).astype(spec.pdtype))
+                    scatter_lib.apply_row_updates(
+                        params["vw"][f], ids[:, f], -lr * g_full,
+                        mode=config.sparse_update, key=key,
+                        old_rows=rows[f],
+                    )
                 )
             out = {"w0": w0, "vw": new_vw}
         else:

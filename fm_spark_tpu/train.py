@@ -44,6 +44,10 @@ class TrainConfig:
     log_every: int = 100
     eval_every: int = 0                    # 0 = only at the end
     metrics_path: str | None = None
+    # Sparse-row write strategy for the fused FieldFM steps (ops/scatter.py):
+    # 'scatter_add' | 'dedup' | 'dedup_sr'. dedup_sr is the bf16-storage
+    # quality fix (stochastic rounding needs deduped set-semantics).
+    sparse_update: str = "scatter_add"
 
 
 def _group_reg(config: TrainConfig):

@@ -18,7 +18,8 @@ from fm_spark_tpu.train import TrainConfig, evaluate_params
 from fm_spark_tpu.data.pipeline import Batches, iterate_once
 
 
-def _train_auc(param_dtype, seed=0, steps=800, batch=256):
+def _train_auc(param_dtype, seed=0, steps=800, batch=256,
+               sparse_update="scatter_add"):
     num_fields, bucket, rank = 5, 64, 8
     ids, vals, labels = synthetic_ctr(
         8000, num_fields * bucket, num_fields, rank=4, seed=seed
@@ -32,7 +33,7 @@ def _train_auc(param_dtype, seed=0, steps=800, batch=256):
         bucket=bucket, init_std=0.05, param_dtype=param_dtype,
     )
     config = TrainConfig(learning_rate=0.2, lr_schedule="constant",
-                         optimizer="sgd")
+                         optimizer="sgd", sparse_update=sparse_update)
     step = make_field_sparse_sgd_step(spec, config)
     params = spec.init(jax.random.key(seed))
     batches = Batches(*tr, batch, seed=seed)
@@ -50,10 +51,17 @@ def test_bf16_tables_track_fp32_auc():
     # loses ~0.014 AUC to update-vanishing against the 8-bit mantissa —
     # OUTSIDE the 1e-3 budget, which is why bf16 storage is opt-in, not
     # the default (PERF.md "bf16 storage"). This test pins that envelope:
-    # a collapse to ~0.5 (updates fully vanishing) must fail loudly, and
-    # an improvement past fp32-0.005 (e.g. after stochastic rounding
-    # lands) should prompt revisiting the default.
+    # a collapse to ~0.5 (updates fully vanishing) must fail loudly.
+    # The recovery path is sparse_update="dedup_sr" (the next test).
     assert auc16 > auc32 - 0.03, f"bf16 {auc16} vs fp32 {auc32}"
+
+
+def test_bf16_with_stochastic_rounding_recovers_fp32_quality():
+    auc32 = _train_auc("float32")
+    auc_sr = _train_auc("bfloat16", sparse_update="dedup_sr")
+    # SR makes rounding unbiased: tiny updates land in expectation, so
+    # bf16+SR must sit inside the BASELINE-style quality envelope.
+    assert auc_sr > auc32 - 0.005, f"bf16+SR {auc_sr} vs fp32 {auc32}"
 
 
 def test_bf16_updates_do_not_vanish():

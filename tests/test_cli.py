@@ -244,14 +244,31 @@ def test_cli_preprocess_and_packed_streaming_train(tmp_path, capsys):
         assert cli.main([
             "train", "--config", "packed_small", "--data", packed,
             "--steps", "10", "--batch-size", "64", "--log-every", "5",
-            "--model-out", model_dir,
+            "--model-out", model_dir, "--test-fraction", "0.2",
         ]) == 0
         out = capsys.readouterr().out
         assert '"saved"' in out
+        # --test-fraction on packed data must produce holdout metrics.
+        eval_line = [l for l in out.splitlines() if '"eval"' in l][-1]
+        assert np.isfinite(json.loads(eval_line)["eval"]["logloss"])
         # Shapes must match: saved model evals on spec-derived synthetic.
         assert cli.main([
             "eval", "--model", model_dir, "--synthetic", "200",
         ]) == 0
+        capsys.readouterr()
+        # And on the packed dir itself (streaming finite pass).
+        assert cli.main([
+            "eval", "--model", model_dir, "--config", "packed_small",
+            "--data", packed,
+        ]) == 0
+        m = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert m["count"] == 600.0
+        pred_file = tmp_path / "p.txt"
+        assert cli.main([
+            "predict", "--model", model_dir, "--config", "packed_small",
+            "--data", packed, "--out", str(pred_file),
+        ]) == 0
+        assert np.loadtxt(pred_file).shape[0] == 600
     finally:
         del configs_lib.CONFIGS["packed_small"]
 

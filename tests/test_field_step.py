@@ -164,3 +164,40 @@ def test_requires_feat_mesh(eight_devices):
     mesh2d = make_mesh(2, 4, devices=eight_devices)
     with pytest.raises(ValueError, match="1-D"):
         make_field_sharded_sgd_body(spec, TrainConfig(optimizer="sgd"), mesh2d)
+
+
+def test_field_sharded_dedup_sr_runs_and_learns(eight_devices):
+    # dedup_sr inside shard_map (per-chip SR keys via axis_index): loss
+    # must fall and tables must move; exact equality is not expected
+    # (SR noise), so this is a smoke + learning check.
+    num_fields, bucket, rank, n_feat, b = 6, 32, 4, 4, 64
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank, num_fields=num_fields,
+        bucket=bucket, init_std=0.1, param_dtype="bfloat16",
+    )
+    config = TrainConfig(learning_rate=0.3, lr_schedule="constant",
+                         optimizer="sgd", sparse_update="dedup_sr")
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    sharded = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(0)), n_feat), mesh
+    )
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    rng = np.random.default_rng(0)
+    from fm_spark_tpu.data import synthetic_ctr
+
+    ids_g, vals, labels = synthetic_ctr(b * 20, num_fields * bucket,
+                                        num_fields, seed=0)
+    offs = (np.arange(num_fields) * bucket).astype(np.int32)
+    ids_l = ids_g - offs[None, :]
+    losses = []
+    for i in range(20):
+        sl = slice(i * b, (i + 1) * b)
+        batch = pad_field_batch(
+            (ids_l[sl], vals[sl], labels[sl], np.ones((b,), np.float32)),
+            num_fields, n_feat,
+        )
+        sharded, loss = step(sharded, jnp.int32(i),
+                             *shard_field_batch(batch, mesh))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses

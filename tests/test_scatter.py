@@ -1,0 +1,101 @@
+"""ops/scatter.py: dedup ≡ scatter_add, SR unbiasedness, bf16+SR quality."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.ops.scatter import apply_row_updates, stochastic_round
+from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+from fm_spark_tpu.train import TrainConfig
+
+
+def test_dedup_matches_scatter_add_fp32():
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(50, 8)), jnp.float32)
+    # Heavy duplication, including ids unseen in the batch.
+    ids = jnp.asarray(rng.integers(0, 20, size=200), jnp.int32)
+    delta = jnp.asarray(rng.normal(size=(200, 8)) * 0.1, jnp.float32)
+    a = apply_row_updates(table, ids, delta, mode="scatter_add")
+    b = apply_row_updates(table, ids, delta, mode="dedup")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dedup_sr_exact_in_fp32():
+    # With an fp32 table SR is the identity, so dedup_sr must equal
+    # scatter_add exactly up to reassociation.
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(size=(30, 4)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, 30, size=100), jnp.int32)
+    delta = jnp.asarray(rng.normal(size=(100, 4)) * 0.05, jnp.float32)
+    old_rows = table[ids]
+    a = apply_row_updates(table, ids, delta, mode="scatter_add")
+    c = apply_row_updates(table, ids, delta, mode="dedup_sr",
+                          key=jax.random.key(0), old_rows=old_rows)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stochastic_round_unbiased_and_lands_small_updates():
+    # A delta far below bf16 ulp of 1.0 must land in expectation.
+    x = jnp.full((20000,), 1.0 + 1e-4, jnp.float32)  # ulp(1.0)=2^-8
+    out = stochastic_round(x, jnp.bfloat16, jax.random.key(0))
+    mean = float(jnp.mean(out.astype(jnp.float32)))
+    # P(round up) = 1e-4 / 2^-8 ≈ 0.0256 → mean ≈ 1.0 + 1e-4.
+    assert abs(mean - (1.0 + 1e-4)) < 3e-5, mean
+    # Deterministic rounding would give exactly 1.0.
+    assert mean > 1.0
+
+
+def test_stochastic_round_fp32_identity():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=64), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(stochastic_round(x, jnp.float32, jax.random.key(0))),
+        np.asarray(x),
+    )
+
+
+def test_unknown_mode_raises():
+    t = jnp.zeros((4, 2))
+    with pytest.raises(ValueError, match="unknown sparse_update"):
+        apply_row_updates(t, jnp.zeros(3, jnp.int32), jnp.zeros((3, 2)),
+                          mode="nope")
+    with pytest.raises(ValueError, match="needs key"):
+        apply_row_updates(t, jnp.zeros(3, jnp.int32), jnp.zeros((3, 2)),
+                          mode="dedup_sr")
+
+
+def test_fused_step_dedup_matches_scatter_add():
+    num_fields, bucket, rank = 4, 32, 4
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank, num_fields=num_fields,
+        bucket=bucket, init_std=0.1,
+    )
+    base = TrainConfig(learning_rate=0.3, optimizer="sgd",
+                       reg_factors=1e-3, reg_linear=1e-4)
+    import dataclasses
+
+    step_a = make_field_sparse_sgd_step(spec, base)
+    step_b = make_field_sparse_sgd_step(
+        spec, dataclasses.replace(base, sparse_update="dedup")
+    )
+    pa = spec.init(jax.random.key(0))
+    pb = jax.tree_util.tree_map(jnp.copy, pa)
+    rng = np.random.default_rng(2)
+    for i in range(3):
+        ids = jnp.asarray(rng.integers(0, bucket, size=(64, num_fields)),
+                          jnp.int32)
+        vals = jnp.asarray(rng.uniform(0.5, 1.5, (64, num_fields)),
+                           jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 2, 64), jnp.float32)
+        w = jnp.ones((64,), jnp.float32)
+        pa, la = step_a(pa, jnp.int32(i), ids, vals, labels, w)
+        pb, lb = step_b(pb, jnp.int32(i), ids, vals, labels, w)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    for f in range(num_fields):
+        np.testing.assert_allclose(
+            np.asarray(pa["vw"][f]), np.asarray(pb["vw"][f]),
+            rtol=1e-4, atol=1e-6,
+        )
