@@ -1,0 +1,176 @@
+"""Pallas TPU kernels for the FieldFM hot path: pipelined row gather/update.
+
+Why these exist (PERF.md): the fused FieldFM step is bound by XLA's
+per-index gather (~13-55ms / 5.1M rows, with a table-size cliff) and
+scatter-add (~55M idx/s) rates — both far below HBM bandwidth for
+260-byte rows, i.e. latency-bound, not bandwidth-bound. These kernels
+attack that directly: ids are scalar-prefetched (SMEM), and each grid
+program issues a deep queue of row-granular async DMAs (HBM→VMEM for
+gather; read-modify-write for update), so many row fetches are in flight
+at once instead of whatever depth XLA's scatter emits.
+
+Status: correctness-verified in interpret mode (tests/test_pallas_fm.py)
+and shape/dtype-compatible with the fused step. They are NOT wired into
+the default path yet — the decision needs a real-chip A/B against the
+XLA ops (the tunnel was down when this landed; see PERF.md "Pallas"
+lever). Integration point: `ops/scatter.py apply_row_updates` and
+`FieldFMSpec.gather_rows`.
+
+Update-kernel contract: row ids must be UNIQUE within the call (pair it
+with the `dedup` mode's segment-sum — duplicate lanes carry
+``valid=False`` and are skipped by predication). Uniqueness is what makes
+the pipelined read-modify-write race-free; XLA's scatter serializes
+colliding writes instead, which is exactly the cost being avoided.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows processed per grid program; also the DMA queue depth per phase.
+_TILE = 256
+
+
+def _gather_kernel(ids_ref, table_ref, out_ref, sems):
+    t = out_ref.shape[0]
+    base = pl.program_id(0) * t
+
+    def start(j, _):
+        dma = pltpu.make_async_copy(
+            table_ref.at[ids_ref[base + j]], out_ref.at[j], sems.at[j]
+        )
+        dma.start()
+        return _
+
+    jax.lax.fori_loop(0, t, start, 0)
+
+    def wait(j, _):
+        pltpu.make_async_copy(
+            table_ref.at[ids_ref[base + j]], out_ref.at[j], sems.at[j]
+        ).wait()
+        return _
+
+    jax.lax.fori_loop(0, t, wait, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gather_rows(table: jax.Array, ids: jax.Array,
+                interpret: bool = False) -> jax.Array:
+    """``table[ids]`` with row-granular pipelined DMAs.
+
+    table: [n, w] (any float dtype), ids: [B] int32 with B % 256 == 0
+    (pad with any valid id; gathers are side-effect free).
+    """
+    b = ids.shape[0]
+    if b % _TILE:
+        raise ValueError(f"ids length {b} must be a multiple of {_TILE}")
+    w = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b // _TILE,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table in HBM
+        out_specs=pl.BlockSpec(
+            (_TILE, w), lambda i, ids: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_TILE,))],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, w), table.dtype),
+        interpret=interpret,
+    )(ids, table)
+
+
+def _update_kernel(ids_ref, valid_ref, delta_ref, table_ref, out_ref,
+                   rows, read_sems, write_sems):
+    # table_ref and out_ref alias the same HBM buffer on TPU; reads go
+    # through table_ref and writes through out_ref so interpret mode
+    # (separate buffers, output pre-copied from the aliased input) sees
+    # the same semantics.
+    t = delta_ref.shape[0]
+    base = pl.program_id(0) * t
+
+    def start_read(j, carry):
+        @pl.when(valid_ref[base + j] != 0)
+        def _go():
+            pltpu.make_async_copy(
+                table_ref.at[ids_ref[base + j]], rows.at[j], read_sems.at[j]
+            ).start()
+
+        return carry
+
+    jax.lax.fori_loop(0, t, start_read, 0)
+
+    def modify_write(j, carry):
+        @pl.when(valid_ref[base + j] != 0)
+        def _go():
+            pltpu.make_async_copy(
+                table_ref.at[ids_ref[base + j]], rows.at[j], read_sems.at[j]
+            ).wait()
+            rows[j] = (
+                rows[j].astype(jnp.float32) + delta_ref[j].astype(jnp.float32)
+            ).astype(rows.dtype)
+            pltpu.make_async_copy(
+                rows.at[j], out_ref.at[ids_ref[base + j]], write_sems.at[j]
+            ).start()
+
+        return carry
+
+    jax.lax.fori_loop(0, t, modify_write, 0)
+
+    def wait_write(j, carry):
+        @pl.when(valid_ref[base + j] != 0)
+        def _go():
+            pltpu.make_async_copy(
+                rows.at[j], out_ref.at[ids_ref[base + j]], write_sems.at[j]
+            ).wait()
+
+        return carry
+
+    jax.lax.fori_loop(0, t, wait_write, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",),
+                   donate_argnames=("table",))
+def update_rows_add(table: jax.Array, ids: jax.Array, valid: jax.Array,
+                    delta: jax.Array, interpret: bool = False) -> jax.Array:
+    """``table[ids[m]] += delta[m]`` for lanes with ``valid[m]`` — in place
+    (the table buffer is donated/aliased).
+
+    ids must be UNIQUE among valid lanes (see module docstring); delta is
+    [B, w] in any float dtype (accumulation happens in fp32); B % 256 == 0.
+    """
+    b = ids.shape[0]
+    if b % _TILE:
+        raise ValueError(f"ids length {b} must be a multiple of {_TILE}")
+    w = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # ids, valid
+        grid=(b // _TILE,),
+        in_specs=[
+            pl.BlockSpec(
+                (_TILE, w), lambda i, ids, valid: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),  # delta
+            pl.BlockSpec(memory_space=pl.ANY),  # table (HBM)
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((_TILE, w), table.dtype),
+            pltpu.SemaphoreType.DMA((_TILE,)),
+            pltpu.SemaphoreType.DMA((_TILE,)),
+        ],
+    )
+    return pl.pallas_call(
+        _update_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={3: 0},  # table arg (after 2 prefetch + delta)
+        interpret=interpret,
+    )(ids, valid.astype(jnp.int32), delta, table)

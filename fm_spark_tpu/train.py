@@ -195,7 +195,7 @@ class FMTrainer:
         self.loss_history: list[float] = []
 
     def fit(self, batches: Iterable, num_steps: int | None = None,
-            checkpointer=None, preemption_guard=None):
+            checkpointer=None, preemption_guard=None, eval_batches=None):
         """Run the training loop; ``batches`` yields (ids, vals, labels, w).
 
         With a :class:`fm_spark_tpu.checkpoint.Checkpointer`, training
@@ -203,6 +203,11 @@ class FMTrainer:
         the checkpointer's cadence, the run resumes from the latest saved
         step automatically, and a ``PreemptionGuard`` (if given) turns
         SIGTERM into an orderly flush-and-return (SURVEY.md §5).
+
+        ``eval_batches`` (a zero-arg callable returning a finite batch
+        iterable, e.g. ``lambda: iterate_once(*te, bs)``) enables periodic
+        held-out evaluation every ``config.eval_every`` steps; metrics are
+        logged with an ``eval_`` prefix.
         """
         total = num_steps if num_steps is not None else self.config.num_steps
         log_every = max(self.config.log_every, 1)
@@ -267,10 +272,39 @@ class FMTrainer:
                     grad_norm=float(m["grad_norm"]),
                 )
                 steps_since_log = 0
+            if eval_batches is not None and (
+                (self.config.eval_every > 0
+                 and self.step_count % self.config.eval_every == 0)
+                or step_i == total - 1  # always evaluate the final model
+            ):
+                em = self.evaluate(eval_batches())
+                self.logger.log(
+                    self.step_count,
+                    **{f"eval_{k}": v for k, v in em.items()},
+                )
+                # Eval wall-clock must not deflate the next training
+                # throughput window.
+                self.logger.reset_rate_clock()
             save()
         save(force=True)
         return self.params
 
     def evaluate(self, batches: Iterable, max_batches: int | None = None) -> dict:
-        """Stream eval batches through the on-device accumulators."""
-        return evaluate_params(self.spec, self.params, batches, max_batches)
+        """Stream eval batches through the on-device accumulators.
+
+        Uses the eval step compiled once at construction — periodic
+        in-training eval (``eval_every``) must not pay a re-trace per
+        invocation.
+        """
+        mstate = metrics_lib.init_metrics()
+        for i, (ids, vals, labels, weights) in enumerate(batches):
+            if max_batches is not None and i >= max_batches:
+                break
+            mstate = self._eval_step(
+                self.params, mstate, jnp.asarray(ids), jnp.asarray(vals),
+                jnp.asarray(labels), jnp.asarray(weights),
+            )
+        return {
+            k: float(v)
+            for k, v in metrics_lib.finalize_metrics(mstate).items()
+        }

@@ -44,6 +44,12 @@ class MetricsLogger:
             self._fh.flush()
         return record
 
+    def reset_rate_clock(self):
+        """Restart the samples/sec window (call after pauses like eval
+        passes or checkpoint stalls, so they don't deflate throughput)."""
+        if self._t0 is not None:
+            self._t0 = time.perf_counter()
+
     def close(self):
         if self._fh is not None:
             self._fh.close()

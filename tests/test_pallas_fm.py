@@ -1,0 +1,85 @@
+"""Pallas row-gather/update kernels vs jnp references (interpret mode).
+
+Interpret mode runs the kernels' DMA/semaphore semantics on CPU; the
+real-chip speed A/B happens in bench variants (PERF.md), but correctness
+is pinned here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu.ops import pallas_fm
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_gather_rows_matches_indexing(dtype):
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(1000, 16)), dtype)
+    ids = jnp.asarray(rng.integers(0, 1000, size=512), jnp.int32)
+    got = pallas_fm.gather_rows(table, ids, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(table[ids]))
+
+
+def test_gather_rows_rejects_ragged():
+    table = jnp.zeros((10, 8), jnp.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        pallas_fm.gather_rows(table, jnp.zeros((100,), jnp.int32),
+                              interpret=True)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_update_rows_add_unique_ids(dtype):
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(size=(600, 8)), dtype)
+    # 512 unique ids out of 600 rows.
+    ids = jnp.asarray(rng.permutation(600)[:512].astype(np.int32))
+    delta = jnp.asarray(rng.normal(size=(512, 8)) * 0.1, jnp.float32)
+    valid = jnp.ones((512,), jnp.int32)
+    want = np.asarray(table, np.float32).copy()
+    want[np.asarray(ids)] += np.asarray(delta)
+    got = pallas_fm.update_rows_add(table, ids, valid,
+                                    delta.astype(dtype), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want.astype(np.float32)
+        if dtype == jnp.float32
+        else np.asarray(want.astype(jnp.bfloat16), np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+def test_update_rows_add_skips_invalid_lanes():
+    rng = np.random.default_rng(2)
+    table = jnp.asarray(rng.normal(size=(300, 4)), jnp.float32)
+    ids_np = rng.permutation(300)[:256].astype(np.int32)
+    valid_np = (rng.random(256) < 0.5).astype(np.int32)
+    # Invalid lanes all point at row 0: if predication failed, row 0
+    # would be clobbered many times over.
+    ids_np = np.where(valid_np == 1, ids_np, 0).astype(np.int32)
+    delta = jnp.asarray(rng.normal(size=(256, 4)), jnp.float32)
+    want = np.asarray(table, np.float32).copy()
+    for m in range(256):
+        if valid_np[m]:
+            want[ids_np[m]] += np.asarray(delta)[m]
+    got = pallas_fm.update_rows_add(
+        table, jnp.asarray(ids_np), jnp.asarray(valid_np), delta,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_update_then_gather_roundtrip():
+    # The two kernels compose: gather sees the updated rows.
+    rng = np.random.default_rng(3)
+    table = jnp.asarray(rng.normal(size=(512, 8)), jnp.float32)
+    ids = jnp.asarray(rng.permutation(512)[:256].astype(np.int32))
+    delta = jnp.ones((256, 8), jnp.float32)
+    valid = jnp.ones((256,), jnp.int32)
+    before = pallas_fm.gather_rows(table, ids, interpret=True)
+    table2 = pallas_fm.update_rows_add(table, ids, valid, delta,
+                                       interpret=True)
+    after = pallas_fm.gather_rows(table2, ids, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(after), np.asarray(before) + 1.0, rtol=1e-6
+    )

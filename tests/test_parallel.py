@@ -146,3 +146,43 @@ def test_sharded_eval_matches_single_device(problem, strategy, mesh_shape, eight
         np.testing.assert_allclose(
             float(out[k]), float(ref[k]), rtol=1e-5, atol=1e-6, err_msg=k
         )
+
+
+@pytest.mark.parametrize("family", ["ffm", "deepfm"])
+def test_dp_supports_ffm_and_deepfm(eight_devices, family):
+    # The reference's one true strategy (dp) must cover every model
+    # family (SURVEY.md §2 parallelism table).
+    import numpy as np
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.parallel import (
+        make_mesh, make_parallel_train_step, shard_batch, shard_params,
+    )
+    from fm_spark_tpu.train import TrainConfig, make_optimizer
+
+    num_features, nnz = 256, 4
+    if family == "ffm":
+        spec = models.FFMSpec(num_features=num_features, rank=4,
+                              num_fields=nnz, init_std=0.05)
+    else:
+        spec = models.DeepFMSpec(num_features=num_features, rank=4,
+                                 num_fields=nnz, mlp_dims=(16, 16, 16),
+                                 init_std=0.05)
+    config = TrainConfig(learning_rate=0.05, optimizer="adam",
+                         reg_factors=1e-4)
+    mesh = make_mesh(8, 1, devices=eight_devices)
+    step = make_parallel_train_step(spec, config, mesh, "dp")
+    params = shard_params(spec.init(jax.random.key(0)), mesh, spec, "dp")
+    opt_state = make_optimizer(config).init(params)
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(5):
+        batch = shard_batch((
+            rng.integers(0, num_features, size=(64, nnz)).astype(np.int32),
+            np.ones((64, nnz), np.float32),
+            rng.integers(0, 2, 64).astype(np.float32),
+            np.ones((64,), np.float32),
+        ), mesh)
+        params, opt_state, m = step(params, opt_state, *batch)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses))

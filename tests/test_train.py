@@ -139,3 +139,32 @@ def test_regression_rmse_uses_clipped_predictions():
     out = evaluate_params(spec, params,
                           [(ids, vals, labels, np.ones(8, np.float32))])
     assert out["rmse"] < 1e-5  # clipped prediction == label exactly
+
+
+def test_eval_every_logs_heldout_metrics():
+    import io
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.data import Batches, iterate_once, synthetic_ctr
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+    from fm_spark_tpu.utils.logging import MetricsLogger
+
+    ids, vals, labels = synthetic_ctr(2000, 200, 4, seed=0)
+    spec = models.FMSpec(num_features=200, rank=4, init_std=0.05)
+    config = TrainConfig(num_steps=30, batch_size=256, learning_rate=0.2,
+                         eval_every=10, log_every=10)
+    trainer = FMTrainer(spec, config)
+    stream = io.StringIO()
+    trainer.logger = MetricsLogger(stream=stream)
+    trainer.fit(
+        Batches(ids, vals, labels, 256, seed=0),
+        eval_batches=lambda: iterate_once(ids, vals, labels, 512),
+    )
+    out = stream.getvalue()
+    eval_lines = [l for l in out.splitlines() if "eval_auc" in l]
+    assert len(eval_lines) == 3  # steps 10, 20, 30
+    import json as _json
+
+    last = _json.loads(eval_lines[-1])
+    assert 0.0 <= last["eval_auc"] <= 1.0
+    assert last["eval_count"] == 2000
