@@ -154,13 +154,16 @@ def make_eval_step(spec):
     return jax.jit(step)
 
 
-def evaluate_params(spec, params, batches, max_batches: int | None = None) -> dict:
+def evaluate_params(spec, params, batches, max_batches: int | None = None,
+                    step=None) -> dict:
     """Stream ``(ids, vals, labels, weights)`` batches → finalized metrics.
 
     Shared by :meth:`FMTrainer.evaluate` and :func:`fm_spark_tpu.compat
-    .evaluate`.
+    .evaluate`. Pass a precompiled ``step`` (from :func:`make_eval_step`)
+    to avoid a re-trace per call — periodic in-training eval does.
     """
-    step = make_eval_step(spec)
+    if step is None:
+        step = make_eval_step(spec)
     mstate = metrics_lib.init_metrics()
     for i, (ids, vals, labels, weights) in enumerate(batches):
         if max_batches is not None and i >= max_batches:
@@ -277,6 +280,9 @@ class FMTrainer:
                  and self.step_count % self.config.eval_every == 0)
                 or step_i == total - 1  # always evaluate the final model
             ):
+                import time as _time
+
+                t_eval = _time.perf_counter()
                 em = self.evaluate(eval_batches())
                 self.logger.log(
                     self.step_count,
@@ -284,27 +290,16 @@ class FMTrainer:
                 )
                 # Eval wall-clock must not deflate the next training
                 # throughput window.
-                self.logger.reset_rate_clock()
+                self.logger.add_pause(_time.perf_counter() - t_eval)
             save()
         save(force=True)
         return self.params
 
     def evaluate(self, batches: Iterable, max_batches: int | None = None) -> dict:
-        """Stream eval batches through the on-device accumulators.
-
-        Uses the eval step compiled once at construction — periodic
-        in-training eval (``eval_every``) must not pay a re-trace per
-        invocation.
-        """
-        mstate = metrics_lib.init_metrics()
-        for i, (ids, vals, labels, weights) in enumerate(batches):
-            if max_batches is not None and i >= max_batches:
-                break
-            mstate = self._eval_step(
-                self.params, mstate, jnp.asarray(ids), jnp.asarray(vals),
-                jnp.asarray(labels), jnp.asarray(weights),
-            )
-        return {
-            k: float(v)
-            for k, v in metrics_lib.finalize_metrics(mstate).items()
-        }
+        """Stream eval batches through the on-device accumulators, using
+        the eval step compiled once at construction (no re-trace per
+        periodic in-training eval)."""
+        return evaluate_params(
+            self.spec, self.params, batches, max_batches,
+            step=self._eval_step,
+        )

@@ -22,18 +22,20 @@ class MetricsLogger:
         self._n_chips = max(n_chips, 1)
         self._t0 = None
         self._samples = 0
+        self._paused = 0.0
 
     def log(self, step: int, samples: int = 0, **metrics) -> dict:
         now = time.perf_counter()
         record = {"step": step, "ts": time.time()}
         if samples:
             if self._t0 is not None:
-                dt = now - self._t0
+                dt = now - self._t0 - self._paused
                 rate = self._samples / dt if dt > 0 else 0.0
                 record["samples_per_sec"] = round(rate, 2)
                 record["samples_per_sec_per_chip"] = round(rate / self._n_chips, 2)
             self._t0 = now
             self._samples = samples
+            self._paused = 0.0
         for k, v in metrics.items():
             record[k] = float(v) if hasattr(v, "__float__") else v
         line = json.dumps(record)
@@ -44,11 +46,11 @@ class MetricsLogger:
             self._fh.flush()
         return record
 
-    def reset_rate_clock(self):
-        """Restart the samples/sec window (call after pauses like eval
-        passes or checkpoint stalls, so they don't deflate throughput)."""
-        if self._t0 is not None:
-            self._t0 = time.perf_counter()
+    def add_pause(self, seconds: float):
+        """Exclude a non-training interval (eval pass, checkpoint stall)
+        from the current samples/sec window — correct whatever the
+        alignment between pause and log cadence."""
+        self._paused += max(float(seconds), 0.0)
 
     def close(self):
         if self._fh is not None:
