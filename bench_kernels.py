@@ -1,0 +1,106 @@
+"""Micro-bench: XLA vs Pallas row ops on the FieldFM hot-path shapes.
+
+Run on a real TPU (needs the chip; CPU numbers are meaningless here):
+
+    python bench_kernels.py [--rows 262144] [--width 65] [--batch 131072]
+                            [--dtype float32|bfloat16]
+
+Prints one JSON line per variant: gather (XLA take vs pallas), update
+(XLA scatter-add vs XLA dedup vs pallas unique-row RMW). Feeds the PERF.md
+decision of whether to wire ops/pallas_fm.py into the fused step.
+"""
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=262_144)
+    ap.add_argument("--width", type=int, default=65)
+    ap.add_argument("--batch", type=int, default=131_072)
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fm_spark_tpu.ops import pallas_fm
+    from fm_spark_tpu.ops.scatter import apply_row_updates
+
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(args.rows, args.width)) * 0.01, dtype
+    )
+    # Zipf-skewed ids like real CTR traffic.
+    ids = jnp.asarray(rng.zipf(1.3, size=args.batch) % args.rows, jnp.int32)
+    delta = jnp.asarray(
+        rng.normal(size=(args.batch, args.width)) * 1e-3, jnp.float32
+    )
+
+    def _fence(out):
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+
+    def timed(name, fn, *rest, threaded=None):
+        """Time fn; ``threaded`` names the first arg, re-fed from the
+        output each iteration (required for donated/aliased tables)."""
+        state = threaded
+        out = fn(state, *rest) if state is not None else fn(*rest)
+        _fence(out)
+        if state is not None:
+            state = out
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(state, *rest) if state is not None else fn(*rest)
+            if state is not None:
+                state = out
+        _fence(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        print(json.dumps({
+            "kernel": name, "ms": round(dt * 1e3, 3),
+            "meg_idx_per_s": round(args.batch / dt / 1e6, 1),
+            "rows": args.rows, "width": args.width, "batch": args.batch,
+            "dtype": args.dtype,
+        }))
+        return out
+
+    gather_xla = jax.jit(lambda t, i: t[i])
+    timed("gather_xla", lambda: gather_xla(table, ids))
+    timed("gather_pallas", lambda: pallas_fm.gather_rows(table, ids))
+
+    scatter_xla = jax.jit(
+        lambda t, i, d: t.at[i].add(d.astype(t.dtype))
+    )
+    timed("scatter_add_xla", lambda t: scatter_xla(t, ids, delta),
+          threaded=jnp.copy(table))
+    dedup_xla = jax.jit(
+        lambda t, i, d: apply_row_updates(t, i, d, mode="dedup")
+    )
+    timed("scatter_dedup_xla", lambda t: dedup_xla(t, ids, delta),
+          threaded=jnp.copy(table))
+
+    # Pallas RMW needs unique valid lanes: dedup outside the timed region
+    # mirrors how the fused step would call it (sort+segment are XLA ops
+    # measured separately above via scatter_dedup_xla's delta).
+    sid = jnp.sort(ids)
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]]
+    )
+    uids = jnp.where(run_start, sid, 0)
+    valid = run_start.astype(jnp.int32)
+    timed("update_pallas_unique",
+          lambda t: pallas_fm.update_rows_add(t, uids, valid, delta),
+          threaded=jnp.copy(table))
+
+    n_unique = int(jnp.sum(run_start))
+    print(json.dumps({"note": "unique_ids_in_batch", "value": n_unique,
+                      "fraction": round(n_unique / args.batch, 4)}))
+
+
+if __name__ == "__main__":
+    main()

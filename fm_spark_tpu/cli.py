@@ -306,7 +306,8 @@ def cmd_train(args) -> int:
         optimizer=args.optimizer,
     )
     tconfig = cfg.train_config(
-        log_every=args.log_every, metrics_path=args.metrics
+        log_every=args.log_every, metrics_path=args.metrics,
+        eval_every=args.eval_every,
     )
 
     te = None
@@ -359,10 +360,27 @@ def cmd_train(args) -> int:
         else contextlib.nullcontext()
     )
     strategy = cfg.strategy
+    eval_source = None
     with profile_ctx:
         if strategy == "single":
+            from fm_spark_tpu.data import iterate_once as _iter_once
+
             trainer = FMTrainer(spec, tconfig)
-            trainer.fit(batches, checkpointer=checkpointer)
+            if te is not None:
+                eval_source = lambda: _iter_once(*te, tconfig.batch_size)
+            elif te_packed is not None:
+                eval_source = lambda: iter_packed_once(
+                    te_packed[0], tconfig.batch_size, bucket=te_packed[2],
+                    row_range=te_packed[1],
+                )
+            else:
+                eval_source = None
+            trainer.fit(
+                batches, checkpointer=checkpointer,
+                eval_batches=(
+                    eval_source if tconfig.eval_every > 0 else None
+                ),
+            )
             params = trainer.params
         else:
             # FMTrainer logs through its own MetricsLogger; these loops
@@ -378,13 +396,17 @@ def cmd_train(args) -> int:
             else:
                 raise SystemExit(f"unknown strategy {strategy!r}")
 
-    if te is not None:
+    metrics = None
+    if strategy == "single" and eval_source is not None:
+        # fit() already evaluated the final model when eval_every > 0 —
+        # don't re-stream the held-out set.
+        metrics = trainer.last_eval or trainer.evaluate(eval_source())
+    elif te is not None:
         from fm_spark_tpu.data import iterate_once
 
         metrics = evaluate_params(
             spec, params, iterate_once(*te, tconfig.batch_size)
         )
-        print(json.dumps({"eval": metrics}))
     elif te_packed is not None:
         ds, row_range, bucket = te_packed
         metrics = evaluate_params(
@@ -392,6 +414,7 @@ def cmd_train(args) -> int:
             iter_packed_once(ds, tconfig.batch_size, bucket=bucket,
                              row_range=row_range),
         )
+    if metrics is not None:
         print(json.dumps({"eval": metrics}))
     if args.model_out:
         models.save_model(args.model_out, spec, params)
@@ -524,6 +547,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--test-fraction", type=float, default=0.2)
     t.add_argument("--log-every", type=int, default=100)
+    t.add_argument("--eval-every", type=int, default=0,
+                   help="run held-out eval every N steps during training "
+                        "(single strategy; needs --test-fraction > 0)")
     t.add_argument("--metrics", help="JSONL metrics file")
     t.add_argument("--model-out", help="directory to save the final model")
     t.add_argument("--checkpoint-dir", help="orbax checkpoint directory")

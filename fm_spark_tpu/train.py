@@ -196,6 +196,7 @@ class FMTrainer:
         self.step_count = 0
         self.logger = MetricsLogger(path=config.metrics_path, n_chips=n_chips)
         self.loss_history: list[float] = []
+        self.last_eval: dict | None = None  # most recent in-fit eval metrics
 
     def fit(self, batches: Iterable, num_steps: int | None = None,
             checkpointer=None, preemption_guard=None, eval_batches=None):
@@ -284,6 +285,7 @@ class FMTrainer:
 
                 t_eval = _time.perf_counter()
                 em = self.evaluate(eval_batches())
+                self.last_eval = em
                 self.logger.log(
                     self.step_count,
                     **{f"eval_{k}": v for k, v in em.items()},

@@ -29,8 +29,11 @@ class MetricsLogger:
         record = {"step": step, "ts": time.time()}
         if samples:
             if self._t0 is not None:
+                # ``samples`` covers exactly the window since the previous
+                # samples-bearing log — pair it with THIS window's
+                # duration (minus recorded pauses), never a stale count.
                 dt = now - self._t0 - self._paused
-                rate = self._samples / dt if dt > 0 else 0.0
+                rate = samples / dt if dt > 0 else 0.0
                 record["samples_per_sec"] = round(rate, 2)
                 record["samples_per_sec_per_chip"] = round(rate / self._n_chips, 2)
             self._t0 = now

@@ -1,0 +1,95 @@
+"""Worker script for the 2-process multi-host smoke test.
+
+The Spark `local-cluster[2,1,1024]` idiom (SURVEY.md §4): a real
+multi-process pseudo-cluster with real serialization — here two JAX
+processes, `jax.distributed.initialize`, 2 fake CPU devices each, one
+global `(data,)` mesh, per-host input shards, and a psum'd dp train step.
+Run by tests/test_multihost.py; prints the final loss for cross-host
+agreement checks.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    port = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.parallel import make_parallel_train_step, param_specs
+    from fm_spark_tpu.train import TrainConfig, make_optimizer
+
+    devices = np.asarray(jax.devices()).reshape(-1, 1)  # [4] global
+    mesh = Mesh(devices, ("data", "feat"))
+
+    num_features, nnz, b_global = 128, 4, 64
+    spec = models.FMSpec(num_features=num_features, rank=4, init_std=0.05)
+    config = TrainConfig(learning_rate=0.3, optimizer="sgd")
+    step = make_parallel_train_step(spec, config, mesh, "dp")
+
+    # Replicated params: same init everywhere.
+    params = spec.init(jax.random.key(0))
+    pspecs = param_specs(spec, "dp")
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.make_array_from_callback(
+            x.shape, NamedSharding(mesh, s), lambda idx: np.asarray(x)[idx]
+        ),
+        params, pspecs,
+    )
+    opt_state = make_optimizer(config).init(params)
+
+    from fm_spark_tpu.data import synthetic_ctr
+
+    # Planted-FM data, deterministic on every host; each host feeds only
+    # its addressable shard (the multi-host input idiom).
+    all_ids, all_vals, all_labels = synthetic_ctr(
+        b_global * 10, num_features, nnz, seed=0
+    )
+    losses = []
+    for i in range(10):
+        sl = slice(i * b_global, (i + 1) * b_global)
+        ids, vals, labels = all_ids[sl], all_vals[sl], all_labels[sl]
+        weights = np.ones((b_global,), np.float32)
+        batch = []
+        for arr, spec_p in zip(
+            (ids, vals, labels, weights),
+            (P("data", None), P("data", None), P("data"), P("data")),
+        ):
+            sharding = NamedSharding(mesh, spec_p)
+            batch.append(
+                jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx, a=arr: a[idx]
+                )
+            )
+        params, opt_state, m = step(params, opt_state, *batch)
+        losses.append(float(m["loss"]))
+
+    assert all(np.isfinite(losses)), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+    print(f"MULTIHOST_OK process={process_id} losses={losses}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
