@@ -84,17 +84,16 @@ def main():
     timed("scatter_dedup_xla", lambda t: dedup_xla(t, ids, delta),
           threaded=jnp.copy(table))
 
-    # Pallas RMW needs unique valid lanes: dedup outside the timed region
-    # mirrors how the fused step would call it (sort+segment are XLA ops
-    # measured separately above via scatter_dedup_xla's delta).
-    sid = jnp.sort(ids)
-    run_start = jnp.concatenate(
-        [jnp.ones((1,), bool), sid[1:] != sid[:-1]]
-    )
+    # Pallas RMW needs unique valid lanes: segment-sum dedup outside the
+    # timed region, exactly as the fused step would feed it (the sort+
+    # segment XLA ops are timed separately in scatter_dedup_xla).
+    from fm_spark_tpu.ops.scatter import _dedup
+
+    sid, summed, run_start, _order = jax.jit(_dedup)(ids, delta)
     uids = jnp.where(run_start, sid, 0)
     valid = run_start.astype(jnp.int32)
     timed("update_pallas_unique",
-          lambda t: pallas_fm.update_rows_add(t, uids, valid, delta),
+          lambda t: pallas_fm.update_rows_add(t, uids, valid, summed),
           threaded=jnp.copy(table))
 
     n_unique = int(jnp.sum(run_start))

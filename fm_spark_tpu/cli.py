@@ -71,7 +71,7 @@ def load_dataset(cfg, args) -> tuple:
             ids, vals, labels = data_lib.synthetic_ctr(
                 n, num_features, cfg.num_fields, seed=cfg.seed
             )
-        if cfg.model == "field_fm":
+        if cfg.model in ("field_fm", "field_ffm"):
             ids = _field_local(ids, cfg.bucket)
         return ids, vals, labels, num_features
 
@@ -102,7 +102,7 @@ def load_dataset(cfg, args) -> tuple:
             lines = lines[1:]
         ids, labels = mod.parse_lines(lines, cfg.bucket, per_field=True)
         vals = np.ones(ids.shape, np.float32)
-        if cfg.model == "field_fm":
+        if cfg.model in ("field_fm", "field_ffm"):
             ids = _field_local(ids, cfg.bucket)
         return ids, vals, labels, cfg.num_features
 
@@ -183,7 +183,31 @@ def _resume(checkpointer, params, opt_state, batches):
     return restored["params"], restored["opt_state"], restored["step"]
 
 
-def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
+def _periodic_evaluator(spec, tconfig, eval_source, logger):
+    """Shared periodic-eval hook for the non-FMTrainer loops: returns
+    ``maybe_eval(step, params_canonical)``, a no-op unless ``eval_every``
+    is set; eval wall-clock is excluded from the throughput window."""
+    if eval_source is None or tconfig.eval_every <= 0:
+        return lambda step, params: None
+    import time as _time
+
+    from fm_spark_tpu.train import evaluate_params, make_eval_step
+
+    estep = make_eval_step(spec)  # compiled once, reused every eval
+
+    def maybe_eval(step, params_thunk):
+        if step % tconfig.eval_every:
+            return
+        t0 = _time.perf_counter()
+        em = evaluate_params(spec, params_thunk(), eval_source(), step=estep)
+        logger.log(step, **{f"eval_{k}": v for k, v in em.items()})
+        logger.add_pause(_time.perf_counter() - t0)
+
+    return maybe_eval
+
+
+def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
+                      eval_source=None):
     """Training loop on the fused sparse-SGD step (FieldFMSpec fast path).
 
     On one device this is the single-chip fused step; with multiple
@@ -194,6 +218,8 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
     import jax
     import jax.numpy as jnp
 
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+
     n = jax.device_count()
     canonical = spec.init(jax.random.key(tconfig.seed))
     # Checkpoints always use the canonical per-field-list layout so a run
@@ -201,7 +227,17 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
     # state; an empty dict stands in for it).
     canonical, _, start = _resume(checkpointer, canonical, {}, batches)
 
-    if n > 1:
+    if isinstance(spec, FieldFFMSpec):
+        # Fused field-aware step; single-chip execution (the FFM
+        # field-sharded layout is a follow-on — cross-field factors make
+        # its partials [B, F, k] per chip, not [B, k]).
+        from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
+
+        step = make_field_ffm_sparse_sgd_step(spec, tconfig)
+        params = canonical
+        prep = lambda b: tuple(map(jnp.asarray, b))
+        to_canonical = lambda p: p
+    elif n > 1:
         if tconfig.batch_size % n:
             raise SystemExit(
                 f"batch_size={tconfig.batch_size} must be divisible by the "
@@ -230,6 +266,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
         prep = lambda b: tuple(map(jnp.asarray, b))
         to_canonical = lambda p: p
 
+    maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger)
     log_every = max(tconfig.log_every, 1)
     since = 0
     for i in range(start, tconfig.num_steps):
@@ -239,6 +276,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
         if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
             logger.log(i + 1, samples=since, loss=float(loss))
             since = 0
+        maybe_eval(i + 1, lambda: to_canonical(params))
         if checkpointer is not None and checkpointer.due(i + 1):
             checkpointer.save(i + 1, to_canonical(params), {},
                               batches.state())
@@ -249,7 +287,8 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None):
     return to_canonical(params)
 
 
-def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None):
+def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None,
+                  eval_source=None):
     """Training loop on the mesh-parallel psum step (dp / row)."""
     import jax
 
@@ -273,6 +312,11 @@ def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None):
     )
     opt_state = make_optimizer(tconfig).init(params)
     params, opt_state, start = _resume(checkpointer, params, opt_state, batches)
+    # Eval streams through the single-device step on gathered params —
+    # rare relative to training, so clarity wins over sharded eval here.
+    maybe_eval = _periodic_evaluator(
+        spec, tconfig, eval_source, logger
+    )
     log_every = max(tconfig.log_every, 1)
     since = 0
     for i in range(start, tconfig.num_steps):
@@ -283,6 +327,7 @@ def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None):
             logger.log(i + 1, samples=since, loss=float(m["loss"]),
                        grad_norm=float(m["grad_norm"]))
             since = 0
+        maybe_eval(i + 1, lambda: jax.device_get(params))
         if checkpointer is not None:
             checkpointer.maybe_save(i + 1, params, opt_state, batches.state())
     if checkpointer is not None:
@@ -324,7 +369,7 @@ def cmd_train(args) -> int:
             max(1, int(len(ds) * (1.0 - args.test_fraction)))
             if args.test_fraction > 0 else len(ds)
         )
-        bucket = cfg.bucket if cfg.model == "field_fm" else 0
+        bucket = cfg.bucket if cfg.model in ("field_fm", "field_ffm") else 0
         batches = StreamingBatches(
             PackedBatches(ds, tconfig.batch_size, seed=cfg.seed,
                           row_range=(0, cut)),
@@ -360,21 +405,20 @@ def cmd_train(args) -> int:
         else contextlib.nullcontext()
     )
     strategy = cfg.strategy
-    eval_source = None
+    from fm_spark_tpu.data import iterate_once as _iter_once
+
+    if te is not None:
+        eval_source = lambda: _iter_once(*te, tconfig.batch_size)
+    elif te_packed is not None:
+        eval_source = lambda: iter_packed_once(
+            te_packed[0], tconfig.batch_size, bucket=te_packed[2],
+            row_range=te_packed[1],
+        )
+    else:
+        eval_source = None
     with profile_ctx:
         if strategy == "single":
-            from fm_spark_tpu.data import iterate_once as _iter_once
-
             trainer = FMTrainer(spec, tconfig)
-            if te is not None:
-                eval_source = lambda: _iter_once(*te, tconfig.batch_size)
-            elif te_packed is not None:
-                eval_source = lambda: iter_packed_once(
-                    te_packed[0], tconfig.batch_size, bucket=te_packed[2],
-                    row_range=te_packed[1],
-                )
-            else:
-                eval_source = None
             trainer.fit(
                 batches, checkpointer=checkpointer,
                 eval_batches=(
@@ -389,10 +433,12 @@ def cmd_train(args) -> int:
                                    n_chips=_jax.device_count())
             if strategy == "field_sparse":
                 params = _fit_field_sparse(spec, tconfig, batches, logger,
-                                           checkpointer)
+                                           checkpointer,
+                                           eval_source=eval_source)
             elif strategy in ("dp", "row"):
                 params = _fit_parallel(spec, tconfig, batches, strategy,
-                                       logger, checkpointer)
+                                       logger, checkpointer,
+                                       eval_source=eval_source)
             else:
                 raise SystemExit(f"unknown strategy {strategy!r}")
 
@@ -444,7 +490,7 @@ def _batches_for_model(args, spec):
         ids, vals, labels = data_lib.synthetic_ctr(
             args.synthetic, spec.num_features, nnz, seed=1
         )
-        if type(spec).__name__ == "FieldFMSpec":
+        if type(spec).__name__ in ("FieldFMSpec", "FieldFFMSpec"):
             ids = _field_local(ids, spec.bucket)
         return iterate_once(ids, vals, labels, args.batch_size)
 
@@ -462,7 +508,7 @@ def _batches_for_model(args, spec):
         )
     if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
         ds = data_lib.PackedDataset(args.data)
-        bucket = cfg.bucket if cfg.model == "field_fm" else 0
+        bucket = cfg.bucket if cfg.model in ("field_fm", "field_ffm") else 0
         return iter_packed_once(ds, args.batch_size, bucket=bucket)
     ids, vals, labels, _ = load_dataset(cfg, args)
     return iterate_once(ids, vals, labels, args.batch_size)

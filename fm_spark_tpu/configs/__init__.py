@@ -82,6 +82,12 @@ class RunConfig:
             return models.FieldFMSpec(
                 **common, num_fields=self.num_fields, bucket=self.bucket
             )
+        if self.model == "field_ffm":
+            if num_features is not None and num_features != self.num_features:
+                raise ValueError("field_ffm shapes are fixed by num_fields*bucket")
+            return models.FieldFFMSpec(
+                **common, num_fields=self.num_fields, bucket=self.bucket
+            )
         if self.model == "ffm":
             return models.FFMSpec(**common, num_fields=self.num_fields)
         if self.model == "deepfm":
@@ -129,9 +135,10 @@ CONFIGS = {
         RunConfig(
             name="avazu_ffm_r16",
             description="Config 4 (BASELINE.json:10): FFM rank-16, Avazu CTR,"
-            " 23 fields (avazu.py), per-field hashed.",
-            model="ffm", dataset="avazu", rank=16, num_fields=23,
-            bucket=1 << 14, strategy="single", num_steps=100_000,
+            " 23 fields (avazu.py), per-field hashed; field-partitioned"
+            " packed tables + fused sparse-SGD fast path.",
+            model="field_ffm", dataset="avazu", rank=16, num_fields=23,
+            bucket=1 << 14, strategy="field_sparse", num_steps=100_000,
             batch_size=8192, learning_rate=0.05, lr_schedule="constant",
         ),
         RunConfig(

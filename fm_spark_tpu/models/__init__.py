@@ -12,6 +12,7 @@ from fm_spark_tpu.models.fm import FMSpec  # noqa: F401
 from fm_spark_tpu.models.ffm import FFMSpec  # noqa: F401
 from fm_spark_tpu.models.deepfm import DeepFMSpec  # noqa: F401
 from fm_spark_tpu.models.field_fm import FieldFMSpec  # noqa: F401
+from fm_spark_tpu.models.field_ffm import FieldFFMSpec  # noqa: F401
 from fm_spark_tpu.models.io import save_model, load_model  # noqa: F401
 from fm_spark_tpu.models.libfm_io import save_libfm, load_libfm  # noqa: F401
 

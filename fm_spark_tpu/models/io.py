@@ -31,12 +31,14 @@ def _register_families():
     from fm_spark_tpu.models.ffm import FFMSpec
     from fm_spark_tpu.models.deepfm import DeepFMSpec
     from fm_spark_tpu.models.field_fm import FieldFMSpec
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
 
     _FAMILIES.update(
         FMSpec=FMSpec,
         FFMSpec=FFMSpec,
         DeepFMSpec=DeepFMSpec,
         FieldFMSpec=FieldFMSpec,
+        FieldFFMSpec=FieldFFMSpec,
     )
 
 

@@ -135,7 +135,9 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         raise ValueError("field-sharded step requires fused_linear=True")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
-    sr_base_key = jax.random.key(config.seed + 0x5EED)
+    from fm_spark_tpu.sparse import _apply_field_updates, _lr_at, _sr_base_key
+
+    sr_base_key = _sr_base_key(config)
     if set(mesh.axis_names) != {"feat"}:
         raise ValueError(
             "field-sharded step runs on a 1-D ('feat',) mesh — tables are "
@@ -147,11 +149,7 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     k = spec.rank
     n_feat = mesh.shape["feat"]
     f_local = padded_num_fields(spec.num_fields, n_feat) // n_feat
-
-    if config.lr_schedule == "inv_sqrt":
-        lr_at = lambda i: config.learning_rate / jnp.sqrt(i.astype(jnp.float32) + 1.0)
-    else:
-        lr_at = lambda i: jnp.float32(config.learning_rate)
+    lr_at = _lr_at(config)
 
     def local_step(params, step_idx, ids, vals, labels, weights):
         # Local blocks in: vw [f_local, bucket, width]; ids/vals
@@ -196,9 +194,7 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         lr = lr_at(step_idx)
         touched = weights > 0
 
-        from fm_spark_tpu.ops import scatter as scatter_lib
-
-        new_slices = []
+        g_fulls = []
         for f in range(f_local):
             g_v = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
             if config.reg_factors:
@@ -209,19 +205,13 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
                     g_l = g_l + config.reg_linear * rows[f][:, k] * touched
             else:
                 g_l = jnp.zeros_like(dscores)
-            g_full = jnp.concatenate([g_v, g_l[:, None]], axis=1)
-            if config.sparse_update == "dedup_sr":
-                # Decorrelate SR noise across (step, global field).
-                gf = lax.axis_index("feat") * f_local + f
-                key = scatter_lib.sr_key(sr_base_key, step_idx, gf)
-            else:
-                key = None
-            new_slices.append(
-                scatter_lib.apply_row_updates(
-                    vw[f], ids[:, f], -lr * g_full,
-                    mode=config.sparse_update, key=key, old_rows=rows[f],
-                )
-            )
+            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        # SR keys are per GLOBAL field, decorrelated across chips.
+        new_slices = _apply_field_updates(
+            [vw[f] for f in range(f_local)], ids, g_fulls, rows, config,
+            sr_base_key, step_idx, lr,
+            field_offset=lax.axis_index("feat") * f_local,
+        )
         new_vw = jnp.stack(new_slices, axis=0)
         out = {"w0": w0, "vw": new_vw}
         if spec.use_bias:

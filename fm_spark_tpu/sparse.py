@@ -31,6 +31,47 @@ from fm_spark_tpu.ops import losses as losses_lib
 from fm_spark_tpu.train import TrainConfig
 
 
+def _lr_at(config: TrainConfig):
+    """The reference's 1-based ``stepSize/√iter`` schedule (or constant),
+    as a traced-step function — single definition for every fused body."""
+    if config.lr_schedule == "inv_sqrt":
+        return lambda i: config.learning_rate / jnp.sqrt(
+            i.astype(jnp.float32) + 1.0
+        )
+    if config.lr_schedule == "constant":
+        return lambda i: jnp.float32(config.learning_rate)
+    raise ValueError(f"unknown lr_schedule {config.lr_schedule!r}")
+
+
+def _sr_base_key(config: TrainConfig):
+    return jax.random.key(config.seed + 0x5EED)
+
+
+def _apply_field_updates(tables, ids, g_fulls, rows, config: TrainConfig,
+                         sr_base_key, step_idx, lr, field_offset=0):
+    """Write ``-lr·g_full`` into each field's table via the configured
+    sparse-update mode (ops/scatter.py); shared by the FieldFM, FieldFFM,
+    and field-sharded bodies so mode/key semantics can never diverge.
+    ``field_offset`` shifts the SR key stream for sharded callers (global
+    field index = offset + local f)."""
+    from fm_spark_tpu.ops import scatter as scatter_lib
+
+    new = []
+    for f, g_full in enumerate(g_fulls):
+        key = (
+            scatter_lib.sr_key(sr_base_key, step_idx, field_offset + f)
+            if config.sparse_update == "dedup_sr"
+            else None
+        )
+        new.append(
+            scatter_lib.apply_row_updates(
+                tables[f], ids[:, f], -lr * g_full,
+                mode=config.sparse_update, key=key, old_rows=rows[f],
+            )
+        )
+    return new
+
+
 def make_field_sparse_sgd_body(spec, config: TrainConfig):
     """Unjitted fused-step body for :class:`FieldFMSpec` (see the jitted
     wrapper :func:`make_field_sparse_sgd_step`); exposed separately so
@@ -47,13 +88,8 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
-    sr_base_key = jax.random.key(config.seed + 0x5EED)
-
-    if config.lr_schedule == "inv_sqrt":
-        lr_at = lambda i: config.learning_rate / jnp.sqrt(i.astype(jnp.float32) + 1.0)
-    else:
-        lr_at = lambda i: jnp.float32(config.learning_rate)
-
+    sr_base_key = _sr_base_key(config)
+    lr_at = _lr_at(config)
     k = spec.rank
 
     def step(params, step_idx, ids, vals, labels, weights):
@@ -99,28 +135,18 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         if spec.fused_linear:
             # ONE row-update per field: interaction grads in cols [:k], the
             # linear grad in col k (zeroed if the linear term is disabled).
-            from fm_spark_tpu.ops import scatter as scatter_lib
-
-            new_vw = []
+            g_fulls = []
             for f in range(F):
                 g_lin = (
                     linear_grad(f)[:, None]
                     if spec.use_linear
                     else jnp.zeros((dscores.shape[0], 1), cd)
                 )
-                g_full = jnp.concatenate([factor_grad(f), g_lin], axis=1)
-                key = (
-                    scatter_lib.sr_key(sr_base_key, step_idx, f)
-                    if config.sparse_update == "dedup_sr"
-                    else None
-                )
-                new_vw.append(
-                    scatter_lib.apply_row_updates(
-                        params["vw"][f], ids[:, f], -lr * g_full,
-                        mode=config.sparse_update, key=key,
-                        old_rows=rows[f],
-                    )
-                )
+                g_fulls.append(jnp.concatenate([factor_grad(f), g_lin], axis=1))
+            new_vw = _apply_field_updates(
+                params["vw"], ids, g_fulls, rows, config, sr_base_key,
+                step_idx, lr,
+            )
             out = {"w0": w0, "vw": new_vw}
         else:
             new_v = [
@@ -154,6 +180,95 @@ def make_field_sparse_sgd_step(spec, config: TrainConfig):
     Tables are donated so updates are in-place in HBM."""
     return jax.jit(
         make_field_sparse_sgd_body(spec, config), donate_argnums=(0,)
+    )
+
+
+def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
+    """Unjitted fused sparse-SGD body for :class:`FieldFFMSpec`.
+
+    Analytic backward of the field-aware interaction (the reference's
+    field-aware `computeGradient` analog, BASELINE.json:10): with
+    ``sel[b,i,j] = v[id_i, field j]·x_i``, the pairwise term is
+    ``½ Σ_{i≠j} ⟨sel[b,i,j], sel[b,j,i]⟩``, so
+
+        ∂L/∂sel[b,i,j] = dscore_b · sel[b,j,i]   (i ≠ j; diagonal 0)
+        ∂L/∂v[id_i, field j] = ∂L/∂sel[b,i,j] · x_i
+
+    — one [B, F, F, k] transpose, then one scatter per field, same
+    index-op count as the FieldFM step.
+    """
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+
+    if type(spec) is not FieldFFMSpec:
+        raise ValueError("expected a FieldFFMSpec")
+    if config.optimizer != "sgd":
+        raise ValueError("sparse step implements plain SGD only")
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    F, k = spec.num_fields, spec.rank
+    sr_base_key = _sr_base_key(config)
+    lr_at = _lr_at(config)
+
+    def step(params, step_idx, ids, vals, labels, weights):
+        w0 = params["w0"]
+        vals_c = vals.astype(cd)
+        rows = spec.gather_rows(params, ids)            # F × [B, F·k+1]
+        sel = spec._sel(rows, vals_c)                   # [B, F, F, k]
+        a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)
+        diag = jnp.trace(a, axis1=1, axis2=2)
+        scores = 0.5 * (jnp.sum(a, axis=(1, 2)) - diag)
+        if spec.use_linear:
+            lins = [r[:, F * k] for r in rows]
+            scores = scores + sum(
+                l * vals_c[:, i] for i, l in enumerate(lins)
+            )
+        if spec.use_bias:
+            scores = scores + w0.astype(cd)
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        loss, dscores = jax.value_and_grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        # d/dsel = ds · selᵀ with a zeroed diagonal.
+        dsel = dscores[:, None, None, None] * jnp.swapaxes(sel, 1, 2)
+        eye = jnp.eye(F, dtype=cd)[None, :, :, None]
+        dsel = dsel * (1.0 - eye)
+        # dv[id_i, :, :] = dsel[b, i, :, :] · x_i  → flat [B, F·k] per field.
+        dv = (dsel * vals_c[:, :, None, None]).reshape(-1, F, F * k)
+
+        g_fulls = []
+        for f in range(F):
+            g_v = dv[:, f, :]
+            if config.reg_factors:
+                g_v = g_v + config.reg_factors * rows[f][:, : F * k] * touched[:, None]
+            if spec.use_linear:
+                g_l = dscores * vals_c[:, f]
+                if config.reg_linear:
+                    g_l = g_l + config.reg_linear * lins[f] * touched
+            else:
+                g_l = jnp.zeros_like(dscores)
+            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        new_vw = _apply_field_updates(
+            params["vw"], ids, g_fulls, rows, config, sr_base_key, step_idx,
+            lr,
+        )
+        out = {"w0": w0, "vw": new_vw}
+        if spec.use_bias:
+            out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
+        return out, loss
+
+    return step
+
+
+def make_field_ffm_sparse_sgd_step(spec, config: TrainConfig):
+    """Jitted fused sparse-SGD step for :class:`FieldFFMSpec`."""
+    return jax.jit(
+        make_field_ffm_sparse_sgd_body(spec, config), donate_argnums=(0,)
     )
 
 

@@ -21,7 +21,6 @@ class MetricsLogger:
         self._stream = stream if stream is not None else sys.stdout
         self._n_chips = max(n_chips, 1)
         self._t0 = None
-        self._samples = 0
         self._paused = 0.0
 
     def log(self, step: int, samples: int = 0, **metrics) -> dict:
@@ -37,7 +36,6 @@ class MetricsLogger:
                 record["samples_per_sec"] = round(rate, 2)
                 record["samples_per_sec_per_chip"] = round(rate / self._n_chips, 2)
             self._t0 = now
-            self._samples = samples
             self._paused = 0.0
         for k, v in metrics.items():
             record[k] = float(v) if hasattr(v, "__float__") else v

@@ -282,3 +282,24 @@ def test_cli_eval_data_requires_config(tmp_path, capsys):
     ]) == 0
     with pytest.raises(SystemExit, match="needs --config"):
         cli.main(["eval", "--model", model_dir, "--data", "/tmp/nope"])
+
+
+def test_eval_every_field_sparse_strategy(capsys):
+    # Periodic eval must work in the non-FMTrainer loops too.
+    small = dataclasses.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"],
+        name="ee_small", bucket=64, num_fields=5,
+    )
+    configs_lib.CONFIGS["ee_small"] = small
+    try:
+        rc = cli.main([
+            "train", "--config", "ee_small", "--synthetic", "2000",
+            "--steps", "24", "--batch-size", "256", "--log-every", "8",
+            "--eval-every", "8",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        eval_lines = [l for l in out.splitlines() if "eval_auc" in l]
+        assert len(eval_lines) == 3  # steps 8, 16, 24
+    finally:
+        del configs_lib.CONFIGS["ee_small"]
