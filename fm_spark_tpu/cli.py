@@ -101,6 +101,10 @@ def load_dataset(cfg, args) -> tuple:
         if cfg.dataset == "avazu" and lines and lines[0].startswith(b"id,"):
             lines = lines[1:]
         ids, labels = mod.parse_lines(lines, cfg.bucket, per_field=True)
+        # parse_lines yields int8 labels (the packed on-disk dtype); every
+        # other loader hands float32 to the jitted steps — match it, or the
+        # step recompiles against a second signature.
+        labels = labels.astype(np.float32)
         vals = np.ones(ids.shape, np.float32)
         if cfg.model in ("field_fm", "field_ffm"):
             ids = _field_local(ids, cfg.bucket)
@@ -348,7 +352,8 @@ def cmd_train(args) -> int:
         args.config,
         num_steps=args.steps, batch_size=args.batch_size,
         learning_rate=args.lr, strategy=args.strategy, seed=args.seed,
-        optimizer=args.optimizer,
+        optimizer=args.optimizer, sparse_update=args.sparse_update,
+        param_dtype=args.param_dtype,
     )
     tconfig = cfg.train_config(
         log_every=args.log_every, metrics_path=args.metrics,
@@ -359,8 +364,11 @@ def cmd_train(args) -> int:
     te_packed = None
     if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
         # Large preprocessed data: stream from the memory-mapped packed
-        # dir. --test-fraction holds out the file's TAIL rows (packed
-        # order is already shuffled at preprocess time).
+        # dir. --test-fraction holds out the file's TAIL rows — a random
+        # split iff the packed dir was shuffled (preprocess shuffles by
+        # default; with --no-shuffle this is a TEMPORAL tail split, e.g.
+        # the last Criteo day, and held-out metrics are not comparable to
+        # a random-split baseline).
         from fm_spark_tpu.data import PackedBatches, PackedDataset
 
         spec = cfg.spec()
@@ -510,7 +518,16 @@ def _batches_for_model(args, spec):
         ds = data_lib.PackedDataset(args.data)
         bucket = cfg.bucket if cfg.model in ("field_fm", "field_ffm") else 0
         return iter_packed_once(ds, args.batch_size, bucket=bucket)
-    ids, vals, labels, _ = load_dataset(cfg, args)
+    ids, vals, labels, num_features = load_dataset(cfg, args)
+    if cfg.bucket <= 0 and num_features > spec.num_features:
+        # Dense-id datasets (movielens/libsvm) size the feature space from
+        # the data; ids beyond the model's table would be silently clamped
+        # by XLA gather into the table edge — meaningless metrics.
+        raise SystemExit(
+            f"dataset has {num_features} features but the model was trained "
+            f"with {spec.num_features}; out-of-range ids would be silently "
+            "clamped — evaluate on data from the training feature space"
+        )
     return iterate_once(ids, vals, labels, args.batch_size)
 
 
@@ -545,6 +562,9 @@ def cmd_predict(args) -> int:
 
 
 def cmd_preprocess(args) -> int:
+    import os
+    import shutil
+
     from fm_spark_tpu import configs as configs_lib
 
     cfg = configs_lib.get_config(args.config)
@@ -553,8 +573,25 @@ def cmd_preprocess(args) -> int:
     mod = __import__(
         f"fm_spark_tpu.data.{cfg.dataset}", fromlist=["preprocess"]
     )
-    stats = mod.preprocess(args.input, args.out_dir, cfg.bucket)
-    print(json.dumps({"out_dir": args.out_dir, "stats": stats}))
+    if args.shuffle:
+        # Source text streams in raw (often temporal) order; a global
+        # external shuffle here is what makes the training-time tail
+        # holdout (--test-fraction) a random split rather than "the last
+        # day of Criteo". One-time cost at preprocess, never in the hot
+        # path.
+        from fm_spark_tpu.data import shuffle_packed
+
+        tmp = args.out_dir.rstrip("/") + ".unshuffled.tmp"
+        stats = mod.preprocess(args.input, tmp, cfg.bucket)
+        # remove_src drops the unshuffled copy as soon as its rows are
+        # dealt — peak scratch ~2x the dataset, not 3x.
+        shuffle_packed(tmp, args.out_dir, seed=cfg.seed, remove_src=True)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+    else:
+        stats = mod.preprocess(args.input, args.out_dir, cfg.bucket)
+    print(json.dumps({"out_dir": args.out_dir, "num_examples": stats,
+                      "shuffled": bool(args.shuffle)}))
     return 0
 
 
@@ -590,6 +627,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--optimizer", default=None)
     t.add_argument("--strategy", default=None,
                    choices=["single", "field_sparse", "dp", "row"])
+    t.add_argument("--sparse-update", default=None, dest="sparse_update",
+                   choices=["scatter_add", "dedup", "dedup_sr"],
+                   help="row-write strategy for the fused sparse steps "
+                        "(dedup_sr = the bf16 quality fix, see PERF.md)")
+    t.add_argument("--param-dtype", default=None, dest="param_dtype",
+                   choices=["float32", "bfloat16"],
+                   help="table storage dtype (bfloat16 halves gather bytes; "
+                        "pair with --sparse-update dedup_sr)")
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--test-fraction", type=float, default=0.2)
     t.add_argument("--log-every", type=int, default=100)
@@ -622,7 +667,10 @@ def build_parser() -> argparse.ArgumentParser:
     pp.add_argument("--config", required=True)
     pp.add_argument("--input", required=True, nargs="+")
     pp.add_argument("--out-dir", required=True)
-    pp.set_defaults(fn=cmd_preprocess)
+    pp.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                    help="keep raw source order (tail holdouts become "
+                         "temporal splits — see train --test-fraction)")
+    pp.set_defaults(fn=cmd_preprocess, shuffle=True)
 
     lc = sub.add_parser("list-configs", help="show registered configs")
     lc.add_argument("--verbose", action="store_true")

@@ -56,6 +56,11 @@ class RunConfig:
     reg_linear: float = 0.0
     reg_factors: float = 1e-6
     seed: int = 0
+    # Sparse-row write strategy for the fused FieldFM steps (ops/scatter.py);
+    # picked up by train_config() via _TRAIN_FIELDS, so the CLI
+    # --sparse-update override reaches the fused step. dedup_sr is the
+    # bf16-storage quality fix promoted in PERF.md.
+    sparse_update: str = "scatter_add"
 
     @property
     def num_features(self) -> int:

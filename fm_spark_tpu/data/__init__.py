@@ -17,5 +17,6 @@ from fm_spark_tpu.data.packed import (  # noqa: F401
     PackedBatches,
     PackedDataset,
     PackedWriter,
+    shuffle_packed,
 )
 from fm_spark_tpu.data.libsvm import load_libsvm, save_libsvm  # noqa: F401

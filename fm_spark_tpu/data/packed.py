@@ -139,6 +139,122 @@ class PackedDataset:
         return ids, vals, np.asarray(self.labels[sel], np.float32)
 
 
+def _row_bytes(ds: PackedDataset) -> int:
+    return 4 * ds.num_fields + 1 + (4 * ds.num_fields if ds.store_vals else 0)
+
+
+def _shuffle_into(ds: PackedDataset, out: PackedWriter,
+                  rng: np.random.Generator, mem_budget_bytes: int,
+                  chunk_rows: int, max_open: int, tmp_dir: str,
+                  depth: int = 0, remove: str | None = None) -> None:
+    """Append a uniform permutation of ``ds`` to ``out`` (recursive deal).
+
+    Fits in memory → load, permute, append. Otherwise deal rows into at
+    most ``max_open`` random groups (bounds simultaneously open file
+    descriptors regardless of dataset size), then recurse per group in
+    order. Random group assignment + uniform within-group permutation =
+    a uniform global permutation. ``remove`` names a directory to delete
+    as soon as ``ds``'s rows are safely elsewhere — each level's scratch
+    is freed while the output grows, capping peak disk at ~2x.
+    """
+    import shutil
+
+    n = len(ds)
+    if n * _row_bytes(ds) <= mem_budget_bytes:
+        perm = rng.permutation(n)
+        # Direct memmap reads: labels stay int8 (PackedDataset.slice would
+        # cast to f32 and, for store_vals=False dirs, allocate throwaway
+        # ones arrays).
+        out.append(np.asarray(ds.ids[:])[perm],
+                   np.asarray(ds.labels[:])[perm],
+                   np.asarray(ds.vals[:])[perm] if ds.store_vals else None)
+        if remove:
+            del ds
+            shutil.rmtree(remove)
+        return
+    groups = min(
+        max_open, int(-(-2 * n * _row_bytes(ds) // mem_budget_bytes))
+    )
+    writers = [
+        PackedWriter(os.path.join(tmp_dir, f"d{depth}_g{i:04d}"),
+                     ds.num_fields, store_vals=ds.store_vals)
+        for i in range(groups)
+    ]
+    for start in range(0, n, chunk_rows):
+        sel = np.s_[start:min(start + chunk_rows, n)]
+        ids = np.asarray(ds.ids[sel])
+        labels = np.asarray(ds.labels[sel])
+        vals = np.asarray(ds.vals[sel]) if ds.store_vals else None
+        assign = rng.integers(groups, size=ids.shape[0])
+        for g in np.unique(assign):
+            m = assign == g
+            writers[g].append(ids[m], labels[m],
+                              vals[m] if ds.store_vals else None)
+    for w in writers:
+        w.close()
+    if remove:
+        del ds
+        shutil.rmtree(remove)
+    for w in writers:
+        if w.num_examples:
+            _shuffle_into(PackedDataset(w.path), out, rng,
+                          mem_budget_bytes, chunk_rows, max_open,
+                          tmp_dir, depth + 1, remove=w.path)
+        else:
+            shutil.rmtree(w.path)
+
+
+def shuffle_packed(src_path: str, out_path: str, seed: int = 0,
+                   mem_budget_bytes: int = 1 << 29,
+                   chunk_rows: int = 1 << 18, max_open: int = 128,
+                   remove_src: bool = False) -> None:
+    """Globally shuffle a packed dir into a new packed dir.
+
+    External shuffle (the tf.data/beam idiom — sequential IO per pass,
+    never materializes the dataset): deal rows into random groups small
+    enough to permute in ``mem_budget_bytes``, recursing when one level
+    of at most ``max_open`` groups is not enough (keeps open file
+    descriptors bounded at TB scale). Deterministic in ``seed``.
+    ``remove_src=True`` deletes the source dir as soon as its rows are
+    dealt, capping peak scratch at ~2x the dataset.
+
+    This is what makes the training-time tail holdout
+    (``cli train --test-fraction``) a random split: criteo/avazu source
+    text streams in temporal order, and without a preprocess-time shuffle
+    the tail is the last day, not a sample.
+    """
+    import shutil
+
+    if os.path.realpath(src_path) == os.path.realpath(out_path):
+        raise ValueError(
+            "shuffle_packed cannot shuffle in place (the output writer "
+            "would truncate the source files it is reading) — write to a "
+            "new directory"
+        )
+    ds = PackedDataset(src_path)
+    rng = np.random.default_rng([seed, 0x50FF1E])  # domain-separated stream
+    tmp_dir = out_path.rstrip("/") + ".shards.tmp"
+    os.makedirs(tmp_dir, exist_ok=True)
+    try:
+        # The source is only removed after the WHOLE shuffle succeeds: a
+        # mid-shuffle failure (ENOSPC...) must never leave the only copy
+        # of undealt rows in scratch dirs. Peak disk is ~2x either way —
+        # internal group dirs shrink as the output grows.
+        with PackedWriter(out_path, ds.num_fields,
+                          store_vals=ds.store_vals) as out:
+            _shuffle_into(ds, out, rng, mem_budget_bytes, chunk_rows,
+                          max_open, tmp_dir)
+    except BaseException:
+        # Never leave a valid-looking truncated output behind.
+        shutil.rmtree(out_path, ignore_errors=True)
+        raise
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    if remove_src:
+        del ds
+        shutil.rmtree(src_path)
+
+
 class PackedBatches:
     """Chunk-shuffled, per-host-sharded, resumable batch iterator.
 

@@ -57,9 +57,20 @@ def stochastic_round(x: jax.Array, dtype, key: jax.Array) -> jax.Array:
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
     rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
-    return jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(
+    out = jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(
         jnp.bfloat16
     )
+    # The integer bit-add carries into the exponent field for values whose
+    # mantissa is all-ones; near bf16 max that can overflow a FINITE input
+    # into inf — saturate to ±max instead. Non-finite inputs bypass the
+    # bit-add entirely (it would corrupt NaN payloads / inf encodings).
+    finite_in = jnp.isfinite(x)
+    maxv = jnp.asarray(jnp.finfo(jnp.bfloat16).max, jnp.bfloat16)
+    out = jnp.where(
+        jnp.isfinite(out) | ~finite_in, out,
+        jnp.sign(x).astype(jnp.bfloat16) * maxv,
+    )
+    return jnp.where(finite_in, out, x.astype(jnp.bfloat16))
 
 
 def _dedup(ids: jax.Array, delta: jax.Array):

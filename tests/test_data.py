@@ -2,6 +2,8 @@
 parsers, per-host sharding, resumable iteration (SURVEY.md §4 parity tests
 + §7 hard part #1)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -136,6 +138,80 @@ def test_packed_batches_restore_different_chunking_raises(tmp_path):
     b3 = PackedBatches(ds, 32, seed=1, chunk_size=128, shuffle=False)
     with pytest.raises(ValueError, match="shuffle"):
         b3.restore(state)
+
+
+@pytest.mark.parametrize("store_vals", [True, False])
+def test_shuffle_packed_permutes_and_preserves_rows(tmp_path, store_vals):
+    from fm_spark_tpu.data.packed import shuffle_packed
+
+    ids, vals, labels = _write_packed(tmp_path, store_vals=store_vals)
+    out = str(tmp_path / "shuffled")
+    # Tiny memory budget + tiny max_open force the RECURSIVE external
+    # path (more groups needed than fds allowed per level).
+    shuffle_packed(str(tmp_path / "ds"), out, seed=3,
+                   mem_budget_bytes=2048, chunk_rows=128, max_open=4)
+    ds = PackedDataset(out)
+    assert len(ds) == len(ids)
+    gi, gv, gl = ds.slice(slice(None))
+    # Rows are a permutation of the originals: compare as sorted records.
+    def records(i, v, l):
+        rec = np.concatenate(
+            [i.astype(np.int64),
+             np.ascontiguousarray(v, np.float32).view(np.int32)
+             .astype(np.int64),
+             np.asarray(l, np.float32).reshape(-1, 1).view(np.int32)
+             .astype(np.int64)], axis=1
+        )
+        return rec[np.lexsort(rec.T)]
+
+    np.testing.assert_array_equal(
+        records(gi, gv, gl), records(ids, vals, labels.astype(np.float32))
+    )
+    # ...and actually shuffled (overwhelmingly unlikely to match).
+    assert not np.array_equal(gi, ids)
+    # Deterministic in (seed, budget shape).
+    out2 = str(tmp_path / "shuffled2")
+    shuffle_packed(str(tmp_path / "ds"), out2, seed=3,
+                   mem_budget_bytes=2048, chunk_rows=128, max_open=4)
+    gi2, _, _ = PackedDataset(out2).slice(slice(None))
+    np.testing.assert_array_equal(gi, gi2)
+    # No temp shards left behind.
+    assert not os.path.exists(out + ".shards.tmp")
+
+
+def test_shuffle_packed_in_place_refused(tmp_path):
+    from fm_spark_tpu.data.packed import shuffle_packed
+
+    _write_packed(tmp_path)
+    src = str(tmp_path / "ds")
+    with pytest.raises(ValueError, match="in place"):
+        shuffle_packed(src, src)
+    # Source untouched by the refused call.
+    assert len(PackedDataset(src)) == 1000
+
+
+def test_shuffle_packed_failure_leaves_no_truncated_output(tmp_path,
+                                                           monkeypatch):
+    from fm_spark_tpu.data import packed as packed_mod
+
+    _write_packed(tmp_path)
+    src = str(tmp_path / "ds")
+    out = str(tmp_path / "out")
+
+    def boom(ds, w, *a, **k):
+        # Emulate a mid-shuffle crash after a partial append.
+        w.append(np.asarray(ds.ids[:10]), np.asarray(ds.labels[:10]),
+                 np.asarray(ds.vals[:10]))
+        raise OSError("disk full")
+
+    monkeypatch.setattr(packed_mod, "_shuffle_into", boom)
+    with pytest.raises(OSError, match="disk full"):
+        packed_mod.shuffle_packed(src, out, remove_src=True)
+    # No valid-looking truncated output, no leftover scratch, and the
+    # source survived even though remove_src was requested.
+    assert not os.path.exists(out)
+    assert not os.path.exists(out + ".shards.tmp")
+    assert len(PackedDataset(src)) == 1000
 
 
 def test_empty_packed_dataset_clear_error(tmp_path):

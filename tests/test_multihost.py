@@ -44,10 +44,26 @@ def test_two_process_dp_psum_agrees():
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
+        if os.environ.get("FMTPU_REQUIRE_MULTIHOST"):
+            raise AssertionError(
+                "multi-process coordinator timed out and "
+                "FMTPU_REQUIRE_MULTIHOST is set"
+            )
+        print("\nWARNING: multihost test SKIPPED (coordinator timeout) — "
+              "set FMTPU_REQUIRE_MULTIHOST=1 to make this a failure",
+              file=sys.stderr)
         pytest.skip("multi-process coordinator timed out in this sandbox")
     if any(p.returncode != 0 for p in procs):
         combined = "\n---\n".join(outs)
         if "UNAVAILABLE" in combined or "DEADLINE" in combined:
+            if os.environ.get("FMTPU_REQUIRE_MULTIHOST"):
+                raise AssertionError(
+                    f"distributed init unavailable and "
+                    f"FMTPU_REQUIRE_MULTIHOST is set:\n{combined[-2000:]}"
+                )
+            print("\nWARNING: multihost test SKIPPED (distributed init "
+                  "unavailable) — set FMTPU_REQUIRE_MULTIHOST=1 to make "
+                  "this a failure", file=sys.stderr)
             pytest.skip(f"distributed init unavailable here:\n{combined[-500:]}")
         raise AssertionError(f"worker failed:\n{combined[-2000:]}")
     # Both processes computed identical psum'd losses.

@@ -57,6 +57,29 @@ def test_stochastic_round_fp32_identity():
     )
 
 
+def test_stochastic_round_nonfinite_and_near_max():
+    # Non-finite inputs must propagate unchanged (the raw bit-add would
+    # corrupt NaN payloads / inf encodings), and finite values near bf16
+    # max must saturate instead of carrying over into inf.
+    key = jax.random.key(0)
+    bf_max = float(jnp.finfo(jnp.bfloat16).max)
+    x = jnp.asarray([np.inf, -np.inf, np.nan, bf_max, -bf_max, 1.0],
+                    jnp.float32)
+    out = stochastic_round(x, jnp.bfloat16, key)
+    o = np.asarray(out, np.float32)
+    assert o[0] == np.inf and o[1] == -np.inf and np.isnan(o[2])
+    assert np.isfinite(o[3]) and np.isfinite(o[4]), o
+    assert o[3] == bf_max and o[4] == -bf_max
+    # Bulk check: f32 values strictly between bf16-max and the next
+    # exponent (the mantissa carry range) never round to inf under any
+    # noise draw — they saturate.
+    big = jnp.full((4096,), np.float32(bf_max) * np.float32(1.001),
+                   jnp.float32)
+    assert float(big[0]) > bf_max and np.isfinite(float(big[0]))
+    outs = stochastic_round(big, jnp.bfloat16, jax.random.key(7))
+    assert np.isfinite(np.asarray(outs, np.float32)).all()
+
+
 def test_unknown_mode_raises():
     t = jnp.zeros((4, 2))
     with pytest.raises(ValueError, match="unknown sparse_update"):
