@@ -1,0 +1,156 @@
+"""Input-pipeline benchmark: packed dir -> StreamingBatches -> device.
+
+Measures the part bench.py deliberately excludes (its data is
+device-resident): sustained host-side feed rate from a Criteo-shaped
+packed directory through the production loader stack, against the
+north-star requirement of ~1.25M samples/sec/chip (BASELINE.md; SURVEY.md
+S7 hard part #1 — "input pipeline at 10M samples/s" across 8 chips).
+
+Prints ONE JSON line with the end-to-end rate (loader + field-local id
+conversion + host->device transfer, prefetched), plus stderr rows for
+each pipeline stage so regressions are attributable:
+
+  stage 1  PackedBatches        memmap read + chunk-shuffled gather
+  stage 2  + field_local        the FieldFM id conversion (cli layer)
+  stage 3  + device_put         blocking transfer, no prefetch
+  stage 4  + Prefetcher         stage 3 with the producer thread hiding
+                                assembly+transfer behind the consumer
+
+Synthesizes its own packed data (one-time, reused across runs via
+--data-dir) so it never depends on real Criteo being present.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+METRIC = "input_pipeline_samples_per_sec"
+TARGET_PER_CHIP = 10_000_000 / 8
+
+
+def _log(msg):
+    print(f"bench_input: {msg}", file=sys.stderr, flush=True)
+
+
+def synthesize_packed(path: str, rows: int, num_fields: int = 39,
+                      bucket: int = 1 << 18, seed: int = 0,
+                      chunk: int = 1 << 20) -> None:
+    """Write a Criteo-shaped packed dir (per-field-offset ids, int8
+    labels, store_vals=False — the criteo.preprocess layout)."""
+    from fm_spark_tpu.data import PackedWriter
+
+    rng = np.random.default_rng(seed)
+    offs = (np.arange(num_fields, dtype=np.int64) * bucket)[None, :]
+    with PackedWriter(path, num_fields, store_vals=False) as w:
+        for start in range(0, rows, chunk):
+            n = min(chunk, rows - start)
+            ids = (rng.integers(0, bucket, size=(n, num_fields),
+                                dtype=np.int64) + offs).astype(np.int32)
+            labels = (rng.random(n) < 0.25).astype(np.int8)
+            w.append(ids, labels)
+
+
+def _rate(make_iter, seconds: float, batch: int,
+          consume=lambda b: None) -> float:
+    """Sustained samples/sec of ``next(it)`` + ``consume(batch)``."""
+    it = make_iter()
+    # Warm the first batch (memmap page-in, jit of nothing, thread spin-up).
+    consume(next(it))
+    n = 0
+    t0 = time.perf_counter()
+    while (dt := time.perf_counter() - t0) < seconds:
+        consume(next(it))
+        n += batch
+    rate = n / dt
+    if hasattr(it, "close"):
+        it.close()
+    return rate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4_000_000,
+                    help="synthetic dataset size (rows)")
+    ap.add_argument("--batch", type=int, default=1 << 17,
+                    help="batch size (matches bench.py's headline)")
+    ap.add_argument("--seconds", type=float, default=8.0,
+                    help="measurement window per stage")
+    ap.add_argument("--data-dir", default="/tmp/fmtpu_bench_input",
+                    help="packed dir to create/reuse")
+    ap.add_argument("--prefetch-depth", type=int, default=4)
+    args = ap.parse_args()
+
+    num_fields, bucket = 39, 1 << 18
+
+    meta = os.path.join(args.data_dir, "meta.json")
+    need = True
+    if os.path.exists(meta):
+        with open(meta) as f:
+            need = json.load(f).get("num_examples") != args.rows
+    if need:
+        _log(f"synthesizing {args.rows} rows into {args.data_dir}...")
+        t0 = time.perf_counter()
+        import shutil
+
+        if os.path.isdir(args.data_dir):
+            shutil.rmtree(args.data_dir)
+        synthesize_packed(args.data_dir, args.rows, num_fields, bucket)
+        _log(f"synthesized in {time.perf_counter() - t0:.1f}s")
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    dev = jax.devices()[0]
+    _log(f"device: {dev.device_kind}")
+
+    from fm_spark_tpu.cli import StreamingBatches
+    from fm_spark_tpu.data import PackedBatches, PackedDataset, Prefetcher
+
+    ds = PackedDataset(args.data_dir)
+
+    def raw():
+        return PackedBatches(ds, args.batch, seed=1)
+
+    def with_field_local():
+        return StreamingBatches(PackedBatches(ds, args.batch, seed=1),
+                                bucket=bucket)
+
+    def put_block(b):
+        jax.block_until_ready(jax.device_put(b))
+
+    stages = [
+        ("packed_batches", raw, lambda b: None),
+        ("+field_local", with_field_local, lambda b: None),
+        ("+device_put", with_field_local, put_block),
+        ("+prefetcher", lambda: Prefetcher(with_field_local(),
+                                           depth=args.prefetch_depth,
+                                           device_put=True),
+         lambda b: jax.block_until_ready(b)),
+    ]
+    rates = {}
+    for name, make, consume in stages:
+        r = _rate(make, args.seconds, args.batch, consume)
+        rates[name] = r
+        _log(f"{name:16s} {r:12.0f} samples/s "
+             f"({r / TARGET_PER_CHIP:.2f}x one chip's need)")
+
+    end_to_end = rates["+prefetcher"]
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(end_to_end, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(end_to_end / TARGET_PER_CHIP, 4),
+        "stages": {k: round(v, 1) for k, v in rates.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

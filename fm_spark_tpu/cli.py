@@ -210,8 +210,20 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
     return maybe_eval
 
 
+def _wrap_prefetch(batches, prefetch: int):
+    """Wrap a (possibly just-restored) batch source with the background
+    prefetcher. Must run AFTER _resume — the producer thread starts
+    reading ahead immediately, so a later restore would race it."""
+    if prefetch <= 0:
+        return batches, lambda: None
+    from fm_spark_tpu.data import Prefetcher
+
+    pf = Prefetcher(batches, depth=prefetch)
+    return pf, pf.close
+
+
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
-                      eval_source=None):
+                      eval_source=None, prefetch: int = 0):
     """Training loop on the fused sparse-SGD step (FieldFMSpec fast path).
 
     On one device this is the single-chip fused step; with multiple
@@ -273,26 +285,30 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger)
     log_every = max(tconfig.log_every, 1)
     since = 0
-    for i in range(start, tconfig.num_steps):
-        batch = batches.next_batch()
-        params, loss = step(params, jnp.int32(i), *prep(batch))
-        since += len(batch[2])
-        if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
-            logger.log(i + 1, samples=since, loss=float(loss))
-            since = 0
-        maybe_eval(i + 1, lambda: to_canonical(params))
-        if checkpointer is not None and checkpointer.due(i + 1):
-            checkpointer.save(i + 1, to_canonical(params), {},
-                              batches.state())
-    if checkpointer is not None:
-        checkpointer.save(tconfig.num_steps, to_canonical(params), {},
-                          batches.state(), force=True)
-        checkpointer.wait()
+    batches, close_prefetch = _wrap_prefetch(batches, prefetch)
+    try:
+        for i in range(start, tconfig.num_steps):
+            batch = batches.next_batch()
+            params, loss = step(params, jnp.int32(i), *prep(batch))
+            since += len(batch[2])
+            if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
+                logger.log(i + 1, samples=since, loss=float(loss))
+                since = 0
+            maybe_eval(i + 1, lambda: to_canonical(params))
+            if checkpointer is not None and checkpointer.due(i + 1):
+                checkpointer.save(i + 1, to_canonical(params), {},
+                                  batches.state())
+        if checkpointer is not None:
+            checkpointer.save(tconfig.num_steps, to_canonical(params), {},
+                              batches.state(), force=True)
+            checkpointer.wait()
+    finally:
+        close_prefetch()
     return to_canonical(params)
 
 
 def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None,
-                  eval_source=None):
+                  eval_source=None, prefetch: int = 0):
     """Training loop on the mesh-parallel psum step (dp / row)."""
     import jax
 
@@ -323,21 +339,26 @@ def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None,
     )
     log_every = max(tconfig.log_every, 1)
     since = 0
-    for i in range(start, tconfig.num_steps):
-        batch = shard_batch(batches.next_batch(), mesh)
-        params, opt_state, m = step(params, opt_state, *batch)
-        since += batch[2].shape[0]
-        if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
-            logger.log(i + 1, samples=since, loss=float(m["loss"]),
-                       grad_norm=float(m["grad_norm"]))
-            since = 0
-        maybe_eval(i + 1, lambda: jax.device_get(params))
+    batches, close_prefetch = _wrap_prefetch(batches, prefetch)
+    try:
+        for i in range(start, tconfig.num_steps):
+            batch = shard_batch(batches.next_batch(), mesh)
+            params, opt_state, m = step(params, opt_state, *batch)
+            since += batch[2].shape[0]
+            if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
+                logger.log(i + 1, samples=since, loss=float(m["loss"]),
+                           grad_norm=float(m["grad_norm"]))
+                since = 0
+            maybe_eval(i + 1, lambda: jax.device_get(params))
+            if checkpointer is not None:
+                checkpointer.maybe_save(i + 1, params, opt_state,
+                                        batches.state())
         if checkpointer is not None:
-            checkpointer.maybe_save(i + 1, params, opt_state, batches.state())
-    if checkpointer is not None:
-        checkpointer.save(tconfig.num_steps, params, opt_state,
-                          batches.state(), force=True)
-        checkpointer.wait()
+            checkpointer.save(tconfig.num_steps, params, opt_state,
+                              batches.state(), force=True)
+            checkpointer.wait()
+    finally:
+        close_prefetch()
     return params
 
 
@@ -432,6 +453,7 @@ def cmd_train(args) -> int:
                 eval_batches=(
                     eval_source if tconfig.eval_every > 0 else None
                 ),
+                prefetch=args.prefetch,
             )
             params = trainer.params
         else:
@@ -442,11 +464,13 @@ def cmd_train(args) -> int:
             if strategy == "field_sparse":
                 params = _fit_field_sparse(spec, tconfig, batches, logger,
                                            checkpointer,
-                                           eval_source=eval_source)
+                                           eval_source=eval_source,
+                                           prefetch=args.prefetch)
             elif strategy in ("dp", "row"):
                 params = _fit_parallel(spec, tconfig, batches, strategy,
                                        logger, checkpointer,
-                                       eval_source=eval_source)
+                                       eval_source=eval_source,
+                                       prefetch=args.prefetch)
             else:
                 raise SystemExit(f"unknown strategy {strategy!r}")
 
@@ -636,6 +660,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="table storage dtype (bfloat16 halves gather bytes; "
                         "pair with --sparse-update dedup_sr)")
     t.add_argument("--seed", type=int, default=None)
+    t.add_argument("--prefetch", type=int, default=2,
+                   help="background batch read-ahead depth (0 = off); "
+                        "overlaps host batch assembly with device compute")
     t.add_argument("--test-fraction", type=float, default=0.2)
     t.add_argument("--log-every", type=int, default=100)
     t.add_argument("--eval-every", type=int, default=0,

@@ -10,6 +10,7 @@ float32 [N])`` — the shape the kernels and XLA want.
 from fm_spark_tpu.data.synthetic import synthetic_ctr  # noqa: F401
 from fm_spark_tpu.data.pipeline import (  # noqa: F401
     Batches,
+    Prefetcher,
     iterate_once,
     train_test_split,
 )

@@ -231,6 +231,13 @@ def shuffle_packed(src_path: str, out_path: str, seed: int = 0,
             "would truncate the source files it is reading) — write to a "
             "new directory"
         )
+    if os.path.isdir(out_path) and os.listdir(out_path):
+        # Also makes the failure cleanup below safe: out_path is always a
+        # directory THIS call created, never pre-existing data.
+        raise ValueError(
+            f"shuffle_packed output dir {out_path!r} exists and is not "
+            "empty — refusing to overwrite"
+        )
     ds = PackedDataset(src_path)
     rng = np.random.default_rng([seed, 0x50FF1E])  # domain-separated stream
     tmp_dir = out_path.rstrip("/") + ".shards.tmp"

@@ -105,6 +105,113 @@ class Batches:
         return self.next_batch()
 
 
+class Prefetcher:
+    """Background-thread batch prefetch with a bounded queue.
+
+    Overlaps host-side batch assembly (memmap reads, fancy indexing,
+    field-local id conversion) and optionally the host→device transfer
+    with device compute — the producer/consumer idiom grain/tf.data use,
+    kept dependency-free. Wraps any batch source with ``next_batch()``
+    (Batches, PackedBatches, cli.StreamingBatches).
+
+    Checkpoint semantics: ``state()`` returns the wrapped source's cursor
+    as of the LAST CONSUMED batch, not the producer's read-ahead cursor —
+    resuming from it replays exactly the batches the training loop never
+    saw. (The producer snapshots ``source.state()`` after producing each
+    batch and the snapshot travels with the batch through the queue.)
+
+    ``device_put=True`` moves each batch onto the default device inside
+    the producer thread (``jax.device_put`` is thread-safe), so transfer
+    cost is paid off the critical path.
+    """
+
+    _STOP = object()
+
+    def __init__(self, source, depth: int = 2, device_put: bool = False):
+        import queue
+        import threading
+
+        self._source = source
+        self._has_state = hasattr(source, "state")
+        self._last_state = source.state() if self._has_state else None
+        self._q = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._terminal = None
+        self._device_put = bool(device_put)
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                batch = self._source.next_batch()
+                if self._device_put:
+                    import jax
+
+                    batch = jax.device_put(batch)
+                state = self._source.state() if self._has_state else None
+                while not self._stop.is_set():
+                    try:
+                        self._q.put((batch, state, None), timeout=0.1)
+                        break
+                    except Exception:  # queue.Full
+                        continue
+        except StopIteration:
+            self._q.put((None, None, StopIteration()))
+        except BaseException as e:  # surface producer crashes to consumer
+            self._q.put((None, None, e))
+
+    def next_batch(self):
+        if self._terminal is not None:
+            # The producer enqueued its terminal sentinel exactly once and
+            # exited; keep re-raising instead of blocking on a queue that
+            # will never be fed again (iterator-protocol contract).
+            if isinstance(self._terminal, StopIteration):
+                raise StopIteration
+            raise self._terminal
+        batch, state, err = self._q.get()
+        if err is not None:
+            self._terminal = err
+            if isinstance(err, StopIteration):
+                raise StopIteration
+            raise err
+        self._last_state = state
+        return batch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+    def state(self) -> dict:
+        if not self._has_state:
+            raise AttributeError("wrapped source has no state()")
+        return self._last_state
+
+    def restore(self, state: dict) -> None:
+        raise RuntimeError(
+            "restore the wrapped source BEFORE constructing the Prefetcher "
+            "(the producer thread starts reading ahead immediately)"
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+        # Drain so a blocked producer put() can observe the stop flag.
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def iterate_once(ids, vals, labels, batch_size: int):
     """One ordered, finite pass over the data — for evaluation.
 

@@ -199,7 +199,8 @@ class FMTrainer:
         self.last_eval: dict | None = None  # most recent in-fit eval metrics
 
     def fit(self, batches: Iterable, num_steps: int | None = None,
-            checkpointer=None, preemption_guard=None, eval_batches=None):
+            checkpointer=None, preemption_guard=None, eval_batches=None,
+            prefetch: int = 0):
         """Run the training loop; ``batches`` yields (ids, vals, labels, w).
 
         With a :class:`fm_spark_tpu.checkpoint.Checkpointer`, training
@@ -212,6 +213,12 @@ class FMTrainer:
         iterable, e.g. ``lambda: iterate_once(*te, bs)``) enables periodic
         held-out evaluation every ``config.eval_every`` steps; metrics are
         logged with an ``eval_`` prefix.
+
+        ``prefetch > 0`` wraps ``batches`` in a background
+        :class:`~fm_spark_tpu.data.Prefetcher` AFTER checkpoint resume
+        (the producer reads ahead immediately, so it must see the
+        restored cursor), overlapping host batch assembly with device
+        compute.
         """
         total = num_steps if num_steps is not None else self.config.num_steps
         log_every = max(self.config.log_every, 1)
@@ -245,6 +252,21 @@ class FMTrainer:
             else:
                 checkpointer.save(*args)
 
+        close_prefetch = lambda: None
+        if prefetch > 0 and hasattr(batches, "next_batch"):
+            from fm_spark_tpu.data import Prefetcher
+
+            batches = Prefetcher(batches, depth=prefetch)
+            close_prefetch = batches.close
+        try:
+            return self._fit_loop(batches, start, total, log_every,
+                                  checkpointer, preemption_guard,
+                                  eval_batches, save)
+        finally:
+            close_prefetch()
+
+    def _fit_loop(self, batches, start, total, log_every, checkpointer,
+                  preemption_guard, eval_batches, save):
         it = iter(batches)
         steps_since_log = 0
         for step_i in range(start, total):

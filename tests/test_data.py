@@ -188,6 +188,14 @@ def test_shuffle_packed_in_place_refused(tmp_path):
         shuffle_packed(src, src)
     # Source untouched by the refused call.
     assert len(PackedDataset(src)) == 1000
+    # Non-empty existing output dir refused (failure cleanup would
+    # otherwise rmtree pre-existing data).
+    occupied = tmp_path / "occupied"
+    occupied.mkdir()
+    (occupied / "keep.txt").write_text("precious")
+    with pytest.raises(ValueError, match="not empty"):
+        shuffle_packed(src, str(occupied))
+    assert (occupied / "keep.txt").read_text() == "precious"
 
 
 def test_shuffle_packed_failure_leaves_no_truncated_output(tmp_path,

@@ -79,3 +79,96 @@ def test_batches_rejects_impossible_config():
         Batches(ids, vals, labels, batch_size=64, drop_remainder=True)
     with pytest.raises(ValueError, match="empty"):
         Batches(ids[:0], vals[:0], labels[:0], batch_size=4)
+
+
+# ------------------------------------------------------------- Prefetcher
+
+
+def test_prefetcher_same_stream_and_state_resume():
+    from fm_spark_tpu.data import Prefetcher
+
+    ids, vals, labels = _data(n=200)
+    ref = Batches(ids, vals, labels, batch_size=32, seed=7)
+    src = Batches(ids, vals, labels, batch_size=32, seed=7)
+    with Prefetcher(src, depth=3) as pf:
+        states = []
+        for _ in range(9):
+            a = ref.next_batch()
+            b = pf.next_batch()
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, np.asarray(y))
+            states.append(pf.state())
+        # Resume from the state after batch 5: restore a FRESH source
+        # first, then wrap — the stream must continue at batch 6.
+        resumed = Batches(ids, vals, labels, batch_size=32, seed=7)
+        resumed.restore(states[4])
+    with Prefetcher(resumed, depth=3) as pf2:
+        ref2 = Batches(ids, vals, labels, batch_size=32, seed=7)
+        ref2.restore(states[4])
+        for _ in range(5):
+            a = ref2.next_batch()
+            b = pf2.next_batch()
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, np.asarray(y))
+
+
+def test_prefetcher_propagates_producer_error():
+    import pytest
+
+    from fm_spark_tpu.data import Prefetcher
+
+    class Boom:
+        def __init__(self):
+            self.n = 0
+
+        def next_batch(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("producer crashed")
+            return (np.zeros(3),)
+
+        def state(self):
+            return {"n": self.n}
+
+    with Prefetcher(Boom(), depth=1) as pf:
+        pf.next_batch()
+        pf.next_batch()
+        with pytest.raises(RuntimeError, match="producer crashed"):
+            pf.next_batch()
+
+
+def test_prefetcher_finite_source_stop_iteration():
+    import pytest
+
+    from fm_spark_tpu.data import Prefetcher
+
+    class Finite:
+        def __init__(self):
+            self.n = 0
+
+        def next_batch(self):
+            if self.n >= 3:
+                raise StopIteration
+            self.n += 1
+            return (np.full(2, self.n),)
+
+    with Prefetcher(Finite(), depth=2) as pf:
+        got = [int(pf.next_batch()[0][0]) for _ in range(3)]
+        assert got == [1, 2, 3]
+        with pytest.raises(StopIteration):
+            pf.next_batch()
+        # Exhausted iterators must KEEP raising (not deadlock on the
+        # empty queue of a dead producer).
+        with pytest.raises(StopIteration):
+            pf.next_batch()
+
+
+def test_prefetcher_close_unblocks_producer():
+    from fm_spark_tpu.data import Prefetcher
+
+    ids, vals, labels = _data(n=200)
+    src = Batches(ids, vals, labels, batch_size=16, seed=0)
+    pf = Prefetcher(src, depth=1)  # tiny queue → producer blocks on put
+    pf.next_batch()
+    pf.close()  # must not hang
+    assert not pf._thread.is_alive()
