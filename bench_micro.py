@@ -1,0 +1,218 @@
+"""Microbenchmarks behind PERF.md's measured facts 1-5.
+
+Each subcommand reproduces one design-driving measurement so the
+architecture rationale stays checkable on any attachment:
+
+  dispatch   fact 1: per-dispatch host/tunnel overhead (trivial scalar
+             add, timed per call) and the fori_loop amortization.
+  gather     fact 2: per-index gather rate vs table BYTES (the ~34MB
+             cliff that motivates per-field sub-tables).
+  scatter    fact 3: scatter-add rate vs operand size (the ~128MB cliff
+             and per-index bound that motivate single-owner sub-tables).
+  matmul     fact 4: MXU peak check (compute is not the binding
+             constraint).
+  cast       fact 5: dense streaming bandwidth (why per-step shadow
+             recasts are off the table).
+  all        run everything.
+
+Prints one JSON line per measurement: {"bench": ..., "config": ...,
+"value": ..., "unit": ...}. Timing uses a device->host transfer as the
+completion fence (block_until_ready returns early on this attachment,
+PERF.md timing note).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _log(msg):
+    print(f"bench_micro: {msg}", file=sys.stderr, flush=True)
+
+
+def _out(bench, config, value, unit):
+    print(json.dumps({"bench": bench, "config": config,
+                      "value": round(value, 3), "unit": unit}), flush=True)
+
+
+def _fence(x):
+    """Reliable completion fence: device->host transfer of one scalar."""
+    import jax.numpy as jnp
+
+    return float(jnp.ravel(x)[0])
+
+
+def bench_dispatch(args):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    one = jnp.float32(1.0)
+
+    @jax.jit
+    def add(x):
+        return x + 1.0
+
+    @jax.jit
+    def add_n(x, n):
+        return lax.fori_loop(0, n, lambda i, c: c + 1.0, x)
+
+    _fence(add(one))           # compile
+    _fence(add_n(one, jnp.int32(2)))
+    t0 = time.perf_counter()
+    x = one
+    for _ in range(args.calls):
+        x = add(x)
+    _fence(x)
+    per_call = (time.perf_counter() - t0) / args.calls
+    _out("dispatch", {"calls": args.calls}, per_call * 1e3,
+         "ms/dispatch")
+
+    t0 = time.perf_counter()
+    _fence(add_n(one, jnp.int32(args.calls)))
+    per_iter = (time.perf_counter() - t0) / args.calls
+    _out("dispatch_fori", {"iters": args.calls}, per_iter * 1e6,
+         "us/iter (same adds inside one fori_loop program)")
+
+
+def _gather_once(rows, width, dtype, n_idx, seed=0):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    table = jnp.zeros((rows, width), dtype)
+    ids = jnp.asarray(
+        np.random.default_rng(seed).integers(0, rows, n_idx), jnp.int32
+    )
+
+    @jax.jit
+    def g(t, i):
+        return jnp.sum(t[i].astype(jnp.float32))
+
+    _fence(g(table, ids))  # compile
+    t0 = time.perf_counter()
+    _fence(g(table, ids))
+    return time.perf_counter() - t0
+
+
+def bench_gather(args):
+    import numpy as np
+
+    for rows, dtype in [(1 << 17, "float32"), (1 << 18, "bfloat16"),
+                        (1 << 18, "float32"), (1 << 19, "float32"),
+                        (1 << 20, "float32")]:
+        dt = _gather_once(rows, args.width, dtype, args.n_idx)
+        tbl_mb = rows * args.width * (2 if dtype == "bfloat16" else 4) / 2**20
+        _out("gather", {"rows": rows, "width": args.width, "dtype": dtype,
+                        "table_mb": round(tbl_mb, 1), "n_idx": args.n_idx},
+             args.n_idx / dt / 1e6, "M idx/s")
+
+
+def bench_scatter(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    for rows, dtype in [(1 << 17, "float32"), (1 << 18, "float32"),
+                        (1 << 19, "float32"), (1 << 20, "float32")]:
+        table = jnp.zeros((rows, args.width), dtype)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, rows, args.n_idx),
+            jnp.int32,
+        )
+        upd = jnp.ones((args.n_idx, args.width), dtype)
+
+        @jax.jit
+        def sc(t, i, u):
+            return t.at[i].add(u, mode="drop")
+
+        _fence(sc(table, ids, upd))  # compile
+        t0 = time.perf_counter()
+        _fence(sc(table, ids, upd))
+        dt = time.perf_counter() - t0
+        op_mb = rows * args.width * 4 / 2**20
+        _out("scatter", {"rows": rows, "width": args.width, "dtype": dtype,
+                         "operand_mb": round(op_mb, 1), "n_idx": args.n_idx},
+             args.n_idx / dt / 1e6, "M idx/s")
+
+
+def bench_matmul(args):
+    import jax
+    import jax.numpy as jnp
+
+    n = args.size
+    a = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(x):
+        return x @ x
+
+    _fence(mm(a))  # compile
+    t0 = time.perf_counter()
+    _fence(mm(a))
+    dt = time.perf_counter() - t0
+    _out("matmul", {"size": n, "dtype": "bfloat16"},
+         2 * n**3 / dt / 1e12, "TFLOP/s")
+
+
+def bench_cast(args):
+    import jax
+    import jax.numpy as jnp
+
+    tables = [jnp.ones((args.rows, args.width), jnp.float32)
+              for _ in range(args.tables)]
+    total_gb = args.tables * args.rows * args.width * 4 / 2**30
+
+    @jax.jit
+    def cast_all(ts):
+        return [t.astype(jnp.bfloat16) for t in ts]
+
+    _fence(cast_all(tables)[0])  # compile
+    t0 = time.perf_counter()
+    _fence(cast_all(tables)[0])
+    dt = time.perf_counter() - t0
+    _out("cast", {"tables": args.tables, "rows": args.rows,
+                  "width": args.width, "read_gb": round(total_gb, 2)},
+         total_gb / dt, "GB/s (fp32 read side)")
+
+
+BENCHES = {
+    "dispatch": bench_dispatch,
+    "gather": bench_gather,
+    "scatter": bench_scatter,
+    "matmul": bench_matmul,
+    "cast": bench_cast,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bench", choices=[*BENCHES, "all"])
+    ap.add_argument("--calls", type=int, default=30)
+    ap.add_argument("--n-idx", type=int, default=5_242_880,
+                    help="gather/scatter index count (~B*F at the "
+                    "headline batch)")
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--rows", type=int, default=1 << 18)
+    ap.add_argument("--tables", type=int, default=39)
+    ap.add_argument("--size", type=int, default=8192)
+    args = ap.parse_args()
+
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    _log(f"device: {jax.devices()[0].device_kind}")
+    for name in (BENCHES if args.bench == "all" else [args.bench]):
+        _log(f"running {name}...")
+        BENCHES[name](args)
+
+
+if __name__ == "__main__":
+    main()

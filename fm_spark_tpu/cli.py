@@ -210,20 +210,9 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
     return maybe_eval
 
 
-def _wrap_prefetch(batches, prefetch: int):
-    """Wrap a (possibly just-restored) batch source with the background
-    prefetcher. Must run AFTER _resume — the producer thread starts
-    reading ahead immediately, so a later restore would race it."""
-    if prefetch <= 0:
-        return batches, lambda: None
-    from fm_spark_tpu.data import Prefetcher
-
-    pf = Prefetcher(batches, depth=prefetch)
-    return pf, pf.close
-
-
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
-                      eval_source=None, prefetch: int = 0):
+                      eval_source=None, prefetch: int = 0,
+                      row_shards: int = 1):
     """Training loop on the fused sparse-SGD step (FieldFMSpec fast path).
 
     On one device this is the single-chip fused step; with multiple
@@ -237,6 +226,15 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     from fm_spark_tpu.models.field_ffm import FieldFFMSpec
 
     n = jax.device_count()
+    if row_shards < 1:
+        raise SystemExit(f"--row-shards must be >= 1, got {row_shards}")
+    if row_shards > 1 and (n == 1 or isinstance(spec, FieldFFMSpec)):
+        # Never silently ignore an explicit sharding request.
+        raise SystemExit(
+            f"--row-shards={row_shards} needs multiple devices and a "
+            "FieldFM model (found "
+            f"{n} device(s), {type(spec).__name__})"
+        )
     canonical = spec.init(jax.random.key(tconfig.seed))
     # Checkpoints always use the canonical per-field-list layout so a run
     # can resume on a different device count (plain SGD has no optimizer
@@ -259,19 +257,25 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 f"batch_size={tconfig.batch_size} must be divisible by the "
                 f"device count ({n}) for the field-sharded strategy"
             )
+        if n % row_shards:
+            raise SystemExit(
+                f"--row-shards={row_shards} must divide the device "
+                f"count ({n})"
+            )
         from fm_spark_tpu.parallel import (
             make_field_mesh, make_field_sharded_sgd_step, pad_field_batch,
             shard_field_batch, shard_field_params, stack_field_params,
             unstack_field_params,
         )
 
-        mesh = make_field_mesh(n)
+        n_feat = n // row_shards
+        mesh = make_field_mesh(n, n_row=row_shards)
         step = make_field_sharded_sgd_step(spec, tconfig, mesh)
         params = shard_field_params(
-            stack_field_params(spec, canonical, n), mesh
+            stack_field_params(spec, canonical, n_feat), mesh
         )
         prep = lambda b: shard_field_batch(
-            pad_field_batch(b, spec.num_fields, n), mesh
+            pad_field_batch(b, spec.num_fields, n_feat), mesh
         )
         to_canonical = lambda p: unstack_field_params(spec, jax.device_get(p))
     else:
@@ -285,7 +289,9 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger)
     log_every = max(tconfig.log_every, 1)
     since = 0
-    batches, close_prefetch = _wrap_prefetch(batches, prefetch)
+    from fm_spark_tpu.data import wrap_prefetch
+
+    batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
         for i in range(start, tconfig.num_steps):
             batch = batches.next_batch()
@@ -339,7 +345,9 @@ def _fit_parallel(spec, tconfig, batches, strategy, logger, checkpointer=None,
     )
     log_every = max(tconfig.log_every, 1)
     since = 0
-    batches, close_prefetch = _wrap_prefetch(batches, prefetch)
+    from fm_spark_tpu.data import wrap_prefetch
+
+    batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
         for i in range(start, tconfig.num_steps):
             batch = shard_batch(batches.next_batch(), mesh)
@@ -465,7 +473,8 @@ def cmd_train(args) -> int:
                 params = _fit_field_sparse(spec, tconfig, batches, logger,
                                            checkpointer,
                                            eval_source=eval_source,
-                                           prefetch=args.prefetch)
+                                           prefetch=args.prefetch,
+                                           row_shards=args.row_shards)
             elif strategy in ("dp", "row"):
                 params = _fit_parallel(spec, tconfig, batches, strategy,
                                        logger, checkpointer,
@@ -660,6 +669,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="table storage dtype (bfloat16 halves gather bytes; "
                         "pair with --sparse-update dedup_sr)")
     t.add_argument("--seed", type=int, default=None)
+    t.add_argument("--row-shards", type=int, default=1, dest="row_shards",
+                   help="field_sparse strategy: shard each field's bucket "
+                        "dimension over this many chips (2-D feat x row "
+                        "mesh; row capacity scale-out)")
     t.add_argument("--prefetch", type=int, default=2,
                    help="background batch read-ahead depth (0 = off); "
                         "overlaps host batch assembly with device compute")

@@ -14,12 +14,10 @@ clipping learned from the data.
 
 from __future__ import annotations
 
-import math
-
 import numpy as np
 
 from fm_spark_tpu import models
-from fm_spark_tpu.data.pipeline import Batches, iterate_once
+from fm_spark_tpu.data.pipeline import Batches, BernoulliBatches, iterate_once
 from fm_spark_tpu.train import FMTrainer, TrainConfig
 
 
@@ -100,7 +98,21 @@ class _SGDEntryPoint:
             init_std=self.initStd,
         )
         spec = self._build_spec(spec_kwargs, ids)
-        batch_size = max(1, int(math.ceil(self.miniBatchFraction * ids.shape[0])))
+        # Reference sampling semantics (SURVEY.md §3.1): each iteration
+        # Bernoulli-samples the dataset at miniBatchFraction — NOT
+        # epoch-shuffled fixed batches. BernoulliBatches reproduces that
+        # exactly (deterministic per (seed, step), weight-masked so jit
+        # keeps one shape, loss averaged over the realized sample like
+        # MLlib's grad/miniBatchSize). fraction=1.0 degenerates to full
+        # batch either way; use the plain cycler there (no mask cost).
+        if self.miniBatchFraction < 1.0:
+            batches = BernoulliBatches(
+                ids, vals, labels, self.miniBatchFraction, seed=self.seed
+            )
+            batch_size = ids.shape[0]
+        else:
+            batch_size = ids.shape[0]
+            batches = Batches(ids, vals, labels, batch_size, seed=self.seed)
         config = TrainConfig(
             num_steps=self.numIterations,
             batch_size=batch_size,
@@ -114,7 +126,7 @@ class _SGDEntryPoint:
             log_every=max(self.numIterations // 10, 1),
         )
         trainer = FMTrainer(spec, config)
-        trainer.fit(Batches(ids, vals, labels, batch_size, seed=self.seed))
+        trainer.fit(batches)
         return FMModel(spec, trainer.params)
 
 

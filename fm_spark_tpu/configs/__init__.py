@@ -131,8 +131,12 @@ CONFIGS = {
             name="criteo1tb_fm_r64",
             description="Config 3 (BASELINE.json:9): FM rank-64, Criteo-1TB,"
             " 39×262144 ≈ 10.2M hashed features; field-partitioned tables"
-            " (bench.py headline) via the fused sparse-SGD step; 'row' is the"
-            " multi-chip scale-out strategy.",
+            " (bench.py headline) via the fused sparse-SGD step. Multi-chip"
+            " scale-out IS this strategy: fields shard over the mesh"
+            " automatically, and --row-shards adds bucket row-sharding"
+            " (2-D feat×row mesh). The generic 'row' strategy materializes"
+            " dense gradients (optax path) — correctness fallback, not the"
+            " at-scale path.",
             model="field_fm", dataset="criteo", rank=64, num_fields=39,
             bucket=1 << 18, strategy="field_sparse", num_steps=1_000_000,
             batch_size=1 << 17, learning_rate=0.05, lr_schedule="constant",

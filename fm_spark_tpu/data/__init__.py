@@ -10,9 +10,11 @@ float32 [N])`` — the shape the kernels and XLA want.
 from fm_spark_tpu.data.synthetic import synthetic_ctr  # noqa: F401
 from fm_spark_tpu.data.pipeline import (  # noqa: F401
     Batches,
+    BernoulliBatches,
     Prefetcher,
     iterate_once,
     train_test_split,
+    wrap_prefetch,
 )
 from fm_spark_tpu.data.packed import (  # noqa: F401
     PackedBatches,

@@ -105,6 +105,65 @@ class Batches:
         return self.next_batch()
 
 
+class BernoulliBatches:
+    """Per-iteration Bernoulli sampling — the reference's exact minibatch
+    semantics (``data.sample(withReplacement=false, miniBatchFraction,
+    seed+i)`` per SGD iteration, SURVEY.md §3.1), TPU-shaped: every step
+    yields the FULL dataset with a fresh Bernoulli(fraction) weight mask,
+    so jit sees one fixed shape and the weighted-mean loss averages over
+    exactly the sampled examples (MLlib divides by the realized sample
+    size; ``wsum`` does the same).
+
+    Deterministic per (seed, step) — resume replays the identical mask
+    sequence. Compared to epoch-shuffled fixed-size ``Batches`` (the
+    throughput-oriented default), this matches the reference's
+    convergence behavior: sample size varies binomially per step and an
+    example can repeat in consecutive steps.
+    """
+
+    def __init__(self, ids, vals, labels, fraction: float, seed: int = 0):
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        self.ids = np.ascontiguousarray(ids)
+        self.vals = np.ascontiguousarray(vals)
+        self.labels = np.ascontiguousarray(labels)
+        if self.ids.shape[0] == 0:
+            raise ValueError("empty dataset")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        self.step = 0
+
+    @property
+    def num_examples(self):
+        return self.ids.shape[0]
+
+    def state(self) -> dict:
+        return {"step": self.step, "seed": self.seed,
+                "fraction": self.fraction}
+
+    def restore(self, state: dict) -> None:
+        for key, have in [("seed", self.seed), ("fraction", self.fraction)]:
+            if key in state and state[key] != have:
+                raise ValueError(
+                    f"restoring sampler state with a different {key}"
+                )
+        self.step = int(state["step"])
+
+    def next_batch(self):
+        rng = np.random.default_rng((self.seed, 0xB3A2, self.step))
+        weights = (
+            rng.random(self.num_examples) < self.fraction
+        ).astype(np.float32)
+        self.step += 1
+        return self.ids, self.vals, self.labels, weights
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
 class Prefetcher:
     """Background-thread batch prefetch with a bounded queue.
 
@@ -203,6 +262,15 @@ class Prefetcher:
                 self._q.get_nowait()
         except Exception:
             pass
+        # A consumer calling next_batch() after (or blocked in get()
+        # during) close must get an error, not a permanent hang on a
+        # queue no producer will ever feed again.
+        if self._terminal is None:
+            self._terminal = RuntimeError("Prefetcher is closed")
+        try:
+            self._q.put_nowait((None, None, self._terminal))
+        except Exception:
+            pass
         self._thread.join(timeout=5)
 
     def __enter__(self):
@@ -210,6 +278,23 @@ class Prefetcher:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def wrap_prefetch(batches, depth: int):
+    """Wrap a batch source with a :class:`Prefetcher`; returns
+    ``(source, close)``. No-op (identity source, noop close) when
+    ``depth <= 0`` or the source has no ``next_batch`` (plain
+    iterables can't be safely read ahead AND checkpointed).
+
+    Call AFTER any checkpoint restore — the producer thread starts
+    reading ahead immediately, so a later restore would race it.
+    Single definition shared by cli training loops and FMTrainer.fit
+    so prefetch lifecycle semantics can never diverge between them.
+    """
+    if depth <= 0 or not hasattr(batches, "next_batch"):
+        return batches, lambda: None
+    pf = Prefetcher(batches, depth=depth)
+    return pf, pf.close
 
 
 def iterate_once(ids, vals, labels, batch_size: int):

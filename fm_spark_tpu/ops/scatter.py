@@ -109,7 +109,10 @@ def apply_row_updates(
         raise ValueError(f"unknown sparse_update mode {mode!r}")
     n = table.shape[0]
     if mode == "scatter_add":
-        return table.at[ids].add(delta.astype(table.dtype))
+        # mode="drop" is XLA's default scatter OOB semantics, made
+        # explicit: the 2-D field-sharded step routes non-owned lanes to
+        # an out-of-bounds sentinel index that MUST be dropped.
+        return table.at[ids].add(delta.astype(table.dtype), mode="drop")
 
     sid, summed, run_start, order = _dedup(ids, delta)
     oob = jnp.where(run_start, sid, n)  # non-run-start lanes are dropped

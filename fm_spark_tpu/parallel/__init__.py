@@ -29,7 +29,10 @@ from fm_spark_tpu.parallel.step import (  # noqa: F401
     make_parallel_eval_step,
 )
 from fm_spark_tpu.parallel.field_step import (  # noqa: F401
+    field_batch_specs,
+    field_param_specs,
     make_field_mesh,
+    make_field_sharded_sgd_body,
     make_field_sharded_sgd_step,
     pad_field_batch,
     shard_field_batch,

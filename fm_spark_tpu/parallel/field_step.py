@@ -24,10 +24,21 @@ Step anatomy (one compiled program, two collectives):
    reference, which tree-aggregates a full dense gradient every
    iteration (SURVEY.md §3.1).
 
-Tables are uniquely owned per field, so this mesh is 1-D over ``feat``.
-Scaling the row capacity further (row-sharding *within* fields over a
-second axis) is the documented follow-on; index rate — the measured
-bottleneck — scales with this axis.
+Tables are uniquely owned per field over the ``feat`` axis. An optional
+second mesh axis ``row`` shards each field's BUCKET dimension
+(``make_field_mesh(n, n_row=r)``), scaling row capacity past per-field
+bucket limits while keeping single-owner write semantics:
+
+- Each ``(field, example)`` id is owned by exactly ONE row shard, so
+  shard-local masked gathers (non-owned lanes zeroed) followed by a
+  ``psum`` over BOTH axes reconstruct the exact partial sums — the same
+  linear-reduction identity, now 2-D (SURVEY.md §7 step 5(b)).
+- Updates scatter through an out-of-bounds sentinel index for non-owned
+  lanes (XLA drop semantics), so each table row still has exactly one
+  writer and no cross-chip gradient reduction exists.
+- Smaller per-chip sub-tables also sit further under the measured
+  gather/scatter size cliffs (PERF.md facts 2-3), so capacity scaling
+  does not regress per-index cost.
 
 Layout: per-field tables stacked into ``[F_pad, bucket, width]`` sharded
 ``P('feat')``; ``F_pad`` rounds F up to the mesh size so chips own equal
@@ -49,15 +60,27 @@ from fm_spark_tpu.ops import losses as losses_lib
 from fm_spark_tpu.train import TrainConfig
 
 
-def make_field_mesh(n_devices: int | None = None, devices=None):
-    """1-D ``feat`` mesh over the chips (field-sharded layout)."""
+def make_field_mesh(n_devices: int | None = None, devices=None,
+                    n_row: int = 1):
+    """Mesh for the field-sharded layout: 1-D ``(feat,)`` by default, or
+    2-D ``(feat, row)`` with ``n_row`` shards of each field's bucket
+    dimension (row capacity scale-out)."""
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     import numpy as np
 
-    return jax.sharding.Mesh(np.asarray(devices), ("feat",))
+    devices = np.asarray(devices)
+    if n_row <= 1:
+        return jax.sharding.Mesh(devices, ("feat",))
+    if devices.size % n_row:
+        raise ValueError(
+            f"n_row={n_row} must divide the device count ({devices.size})"
+        )
+    return jax.sharding.Mesh(
+        devices.reshape(devices.size // n_row, n_row), ("feat", "row")
+    )
 
 
 def padded_num_fields(num_fields: int, n_feat: int) -> int:
@@ -102,15 +125,35 @@ def pad_field_batch(batch, num_fields: int, n_feat: int):
     return ids, vals, labels, weights
 
 
-# Batch enters row-sharded over the chips; the step's all_to_all turns it
-# field-sharded on device.
+# Batch enters example-sharded over the chips; the step's all_to_all turns
+# it field-sharded on device. (1-D constants kept for direct callers; the
+# mesh-aware functions below handle both layouts.)
 BATCH_SPECS = (P("feat", None), P("feat", None), P("feat"), P("feat"))
 PARAM_SPECS = {"w0": P(), "vw": P("feat", None, None)}
 
 
+def field_param_specs(mesh) -> dict:
+    """Param PartitionSpecs for a 1-D or 2-D field mesh: the stacked
+    ``vw [F_pad, bucket, width]`` shards fields over ``feat`` and (2-D)
+    the bucket dimension over ``row``."""
+    if "row" in mesh.axis_names:
+        return {"w0": P(), "vw": P("feat", "row", None)}
+    return PARAM_SPECS
+
+
+def field_batch_specs(mesh) -> tuple:
+    """Batch PartitionSpecs: the example axis shards over every mesh
+    axis (each chip is fed a distinct slice of the global batch)."""
+    if "row" in mesh.axis_names:
+        ax = ("feat", "row")
+        return (P(ax, None), P(ax, None), P(ax), P(ax))
+    return BATCH_SPECS
+
+
 def shard_field_params(stacked: dict, mesh) -> dict:
+    specs = field_param_specs(mesh)
     return {
-        k: jax.device_put(v, NamedSharding(mesh, PARAM_SPECS[k]))
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in stacked.items()
     }
 
@@ -118,7 +161,7 @@ def shard_field_params(stacked: dict, mesh) -> dict:
 def shard_field_batch(batch, mesh):
     return tuple(
         jax.device_put(jnp.asarray(x), NamedSharding(mesh, s))
-        for x, s in zip(batch, BATCH_SPECS)
+        for x, s in zip(batch, field_batch_specs(mesh))
     )
 
 
@@ -138,34 +181,70 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import _apply_field_updates, _lr_at, _sr_base_key
 
     sr_base_key = _sr_base_key(config)
-    if set(mesh.axis_names) != {"feat"}:
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
-            "field-sharded step runs on a 1-D ('feat',) mesh — tables are "
-            "single-owner per field; see module docstring (use "
-            "make_field_mesh)"
+            "field-sharded step runs on a ('feat',) or ('feat', 'row') "
+            "mesh; see module docstring (use make_field_mesh)"
         )
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank
     n_feat = mesh.shape["feat"]
-    f_local = padded_num_fields(spec.num_fields, n_feat) // n_feat
+    n_row = mesh.shape.get("row", 1)
+    two_d = n_row > 1
+    if two_d and spec.bucket % n_row:
+        raise ValueError(
+            f"bucket={spec.bucket} must divide evenly over n_row={n_row} "
+            "row shards"
+        )
+    bucket_local = spec.bucket // n_row
+    f_pad = padded_num_fields(spec.num_fields, n_feat)
+    f_local = f_pad // n_feat
+    score_axes = ("feat", "row") if two_d else "feat"
     lr_at = _lr_at(config)
 
     def local_step(params, step_idx, ids, vals, labels, weights):
-        # Local blocks in: vw [f_local, bucket, width]; ids/vals
+        # Local blocks in: vw [f_local, bucket/n_row, width]; ids/vals
         # [B/n, F_pad]; labels/weights [B/n].
         vw = params["vw"]
         w0 = params["w0"]
-        # Row-sharded → field-sharded: [B/n, F_pad] → [B, f_local].
+        # Example-sharded → field-sharded: [B/n, F_pad] → [B, f_local].
+        # 2-D: the all_to_all runs per row group ([B/n_row, f_local]),
+        # then an all_gather over 'row' replicates the example axis
+        # within each field group. labels/weights follow the SAME
+        # collective order (feat then row) so the example permutation
+        # stays consistent across all four arrays.
         ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
                              tiled=True)
         vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
                               tiled=True)
         labels = lax.all_gather(labels, "feat", tiled=True)
         weights = lax.all_gather(weights, "feat", tiled=True)
+        if two_d:
+            ids = lax.all_gather(ids, "row", tiled=True)
+            vals = lax.all_gather(vals, "row", tiled=True)
+            labels = lax.all_gather(labels, "row", tiled=True)
+            weights = lax.all_gather(weights, "row", tiled=True)
 
         vals_c = vals.astype(cd)
-        rows = [vw[f][ids[:, f]].astype(cd) for f in range(f_local)]
+        if two_d:
+            # Each (field, example) id is owned by exactly one row shard:
+            # gather locally where owned, zero elsewhere; the psum over
+            # both axes below reconstructs the exact sums.
+            lo = lax.axis_index("row") * bucket_local
+            loc = ids - lo
+            own = (loc >= 0) & (loc < bucket_local)
+            gidx = jnp.clip(loc, 0, bucket_local - 1)
+            rows = [
+                vw[f][gidx[:, f]].astype(cd) * own[:, f, None]
+                for f in range(f_local)
+            ]
+            # Non-owned update lanes go to an out-of-bounds sentinel row
+            # and are dropped by XLA scatter — single-owner writes.
+            uidx = jnp.where(own, loc, bucket_local)
+        else:
+            rows = [vw[f][ids[:, f]].astype(cd) for f in range(f_local)]
+            uidx = ids
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s_p = sum(xvs)
         sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
@@ -175,9 +254,9 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             else jnp.zeros((ids.shape[0],), cd)
         )
         # The scores collective: [B,k] + 2·[B] per step; tables never move.
-        s = lax.psum(s_p, "feat")
-        sq = lax.psum(sq_p, "feat")
-        lin = lax.psum(lin_p, "feat")
+        s = lax.psum(s_p, score_axes)
+        sq = lax.psum(sq_p, score_axes)
+        lin = lax.psum(lin_p, score_axes)
         scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
         if spec.use_linear:
             scores = scores + lin
@@ -196,6 +275,9 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
 
         g_fulls = []
         for f in range(f_local):
+            # s − xvs[f] is exactly s_{-f} for OWNED lanes (their xv is in
+            # the psum); non-owned lanes produce garbage that the sentinel
+            # index drops.
             g_v = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
             if config.reg_factors:
                 g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
@@ -206,11 +288,14 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
-        # SR keys are per GLOBAL field, decorrelated across chips.
+        # SR keys: one stream per (global field, row shard) so noise never
+        # correlates across the chips sharing a field.
+        field_offset = lax.axis_index("feat") * f_local
+        if two_d:
+            field_offset = field_offset + lax.axis_index("row") * f_pad
         new_slices = _apply_field_updates(
-            [vw[f] for f in range(f_local)], ids, g_fulls, rows, config,
-            sr_base_key, step_idx, lr,
-            field_offset=lax.axis_index("feat") * f_local,
+            [vw[f] for f in range(f_local)], uidx, g_fulls, rows, config,
+            sr_base_key, step_idx, lr, field_offset=field_offset,
         )
         new_vw = jnp.stack(new_slices, axis=0)
         out = {"w0": w0, "vw": new_vw}
@@ -222,8 +307,8 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     return jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(PARAM_SPECS, P(), *BATCH_SPECS),
-        out_specs=(PARAM_SPECS, P()),
+        in_specs=(field_param_specs(mesh), P(), *field_batch_specs(mesh)),
+        out_specs=(field_param_specs(mesh), P()),
         check_vma=False,
     )
 

@@ -15,6 +15,16 @@ whole cycle is one compiled program over a ``(data, feat)`` mesh:
   The backward pass then writes only shard-local rows: the 10M×64 table
   never moves over the interconnect, only [B, k] activations do.
 
+  SCALE CAVEAT: ``row`` still materializes a dense per-shard gradient
+  table each step (the generic optax update below) — measured at ~94k
+  samples/sec/chip on CTR shapes (PERF.md headline table), ~8× below the
+  fused path. It exists for exact optimizer parity (adam/adagrad, global
+  L2) and as the FM-family generic strategy; the AT-SCALE path for CTR
+  training is the field-sharded fused sparse step
+  (``parallel/field_step.py``, strategy ``field_sparse``), which shards
+  fields over the mesh and optionally row-shards buckets (2-D
+  ``feat×row`` mesh, CLI ``--row-shards``) with in-place sparse updates.
+
 The optimizer update runs under jit *outside* shard_map: with params placed
 by :func:`shard_params`, XLA's SPMD partitioner keeps every elementwise
 update local to the shard that owns the rows.

@@ -252,12 +252,9 @@ class FMTrainer:
             else:
                 checkpointer.save(*args)
 
-        close_prefetch = lambda: None
-        if prefetch > 0 and hasattr(batches, "next_batch"):
-            from fm_spark_tpu.data import Prefetcher
+        from fm_spark_tpu.data import wrap_prefetch
 
-            batches = Prefetcher(batches, depth=prefetch)
-            close_prefetch = batches.close
+        batches, close_prefetch = wrap_prefetch(batches, prefetch)
         try:
             return self._fit_loop(batches, start, total, log_every,
                                   checkpointer, preemption_guard,

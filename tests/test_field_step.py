@@ -13,6 +13,7 @@ import pytest
 from fm_spark_tpu import models
 from fm_spark_tpu.parallel import (
     make_field_mesh,
+    make_field_sharded_sgd_body,
     make_field_sharded_sgd_step,
     pad_field_batch,
     shard_field_batch,
@@ -162,8 +163,151 @@ def test_requires_feat_mesh(eight_devices):
     spec = models.FieldFMSpec(num_features=2 * 8, rank=2, num_fields=2,
                               bucket=8)
     mesh2d = make_mesh(2, 4, devices=eight_devices)
-    with pytest.raises(ValueError, match="1-D"):
+    with pytest.raises(ValueError, match="'feat'"):
         make_field_sharded_sgd_body(spec, TrainConfig(optimizer="sgd"), mesh2d)
+
+
+# ------------------------------------------------- 2-D (feat, row) mesh
+
+
+@pytest.mark.parametrize("n_feat,n_row,num_fields,mode", [
+    (4, 2, 6, "scatter_add"),   # fields pad 6 → 8, bucket split in 2
+    (2, 4, 5, "scatter_add"),   # uneven fields + deep row split
+    (1, 8, 3, "scatter_add"),   # PURE row sharding (capacity only)
+    (4, 2, 6, "dedup"),         # dedup's drop-lane path + sentinel rows
+])
+def test_field_sharded_2d_matches_single_chip(eight_devices, n_feat, n_row,
+                                              num_fields, mode):
+    bucket, rank, b = 32, 4, 64
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank,
+        num_fields=num_fields, bucket=bucket, init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.3, lr_schedule="inv_sqrt",
+                         optimizer="sgd", reg_factors=1e-3, reg_linear=1e-4,
+                         reg_bias=1e-4, sparse_update=mode)
+    mesh = make_field_mesh(n_feat * n_row, devices=eight_devices,
+                           n_row=n_row)
+    assert dict(mesh.shape) == {"feat": n_feat, "row": n_row}
+
+    params = spec.init(jax.random.key(0))
+    ref_params = jax.tree_util.tree_map(jnp.copy, params)
+    sharded = shard_field_params(
+        stack_field_params(spec, params, n_feat), mesh
+    )
+    import dataclasses
+
+    step_sharded = make_field_sharded_sgd_step(spec, config, mesh)
+    # dedup ≡ scatter_add up to reassociation, so one single-chip oracle
+    # serves both parametrizations.
+    step_single = make_field_sparse_sgd_step(
+        spec, dataclasses.replace(config, sparse_update="scatter_add")
+    )
+
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        batch = _make_batch(rng, b, num_fields, bucket)
+        sb = shard_field_batch(
+            pad_field_batch(batch, num_fields, n_feat), mesh
+        )
+        sharded, loss_sh = step_sharded(sharded, jnp.int32(i), *sb)
+        ref_params, loss_ref = step_single(
+            ref_params, jnp.int32(i), *map(jnp.asarray, batch)
+        )
+        np.testing.assert_allclose(
+            float(loss_sh), float(loss_ref), rtol=1e-5
+        )
+
+    got = unstack_field_params(spec, jax.device_get(sharded))
+    np.testing.assert_allclose(
+        float(got["w0"]), float(ref_params["w0"]), rtol=1e-5
+    )
+    for f in range(num_fields):
+        np.testing.assert_allclose(
+            np.asarray(got["vw"][f]), np.asarray(ref_params["vw"][f]),
+            rtol=2e-4, atol=1e-6,
+        )
+
+
+def test_field_sharded_2d_weighted_and_padded(eight_devices):
+    # Zero-weight tail rows + padded field slots, on the 2-D mesh.
+    num_fields, bucket, rank, n_feat, n_row, b = 5, 16, 2, 2, 4, 32
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank,
+        num_fields=num_fields, bucket=bucket, init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.2, optimizer="sgd")
+    mesh = make_field_mesh(8, devices=eight_devices, n_row=n_row)
+    params = spec.init(jax.random.key(2))
+    ref_params = jax.tree_util.tree_map(jnp.copy, params)
+    sharded = shard_field_params(
+        stack_field_params(spec, params, n_feat), mesh
+    )
+    step_sharded = make_field_sharded_sgd_step(spec, config, mesh)
+    step_single = make_field_sparse_sgd_step(spec, config)
+    rng = np.random.default_rng(3)
+    ids, vals, labels, weights = _make_batch(rng, b, num_fields, bucket)
+    weights[b // 2:] = 0.0
+    batch = (ids, vals, labels, weights)
+    sb = shard_field_batch(pad_field_batch(batch, num_fields, n_feat), mesh)
+    sharded, loss_sh = step_sharded(sharded, jnp.int32(0), *sb)
+    ref_params, loss_ref = step_single(
+        ref_params, jnp.int32(0), *map(jnp.asarray, batch)
+    )
+    np.testing.assert_allclose(float(loss_sh), float(loss_ref), rtol=1e-5)
+    got = unstack_field_params(spec, jax.device_get(sharded))
+    for f in range(num_fields):
+        np.testing.assert_allclose(
+            np.asarray(got["vw"][f]), np.asarray(ref_params["vw"][f]),
+            rtol=2e-4, atol=1e-6,
+        )
+    vw = np.asarray(jax.device_get(sharded["vw"]))
+    np.testing.assert_array_equal(vw[num_fields:], 0.0)  # padding inert
+
+
+def test_field_sharded_2d_bucket_divisibility(eight_devices):
+    spec = models.FieldFMSpec(num_features=2 * 12, rank=2, num_fields=2,
+                              bucket=12)
+    mesh = make_field_mesh(8, devices=eight_devices, n_row=8)
+    with pytest.raises(ValueError, match="divide evenly"):
+        make_field_sharded_sgd_body(
+            spec, TrainConfig(optimizer="sgd"), mesh
+        )
+
+
+def test_field_sharded_2d_dedup_sr_learns(eight_devices):
+    # bf16 + stochastic rounding through the 2-D sentinel path: per
+    # (field, row-shard) SR keys, loss must fall, padding stays zero.
+    num_fields, bucket, rank, n_feat, n_row, b = 3, 32, 4, 2, 4, 64
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank, num_fields=num_fields,
+        bucket=bucket, init_std=0.1, param_dtype="bfloat16",
+    )
+    config = TrainConfig(learning_rate=0.3, lr_schedule="constant",
+                         optimizer="sgd", sparse_update="dedup_sr")
+    mesh = make_field_mesh(8, devices=eight_devices, n_row=n_row)
+    sharded = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(0)), n_feat), mesh
+    )
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    from fm_spark_tpu.data import synthetic_ctr
+
+    ids_g, vals, labels = synthetic_ctr(b * 20, num_fields * bucket,
+                                        num_fields, seed=0)
+    offs = (np.arange(num_fields) * bucket).astype(np.int32)
+    ids_l = ids_g - offs[None, :]
+    losses = []
+    for i in range(20):
+        sl = slice(i * b, (i + 1) * b)
+        batch = pad_field_batch(
+            (ids_l[sl], vals[sl], labels[sl], np.ones((b,), np.float32)),
+            num_fields, n_feat,
+        )
+        sharded, loss = step(sharded, jnp.int32(i),
+                             *shard_field_batch(batch, mesh))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
 def test_field_sharded_dedup_sr_runs_and_learns(eight_devices):

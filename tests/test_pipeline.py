@@ -172,3 +172,54 @@ def test_prefetcher_close_unblocks_producer():
     pf.next_batch()
     pf.close()  # must not hang
     assert not pf._thread.is_alive()
+    # Use-after-close errors instead of deadlocking on the dead queue.
+    import pytest
+
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.next_batch()
+
+
+# ------------------------------------------------------- BernoulliBatches
+
+
+def test_bernoulli_batches_reference_sampling_semantics():
+    from fm_spark_tpu.data import BernoulliBatches
+
+    ids, vals, labels = _data(n=4000)
+    p = 0.25
+    b = BernoulliBatches(ids, vals, labels, p, seed=5)
+    masks = []
+    for _ in range(6):
+        bi, bv, bl, w = b.next_batch()
+        # Full fixed shape every step; arrays untouched, only the mask
+        # varies.
+        assert bi.shape == ids.shape and w.shape == (4000,)
+        assert set(np.unique(w)) <= {0.0, 1.0}
+        masks.append(w)
+    # Fresh independent Bernoulli draw each iteration (reference
+    # data.sample(false, frac, seed+i)): masks differ, each ~ p·N.
+    for i in range(5):
+        assert not np.array_equal(masks[i], masks[i + 1])
+        assert abs(masks[i].sum() / 4000 - p) < 0.05
+    # Deterministic per (seed, step) and exactly resumable.
+    b2 = BernoulliBatches(ids, vals, labels, p, seed=5)
+    b2.restore({"step": 3, "seed": 5, "fraction": p})
+    np.testing.assert_array_equal(b2.next_batch()[3], masks[3])
+    # Different seed → different stream.
+    b3 = BernoulliBatches(ids, vals, labels, p, seed=6)
+    assert not np.array_equal(b3.next_batch()[3], masks[0])
+
+
+def test_bernoulli_batches_validation():
+    import pytest
+
+    from fm_spark_tpu.data import BernoulliBatches
+
+    ids, vals, labels = _data(n=10)
+    with pytest.raises(ValueError, match="fraction"):
+        BernoulliBatches(ids, vals, labels, 0.0)
+    with pytest.raises(ValueError, match="fraction"):
+        BernoulliBatches(ids, vals, labels, 1.5)
+    b = BernoulliBatches(ids, vals, labels, 0.5, seed=1)
+    with pytest.raises(ValueError, match="different seed"):
+        b.restore({"step": 0, "seed": 9, "fraction": 0.5})
