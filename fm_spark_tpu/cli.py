@@ -71,7 +71,7 @@ def load_dataset(cfg, args) -> tuple:
             ids, vals, labels = data_lib.synthetic_ctr(
                 n, num_features, cfg.num_fields, seed=cfg.seed
             )
-        if cfg.model in ("field_fm", "field_ffm"):
+        if cfg.field_local_ids:
             ids = _field_local(ids, cfg.bucket)
         return ids, vals, labels, num_features
 
@@ -106,7 +106,7 @@ def load_dataset(cfg, args) -> tuple:
         # step recompiles against a second signature.
         labels = labels.astype(np.float32)
         vals = np.ones(ids.shape, np.float32)
-        if cfg.model in ("field_fm", "field_ffm"):
+        if cfg.field_local_ids:
             ids = _field_local(ids, cfg.bucket)
         return ids, vals, labels, cfg.num_features
 
@@ -213,43 +213,64 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                       eval_source=None, prefetch: int = 0,
                       row_shards: int = 1):
-    """Training loop on the fused sparse-SGD step (FieldFMSpec fast path).
+    """Training loop on the fused sparse steps (the CTR fast path).
 
     On one device this is the single-chip fused step; with multiple
     devices the field-sharded layout (parallel/field_step.py) is used —
     tables partitioned over chips, all_to_all batch re-shard inside the
-    step.
+    step. FieldDeepFM additionally carries optax state for its dense
+    head (MLP + bias); pure-SGD models carry an empty dict so the loop
+    and checkpoints have one shape.
     """
     import jax
     import jax.numpy as jnp
 
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
     from fm_spark_tpu.models.field_ffm import FieldFFMSpec
 
     n = jax.device_count()
+    is_deepfm = isinstance(spec, FieldDeepFMSpec)
     if row_shards < 1:
         raise SystemExit(f"--row-shards must be >= 1, got {row_shards}")
-    if row_shards > 1 and (n == 1 or isinstance(spec, FieldFFMSpec)):
+    if row_shards > 1 and (n == 1 or not isinstance(spec, FieldFMSpec)):
         # Never silently ignore an explicit sharding request.
         raise SystemExit(
             f"--row-shards={row_shards} needs multiple devices and a "
-            "FieldFM model (found "
-            f"{n} device(s), {type(spec).__name__})"
+            f"FieldFM model (found {n} device(s), {type(spec).__name__})"
         )
     canonical = spec.init(jax.random.key(tconfig.seed))
-    # Checkpoints always use the canonical per-field-list layout so a run
-    # can resume on a different device count (plain SGD has no optimizer
-    # state; an empty dict stands in for it).
-    canonical, _, start = _resume(checkpointer, canonical, {}, batches)
+    opt0 = {}
+    if is_deepfm:
+        from fm_spark_tpu.train import make_optimizer
 
+        # Dense-head optimizer state only (structure is device-count
+        # independent, so checkpoints resume on any mesh).
+        opt0 = make_optimizer(tconfig).init(
+            {"w0": canonical["w0"], "mlp": canonical["mlp"]}
+        )
+    # Checkpoints always use the canonical per-field-list layout so a run
+    # can resume on a different device count.
+    canonical, opt0, start = _resume(checkpointer, canonical, opt0, batches)
+
+    def adapt(step_pl):
+        """Lift a ``(params, i, *b) → (params, loss)`` step into the
+        uniform ``(params, opt, i, *b) → (params, opt, loss)`` shape."""
+        def wrapped(params, opt, i, *b):
+            params, loss = step_pl(params, i, *b)
+            return params, opt, loss
+        return wrapped
+
+    host = lambda b: tuple(map(jnp.asarray, b))
     if isinstance(spec, FieldFFMSpec):
         # Fused field-aware step; single-chip execution (the FFM
         # field-sharded layout is a follow-on — cross-field factors make
         # its partials [B, F, k] per chip, not [B, k]).
         from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
 
-        step = make_field_ffm_sparse_sgd_step(spec, tconfig)
-        params = canonical
-        prep = lambda b: tuple(map(jnp.asarray, b))
+        step = adapt(make_field_ffm_sparse_sgd_step(spec, tconfig))
+        params, opt = canonical, opt0
+        prep = host
         to_canonical = lambda p: p
     elif n > 1:
         if tconfig.batch_size % n:
@@ -263,27 +284,48 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 f"count ({n})"
             )
         from fm_spark_tpu.parallel import (
-            make_field_mesh, make_field_sharded_sgd_step, pad_field_batch,
-            shard_field_batch, shard_field_params, stack_field_params,
+            make_field_deepfm_sharded_step, make_field_mesh,
+            make_field_sharded_sgd_step, pad_field_batch,
+            shard_field_batch, shard_field_deepfm_params,
+            shard_field_params, stack_field_deepfm_params,
+            stack_field_params, unstack_field_deepfm_params,
             unstack_field_params,
         )
 
         n_feat = n // row_shards
         mesh = make_field_mesh(n, n_row=row_shards)
-        step = make_field_sharded_sgd_step(spec, tconfig, mesh)
-        params = shard_field_params(
-            stack_field_params(spec, canonical, n_feat), mesh
-        )
         prep = lambda b: shard_field_batch(
             pad_field_batch(b, spec.num_fields, n_feat), mesh
         )
-        to_canonical = lambda p: unstack_field_params(spec, jax.device_get(p))
+        if is_deepfm:
+            step = make_field_deepfm_sharded_step(spec, tconfig, mesh)
+            params = shard_field_deepfm_params(
+                stack_field_deepfm_params(spec, canonical, n_feat), mesh
+            )
+            opt = jax.device_put(opt0)
+            to_canonical = lambda p: unstack_field_deepfm_params(
+                spec, jax.device_get(p)
+            )
+        else:
+            step = adapt(make_field_sharded_sgd_step(spec, tconfig, mesh))
+            params = shard_field_params(
+                stack_field_params(spec, canonical, n_feat), mesh
+            )
+            opt = opt0
+            to_canonical = lambda p: unstack_field_params(
+                spec, jax.device_get(p)
+            )
     else:
-        from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+        if is_deepfm:
+            from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
 
-        step = make_field_sparse_sgd_step(spec, tconfig)
-        params = canonical
-        prep = lambda b: tuple(map(jnp.asarray, b))
+            step = make_field_deepfm_sparse_step(spec, tconfig)
+        else:
+            from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+
+            step = adapt(make_field_sparse_sgd_step(spec, tconfig))
+        params, opt = canonical, opt0
+        prep = host
         to_canonical = lambda p: p
 
     maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger)
@@ -291,22 +333,27 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     since = 0
     from fm_spark_tpu.data import wrap_prefetch
 
+    opt_canonical = (
+        (lambda o: jax.device_get(o)) if is_deepfm else (lambda o: {})
+    )
     batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
         for i in range(start, tconfig.num_steps):
             batch = batches.next_batch()
-            params, loss = step(params, jnp.int32(i), *prep(batch))
+            params, opt, loss = step(params, opt, jnp.int32(i),
+                                     *prep(batch))
             since += len(batch[2])
             if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
                 logger.log(i + 1, samples=since, loss=float(loss))
                 since = 0
             maybe_eval(i + 1, lambda: to_canonical(params))
             if checkpointer is not None and checkpointer.due(i + 1):
-                checkpointer.save(i + 1, to_canonical(params), {},
-                                  batches.state())
+                checkpointer.save(i + 1, to_canonical(params),
+                                  opt_canonical(opt), batches.state())
         if checkpointer is not None:
-            checkpointer.save(tconfig.num_steps, to_canonical(params), {},
-                              batches.state(), force=True)
+            checkpointer.save(tconfig.num_steps, to_canonical(params),
+                              opt_canonical(opt), batches.state(),
+                              force=True)
             checkpointer.wait()
     finally:
         close_prefetch()
@@ -406,7 +453,7 @@ def cmd_train(args) -> int:
             max(1, int(len(ds) * (1.0 - args.test_fraction)))
             if args.test_fraction > 0 else len(ds)
         )
-        bucket = cfg.bucket if cfg.model in ("field_fm", "field_ffm") else 0
+        bucket = cfg.bucket if cfg.field_local_ids else 0
         batches = StreamingBatches(
             PackedBatches(ds, tconfig.batch_size, seed=cfg.seed,
                           row_range=(0, cut)),
@@ -531,7 +578,7 @@ def _batches_for_model(args, spec):
         ids, vals, labels = data_lib.synthetic_ctr(
             args.synthetic, spec.num_features, nnz, seed=1
         )
-        if type(spec).__name__ in ("FieldFMSpec", "FieldFFMSpec"):
+        if getattr(spec, "field_local_ids", False):
             ids = _field_local(ids, spec.bucket)
         return iterate_once(ids, vals, labels, args.batch_size)
 
@@ -549,7 +596,7 @@ def _batches_for_model(args, spec):
         )
     if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
         ds = data_lib.PackedDataset(args.data)
-        bucket = cfg.bucket if cfg.model in ("field_fm", "field_ffm") else 0
+        bucket = cfg.bucket if cfg.field_local_ids else 0
         return iter_packed_once(ds, args.batch_size, bucket=bucket)
     ids, vals, labels, num_features = load_dataset(cfg, args)
     if cfg.bucket <= 0 and num_features > spec.num_features:

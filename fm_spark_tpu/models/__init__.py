@@ -11,6 +11,7 @@ from fm_spark_tpu.models.base import ModelSpec, predict_from_scores  # noqa: F40
 from fm_spark_tpu.models.fm import FMSpec  # noqa: F401
 from fm_spark_tpu.models.ffm import FFMSpec  # noqa: F401
 from fm_spark_tpu.models.deepfm import DeepFMSpec  # noqa: F401
+from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec  # noqa: F401
 from fm_spark_tpu.models.field_fm import FieldFMSpec  # noqa: F401
 from fm_spark_tpu.models.field_ffm import FieldFFMSpec  # noqa: F401
 from fm_spark_tpu.models.io import save_model, load_model  # noqa: F401

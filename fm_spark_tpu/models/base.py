@@ -36,6 +36,11 @@ class ModelSpec:
     param_dtype: str = "float32"          # storage dtype for the big tables
     compute_dtype: str = "float32"        # accumulation dtype
 
+    # Field-partitioned subclasses override to True: their tables take
+    # FIELD-LOCAL ids in [0, bucket) and data layers must convert
+    # per-field-offset global ids first (cli._field_local).
+    field_local_ids = False
+
     def __post_init__(self):
         if self.task not in ("classification", "regression"):
             raise ValueError(f"unknown task {self.task!r}")

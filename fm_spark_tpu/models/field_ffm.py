@@ -40,6 +40,9 @@ class FieldFFMSpec(base.ModelSpec):
     bucket: int = 0
     fused_linear: bool = True
 
+    # Tables take FIELD-LOCAL ids (see FieldFMSpec).
+    field_local_ids = True
+
     def __post_init__(self):
         super().__post_init__()
         if self.num_fields <= 0 or self.bucket <= 0:

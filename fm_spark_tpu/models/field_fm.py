@@ -41,6 +41,11 @@ class FieldFMSpec(base.ModelSpec):
     # halving index ops is ~2× on the hot path.
     fused_linear: bool = True
 
+    # Tables take FIELD-LOCAL ids in [0, bucket) — data layers must
+    # convert per-field-offset globals (cli._field_local; the CLI gates
+    # key on this flag).
+    field_local_ids = True
+
     def __post_init__(self):
         super().__post_init__()
         if self.num_fields <= 0 or self.bucket <= 0:

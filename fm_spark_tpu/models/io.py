@@ -30,6 +30,7 @@ def _register_families():
     from fm_spark_tpu.models.fm import FMSpec
     from fm_spark_tpu.models.ffm import FFMSpec
     from fm_spark_tpu.models.deepfm import DeepFMSpec
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
     from fm_spark_tpu.models.field_fm import FieldFMSpec
     from fm_spark_tpu.models.field_ffm import FieldFFMSpec
 
@@ -37,6 +38,7 @@ def _register_families():
         FMSpec=FMSpec,
         FFMSpec=FFMSpec,
         DeepFMSpec=DeepFMSpec,
+        FieldDeepFMSpec=FieldDeepFMSpec,
         FieldFMSpec=FieldFMSpec,
         FieldFFMSpec=FieldFFMSpec,
     )

@@ -31,12 +31,16 @@ from fm_spark_tpu.parallel.step import (  # noqa: F401
 from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     field_batch_specs,
     field_param_specs,
+    make_field_deepfm_sharded_step,
     make_field_mesh,
     make_field_sharded_sgd_body,
     make_field_sharded_sgd_step,
     pad_field_batch,
     shard_field_batch,
+    shard_field_deepfm_params,
     shard_field_params,
+    stack_field_deepfm_params,
     stack_field_params,
+    unstack_field_deepfm_params,
     unstack_field_params,
 )

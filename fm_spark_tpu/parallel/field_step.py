@@ -318,3 +318,210 @@ def make_field_sharded_sgd_step(spec, config: TrainConfig, mesh):
     return jax.jit(
         make_field_sharded_sgd_body(spec, config, mesh), donate_argnums=(0,)
     )
+
+
+# ---------------------------------------------------------------- DeepFM
+
+
+def stack_field_deepfm_params(spec, params, n_feat: int) -> dict:
+    """Per-field list → stacked layout, keeping the dense head."""
+    stacked = stack_field_params(
+        spec._field_fm_spec(), {"w0": params["w0"], "vw": params["vw"]},
+        n_feat,
+    )
+    stacked["mlp"] = params["mlp"]
+    return stacked
+
+
+def unstack_field_deepfm_params(spec, stacked: dict) -> dict:
+    out = unstack_field_params(spec._field_fm_spec(),
+                               {"w0": stacked["w0"], "vw": stacked["vw"]})
+    out["mlp"] = stacked["mlp"]
+    return out
+
+
+def shard_field_deepfm_params(stacked: dict, mesh) -> dict:
+    """vw field-sharded over ``feat``; the dense head replicated."""
+    out = {
+        "w0": jax.device_put(stacked["w0"], NamedSharding(mesh, P())),
+        "vw": jax.device_put(stacked["vw"],
+                             NamedSharding(mesh, P("feat", None, None))),
+        "mlp": jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+            stacked["mlp"],
+        ),
+    }
+    return out
+
+
+def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
+    """Field-sharded fused DeepFM step (1-D ``feat`` mesh).
+
+    Embedding tables are single-owner per field exactly as in the FM
+    step; the deep head additionally needs the FULL ``h = concat(xv)``
+    on every chip, obtained with one ``all_gather`` of the local xv
+    columns over ``feat`` ([B, F·k] activations — the tables still never
+    move). Every chip then runs the identical MLP forward/backward on
+    replicated weights (MLP FLOPs are negligible next to the index ops,
+    PERF.md fact 4), so the dense gradient is replicated by construction
+    and one optax update outside the shard_map keeps the head in sync.
+
+    Returns ``step(params, opt_state, step_idx, ids, vals, labels,
+    weights) → (params, opt_state, loss)`` with ``step.init_opt_state``;
+    params enter via :func:`shard_field_deepfm_params`.
+    """
+    import functools
+
+    import optax
+
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.sparse import _apply_field_updates, _lr_at, _sr_base_key
+    from fm_spark_tpu.train import make_optimizer
+
+    if type(spec) is not FieldDeepFMSpec:
+        raise ValueError("expected a FieldDeepFMSpec")
+    if set(mesh.axis_names) != {"feat"}:
+        raise ValueError(
+            "field-sharded DeepFM runs on a 1-D ('feat',) mesh (row "
+            "sharding of the shared embedding is a follow-on)"
+        )
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    k = spec.rank
+    F = spec.num_fields
+    n_feat = mesh.shape["feat"]
+    f_pad = padded_num_fields(F, n_feat)
+    f_local = f_pad // n_feat
+    sr_base_key = _sr_base_key(config)
+    lr_at = _lr_at(config)
+    dense_opt = make_optimizer(config)
+
+    mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
+    mlp_specs = jax.tree_util.tree_map(lambda _: P(), mlp_struct)
+    pspecs = {"w0": P(), "vw": P("feat", None, None), "mlp": mlp_specs}
+
+    def local_step(params, step_idx, ids, vals, labels, weights):
+        vw = params["vw"]
+        w0 = params["w0"]
+        mlp = params["mlp"]
+        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
+                             tiled=True)
+        vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
+                              tiled=True)
+        labels = lax.all_gather(labels, "feat", tiled=True)
+        weights = lax.all_gather(weights, "feat", tiled=True)
+
+        vals_c = vals.astype(cd)
+        rows = [vw[f][ids[:, f]].astype(cd) for f in range(f_local)]
+        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        s_p = sum(xvs)
+        sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
+        lin_p = (
+            sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
+            if spec.use_linear
+            else jnp.zeros((ids.shape[0],), cd)
+        )
+        s = lax.psum(s_p, "feat")
+        sq = lax.psum(sq_p, "feat")
+        lin = lax.psum(lin_p, "feat")
+        fm_scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
+        if spec.use_linear:
+            fm_scores = fm_scores + lin
+
+        # Deep head input: local xv columns gathered into global field
+        # order ([B, f_pad·k], padding columns are zero), trimmed to the
+        # MLP's F·k input.
+        h_local = jnp.concatenate(xvs, axis=1)
+        h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
+        h = h_full[:, : F * k]
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def head_loss(dense, h_in):
+            sc = fm_scores + spec.deep_scores(dense["mlp"], h_in)
+            if spec.use_bias:
+                sc = sc + dense["w0"].astype(cd)
+            per = per_example_loss(sc, labels) * weights
+            return jnp.sum(per) / wsum, sc
+
+        (loss, scores), vjp = jax.vjp(head_loss, {"w0": w0, "mlp": mlp}, h)
+        g_dense, g_h = vjp((jnp.ones_like(loss), jnp.zeros_like(scores)))
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        dscores = jax.grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        # This chip's slice of the deep pullback, padded back to f_pad·k
+        # so padding fields see zero deep grad.
+        g_h_pad = jnp.pad(g_h, ((0, 0), (0, f_pad * k - F * k)))
+        col0 = lax.axis_index("feat") * (f_local * k)
+        g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0, f_local * k,
+                                           axis=1)
+
+        g_fulls = []
+        for f in range(f_local):
+            g_v = (
+                dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+                + g_h_loc[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+            )
+            if config.reg_factors:
+                g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+            if spec.use_linear:
+                g_l = dscores * vals_c[:, f]
+                if config.reg_linear:
+                    g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+            else:
+                g_l = jnp.zeros_like(dscores)
+            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        new_slices = _apply_field_updates(
+            [vw[f] for f in range(f_local)], ids, g_fulls, rows, config,
+            sr_base_key, step_idx, lr,
+            field_offset=lax.axis_index("feat") * f_local,
+        )
+        return jnp.stack(new_slices, axis=0), g_dense, loss
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(pspecs, P(), *field_batch_specs(mesh)),
+        out_specs=(P("feat", None, None),
+                   {"w0": P(), "mlp": mlp_specs}, P()),
+        check_vma=False,
+    )
+
+    def dense_subtree(params):
+        return {"w0": params["w0"], "mlp": params["mlp"]}
+
+    def init_opt_state(params):
+        return dense_opt.init(dense_subtree(params))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def _step(params, opt_state, step_idx, ids, vals, labels, weights):
+        new_vw, g_dense, loss = sharded(params, step_idx, ids, vals,
+                                        labels, weights)
+        if config.reg_bias:
+            g_dense["w0"] = g_dense["w0"] + config.reg_bias * params["w0"]
+        if config.reg_factors:
+            g_dense["mlp"] = jax.tree_util.tree_map(
+                lambda g, p: g + config.reg_factors * p,
+                g_dense["mlp"], params["mlp"],
+            )
+        updates, new_opt = dense_opt.update(
+            g_dense, opt_state, dense_subtree(params)
+        )
+        new_dense = optax.apply_updates(dense_subtree(params), updates)
+        return (
+            {"w0": new_dense["w0"], "vw": new_vw, "mlp": new_dense["mlp"]},
+            new_opt,
+            loss,
+        )
+
+    def step(params, opt_state, step_idx, ids, vals, labels, weights):
+        return _step(params, opt_state, step_idx, ids, vals, labels,
+                     weights)
+
+    step.init_opt_state = init_opt_state
+    return step

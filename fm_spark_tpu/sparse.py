@@ -272,6 +272,130 @@ def make_field_ffm_sparse_sgd_step(spec, config: TrainConfig):
     )
 
 
+def make_field_deepfm_sparse_step(spec, config: TrainConfig):
+    """Fused hybrid step for :class:`FieldDeepFMSpec` — the CTR fast path
+    for config 5 (BASELINE.json:11).
+
+    Embedding tables (the 10M-row side) update via the analytic sparse
+    scatter rule — the FM part is the reference's ``x_i(s_f − v_{i,f}x_i)``
+    with the deep head's contribution added through one ``jax.vjp`` of
+    the MLP wrt its input ``h = concat(xv)``:
+
+        ∂L/∂rows_f[:, :k] = dscores·x_f·(s − xv_f)  +  g_h[:, f·k:(f+1)·k]·x_f
+
+    (``g_h`` already carries dscores through the vjp). The MLP + bias —
+    the only dense parameters — update with the configured optax
+    optimizer (Adam for the registered config): no dense table gradient
+    and no table-sized moment state ever exists.
+
+    Returns ``step(params, opt_state, step_idx, ids, vals, labels,
+    weights) → (params, opt_state, loss)``; ``opt_state`` covers only
+    ``{"w0", "mlp"}``.
+    """
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.train import make_optimizer
+
+    if type(spec) is not FieldDeepFMSpec:
+        raise ValueError("expected a FieldDeepFMSpec")
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    F, k = spec.num_fields, spec.rank
+    sr_base_key = _sr_base_key(config)
+    lr_at = _lr_at(config)
+    dense_opt = make_optimizer(config)
+
+    import optax
+
+    def dense_subtree(params):
+        return {"w0": params["w0"], "mlp": params["mlp"]}
+
+    def init_opt_state(params):
+        return dense_opt.init(dense_subtree(params))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def _step(params, opt_state, step_idx, ids, vals, labels, weights):
+        w0 = params["w0"]
+        vals_c = vals.astype(cd)
+        rows = spec.gather_rows(params, ids)            # F × [B, k+1]
+        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        s = sum(xvs)
+        sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
+        fm_scores = 0.5 * (jnp.sum(s * s, axis=1) - sum_sq)
+        if spec.use_linear:
+            fm_scores = fm_scores + sum(
+                r[:, k] * vals_c[:, f] for f, r in enumerate(rows)
+            )
+        h = jnp.concatenate(xvs, axis=1)                # [B, F·k]
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def head_loss(dense, h_in):
+            sc = fm_scores + spec.deep_scores(dense["mlp"], h_in)
+            if spec.use_bias:
+                sc = sc + dense["w0"].astype(cd)
+            per = per_example_loss(sc, labels) * weights
+            return jnp.sum(per) / wsum, sc
+
+        # One vjp covers the dense params AND the deep head's pullback to
+        # h; dscores (for the analytic FM table rule) comes from a grad
+        # wrt scores at the returned value — cheap closed forms.
+        (loss, scores), vjp = jax.vjp(
+            head_loss, dense_subtree(params), h, has_aux=False
+        )
+        g_dense, g_h = vjp((jnp.ones_like(loss), jnp.zeros_like(scores)))
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        dscores = jax.grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        g_fulls = []
+        for f in range(F):
+            g_v = (
+                dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+                + g_h[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+            )
+            if config.reg_factors:
+                g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+            if spec.use_linear:
+                g_l = dscores * vals_c[:, f]
+                if config.reg_linear:
+                    g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+            else:
+                g_l = jnp.zeros_like(dscores)
+            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        new_vw = _apply_field_updates(
+            params["vw"], ids, g_fulls, rows, config, sr_base_key,
+            step_idx, lr,
+        )
+
+        # Dense side: optax on {"w0", "mlp"} only (+ L2 per group).
+        if config.reg_bias:
+            g_dense["w0"] = g_dense["w0"] + config.reg_bias * w0
+        if config.reg_factors:
+            g_dense["mlp"] = jax.tree_util.tree_map(
+                lambda g, p: g + config.reg_factors * p,
+                g_dense["mlp"], params["mlp"],
+            )
+        updates, opt_state = dense_opt.update(
+            g_dense, opt_state, dense_subtree(params)
+        )
+        new_dense = optax.apply_updates(dense_subtree(params), updates)
+        return (
+            {"w0": new_dense["w0"], "vw": new_vw, "mlp": new_dense["mlp"]},
+            opt_state,
+            loss,
+        )
+
+    def step(params, opt_state, step_idx, ids, vals, labels, weights):
+        return _step(params, opt_state, step_idx, ids, vals, labels, weights)
+
+    step.init_opt_state = init_opt_state
+    return step
+
+
 def make_sparse_sgd_step(spec, config: TrainConfig):
     """Build the fused sparse-SGD step for the plain-FM family.
 

@@ -35,6 +35,31 @@ def test_every_config_builds_a_spec(name):
     assert tc.num_steps == 3
 
 
+def test_field_local_id_conversion_covers_every_field_model():
+    # Regression (round-2 review): the id-conversion gate must key on the
+    # single field_local_ids predicate — a field-partitioned model missed
+    # by a hardcoded name tuple trains on silently-clamped ids.
+    import argparse
+
+    for model in ("field_fm", "field_ffm", "field_deepfm"):
+        cfg = dataclasses.replace(
+            configs_lib.CONFIGS["criteo1tb_fm_r64"],
+            name=f"t_{model}", model=model, bucket=64, num_fields=5,
+            rank=4,
+        )
+        assert cfg.field_local_ids
+        args = argparse.Namespace(synthetic=300, data=None)
+        ids, vals, labels, _ = cli.load_dataset(cfg, args)
+        assert ids.max() < cfg.bucket, (
+            f"{model}: ids not field-local — would clamp into table edge"
+        )
+        spec = cfg.spec()
+        assert getattr(spec, "field_local_ids", False)
+    # Non-field models keep global/dense ids.
+    assert not configs_lib.CONFIGS["movielens_fm_r8"].field_local_ids
+    assert not configs_lib.CONFIGS["criteo_kaggle_fm_r32"].field_local_ids
+
+
 def test_flagship_config_uses_fused_scale_out_not_dense_row():
     # VERDICT r1 #7: the at-scale CTR path is the fused field-sharded
     # step; the dense-gradient 'row' strategy must not be presented as
@@ -107,6 +132,22 @@ def test_cli_train_field_sparse(tmp_path, capsys):
         _train_eval_predict(tmp_path, "criteo_small", capsys)
     finally:
         del configs_lib.CONFIGS["criteo_small"]
+
+
+def test_cli_train_field_deepfm(tmp_path, capsys):
+    # Config 5's CTR fast path (field-partitioned embedding + dense Adam
+    # head), shrunk; exercises the sharded deepfm loop on the fake mesh
+    # including model save/eval/predict roundtrip.
+    small = dataclasses.replace(
+        configs_lib.CONFIGS["criteo1tb_deepfm"],
+        name="deepfm_small", bucket=64, num_fields=5, rank=4,
+        mlp_dims=(16, 16, 16),
+    )
+    configs_lib.CONFIGS["deepfm_small"] = small
+    try:
+        _train_eval_predict(tmp_path, "deepfm_small", capsys)
+    finally:
+        del configs_lib.CONFIGS["deepfm_small"]
 
 
 def test_cli_train_dp(tmp_path, capsys):
