@@ -90,6 +90,49 @@ def _dedup(ids: jax.Array, delta: jax.Array):
     return sid, summed[seg], run_start, order
 
 
+def _pallas_pad(x: jax.Array, mult: int, fill=0):
+    pad = (-x.shape[0]) % mult
+    if pad == 0:
+        return x
+    widths = ((0, pad),) + ((0, 0),) * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def pallas_gather(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Pipelined-DMA row gather (ops/pallas_fm.py), padding ids to the
+    kernel's tile multiple; interpret mode off-TPU."""
+    from fm_spark_tpu.ops import pallas_fm
+
+    b = ids.shape[0]
+    interpret = jax.default_backend() != "tpu"
+    # Clamp pad/sentinel ids in-range: gather is side-effect free and the
+    # 2-D sharded path masks non-owned lanes itself.
+    safe = jnp.clip(_pallas_pad(ids, pallas_fm._TILE), 0,
+                    table.shape[0] - 1)
+    return pallas_fm.gather_rows(table, safe, interpret=interpret)[:b]
+
+
+def _pallas_dedup_add(table, ids, delta):
+    """dedup + pipelined read-modify-write: the Pallas replacement for
+    both 'scatter_add' and 'dedup' (bitwise-same up to reassociation).
+    Out-of-range ids (the 2-D mesh's drop sentinel) become invalid
+    lanes."""
+    from fm_spark_tpu.ops import pallas_fm
+
+    n = table.shape[0]
+    sid, summed, run_start, _ = _dedup(ids, delta)
+    valid = run_start & (sid < n)
+    interpret = jax.default_backend() != "tpu"
+    return pallas_fm.update_rows_add(
+        table,
+        _pallas_pad(jnp.where(valid, sid, 0), pallas_fm._TILE),
+        _pallas_pad(valid, pallas_fm._TILE, fill=False),
+        _pallas_pad(jnp.where(valid[:, None], summed, 0.0),
+                    pallas_fm._TILE),
+        interpret=interpret,
+    )
+
+
 def apply_row_updates(
     table: jax.Array,
     ids: jax.Array,
@@ -97,6 +140,7 @@ def apply_row_updates(
     mode: str = "scatter_add",
     key: jax.Array | None = None,
     old_rows: jax.Array | None = None,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Apply per-row ``delta`` ([B, w] in compute dtype) to ``table``
     ([n, w] in storage dtype) at ``ids`` ([B]).
@@ -104,10 +148,15 @@ def apply_row_updates(
     ``old_rows`` ([B, w], compute dtype) are the previously gathered rows
     — required for ``dedup_sr`` (the new value is formed in fp32 from
     them, so no second gather is paid). ``key`` seeds SR.
+    ``use_pallas`` routes 'scatter_add'/'dedup' through the pipelined
+    read-modify-write kernel (dedup_sr keeps its XLA set-semantics
+    write-back, which stochastic rounding requires).
     """
     if mode not in SPARSE_UPDATE_MODES:
         raise ValueError(f"unknown sparse_update mode {mode!r}")
     n = table.shape[0]
+    if use_pallas and mode in ("scatter_add", "dedup"):
+        return _pallas_dedup_add(table, ids, delta)
     if mode == "scatter_add":
         # mode="drop" is XLA's default scatter OOB semantics, made
         # explicit: the 2-D field-sharded step routes non-owned lanes to

@@ -67,9 +67,20 @@ def _apply_field_updates(tables, ids, g_fulls, rows, config: TrainConfig,
             scatter_lib.apply_row_updates(
                 tables[f], ids[:, f], -lr * g_full,
                 mode=config.sparse_update, key=key, old_rows=rows[f],
+                use_pallas=config.use_pallas,
             )
         )
     return new
+
+
+def _gather_fn(config: TrainConfig):
+    """Row-gather routing for the fused bodies: XLA ``table[idx]`` or the
+    Pallas pipelined-DMA kernel (``config.use_pallas``)."""
+    if not config.use_pallas:
+        return lambda table, idx: table[idx]
+    from fm_spark_tpu.ops.scatter import pallas_gather
+
+    return pallas_gather
 
 
 def make_field_sparse_sgd_body(spec, config: TrainConfig):
@@ -85,17 +96,24 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("sparse step implements plain SGD only")
     if config.sparse_update != "scatter_add" and not spec.fused_linear:
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
+    if config.use_pallas and not spec.fused_linear:
+        raise ValueError("use_pallas requires fused_linear=True")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
     sr_base_key = _sr_base_key(config)
     lr_at = _lr_at(config)
+    gat = _gather_fn(config)
     k = spec.rank
 
     def step(params, step_idx, ids, vals, labels, weights):
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        rows = spec.gather_rows(params, ids)            # F × [B, width]
+        if spec.fused_linear:
+            rows = [gat(params["vw"][f], ids[:, f]).astype(cd)
+                    for f in range(F)]
+        else:
+            rows = spec.gather_rows(params, ids)        # F × [B, width]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)                                    # [B, k]
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
@@ -302,6 +320,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
     F, k = spec.num_fields, spec.rank
     sr_base_key = _sr_base_key(config)
     lr_at = _lr_at(config)
+    gat = _gather_fn(config)
     dense_opt = make_optimizer(config)
 
     import optax
@@ -316,7 +335,8 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
     def _step(params, opt_state, step_idx, ids, vals, labels, weights):
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        rows = spec.gather_rows(params, ids)            # F × [B, k+1]
+        rows = [gat(params["vw"][f], ids[:, f]).astype(cd)
+                for f in range(F)]                      # F × [B, k+1]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)

@@ -48,6 +48,14 @@ class TrainConfig:
     # 'scatter_add' | 'dedup' | 'dedup_sr'. dedup_sr is the bf16-storage
     # quality fix (stochastic rounding needs deduped set-semantics).
     sparse_update: str = "scatter_add"
+    # Route the fused steps' row gather/update through the Pallas
+    # pipelined-DMA kernels (ops/pallas_fm.py) instead of XLA
+    # gather/scatter. The update side dedups in-batch first (the kernel's
+    # read-modify-write needs unique ids); dedup_sr keeps its XLA
+    # set-semantics write-back. Off-TPU backends run the kernels in
+    # interpret mode (correctness only — the A/B belongs on a real chip,
+    # PERF.md "Pallas" lever).
+    use_pallas: bool = False
 
 
 def _group_reg(config: TrainConfig):
