@@ -96,7 +96,8 @@ def inner_main(args):
         param_dtype=args.param_dtype,
     )
     config = TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                         optimizer="sgd", sparse_update=args.sparse_update)
+                         optimizer="sgd", sparse_update=args.sparse_update,
+                         use_pallas=args.use_pallas)
     body = make_field_sparse_sgd_body(spec, config)
 
     params = spec.init(jax.random.key(0))
@@ -207,6 +208,9 @@ def main():
                     choices=["float32", "bfloat16"])
     ap.add_argument("--sparse-update", default="scatter_add",
                     choices=["scatter_add", "dedup", "dedup_sr"])
+    ap.add_argument("--use-pallas", action="store_true", dest="use_pallas",
+                    help="route row gather/update through the Pallas "
+                         "pipelined-DMA kernels (PERF.md 'Pallas' lever)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
@@ -227,6 +231,8 @@ def main():
         "--batch", str(args.batch),
         "--steps", str(args.steps),
     ]
+    if args.use_pallas:
+        argv.append("--use-pallas")
     failures = []
     for attempt in range(1, args.attempts + 1):
         _log(f"[parent] attempt {attempt}/{args.attempts}")

@@ -430,6 +430,7 @@ def cmd_train(args) -> int:
         learning_rate=args.lr, strategy=args.strategy, seed=args.seed,
         optimizer=args.optimizer, sparse_update=args.sparse_update,
         param_dtype=args.param_dtype,
+        use_pallas=True if args.use_pallas else None,
     )
     tconfig = cfg.train_config(
         log_every=args.log_every, metrics_path=args.metrics,
@@ -715,6 +716,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="table storage dtype (bfloat16 halves gather bytes; "
                         "pair with --sparse-update dedup_sr)")
+    t.add_argument("--use-pallas", action="store_true", dest="use_pallas",
+                   help="route fused-step row gather/update through the "
+                        "Pallas pipelined-DMA kernels (TPU; interpret mode "
+                        "elsewhere)")
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--row-shards", type=int, default=1, dest="row_shards",
                    help="field_sparse strategy: shard each field's bucket "

@@ -61,6 +61,10 @@ class RunConfig:
     # --sparse-update override reaches the fused step. dedup_sr is the
     # bf16-storage quality fix promoted in PERF.md.
     sparse_update: str = "scatter_add"
+    # Route fused-step row gather/update through the Pallas pipelined-DMA
+    # kernels (ops/pallas_fm.py) instead of XLA gather/scatter; reaches
+    # the step via train_config() like sparse_update.
+    use_pallas: bool = False
 
     @property
     def field_local_ids(self) -> bool:

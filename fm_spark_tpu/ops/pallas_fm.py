@@ -9,12 +9,13 @@ program issues a deep queue of row-granular async DMAs (HBM→VMEM for
 gather; read-modify-write for update), so many row fetches are in flight
 at once instead of whatever depth XLA's scatter emits.
 
-Status: correctness-verified in interpret mode (tests/test_pallas_fm.py)
-and shape/dtype-compatible with the fused step. They are NOT wired into
-the default path yet — the decision needs a real-chip A/B against the
-XLA ops (the tunnel was down when this landed; see PERF.md "Pallas"
-lever). Integration point: `ops/scatter.py apply_row_updates` and
-`FieldFMSpec.gather_rows`.
+Status: wired into the fused steps behind ``TrainConfig.use_pallas``
+(sparse.py `_gather_fn` / ops/scatter.py `apply_row_updates`), reachable
+via ``bench.py --use-pallas`` and ``fmtpu train --use-pallas``.
+Kernel semantics are pinned in interpret mode (tests/test_pallas_fm.py)
+and the integration — padding, dedup-before-RMW, sharded OOB sentinels —
+in tests/test_sparse_pallas.py. Whether it becomes the DEFAULT is a
+real-chip A/B against the XLA ops (PERF.md "Pallas" lever).
 
 Update-kernel contract: row ids must be UNIQUE within the call (pair it
 with the `dedup` mode's segment-sum — duplicate lanes carry

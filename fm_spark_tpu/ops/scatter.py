@@ -114,14 +114,18 @@ def pallas_gather(table: jax.Array, ids: jax.Array) -> jax.Array:
 
 def _pallas_dedup_add(table, ids, delta):
     """dedup + pipelined read-modify-write: the Pallas replacement for
-    both 'scatter_add' and 'dedup' (bitwise-same up to reassociation).
-    Out-of-range ids (the 2-D mesh's drop sentinel) become invalid
-    lanes."""
+    both 'scatter_add' and 'dedup'. Any out-of-range id (the 2-D mesh's
+    high drop sentinel, or a negative) becomes an invalid lane, matching
+    XLA scatter's mode="drop". Numerics note: duplicates are summed in
+    fp32 and rounded ONCE into the storage dtype — for fp32 tables this
+    is 'scatter_add' up to reassociation, but for bf16 tables it is
+    systematically MORE accurate than XLA's round-per-duplicate-write
+    scatter (closer to 'dedup', which shares the segment-sum)."""
     from fm_spark_tpu.ops import pallas_fm
 
     n = table.shape[0]
     sid, summed, run_start, _ = _dedup(ids, delta)
-    valid = run_start & (sid < n)
+    valid = run_start & (sid >= 0) & (sid < n)
     interpret = jax.default_backend() != "tpu"
     return pallas_fm.update_rows_add(
         table,

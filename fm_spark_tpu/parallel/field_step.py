@@ -178,9 +178,16 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         raise ValueError("field-sharded step requires fused_linear=True")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
-    from fm_spark_tpu.sparse import _apply_field_updates, _lr_at, _sr_base_key
+    from fm_spark_tpu.sparse import (
+        _apply_field_updates,
+        _gather_all,
+        _gather_fn,
+        _lr_at,
+        _sr_base_key,
+    )
 
     sr_base_key = _sr_base_key(config)
+    gat = _gather_fn(config)
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded step runs on a ('feat',) or ('feat', 'row') "
@@ -236,14 +243,14 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             own = (loc >= 0) & (loc < bucket_local)
             gidx = jnp.clip(loc, 0, bucket_local - 1)
             rows = [
-                vw[f][gidx[:, f]].astype(cd) * own[:, f, None]
-                for f in range(f_local)
+                r * own[:, f, None]
+                for f, r in enumerate(_gather_all(gat, vw, gidx, cd))
             ]
             # Non-owned update lanes go to an out-of-bounds sentinel row
             # and are dropped by XLA scatter — single-owner writes.
             uidx = jnp.where(own, loc, bucket_local)
         else:
-            rows = [vw[f][ids[:, f]].astype(cd) for f in range(f_local)]
+            rows = _gather_all(gat, vw, ids, cd)
             uidx = ids
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s_p = sum(xvs)
@@ -375,7 +382,13 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     import optax
 
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
-    from fm_spark_tpu.sparse import _apply_field_updates, _lr_at, _sr_base_key
+    from fm_spark_tpu.sparse import (
+        _apply_field_updates,
+        _gather_all,
+        _gather_fn,
+        _lr_at,
+        _sr_base_key,
+    )
     from fm_spark_tpu.train import make_optimizer
 
     if type(spec) is not FieldDeepFMSpec:
@@ -394,6 +407,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     f_local = f_pad // n_feat
     sr_base_key = _sr_base_key(config)
     lr_at = _lr_at(config)
+    gat = _gather_fn(config)
     dense_opt = make_optimizer(config)
 
     mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
@@ -412,7 +426,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         weights = lax.all_gather(weights, "feat", tiled=True)
 
         vals_c = vals.astype(cd)
-        rows = [vw[f][ids[:, f]].astype(cd) for f in range(f_local)]
+        rows = _gather_all(gat, vw, ids, cd)
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s_p = sum(xvs)
         sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)

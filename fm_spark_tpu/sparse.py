@@ -83,6 +83,13 @@ def _gather_fn(config: TrainConfig):
     return pallas_gather
 
 
+def _gather_all(gat, tables, ids, cd):
+    """One routed gather per field, cast to compute dtype — the single
+    definition of the fused bodies' ``rows`` idiom (five call sites across
+    sparse.py and parallel/field_step.py must not drift)."""
+    return [gat(tables[f], ids[:, f]).astype(cd) for f in range(len(tables))]
+
+
 def make_field_sparse_sgd_body(spec, config: TrainConfig):
     """Unjitted fused-step body for :class:`FieldFMSpec` (see the jitted
     wrapper :func:`make_field_sparse_sgd_step`); exposed separately so
@@ -110,8 +117,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         w0 = params["w0"]
         vals_c = vals.astype(cd)
         if spec.fused_linear:
-            rows = [gat(params["vw"][f], ids[:, f]).astype(cd)
-                    for f in range(F)]
+            rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, k+1]
         else:
             rows = spec.gather_rows(params, ids)        # F × [B, width]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
@@ -226,11 +232,12 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     F, k = spec.num_fields, spec.rank
     sr_base_key = _sr_base_key(config)
     lr_at = _lr_at(config)
+    gat = _gather_fn(config)
 
     def step(params, step_idx, ids, vals, labels, weights):
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        rows = spec.gather_rows(params, ids)            # F × [B, F·k+1]
+        rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, F·k+1]
         sel = spec._sel(rows, vals_c)                   # [B, F, F, k]
         a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)
         diag = jnp.trace(a, axis1=1, axis2=2)
@@ -335,8 +342,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
     def _step(params, opt_state, step_idx, ids, vals, labels, weights):
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        rows = [gat(params["vw"][f], ids[:, f]).astype(cd)
-                for f in range(F)]                      # F × [B, k+1]
+        rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, k+1]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)

@@ -28,6 +28,10 @@ def test_two_process_dp_psum_agrees():
         k: v for k, v in os.environ.items()
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
+    # sys.path[0] for a script is tests/, not the repo root — make the
+    # package importable without requiring an installed wheel.
+    repo_root = os.path.dirname(os.path.dirname(script))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, script, str(i), "2", str(port)],

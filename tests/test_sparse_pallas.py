@@ -1,0 +1,222 @@
+"""`TrainConfig.use_pallas` routing: fused steps via the Pallas kernels
+(interpret mode off-TPU) must match the XLA gather/scatter path.
+
+The kernel internals are pinned by tests/test_pallas_fm.py; these tests
+pin the *integration* — id padding/clamping, dedup-before-RMW, OOB
+sentinel handling, and the gather routing inside the fused bodies.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.sparse import (
+    make_field_deepfm_sparse_step,
+    make_field_ffm_sparse_sgd_step,
+    make_field_sparse_sgd_step,
+)
+from fm_spark_tpu.train import TrainConfig
+
+F, BUCKET, K, B = 5, 64, 4, 48
+
+
+@pytest.fixture
+def batch(rng):
+    # Heavy duplication within fields to exercise the dedup path the
+    # update kernel requires.
+    ids = rng.integers(0, 8, size=(B, F)).astype(np.int32)
+    vals = rng.normal(size=(B, F)).astype(np.float32)
+    labels = rng.integers(0, 2, B).astype(np.float32)
+    return jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(labels)
+
+
+def _spec():
+    return models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, fused_linear=True,
+    )
+
+
+@pytest.mark.parametrize("mode", ["scatter_add", "dedup"])
+def test_field_step_pallas_matches_xla(batch, mode):
+    ids, vals, labels = batch
+    spec = _spec()
+    params = spec.init(jax.random.key(0))
+    params_p = jax.tree_util.tree_map(jnp.copy, params)
+    cfg = dict(learning_rate=0.2, lr_schedule="inv_sqrt", optimizer="sgd",
+               sparse_update=mode)
+    step_x = make_field_sparse_sgd_step(spec, TrainConfig(**cfg))
+    step_p = make_field_sparse_sgd_step(
+        spec, TrainConfig(use_pallas=True, **cfg)
+    )
+    w = jnp.ones((B,))
+    for i in range(3):
+        params, loss_x = step_x(params, jnp.int32(i), ids, vals, labels, w)
+        params_p, loss_p = step_p(params_p, jnp.int32(i), ids, vals, labels, w)
+        np.testing.assert_allclose(float(loss_p), float(loss_x), rtol=1e-5)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_p["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-4, atol=1e-6, err_msg=f"field {f}",
+        )
+
+
+def test_field_step_pallas_with_zero_weight_rows(batch):
+    # weights==0 rows must not move the table (masked examples still
+    # occupy scatter lanes; dedup must sum their zero grads harmlessly).
+    ids, vals, labels = batch
+    spec = _spec()
+    params = spec.init(jax.random.key(1))
+    params_p = jax.tree_util.tree_map(jnp.copy, params)
+    cfg = dict(learning_rate=0.3, optimizer="sgd", sparse_update="dedup")
+    step_x = make_field_sparse_sgd_step(spec, TrainConfig(**cfg))
+    step_p = make_field_sparse_sgd_step(
+        spec, TrainConfig(use_pallas=True, **cfg)
+    )
+    w = jnp.asarray((np.arange(B) % 3 == 0).astype(np.float32))
+    params, _ = step_x(params, jnp.int32(0), ids, vals, labels, w)
+    params_p, _ = step_p(params_p, jnp.int32(0), ids, vals, labels, w)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_p["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+def test_deepfm_step_pallas_matches_xla(batch):
+    ids, vals, labels = batch
+    spec = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, mlp_dims=(8, 8),
+    )
+    params = spec.init(jax.random.key(2))
+    params_p = jax.tree_util.tree_map(jnp.copy, params)
+    cfg = dict(learning_rate=0.05, optimizer="adam")
+    step_x = make_field_deepfm_sparse_step(spec, TrainConfig(**cfg))
+    step_p = make_field_deepfm_sparse_step(
+        spec, TrainConfig(use_pallas=True, **cfg)
+    )
+    opt_x = step_x.init_opt_state(params)
+    opt_p = step_p.init_opt_state(params_p)
+    w = jnp.ones((B,))
+    for i in range(2):
+        params, opt_x, loss_x = step_x(
+            params, opt_x, jnp.int32(i), ids, vals, labels, w
+        )
+        params_p, opt_p, loss_p = step_p(
+            params_p, opt_p, jnp.int32(i), ids, vals, labels, w
+        )
+        np.testing.assert_allclose(float(loss_p), float(loss_x), rtol=1e-5)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_p["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+def test_ffm_step_pallas_matches_xla(batch):
+    ids, vals, labels = batch
+    spec = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=3, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    params = spec.init(jax.random.key(4))
+    params_p = jax.tree_util.tree_map(jnp.copy, params)
+    cfg = dict(learning_rate=0.2, optimizer="sgd", sparse_update="dedup")
+    step_x = make_field_ffm_sparse_sgd_step(spec, TrainConfig(**cfg))
+    step_p = make_field_ffm_sparse_sgd_step(
+        spec, TrainConfig(use_pallas=True, **cfg)
+    )
+    w = jnp.ones((B,))
+    for i in range(2):
+        params, loss_x = step_x(params, jnp.int32(i), ids, vals, labels, w)
+        params_p, loss_p = step_p(params_p, jnp.int32(i), ids, vals, labels, w)
+        np.testing.assert_allclose(float(loss_p), float(loss_x), rtol=1e-5)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_p["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+def test_pallas_update_drops_negative_and_high_ids():
+    """XLA scatter mode='drop' parity: out-of-range lanes (high sentinel
+    OR negative) must not touch the table — a negative id especially must
+    not corrupt row 0 via index clamping."""
+    from fm_spark_tpu.ops.scatter import apply_row_updates
+
+    table = jnp.ones((16, 4), jnp.float32)
+    ids = jnp.asarray([3, -1, 16, 100, -7, 3], jnp.int32)
+    delta = jnp.full((6, 4), 10.0, jnp.float32)
+    got = apply_row_updates(table, ids, delta, mode="dedup", use_pallas=True)
+    want = np.ones((16, 4), np.float32)
+    want[3] += 20.0  # two valid lanes, deduped
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_pallas_requires_fused_linear():
+    spec = models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        fused_linear=False,
+    )
+    with pytest.raises(ValueError, match="fused_linear"):
+        make_field_sparse_sgd_step(
+            spec, TrainConfig(optimizer="sgd", use_pallas=True)
+        )
+
+
+@pytest.mark.parametrize("n_row", [1, 2], ids=["feat4", "feat2xrow2"])
+def test_sharded_field_step_pallas_matches_single(rng, n_row):
+    """use_pallas flows into the field-sharded step's gathers and shared
+    update helper. The 2-D (feat, row) variant is the one that actually
+    emits the bucket_local drop sentinel into the Pallas update — those
+    lanes must become invalid kernel lanes (XLA mode='drop' parity)."""
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_mesh,
+        make_field_sharded_sgd_step,
+        pad_field_batch,
+        shard_field_batch,
+        shard_field_params,
+        stack_field_params,
+        unstack_field_params,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices (fake CPU mesh)")
+    spec = _spec()
+    mesh = make_field_mesh(4, n_row=n_row)
+    ids = rng.integers(0, 8, size=(B, F)).astype(np.int32)
+    vals = rng.normal(size=(B, F)).astype(np.float32)
+    labels = rng.integers(0, 2, B).astype(np.float32)
+    w = np.ones((B,), np.float32)
+
+    cfg = dict(learning_rate=0.2, optimizer="sgd", sparse_update="dedup")
+    params0 = spec.init(jax.random.key(3))
+    params_single = jax.tree_util.tree_map(jnp.copy, params0)
+    step_single = make_field_sparse_sgd_step(spec, TrainConfig(**cfg))
+
+    config_p = TrainConfig(use_pallas=True, **cfg)
+    stacked = stack_field_params(spec, params0, mesh.shape["feat"])
+    sharded = shard_field_params(stacked, mesh)
+    step_sharded = make_field_sharded_sgd_step(spec, config_p, mesh)
+
+    batch = pad_field_batch(
+        (jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(labels),
+         jnp.asarray(w)),
+        spec.num_fields, mesh.shape["feat"],
+    )
+    sbatch = shard_field_batch(batch, mesh)
+    for i in range(2):
+        params_single, _ = step_single(
+            params_single, jnp.int32(i), jnp.asarray(ids), jnp.asarray(vals),
+            jnp.asarray(labels), jnp.asarray(w),
+        )
+        sharded, _ = step_sharded(sharded, jnp.int32(i), *sbatch)
+    back = unstack_field_params(spec, jax.device_get(sharded))
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(back["vw"][f]), np.asarray(params_single["vw"][f]),
+            rtol=1e-4, atol=1e-6, err_msg=f"field {f}",
+        )
