@@ -14,8 +14,18 @@ Status: wired into the fused steps behind ``TrainConfig.use_pallas``
 via ``bench.py --use-pallas`` and ``fmtpu train --use-pallas``.
 Kernel semantics are pinned in interpret mode (tests/test_pallas_fm.py)
 and the integration — padding, dedup-before-RMW, sharded OOB sentinels —
-in tests/test_sparse_pallas.py. Whether it becomes the DEFAULT is a
-real-chip A/B against the XLA ops (PERF.md "Pallas" lever).
+in tests/test_sparse_pallas.py.
+
+**Real-chip A/B verdict (round 2, PERF.md): XLA wins — use_pallas stays
+off by default.** Mosaic constraints found on hardware: (a) row-granular
+DMA slices must be 128-lane aligned, so the width-65 fused layout does
+not compile (the `require_aligned` checks below turn that into a clear
+error); (b) scalar-prefetching the full id vector caps batch size by
+SMEM (131072 ids = 512KB overflows). At an aligned width 128 the gather
+kernel measured 12.6ms vs XLA's 9.8ms for 131072 Zipf ids — XLA's
+native gather is faster than row-granular pipelined DMA at these
+shapes. Kept as an experimental flag for re-evaluation on future
+hardware/toolchains.
 
 Update-kernel contract: row ids must be UNIQUE within the call (pair it
 with the `dedup` mode's segment-sum — duplicate lanes carry
@@ -35,6 +45,28 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Rows processed per grid program; also the DMA queue depth per phase.
 _TILE = 256
+
+# Mosaic limits discovered on real v5e hardware (PERF.md round-2 A/B).
+_LANE = 128          # row-granular DMA slices must be 128-lane aligned
+_SMEM_ID_LIMIT = 64 * 1024  # scalar-prefetched int32 ids that fit SMEM
+
+
+def _require_compilable(width: int, n_ids: int, interpret: bool, who: str):
+    """Fail with an actionable message instead of a Mosaic internal error
+    for the two hardware constraints interpret mode cannot see."""
+    if interpret:
+        return
+    if width % _LANE:
+        raise ValueError(
+            f"{who}: table width {width} must be a multiple of {_LANE} on "
+            f"real TPU (Mosaic row-DMA lane alignment); pad the table "
+            f"width or use the XLA path (use_pallas=False)"
+        )
+    if n_ids > _SMEM_ID_LIMIT:
+        raise ValueError(
+            f"{who}: {n_ids} ids exceed the scalar-prefetch SMEM budget "
+            f"({_SMEM_ID_LIMIT}); split the batch or use the XLA path"
+        )
 
 
 def _gather_kernel(ids_ref, table_ref, out_ref, sems):
@@ -71,6 +103,7 @@ def gather_rows(table: jax.Array, ids: jax.Array,
     if b % _TILE:
         raise ValueError(f"ids length {b} must be a multiple of {_TILE}")
     w = table.shape[1]
+    _require_compilable(w, b, interpret, "gather_rows")
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b // _TILE,),
@@ -151,6 +184,7 @@ def update_rows_add(table: jax.Array, ids: jax.Array, valid: jax.Array,
     if b % _TILE:
         raise ValueError(f"ids length {b} must be a multiple of {_TILE}")
     w = table.shape[1]
+    _require_compilable(w, 2 * b, interpret, "update_rows_add")
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # ids, valid
         grid=(b // _TILE,),
