@@ -177,12 +177,112 @@ def bench_cast(args):
          total_gb / dt, "GB/s (fp32 read side)")
 
 
+def bench_dedup(args):
+    """Probes behind the host-assisted dedup lever (PERF.md round 3):
+    the headline step's 39-field update cost under each write strategy,
+    all fields in ONE jitted program (matching the fused step's shape).
+
+    Answers two real-chip questions the design hinges on:
+    (a) does XLA scatter get cheaper when duplicate lanes become
+        OOB-drop no-ops (unique-only writes)?
+    (b) how much of the device-side dedup cost is the argsort that a
+        host prefetch thread could precompute?
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, rows, width, b = args.tables, args.rows, args.width + 1, args.n_idx
+    rng = np.random.default_rng(0)
+    ids_np = (rng.zipf(1.3, size=(b, F)) % rows).astype(np.int32)
+    uniq_frac = np.mean(
+        [np.unique(ids_np[:, f]).size for f in range(F)]
+    ) / b
+    ids = jnp.asarray(ids_np)
+    upd = jnp.full((b, width), 1e-3, jnp.float32)
+    tables = [jnp.zeros((rows, width), jnp.float32) for _ in range(F)]
+
+    # Host-side aux (what the prefetch thread would ship): per-field sort
+    # order and run-start mask, one vectorized numpy pass for all fields.
+    order_np = np.argsort(ids_np, axis=0, kind="stable").astype(np.int32)
+    sid_np = np.take_along_axis(ids_np, order_np, axis=0)
+    run_np = np.concatenate(
+        [np.ones((1, F), bool), sid_np[1:] != sid_np[:-1]], axis=0
+    )
+    order = jnp.asarray(order_np)
+    run_start = jnp.asarray(run_np)
+    sid_dev = jnp.asarray(sid_np)
+    # Compacted per-field segment map: seg[p] = segment index of sorted
+    # lane p; useg[s] = the unique id segment s writes to (OOB-padded) —
+    # both host-computable, so the device never sorts or re-expands.
+    seg_np = run_np.cumsum(axis=0).astype(np.int32) - 1
+    useg_np = np.full((b, F), rows, np.int32)
+    for f in range(F):
+        u = sid_np[run_np[:, f], f]
+        useg_np[: u.size, f] = u
+    seg_dev = jnp.asarray(seg_np)
+    useg = jnp.asarray(useg_np)
+
+    def timed(name, fn, *xs, extra=None):
+        f = jax.jit(fn)  # returns ALL tables — nothing is DCE'd
+
+        def run():
+            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
+
+        run()  # compile
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        cfg = {"fields": F, "rows": rows, "width": width, "batch": b,
+               "uniq_frac": round(float(uniq_frac), 3)}
+        if extra:
+            cfg.update(extra)
+        _out(f"dedup_{name}", cfg, dt * 1e3, "ms/step-equivalent")
+        return dt
+
+    def scatter_all(ts, idx):
+        return [t.at[idx[:, f]].add(upd, mode="drop")
+                for f, t in enumerate(ts)]
+
+    timed("scatter_zipf", scatter_all, tables, ids)
+    # Duplicate lanes routed out-of-bounds: same index count, unique
+    # writes only — isolates whether dropped lanes are cheaper.
+    oob_ids = jnp.where(run_start, sid_dev, rows)
+    timed("scatter_dropped_dups", scatter_all, tables, oob_ids)
+
+    def argsort_all(idx):
+        return [jnp.argsort(idx[:, f]) for f in range(F)]
+
+    timed("argsort_only", argsort_all, ids)
+
+    def dedup_device_all(ts, idx):
+        from fm_spark_tpu.ops.scatter import apply_row_updates
+        return [apply_row_updates(t, idx[:, f], upd, mode="dedup")
+                for f, t in enumerate(ts)]
+
+    timed("device_full", dedup_device_all, tables, ids)
+
+    def dedup_hostaux_all(ts, o, sg, u):
+        # Device work: ONE batch-to-batch gather (delta reorder), one
+        # segment_sum, one unique-target scatter. No sort, no [seg]
+        # re-expansion.
+        out = []
+        for f, t in enumerate(ts):
+            sdelta = upd[o[:, f]]
+            summed = jax.ops.segment_sum(sdelta, sg[:, f], num_segments=b)
+            out.append(t.at[u[:, f]].add(summed, mode="drop"))
+        return out
+
+    timed("hostaux", dedup_hostaux_all, tables, order, seg_dev, useg)
+
+
 BENCHES = {
     "dispatch": bench_dispatch,
     "gather": bench_gather,
     "scatter": bench_scatter,
     "matmul": bench_matmul,
     "cast": bench_cast,
+    "dedup": bench_dedup,
 }
 
 
