@@ -97,16 +97,27 @@ def inner_main(args):
     )
     config = TrainConfig(learning_rate=0.05, lr_schedule="constant",
                          optimizer="sgd", sparse_update=args.sparse_update,
-                         use_pallas=args.use_pallas)
+                         use_pallas=args.use_pallas,
+                         host_dedup=args.host_dedup)
     body = make_field_sparse_sgd_body(spec, config)
 
     params = spec.init(jax.random.key(0))
     rng = np.random.default_rng(0)
     # Criteo-like Zipf skew within each field's bucket.
-    ids = jnp.asarray(rng.zipf(1.3, size=(batch, num_fields)) % bucket, jnp.int32)
+    ids_np = (rng.zipf(1.3, size=(batch, num_fields)) % bucket).astype(np.int32)
+    ids = jnp.asarray(ids_np)
     vals = jnp.ones((batch, num_fields), jnp.float32)
     labels = jnp.asarray(rng.integers(0, 2, batch), jnp.float32)
     weights = jnp.ones((batch,), jnp.float32)
+    aux = None
+    if args.host_dedup:
+        # Device-throughput bench: the aux for the (fixed) bench batch is
+        # computed once here; in production it rides the prefetch thread
+        # (data/pipeline.DedupAuxBatches) — bench_input.py --host-dedup
+        # measures that host-side rate.
+        from fm_spark_tpu.ops.scatter import dedup_aux
+
+        aux = jax.device_put(dedup_aux(ids_np))
 
     import functools
 
@@ -114,22 +125,24 @@ def inner_main(args):
     # program the timed call runs (a static count would recompile inside
     # the timed region).
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def run(params, ids, vals, labels, weights, n_steps):
+    def run(params, ids, vals, labels, weights, aux, n_steps):
         def fbody(i, carry):
             p, _ = carry
-            return body(p, i, ids, vals, labels, weights)
+            return body(p, i, ids, vals, labels, weights, aux)
 
         return lax.fori_loop(0, n_steps, fbody, (params, jnp.float32(0)))
 
     _log("[inner] compiling + warmup (first TPU compile is slow, ~20-60s)...")
     t0 = time.perf_counter()
-    params, loss = run(params, ids, vals, labels, weights, jnp.int32(steps_warmup))
+    params, loss = run(params, ids, vals, labels, weights, aux,
+                       jnp.int32(steps_warmup))
     float(loss)  # d2h fence
     _log(f"[inner] warmup done in {time.perf_counter() - t0:.1f}s; "
          f"timing {steps_timed} steps x batch {batch}...")
 
     t0 = time.perf_counter()
-    params, loss = run(params, ids, vals, labels, weights, jnp.int32(steps_timed))
+    params, loss = run(params, ids, vals, labels, weights, aux,
+                       jnp.int32(steps_timed))
     final_loss = float(loss)  # d2h fence
     dt = time.perf_counter() - t0
 
@@ -211,6 +224,10 @@ def main():
     ap.add_argument("--use-pallas", action="store_true", dest="use_pallas",
                     help="route row gather/update through the Pallas "
                          "pipelined-DMA kernels (PERF.md 'Pallas' lever)")
+    ap.add_argument("--host-dedup", action="store_true", dest="host_dedup",
+                    help="host-precomputed dedup aux: device writes each "
+                         "unique id once (PERF.md round-3 lever; pair "
+                         "with --sparse-update dedup or dedup_sr)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
@@ -233,6 +250,8 @@ def main():
     ]
     if args.use_pallas:
         argv.append("--use-pallas")
+    if args.host_dedup:
+        argv.append("--host-dedup")
     failures = []
     for attempt in range(1, args.attempts + 1):
         _log(f"[parent] attempt {attempt}/{args.attempts}")

@@ -82,6 +82,10 @@ def main():
     ap.add_argument("--data-dir", default="/tmp/fmtpu_bench_input",
                     help="packed dir to create/reuse")
     ap.add_argument("--prefetch-depth", type=int, default=4)
+    ap.add_argument("--host-dedup", action="store_true", dest="host_dedup",
+                    help="add the DedupAuxBatches stage (per-batch argsort "
+                         "+ segment maps on the host) — the feed-rate cost "
+                         "of TrainConfig.host_dedup")
     args = ap.parse_args()
 
     num_fields, bucket = 39, 1 << 18
@@ -126,11 +130,21 @@ def main():
     def put_block(b):
         jax.block_until_ready(jax.device_put(b))
 
+    from fm_spark_tpu.data import DedupAuxBatches
+
+    source = (
+        (lambda: DedupAuxBatches(with_field_local()))
+        if args.host_dedup else with_field_local
+    )
     stages = [
         ("packed_batches", raw, lambda b: None),
         ("+field_local", with_field_local, lambda b: None),
-        ("+device_put", with_field_local, put_block),
-        ("+prefetcher", lambda: Prefetcher(with_field_local(),
+    ]
+    if args.host_dedup:
+        stages.append(("+dedup_aux", source, lambda b: None))
+    stages += [
+        ("+device_put", source, put_block),
+        ("+prefetcher", lambda: Prefetcher(source(),
                                            depth=args.prefetch_depth,
                                            device_put=True),
          lambda b: jax.block_until_ready(b)),

@@ -261,7 +261,14 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             return params, opt, loss
         return wrapped
 
-    host = lambda b: tuple(map(jnp.asarray, b))
+    host = lambda b: jax.tree_util.tree_map(jnp.asarray, tuple(b))
+    if tconfig.host_dedup and (
+        not isinstance(spec, FieldFMSpec) or n > 1
+    ):
+        raise SystemExit(
+            "--host-dedup currently supports the single-chip FieldFM "
+            f"fused step only (found {type(spec).__name__}, {n} device(s))"
+        )
     if isinstance(spec, FieldFFMSpec):
         # Fused field-aware step; single-chip execution (the FFM
         # field-sharded layout is a follow-on — cross-field factors make
@@ -336,6 +343,12 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     opt_canonical = (
         (lambda o: jax.device_get(o)) if is_deepfm else (lambda o: {})
     )
+    if tconfig.host_dedup:
+        # BEFORE the prefetcher: the per-field argsorts run in the
+        # producer thread, off the device critical path.
+        from fm_spark_tpu.data import DedupAuxBatches
+
+        batches = DedupAuxBatches(batches)
     batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
         for i in range(start, tconfig.num_steps):
@@ -435,6 +448,7 @@ def cmd_train(args) -> int:
     tconfig = cfg.train_config(
         log_every=args.log_every, metrics_path=args.metrics,
         eval_every=args.eval_every,
+        host_dedup=True if args.host_dedup else None,
     )
 
     te = None
@@ -490,6 +504,13 @@ def cmd_train(args) -> int:
         else contextlib.nullcontext()
     )
     strategy = cfg.strategy
+    if tconfig.host_dedup and strategy != "field_sparse":
+        # Never silently ignore an explicit fast-path request: only the
+        # fused field_sparse loop consumes the aux operand.
+        raise SystemExit(
+            f"--host-dedup requires strategy 'field_sparse' "
+            f"(config {cfg.name!r} resolves to {strategy!r})"
+        )
     from fm_spark_tpu.data import iterate_once as _iter_once
 
     if te is not None:
@@ -720,6 +741,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route fused-step row gather/update through the "
                         "Pallas pipelined-DMA kernels (TPU; interpret mode "
                         "elsewhere)")
+    t.add_argument("--host-dedup", action="store_true", dest="host_dedup",
+                   help="precompute per-batch dedup sort/segment maps on "
+                        "the host prefetch thread; device writes each "
+                        "unique id once (needs --sparse-update dedup or "
+                        "dedup_sr; single-chip FieldFM)")
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--row-shards", type=int, default=1, dest="row_shards",
                    help="field_sparse strategy: shard each field's bucket "

@@ -11,6 +11,7 @@ from fm_spark_tpu.data.synthetic import synthetic_ctr  # noqa: F401
 from fm_spark_tpu.data.pipeline import (  # noqa: F401
     Batches,
     BernoulliBatches,
+    DedupAuxBatches,
     Prefetcher,
     iterate_once,
     train_test_split,

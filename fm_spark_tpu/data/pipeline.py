@@ -164,6 +164,39 @@ class BernoulliBatches:
         return self.next_batch()
 
 
+class DedupAuxBatches:
+    """Batch-source wrapper that appends host-precomputed dedup aux
+    (:func:`fm_spark_tpu.ops.scatter.dedup_aux`) to each 4-tuple batch,
+    yielding ``(ids, vals, labels, weights, aux)``.
+
+    Wrap the source with this BEFORE :class:`Prefetcher` so the argsort
+    work lands in the producer thread, off the device critical path —
+    that placement is the entire point of host-assisted dedup
+    (PERF.md round-3 lever).
+    """
+
+    def __init__(self, source):
+        self._source = source
+
+    def next_batch(self):
+        from fm_spark_tpu.ops.scatter import dedup_aux
+
+        ids, vals, labels, weights = self._source.next_batch()
+        return ids, vals, labels, weights, dedup_aux(ids)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, state) -> None:
+        self._source.restore(state)
+
+
 class Prefetcher:
     """Background-thread batch prefetch with a bounded queue.
 

@@ -69,6 +69,12 @@ def _load():
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+        lib.fm_dedup_aux.restype = None
+        lib.fm_dedup_aux.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
         _lib = lib
         return _lib
 
@@ -163,3 +169,22 @@ def parse_criteo_chunk(chunk: bytes, bucket: int, per_field: bool = True,
             f"malformed criteo line (chunk line {lineno}): {snippet!r}"
         )
     return ids[:n], labels[:n], int(consumed.value)
+
+
+def dedup_aux_native(ids: np.ndarray, bucket: int):
+    """Native counting-sort dedup precompute (fm_dedup_aux); returns
+    ``(order, seg, useg, ord_first)`` int32 ``[F, B]`` arrays, or None
+    when the library is unavailable (caller falls back to numpy —
+    ops/scatter.dedup_aux)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    b, f = ids.shape
+    out = tuple(np.empty((f, b), np.int32) for _ in range(4))
+    lib.fm_dedup_aux(
+        ids.ctypes.data, b, f, int(bucket),
+        out[0].ctypes.data, out[1].ctypes.data, out[2].ctypes.data,
+        out[3].ctypes.data,
+    )
+    return out

@@ -90,6 +90,86 @@ def _dedup(ids: jax.Array, delta: jax.Array):
     return sid, summed[seg], run_start, order
 
 
+def dedup_aux(ids):
+    """HOST-side dedup precompute for a ``[B, F]`` id batch.
+
+    The device-side ``_dedup`` pays a per-field argsort every step; none
+    of that work depends on model state, so a prefetch thread can ship it
+    with the batch (PERF.md round-3 "host-assisted dedup" lever). Returns
+    ``(order, seg, useg, ord_first)``, each int32 ``[F, B]`` (per-field
+    slices contiguous):
+
+    - ``order``     — per-field stable argsort of the ids;
+    - ``seg``       — segment index of each SORTED lane (duplicates share
+                      a segment);
+    - ``useg``      — the unique id segment ``s`` writes to, padded past
+                      the segment count with an out-of-range sentinel
+                      (int32 max ≥ any table size → dropped);
+    - ``ord_first`` — original lane of each segment's first sorted
+                      occurrence (the dedup_sr representative row).
+
+    Fast path: the native threaded counting sort (native/fasthash.cpp
+    ``fm_dedup_aux``, O(B + bucket) per field); fallback: numpy stable
+    argsort (identical output — counting sort and stable argsort agree
+    exactly; pinned in tests/test_host_dedup.py).
+    """
+    import numpy as np
+
+    ids = np.asarray(ids)
+    squeeze = ids.ndim == 1
+    if squeeze:
+        ids = ids[:, None]
+    b, f = ids.shape
+    if b == 0:
+        empty = tuple(np.empty((f, 0), np.int32) for _ in range(4))
+        return tuple(a[0] for a in empty) if squeeze else empty
+    if ids.min() < 0:
+        raise ValueError("dedup_aux requires non-negative ids")
+    bucket = int(ids.max()) + 1
+
+    from fm_spark_tpu import native
+
+    out = native.dedup_aux_native(ids, bucket)
+    if out is None:
+        idsT = np.ascontiguousarray(ids.T)
+        order = np.argsort(idsT, axis=1, kind="stable").astype(np.int32)
+        sid = np.take_along_axis(idsT, order, axis=1)
+        run = np.concatenate(
+            [np.ones((f, 1), bool), sid[:, 1:] != sid[:, :-1]], axis=1
+        )
+        seg = run.cumsum(axis=1).astype(np.int32) - 1
+        useg = np.full((f, b), np.iinfo(np.int32).max, np.int32)
+        ord_first = np.zeros((f, b), np.int32)
+        for j in range(f):  # tiny per-field compactions
+            m = run[j]
+            u = sid[j, m]
+            useg[j, : u.size] = u
+            ord_first[j, : u.size] = order[j, m]
+        out = (order, seg, useg, ord_first)
+    if squeeze:
+        return tuple(a[0] for a in out)
+    return out
+
+
+def _aux_apply(table, delta, aux, mode, key, old_rows):
+    """Segment-sum + unique-target write from host-precomputed ``aux``
+    (see :func:`dedup_aux`; per-field [B] slices here). No device sort,
+    no per-lane re-expansion — the scatter touches each unique id once."""
+    order, seg, useg, ord_first = aux
+    summed = jax.ops.segment_sum(
+        delta[order], seg, num_segments=delta.shape[0],
+        indices_are_sorted=True,
+    )
+    if mode == "dedup":
+        return table.at[useg].add(summed.astype(table.dtype), mode="drop")
+    new_rows = (
+        old_rows[ord_first].astype(jnp.float32) + summed.astype(jnp.float32)
+    )
+    return table.at[useg].set(
+        stochastic_round(new_rows, table.dtype, key), mode="drop"
+    )
+
+
 def _pallas_pad(x: jax.Array, mult: int, fill=0):
     pad = (-x.shape[0]) % mult
     if pad == 0:
@@ -145,6 +225,7 @@ def apply_row_updates(
     key: jax.Array | None = None,
     old_rows: jax.Array | None = None,
     use_pallas: bool = False,
+    aux=None,
 ) -> jax.Array:
     """Apply per-row ``delta`` ([B, w] in compute dtype) to ``table``
     ([n, w] in storage dtype) at ``ids`` ([B]).
@@ -155,10 +236,23 @@ def apply_row_updates(
     ``use_pallas`` routes 'scatter_add'/'dedup' through the pipelined
     read-modify-write kernel (dedup_sr keeps its XLA set-semantics
     write-back, which stochastic rounding requires).
+    ``aux`` (dedup modes) is :func:`dedup_aux`'s host-precomputed
+    ``(order, seg, useg, ord_first)`` for THIS ids column — skips the
+    device argsort and writes each unique id exactly once. SR note: the
+    aux path draws its rounding noise at segment-compacted positions
+    rather than sorted-lane positions, so dedup_sr aux-vs-device results
+    are equal in distribution (and bitwise for fp32), not bitwise for
+    bf16.
     """
     if mode not in SPARSE_UPDATE_MODES:
         raise ValueError(f"unknown sparse_update mode {mode!r}")
     n = table.shape[0]
+    if aux is not None:
+        if mode == "scatter_add":
+            raise ValueError("aux requires a dedup mode")
+        if mode == "dedup_sr" and (key is None or old_rows is None):
+            raise ValueError("dedup_sr needs key= and old_rows=")
+        return _aux_apply(table, delta, aux, mode, key, old_rows)
     if use_pallas and mode in ("scatter_add", "dedup"):
         return _pallas_dedup_add(table, ids, delta)
     if mode == "scatter_add":

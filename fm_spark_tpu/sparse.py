@@ -48,12 +48,15 @@ def _sr_base_key(config: TrainConfig):
 
 
 def _apply_field_updates(tables, ids, g_fulls, rows, config: TrainConfig,
-                         sr_base_key, step_idx, lr, field_offset=0):
+                         sr_base_key, step_idx, lr, field_offset=0,
+                         aux=None):
     """Write ``-lr·g_full`` into each field's table via the configured
     sparse-update mode (ops/scatter.py); shared by the FieldFM, FieldFFM,
     and field-sharded bodies so mode/key semantics can never diverge.
     ``field_offset`` shifts the SR key stream for sharded callers (global
-    field index = offset + local f)."""
+    field index = offset + local f). ``aux`` is the host-precomputed
+    dedup tuple of [F, B] arrays (ops/scatter.dedup_aux), sliced per
+    field here."""
     from fm_spark_tpu.ops import scatter as scatter_lib
 
     new = []
@@ -68,6 +71,7 @@ def _apply_field_updates(tables, ids, g_fulls, rows, config: TrainConfig,
                 tables[f], ids[:, f], -lr * g_full,
                 mode=config.sparse_update, key=key, old_rows=rows[f],
                 use_pallas=config.use_pallas,
+                aux=None if aux is None else tuple(a[f] for a in aux),
             )
         )
     return new
@@ -105,6 +109,13 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     if config.use_pallas and not spec.fused_linear:
         raise ValueError("use_pallas requires fused_linear=True")
+    if config.host_dedup:
+        if config.sparse_update not in ("dedup", "dedup_sr"):
+            raise ValueError(
+                "host_dedup requires sparse_update='dedup' or 'dedup_sr'"
+            )
+        if config.use_pallas:
+            raise ValueError("host_dedup and use_pallas are exclusive")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -113,7 +124,11 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
     gat = _gather_fn(config)
     k = spec.rank
 
-    def step(params, step_idx, ids, vals, labels, weights):
+    def step(params, step_idx, ids, vals, labels, weights, aux=None):
+        if config.host_dedup and aux is None:
+            raise ValueError(
+                "host_dedup step needs the batch's dedup_aux operand"
+            )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
         if spec.fused_linear:
@@ -169,7 +184,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                 g_fulls.append(jnp.concatenate([factor_grad(f), g_lin], axis=1))
             new_vw = _apply_field_updates(
                 params["vw"], ids, g_fulls, rows, config, sr_base_key,
-                step_idx, lr,
+                step_idx, lr, aux=aux,
             )
             out = {"w0": w0, "vw": new_vw}
         else:

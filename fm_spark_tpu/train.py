@@ -56,6 +56,13 @@ class TrainConfig:
     # interpret mode (correctness only — the A/B belongs on a real chip,
     # PERF.md "Pallas" lever).
     use_pallas: bool = False
+    # Host-assisted dedup (PERF.md round-3 lever): the prefetch thread
+    # precomputes each batch's per-field sort/segment maps
+    # (ops/scatter.dedup_aux) and ships them with the batch, so the
+    # device never sorts and the scatter writes each unique id once.
+    # Requires a dedup sparse_update mode; the fused FieldFM step then
+    # takes a trailing ``aux`` operand.
+    host_dedup: bool = False
 
 
 def _group_reg(config: TrainConfig):
