@@ -262,12 +262,14 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         return wrapped
 
     host = lambda b: jax.tree_util.tree_map(jnp.asarray, tuple(b))
-    if tconfig.host_dedup and (
-        not isinstance(spec, FieldFMSpec) or n > 1
-    ):
+    if tconfig.host_dedup and n > 1 and not isinstance(spec, FieldFFMSpec):
+        # All three single-chip fused bodies consume the aux operand; the
+        # SHARDED steps do not (their all_to_all re-shards the batch, so
+        # host-side per-field maps would be wrong) — hard-fail rather
+        # than silently ignore the fast-path request.
         raise SystemExit(
-            "--host-dedup currently supports the single-chip FieldFM "
-            f"fused step only (found {type(spec).__name__}, {n} device(s))"
+            f"--host-dedup supports the single-chip fused steps only "
+            f"(found {n} devices; drop --host-dedup or run on 1 chip)"
         )
     if isinstance(spec, FieldFFMSpec):
         # Fused field-aware step; single-chip execution (the FFM

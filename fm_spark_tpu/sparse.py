@@ -47,6 +47,19 @@ def _sr_base_key(config: TrainConfig):
     return jax.random.key(config.seed + 0x5EED)
 
 
+def _check_host_dedup(config: TrainConfig):
+    """Shared host_dedup preconditions for every fused body (single
+    definition so the three factories can never drift)."""
+    if not config.host_dedup:
+        return
+    if config.sparse_update not in ("dedup", "dedup_sr"):
+        raise ValueError(
+            "host_dedup requires sparse_update='dedup' or 'dedup_sr'"
+        )
+    if config.use_pallas:
+        raise ValueError("host_dedup and use_pallas are exclusive")
+
+
 def _apply_field_updates(tables, ids, g_fulls, rows, config: TrainConfig,
                          sr_base_key, step_idx, lr, field_offset=0,
                          aux=None):
@@ -109,13 +122,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     if config.use_pallas and not spec.fused_linear:
         raise ValueError("use_pallas requires fused_linear=True")
-    if config.host_dedup:
-        if config.sparse_update not in ("dedup", "dedup_sr"):
-            raise ValueError(
-                "host_dedup requires sparse_update='dedup' or 'dedup_sr'"
-            )
-        if config.use_pallas:
-            raise ValueError("host_dedup and use_pallas are exclusive")
+    _check_host_dedup(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -242,6 +249,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldFFMSpec")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
+    _check_host_dedup(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F, k = spec.num_fields, spec.rank
@@ -249,7 +257,11 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     lr_at = _lr_at(config)
     gat = _gather_fn(config)
 
-    def step(params, step_idx, ids, vals, labels, weights):
+    def step(params, step_idx, ids, vals, labels, weights, aux=None):
+        if config.host_dedup and aux is None:
+            raise ValueError(
+                "host_dedup step needs the batch's dedup_aux operand"
+            )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
         rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, F·k+1]
@@ -295,7 +307,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
         new_vw = _apply_field_updates(
             params["vw"], ids, g_fulls, rows, config, sr_base_key, step_idx,
-            lr,
+            lr, aux=aux,
         )
         out = {"w0": w0, "vw": new_vw}
         if spec.use_bias:
@@ -337,6 +349,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
+    _check_host_dedup(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F, k = spec.num_fields, spec.rank
@@ -354,7 +367,12 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
         return dense_opt.init(dense_subtree(params))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def _step(params, opt_state, step_idx, ids, vals, labels, weights):
+    def _step(params, opt_state, step_idx, ids, vals, labels, weights,
+              aux=None):
+        if config.host_dedup and aux is None:
+            raise ValueError(
+                "host_dedup step needs the batch's dedup_aux operand"
+            )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
         rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, k+1]
@@ -409,7 +427,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
         new_vw = _apply_field_updates(
             params["vw"], ids, g_fulls, rows, config, sr_base_key,
-            step_idx, lr,
+            step_idx, lr, aux=aux,
         )
 
         # Dense side: optax on {"w0", "mlp"} only (+ L2 per group).
@@ -430,8 +448,10 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
             loss,
         )
 
-    def step(params, opt_state, step_idx, ids, vals, labels, weights):
-        return _step(params, opt_state, step_idx, ids, vals, labels, weights)
+    def step(params, opt_state, step_idx, ids, vals, labels, weights,
+             aux=None):
+        return _step(params, opt_state, step_idx, ids, vals, labels,
+                     weights, aux)
 
     step.init_opt_state = init_opt_state
     return step

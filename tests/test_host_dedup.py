@@ -115,6 +115,71 @@ def test_field_step_host_dedup_matches_device(rng, mode):
         )
 
 
+@pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
+def test_ffm_step_host_dedup_matches_device(rng, mode):
+    from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
+
+    spec = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=3, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    ids_np = rng.integers(0, 8, size=(B, F)).astype(np.int32)
+    batch = (jnp.asarray(ids_np),
+             jnp.asarray(rng.normal(size=(B, F)).astype(np.float32)),
+             jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+             jnp.ones((B,)))
+    cfg = dict(learning_rate=0.2, optimizer="sgd", sparse_update=mode)
+    params = spec.init(jax.random.key(1))
+    params_h = jax.tree_util.tree_map(jnp.copy, params)
+    step_d = make_field_ffm_sparse_sgd_step(spec, TrainConfig(**cfg))
+    step_h = make_field_ffm_sparse_sgd_step(
+        spec, TrainConfig(host_dedup=True, **cfg)
+    )
+    aux = tuple(jnp.asarray(a) for a in dedup_aux(ids_np))
+    for i in range(2):
+        params, _ = step_d(params, jnp.int32(i), *batch)
+        params_h, _ = step_h(params_h, jnp.int32(i), *batch, aux)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_h["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
+@pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
+def test_deepfm_step_host_dedup_matches_device(rng, mode):
+    from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
+
+    spec = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, mlp_dims=(8, 8),
+    )
+    ids_np = rng.integers(0, 8, size=(B, F)).astype(np.int32)
+    batch = (jnp.asarray(ids_np),
+             jnp.asarray(rng.normal(size=(B, F)).astype(np.float32)),
+             jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+             jnp.ones((B,)))
+    cfg = dict(learning_rate=0.05, optimizer="adam", sparse_update=mode)
+    params = spec.init(jax.random.key(2))
+    params_h = jax.tree_util.tree_map(jnp.copy, params)
+    step_d = make_field_deepfm_sparse_step(spec, TrainConfig(**cfg))
+    step_h = make_field_deepfm_sparse_step(
+        spec, TrainConfig(host_dedup=True, **cfg)
+    )
+    opt_d = step_d.init_opt_state(params)
+    opt_h = step_h.init_opt_state(params_h)
+    aux = tuple(jnp.asarray(a) for a in dedup_aux(ids_np))
+    for i in range(2):
+        params, opt_d, _ = step_d(params, opt_d, jnp.int32(i), *batch)
+        params_h, opt_h, _ = step_h(params_h, opt_h, jnp.int32(i), *batch,
+                                    aux)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_h["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
 def test_host_dedup_requires_dedup_mode():
     spec = models.FieldFMSpec(
         num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
