@@ -1,0 +1,246 @@
+"""Quality-parity protocol: committed, reproducible AUC envelope.
+
+BASELINE.md's quality bar is "AUC within 1e-3 of the Spark CPU baseline"
+on config 1 (MovieLens-100K). Neither the reference implementation nor
+real MovieLens/Criteo data exists in this image (SURVEY.md §0), so the
+committed stand-in oracle chain is:
+
+  numpy float64 full-batch SGD  (this file — independent of JAX; the
+        reference's runMiniBatchSGD semantics, SURVEY.md §3.1)
+    ⇕  budget 5e-3: different implementation, RNG stream, and init —
+       this rung checks the IMPLEMENTATION, not bitwise numerics
+    ⇕  the same exact rank-sum AUC is applied to both sides
+  fm_spark_tpu fp32 fused step  (the shipped path)
+    ⇕  budget 1e-3 (the BASELINE-style bar): same code path, same
+       batches — only the numeric shortcut under test differs
+  every numeric variant         (bf16+dedup_sr, host_dedup, dedup, ...)
+
+Run `python bench_quality.py` (CPU or TPU); it prints one JSON line per
+variant plus a `pass` verdict per comparison. QUALITY.md records the
+committed numbers from this exact script. The planted-FM task
+(data/synthetic.py) is fully deterministic from its seed, so drift in
+any committed number is a regression signal, not noise.
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+TASK = dict(n=20_000, num_fields=8, bucket=128, rank=8, planted_rank=4,
+            seed=7)
+TRAIN = dict(steps=1500, batch=512, lr=0.15)
+
+
+def _log(msg):
+    print(f"bench_quality: {msg}", file=sys.stderr, flush=True)
+
+
+def _data():
+    from fm_spark_tpu.data import synthetic_ctr, train_test_split
+
+    ids, vals, labels = synthetic_ctr(
+        TASK["n"], TASK["num_fields"] * TASK["bucket"], TASK["num_fields"],
+        rank=TASK["planted_rank"], seed=TASK["seed"],
+    )
+    offs = (np.arange(TASK["num_fields"]) * TASK["bucket"]).astype(np.int32)
+    return train_test_split(ids - offs[None, :], vals, labels, 0.25,
+                            seed=TASK["seed"])
+
+
+def _auc(scores, labels):
+    """Exact rank-sum AUC with tie-averaged (mid) ranks — the SAME metric
+    is applied to the oracle and to every framework variant so the deltas
+    measure numerics, not metric definition (the framework's streaming
+    histogram AUC is deliberately NOT used here)."""
+    scores = np.asarray(scores, np.float64)
+    order = np.argsort(scores, kind="stable")
+    s = scores[order]
+    ranks_sorted = np.arange(1, len(s) + 1, dtype=np.float64)
+    # Average ranks within tied runs.
+    boundary = np.concatenate([[True], s[1:] != s[:-1]])
+    grp = np.cumsum(boundary) - 1
+    sums = np.bincount(grp, weights=ranks_sorted)
+    cnts = np.bincount(grp)
+    ranks = np.empty(len(s), np.float64)
+    ranks[order] = (sums / cnts)[grp]
+    pos = np.asarray(labels) > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    return float(
+        (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    )
+
+
+def numpy_float64_oracle(tr, te):
+    """Minibatch SGD on the FM identity in float64 numpy — an
+    implementation with no JAX, no fused step, no scatter tricks: the
+    independent oracle the fp32 path is judged against."""
+    rng = np.random.default_rng(TASK["seed"])
+    F, bucket, k = TASK["num_fields"], TASK["bucket"], TASK["rank"]
+    n_rows = F * bucket
+    v = rng.normal(0, 0.05, size=(n_rows, k)).astype(np.float64)
+    w = np.zeros(n_rows, np.float64)
+    w0 = 0.0
+    ids_tr, vals_tr, y_tr = (np.asarray(a) for a in tr)
+    gids = ids_tr + (np.arange(F) * bucket)[None, :]
+    n = len(y_tr)
+    order = rng.permutation(n)
+    lr, B = TRAIN["lr"], TRAIN["batch"]
+    pos = 0
+    for step in range(TRAIN["steps"]):
+        if pos + B > n:
+            order = rng.permutation(n)
+            pos = 0
+        sel = order[pos: pos + B]
+        pos += B
+        bi, bx, by = gids[sel], vals_tr[sel].astype(np.float64), y_tr[sel]
+        rows = v[bi]                                   # [B, F, k]
+        xv = rows * bx[..., None]
+        s = xv.sum(axis=1)                             # [B, k]
+        scores = (w0 + (w[bi] * bx).sum(axis=1)
+                  + 0.5 * ((s * s).sum(axis=1) - (xv * xv).sum(axis=(1, 2))))
+        p = 1.0 / (1.0 + np.exp(-scores))
+        d = (p - by) / B                               # dL/dscore
+        g_rows = d[:, None, None] * bx[..., None] * (s[:, None, :] - xv)
+        np.add.at(v, bi, -lr * g_rows)
+        np.add.at(w, bi, -lr * (d[:, None] * bx))
+        w0 -= lr * d.sum()
+    ids_te, vals_te, y_te = (np.asarray(a) for a in te)
+    gte = ids_te + (np.arange(F) * bucket)[None, :]
+    rows = v[gte]
+    xv = rows * vals_te[..., None].astype(np.float64)
+    s = xv.sum(axis=1)
+    scores = (w0 + (w[gte] * vals_te).sum(axis=1)
+              + 0.5 * ((s * s).sum(axis=1) - (xv * xv).sum(axis=(1, 2))))
+    return _auc(scores, y_te)
+
+
+def _jax():
+    """Import jax honoring an explicit JAX_PLATFORMS=cpu request — the
+    installed TPU plugin ignores the env var (same guard as bench.py and
+    cli.main; without it a hung TPU attachment hangs this script too)."""
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    return jax
+
+
+def framework_variant(tr, te, param_dtype="float32",
+                      sparse_update="scatter_add", host_dedup=False):
+    jax = _jax()
+    import jax.numpy as jnp
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.data import Batches, DedupAuxBatches
+    from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+    from fm_spark_tpu.train import TrainConfig
+
+    spec = models.FieldFMSpec(
+        num_features=TASK["num_fields"] * TASK["bucket"], rank=TASK["rank"],
+        num_fields=TASK["num_fields"], bucket=TASK["bucket"], init_std=0.05,
+        param_dtype=param_dtype,
+    )
+    config = TrainConfig(
+        learning_rate=TRAIN["lr"], lr_schedule="constant", optimizer="sgd",
+        sparse_update=sparse_update, host_dedup=host_dedup,
+        seed=TASK["seed"],
+    )
+    step = make_field_sparse_sgd_step(spec, config)
+    params = spec.init(jax.random.key(TASK["seed"]))
+    batches = Batches(*tr, TRAIN["batch"], seed=TASK["seed"])
+    if host_dedup:
+        batches = DedupAuxBatches(batches)
+    for i in range(TRAIN["steps"]):
+        b = tuple(jax.tree_util.tree_map(jnp.asarray, tuple(
+            batches.next_batch()
+        )))
+        params, _ = step(params, jnp.int32(i), *b)
+    # Score the held-out set and apply the SAME exact AUC as the oracle
+    # (evaluate_params' histogram AUC would conflate metric quantization
+    # with numeric parity).
+    ids_te, vals_te, y_te = te
+    scores = np.asarray(
+        spec.scores(params, jnp.asarray(ids_te), jnp.asarray(vals_te)),
+        np.float64,
+    )
+    return _auc(scores, np.asarray(y_te))
+
+
+VARIANTS = {
+    "fp32_scatter_add": dict(),
+    "fp32_dedup": dict(sparse_update="dedup"),
+    "fp32_host_dedup": dict(sparse_update="dedup", host_dedup=True),
+    "bf16_scatter_add": dict(param_dtype="bfloat16"),
+    "bf16_dedup_sr": dict(param_dtype="bfloat16", sparse_update="dedup_sr"),
+    "bf16_dedup_sr_host": dict(param_dtype="bfloat16",
+                               sparse_update="dedup_sr", host_dedup=True),
+}
+
+# The committed protocol budgets (QUALITY.md): fp32-vs-oracle is expected
+# to sit within the BASELINE-style 1e-3 band up to seed noise; the bf16
+# scatter_add row is EXPECTED to fail (that is the measured failure
+# dedup_sr exists to fix).
+BUDGET_VS_FP32 = {
+    "fp32_dedup": 1e-3,
+    "fp32_host_dedup": 1e-3,
+    "bf16_dedup_sr": 5e-3,
+    "bf16_dedup_sr_host": 5e-3,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", nargs="*", default=list(VARIANTS),
+                    choices=list(VARIANTS))
+    ap.add_argument("--skip-oracle", action="store_true")
+    args = ap.parse_args()
+
+    tr, te = _data()
+    out = {}
+    if not args.skip_oracle:
+        _log("numpy float64 oracle...")
+        out["numpy_float64_oracle"] = numpy_float64_oracle(tr, te)
+        _log(f"  auc={out['numpy_float64_oracle']:.4f}")
+    for name in args.variants:
+        _log(f"variant {name}...")
+        out[name] = framework_variant(tr, te, **VARIANTS[name])
+        _log(f"  auc={out[name]:.4f}")
+
+    checks = {}
+    fp32 = out.get("fp32_scatter_add")
+    if fp32 is not None and "numpy_float64_oracle" in out:
+        d = abs(fp32 - out["numpy_float64_oracle"])
+        checks["fp32_vs_float64_oracle"] = {
+            "delta": round(d, 5), "budget": 5e-3, "pass": d <= 5e-3,
+        }
+    for name, budget in BUDGET_VS_FP32.items():
+        if fp32 is not None and name in out:
+            d = abs(out[name] - fp32)
+            checks[f"{name}_vs_fp32"] = {
+                "delta": round(d, 5), "budget": budget, "pass": d <= budget,
+            }
+    # An empty check set must never read as success (a --variants subset
+    # that skips the fp32 reference would otherwise vacuously pass).
+    ok = bool(checks) and all(c["pass"] for c in checks.values())
+    print(json.dumps({
+        "task": TASK, "train": TRAIN,
+        "auc": {k: round(v, 5) for k, v in out.items()},
+        "checks": checks,
+        "all_pass": ok,
+        **({} if checks else {"error": "no comparisons ran — include "
+                              "fp32_scatter_add and/or the oracle"}),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
