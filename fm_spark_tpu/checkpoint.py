@@ -68,6 +68,15 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def due_window(self, step: int, window: int) -> bool:
+        """True iff a save-multiple falls in ``(step - window, step]`` —
+        the cadence check for loops whose step counter advances in
+        strides > 1 (cli ``--steps-per-call``); exact-modulo ``due``
+        would fire only at lcm intervals or, off-aligned, never."""
+        if self.save_every <= 0 or window <= 0:
+            return False
+        return (step // self.save_every) > ((step - window) // self.save_every)
+
     def due(self, step: int) -> bool:
         """Is ``step`` on the save cadence? (Cheap; check before building
         state snapshots.)"""

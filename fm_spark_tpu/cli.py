@@ -192,15 +192,19 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
     ``maybe_eval(step, params_canonical)``, a no-op unless ``eval_every``
     is set; eval wall-clock is excluded from the throughput window."""
     if eval_source is None or tconfig.eval_every <= 0:
-        return lambda step, params: None
+        return lambda step, params, window=1: None
     import time as _time
 
     from fm_spark_tpu.train import evaluate_params, make_eval_step
 
     estep = make_eval_step(spec)  # compiled once, reused every eval
 
-    def maybe_eval(step, params_thunk):
-        if step % tconfig.eval_every:
+    def maybe_eval(step, params_thunk, window=1):
+        # Windowed cadence: fire iff a multiple of eval_every falls in
+        # (step - window, step]. window=1 is the classic modulo; multi-
+        # step loops pass their stride so off-aligned steps still fire.
+        every = tconfig.eval_every
+        if (step // every) <= ((step - window) // every):
             return
         t0 = _time.perf_counter()
         em = evaluate_params(spec, params_thunk(), eval_source(), step=estep)
@@ -212,7 +216,7 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
 
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                       eval_source=None, prefetch: int = 0,
-                      row_shards: int = 1):
+                      row_shards: int = 1, steps_per_call: int = 1):
     """Training loop on the fused sparse steps (the CTR fast path).
 
     On one device this is the single-chip fused step; with multiple
@@ -221,6 +225,11 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     step. FieldDeepFM additionally carries optax state for its dense
     head (MLP + bias); pure-SGD models carry an empty dict so the loop
     and checkpoints have one shape.
+
+    ``steps_per_call > 1`` (single-chip FM/FFM) rolls that many steps
+    into one compiled ``fori_loop`` program over host-stacked batches —
+    bench.py's dispatch amortization for the production loop (PERF.md
+    fact 1). Logging/eval/checkpoint cadence rounds to call boundaries.
     """
     import jax
     import jax.numpy as jnp
@@ -270,6 +279,21 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         raise SystemExit(
             f"--host-dedup supports the single-chip fused steps only "
             f"(found {n} devices; drop --host-dedup or run on 1 chip)"
+        )
+    if steps_per_call < 1:
+        raise SystemExit(
+            f"--steps-per-call must be >= 1, got {steps_per_call}"
+        )
+    multi = steps_per_call > 1
+    if multi and (
+        is_deepfm or (n > 1 and not isinstance(spec, FieldFFMSpec))
+    ):
+        # DeepFM carries optax state through the call and the sharded
+        # steps take mesh-prepped operands — neither rolls into the
+        # pure-SGD fori body. Hard-fail, never silently run one-by-one.
+        raise SystemExit(
+            "--steps-per-call > 1 supports the single-chip FM/FFM fused "
+            f"steps only (found {type(spec).__name__}, {n} device(s))"
         )
     if isinstance(spec, FieldFFMSpec):
         # Fused field-aware step; single-chip execution (the FFM
@@ -351,20 +375,52 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         from fm_spark_tpu.data import DedupAuxBatches
 
         batches = DedupAuxBatches(batches)
+    if multi:
+        from fm_spark_tpu.data import StackedBatches
+        from fm_spark_tpu.sparse import make_field_sparse_multistep
+
+        # Stacking also runs in the prefetch producer thread. `total`
+        # bounds source consumption so the tail stack pads instead of
+        # reading batches that would never train (exact-resume cursor).
+        batches = StackedBatches(batches, steps_per_call,
+                                 total=tconfig.num_steps - start)
+        mstep = make_field_sparse_multistep(spec, tconfig, steps_per_call)
     batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
-        for i in range(start, tconfig.num_steps):
-            batch = batches.next_batch()
-            params, opt, loss = step(params, opt, jnp.int32(i),
-                                     *prep(batch))
-            since += len(batch[2])
-            if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
-                logger.log(i + 1, samples=since, loss=float(loss))
-                since = 0
-            maybe_eval(i + 1, lambda: to_canonical(params))
-            if checkpointer is not None and checkpointer.due(i + 1):
-                checkpointer.save(i + 1, to_canonical(params),
-                                  opt_canonical(opt), batches.state())
+        if multi:
+            i = start
+            while i < tconfig.num_steps:
+                m = min(steps_per_call, tconfig.num_steps - i)
+                stacked = batches.next_batch()
+                params, loss = mstep(params, jnp.int32(i), jnp.int32(m),
+                                     *prep(stacked))
+                i += m
+                since += m * stacked[2].shape[1]
+                # Windowed cadences: a multiple of the interval inside
+                # (i-m, i] fires, so stride-advanced (and off-aligned
+                # resumed) counters never silently skip.
+                if (i // log_every) > ((i - m) // log_every) or (
+                    i >= tconfig.num_steps
+                ):
+                    logger.log(i, samples=since, loss=float(loss))
+                    since = 0
+                maybe_eval(i, lambda: to_canonical(params), window=m)
+                if checkpointer is not None and checkpointer.due_window(i, m):
+                    checkpointer.save(i, to_canonical(params), {},
+                                      batches.state())
+        else:
+            for i in range(start, tconfig.num_steps):
+                batch = batches.next_batch()
+                params, opt, loss = step(params, opt, jnp.int32(i),
+                                         *prep(batch))
+                since += len(batch[2])
+                if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
+                    logger.log(i + 1, samples=since, loss=float(loss))
+                    since = 0
+                maybe_eval(i + 1, lambda: to_canonical(params))
+                if checkpointer is not None and checkpointer.due(i + 1):
+                    checkpointer.save(i + 1, to_canonical(params),
+                                      opt_canonical(opt), batches.state())
         if checkpointer is not None:
             checkpointer.save(tconfig.num_steps, to_canonical(params),
                               opt_canonical(opt), batches.state(),
@@ -513,6 +569,11 @@ def cmd_train(args) -> int:
             f"--host-dedup requires strategy 'field_sparse' "
             f"(config {cfg.name!r} resolves to {strategy!r})"
         )
+    if args.steps_per_call > 1 and strategy != "field_sparse":
+        raise SystemExit(
+            f"--steps-per-call requires strategy 'field_sparse' "
+            f"(config {cfg.name!r} resolves to {strategy!r})"
+        )
     from fm_spark_tpu.data import iterate_once as _iter_once
 
     if te is not None:
@@ -545,7 +606,8 @@ def cmd_train(args) -> int:
                                            checkpointer,
                                            eval_source=eval_source,
                                            prefetch=args.prefetch,
-                                           row_shards=args.row_shards)
+                                           row_shards=args.row_shards,
+                                           steps_per_call=args.steps_per_call)
             elif strategy in ("dp", "row"):
                 params = _fit_parallel(spec, tconfig, batches, strategy,
                                        logger, checkpointer,
@@ -753,6 +815,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="field_sparse strategy: shard each field's bucket "
                         "dimension over this many chips (2-D feat x row "
                         "mesh; row capacity scale-out)")
+    t.add_argument("--steps-per-call", type=int, default=1,
+                   dest="steps_per_call",
+                   help="roll N steps into one compiled program "
+                        "(single-chip FM/FFM field_sparse; amortizes "
+                        "per-dispatch overhead, PERF.md fact 1); "
+                        "logging/eval/checkpoint round to call boundaries")
     t.add_argument("--prefetch", type=int, default=2,
                    help="background batch read-ahead depth (0 = off); "
                         "overlaps host batch assembly with device compute")

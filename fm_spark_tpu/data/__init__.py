@@ -13,6 +13,7 @@ from fm_spark_tpu.data.pipeline import (  # noqa: F401
     BernoulliBatches,
     DedupAuxBatches,
     Prefetcher,
+    StackedBatches,
     iterate_once,
     train_test_split,
     wrap_prefetch,

@@ -197,6 +197,61 @@ class DedupAuxBatches:
         self._source.restore(state)
 
 
+class StackedBatches:
+    """Batch-source wrapper that stacks ``n`` consecutive batches on a
+    leading axis — the input shape for
+    :func:`fm_spark_tpu.sparse.make_field_sparse_multistep` (one device
+    dispatch per ``n`` steps). Tree-aware, so it composes with
+    :class:`DedupAuxBatches` (the aux tuple's leaves stack too). Wrap
+    BEFORE :class:`Prefetcher` so the stacking memcpy runs in the
+    producer thread.
+
+    ``state()`` reflects the source cursor AFTER the batches of the last
+    stack — resume replays from the next unseen batch. ``total`` bounds
+    how many SOURCE batches are ever consumed: the final stack of a
+    finite run takes only the remainder from the source and pads with
+    inert copies of its last real batch (the consumer's dynamic step
+    count never executes them), so the checkpointed cursor stays exact
+    — no trained-data gap on resume.
+    """
+
+    def __init__(self, source, n: int, total: int | None = None):
+        import jax
+
+        if n < 1:
+            raise ValueError(f"stack size must be >= 1, got {n}")
+        self._source = source
+        self._n = n
+        self._left = total  # None = unbounded
+        self._tree = jax.tree_util
+
+    def next_batch(self):
+        import numpy as np
+
+        take = self._n if self._left is None else min(self._n, self._left)
+        if take <= 0:
+            raise StopIteration
+        batches = [tuple(self._source.next_batch()) for _ in range(take)]
+        if self._left is not None:
+            self._left -= take
+        batches += [batches[-1]] * (self._n - take)
+        return self._tree.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *batches
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, state) -> None:
+        self._source.restore(state)
+
+
 class Prefetcher:
     """Background-thread batch prefetch with a bounded queue.
 

@@ -229,6 +229,48 @@ def make_field_sparse_sgd_step(spec, config: TrainConfig):
     )
 
 
+def make_field_sparse_multistep(spec, config: TrainConfig, n: int):
+    """Roll ``n`` fused steps into ONE compiled program (``lax.fori_loop``)
+    — the production-loop version of bench.py's dispatch amortization
+    (PERF.md fact 1: per-dispatch overhead ≈ 66ms on the tunnel-attached
+    chip, a large fraction of a ~180ms step).
+
+    Works for the pure-SGD fused bodies (FieldFM / FieldFFM — no
+    optimizer state in the carry). Returns ``mstep(params, step0, m,
+    ids, vals, labels, weights, aux=None) → (params, last_loss)`` over
+    batches STACKED on a leading ``[n, ...]`` axis
+    (data/pipeline.StackedBatches); ``m ≤ n`` (dynamic) is how many
+    stacked steps actually execute — the training loop's tail call passes
+    the remainder and the unused slices are never touched. ``step0 + j``
+    is the global step fed to the lr schedule and SR keys, so the math is
+    IDENTICAL to ``n`` separate step calls (equivalence-tested).
+    """
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+
+    if n < 1:
+        raise ValueError(f"steps per call must be >= 1, got {n}")
+    body = (
+        make_field_ffm_sparse_sgd_body(spec, config)
+        if isinstance(spec, FieldFFMSpec)
+        else make_field_sparse_sgd_body(spec, config)
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def mstep(params, step0, m, ids, vals, labels, weights, aux=None):
+        def fbody(j, carry):
+            p, _ = carry
+            a = (
+                None if aux is None
+                else jax.tree_util.tree_map(lambda x: x[j], aux)
+            )
+            return body(p, step0 + j, ids[j], vals[j], labels[j],
+                        weights[j], a)
+
+        return jax.lax.fori_loop(0, m, fbody, (params, jnp.float32(0)))
+
+    return mstep
+
+
 def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     """Unjitted fused sparse-SGD body for :class:`FieldFFMSpec`.
 
