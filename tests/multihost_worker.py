@@ -43,6 +43,15 @@ def main() -> int:
     devices = np.asarray(jax.devices()).reshape(-1, 1)  # [4] global
     mesh = Mesh(devices, ("data", "feat"))
 
+    def make_global(arr, msh, spec_p):
+        """Global array from per-process-identical host data — each
+        process serves only the shard indices it owns (the multi-host
+        input idiom; default-arg capture pins the array per call)."""
+        a = np.asarray(arr)
+        return jax.make_array_from_callback(
+            a.shape, NamedSharding(msh, spec_p), lambda idx, a=a: a[idx]
+        )
+
     num_features, nnz, b_global = 128, 4, 64
     spec = models.FMSpec(num_features=num_features, rank=4, init_std=0.05)
     config = TrainConfig(learning_rate=0.3, optimizer="sgd")
@@ -52,10 +61,7 @@ def main() -> int:
     params = spec.init(jax.random.key(0))
     pspecs = param_specs(spec, "dp")
     params = jax.tree_util.tree_map(
-        lambda x, s: jax.make_array_from_callback(
-            x.shape, NamedSharding(mesh, s), lambda idx: np.asarray(x)[idx]
-        ),
-        params, pspecs,
+        lambda x, s: make_global(x, mesh, s), params, pspecs
     )
     opt_state = make_optimizer(config).init(params)
 
@@ -71,23 +77,69 @@ def main() -> int:
         sl = slice(i * b_global, (i + 1) * b_global)
         ids, vals, labels = all_ids[sl], all_vals[sl], all_labels[sl]
         weights = np.ones((b_global,), np.float32)
-        batch = []
-        for arr, spec_p in zip(
-            (ids, vals, labels, weights),
-            (P("data", None), P("data", None), P("data"), P("data")),
-        ):
-            sharding = NamedSharding(mesh, spec_p)
-            batch.append(
-                jax.make_array_from_callback(
-                    arr.shape, sharding, lambda idx, a=arr: a[idx]
-                )
+        batch = [
+            make_global(arr, mesh, spec_p)
+            for arr, spec_p in zip(
+                (ids, vals, labels, weights),
+                (P("data", None), P("data", None), P("data"), P("data")),
             )
+        ]
         params, opt_state, m = step(params, opt_state, *batch)
         losses.append(float(m["loss"]))
 
     assert all(np.isfinite(losses)), losses
     assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
-    print(f"MULTIHOST_OK process={process_id} losses={losses}")
+
+    # ---- Phase 2: the field-sharded fused step across process
+    # boundaries — all_to_all batch re-shard + psum of partial sums with
+    # real cross-process collectives (the CTR fast path's multi-chip
+    # layout, parallel/field_step.py).
+    from fm_spark_tpu.parallel import (
+        make_field_mesh,
+        make_field_sharded_sgd_step,
+        field_batch_specs,
+        field_param_specs,
+        pad_field_batch,
+        stack_field_params,
+    )
+
+    F, bucket = 6, 32
+    fspec = models.FieldFMSpec(
+        num_features=F * bucket, rank=4, num_fields=F, bucket=bucket,
+        init_std=0.05,
+    )
+    fmesh = make_field_mesh(len(jax.devices()))
+    fconfig = TrainConfig(learning_rate=0.3, optimizer="sgd",
+                          sparse_update="dedup")
+    fstep = make_field_sharded_sgd_step(fspec, fconfig, fmesh)
+    stacked = stack_field_params(fspec, fspec.init(jax.random.key(1)),
+                                 fmesh.shape["feat"])
+    pspecs2 = field_param_specs(fmesh)
+    fparams = {
+        k: make_global(v, fmesh, pspecs2[k]) for k, v in stacked.items()
+    }
+    fids, fvals, flabels = synthetic_ctr(b_global * 10, F * bucket, F,
+                                         seed=2)
+    fids = fids - (np.arange(F) * bucket)[None, :].astype(fids.dtype)
+    flosses = []
+    for i in range(10):
+        sl = slice(i * b_global, (i + 1) * b_global)
+        fb = pad_field_batch(
+            (fids[sl], fvals[sl], flabels[sl],
+             np.ones((b_global,), np.float32)),
+            F, fmesh.shape["feat"],
+        )
+        gb = [
+            make_global(a, fmesh, sp)
+            for a, sp in zip(fb, field_batch_specs(fmesh))
+        ]
+        fparams, fl = fstep(fparams, jnp.int32(i), *gb)
+        flosses.append(float(fl))
+    assert all(np.isfinite(flosses)), flosses
+    assert np.mean(flosses[-3:]) < np.mean(flosses[:3]), flosses
+
+    print(f"MULTIHOST_OK process={process_id} "
+          f"losses={losses}+{flosses}")
     return 0
 
 
