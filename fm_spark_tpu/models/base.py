@@ -27,7 +27,7 @@ class ModelSpec:
     num_features: int
     rank: int
     task: str = "classification"          # 'classification' | 'regression'
-    loss: str | None = None               # 'logistic' | 'squared'; None ⇒ by task
+    loss: str | None = None       # 'logistic'|'squared'|'hinge'; None ⇒ by task
     use_bias: bool = True                 # dim k0
     use_linear: bool = True               # dim k1
     init_std: float = 0.01
@@ -55,10 +55,10 @@ class ModelSpec:
         from fm_spark_tpu.ops import losses
 
         losses.loss_fn(self.loss)
-        if self.task == "regression" and self.loss == "logistic":
+        if self.task == "regression" and self.loss in ("logistic", "hinge"):
             raise ValueError(
-                "logistic loss expects {0,1} labels; use loss='squared' "
-                "(or leave loss unset) for task='regression'"
+                f"{self.loss} loss expects {{0,1}} labels; use "
+                "loss='squared' (or leave loss unset) for task='regression'"
             )
 
     @property

@@ -26,14 +26,24 @@ def squared_loss(scores: jax.Array, labels: jax.Array) -> jax.Array:
     return 0.5 * d * d
 
 
+def hinge_loss(scores: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example hinge ``max(0, 1 − t·s)`` with labels in {0,1} mapped
+    to t ∈ {−1,+1} (MLlib's HingeGradient convention — SURVEY.md §0.2
+    lists hinge as a loss-inventory verification item; kept for parity
+    with MLlib-scaffolded forks)."""
+    t = 2.0 * labels - 1.0
+    return jnp.maximum(0.0, 1.0 - t * scores)
+
+
 _LOSSES = {
     "logistic": logistic_loss,
     "squared": squared_loss,
+    "hinge": hinge_loss,
 }
 
 
 def loss_fn(name: str):
-    """Look up a per-example loss by name ('logistic' | 'squared')."""
+    """Look up a per-example loss by name ('logistic'|'squared'|'hinge')."""
     try:
         return _LOSSES[name]
     except KeyError:

@@ -110,8 +110,21 @@ def test_bf16_table_fp32_accum_close(rng):
 
 def test_loss_fn_lookup():
     assert losses.loss_fn("logistic") is losses.logistic_loss
+    assert losses.loss_fn("hinge") is losses.hinge_loss
     with pytest.raises(ValueError):
-        losses.loss_fn("hinge")
+        losses.loss_fn("absolute")
+
+
+def test_hinge_loss_values():
+    s = jnp.asarray([2.0, 0.5, -3.0, 0.0])
+    y = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    # t = {+1, -1, -1, +1}; hinge = max(0, 1 - t*s)
+    np.testing.assert_allclose(
+        np.asarray(losses.hinge_loss(s, y)), [0.0, 1.5, 0.0, 1.0]
+    )
+    # Subgradient through jax.grad is finite and zero in the flat region.
+    g = jax.grad(lambda x: jnp.sum(losses.hinge_loss(x, y)))(s)
+    np.testing.assert_allclose(np.asarray(g), [0.0, 1.0, 0.0, -1.0])
 
 
 def test_logistic_loss_matches_stable_bce(rng):
