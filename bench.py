@@ -95,13 +95,6 @@ def inner_main(args):
         num_fields=num_fields, bucket=bucket, init_std=0.01,
         param_dtype=args.param_dtype,
     )
-    config = TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                         optimizer="sgd", sparse_update=args.sparse_update,
-                         use_pallas=args.use_pallas,
-                         host_dedup=args.host_dedup)
-    body = make_field_sparse_sgd_body(spec, config)
-
-    params = spec.init(jax.random.key(0))
     rng = np.random.default_rng(0)
     # Criteo-like Zipf skew within each field's bucket.
     ids_np = (rng.zipf(1.3, size=(batch, num_fields)) % bucket).astype(np.int32)
@@ -109,55 +102,99 @@ def inner_main(args):
     vals = jnp.ones((batch, num_fields), jnp.float32)
     labels = jnp.asarray(rng.integers(0, 2, batch), jnp.float32)
     weights = jnp.ones((batch,), jnp.float32)
-    aux = None
-    if args.host_dedup:
-        # Device-throughput bench: the aux for the (fixed) bench batch is
-        # computed once here; in production it rides the prefetch thread
-        # (data/pipeline.DedupAuxBatches) — bench_input.py --host-dedup
-        # measures that host-side rate.
-        from fm_spark_tpu.ops.scatter import dedup_aux
 
-        aux = jax.device_put(dedup_aux(ids_np))
+    # Variant sweep: with explicit knobs, measure exactly what was asked;
+    # with pure defaults, ALSO measure the host-dedup candidate (PERF.md
+    # round-3 lever) and report the fastest — the headline is "the
+    # framework's best configuration", decided by measurement, not by a
+    # default frozen before the chip could confirm it.
+    explicit = (args.sparse_update != "scatter_add" or args.use_pallas
+                or args.host_dedup or args.param_dtype != "float32"
+                or args.rank != 64 or args.batch != 1 << 17
+                or args.steps != 20)
+    variants = [(
+        f"{args.param_dtype}/{args.sparse_update}"
+        + ("/pallas" if args.use_pallas else "")
+        + ("/hostdedup" if args.host_dedup else ""),
+        TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                    optimizer="sgd", sparse_update=args.sparse_update,
+                    use_pallas=args.use_pallas, host_dedup=args.host_dedup),
+    )]
+    if not explicit:
+        variants.append((
+            "float32/dedup/hostdedup",
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup",
+                        host_dedup=True),
+        ))
 
     import functools
 
-    # n_steps is a DYNAMIC argument so the warmup call compiles the exact
-    # program the timed call runs (a static count would recompile inside
-    # the timed region).
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run(params, ids, vals, labels, weights, aux, n_steps):
-        def fbody(i, carry):
-            p, _ = carry
-            return body(p, i, ids, vals, labels, weights, aux)
+    aux_cache = None
+    results = []
+    for label, config in variants:
+        body = make_field_sparse_sgd_body(spec, config)
+        aux = None
+        if config.host_dedup:
+            # Aux for the (fixed) bench batch is computed once here; in
+            # production it rides the prefetch thread (DedupAuxBatches) —
+            # bench_input.py --host-dedup measures that host-side rate.
+            if aux_cache is None:
+                from fm_spark_tpu.ops.scatter import dedup_aux
 
-        return lax.fori_loop(0, n_steps, fbody, (params, jnp.float32(0)))
+                aux_cache = jax.device_put(dedup_aux(ids_np))
+            aux = aux_cache
+        params = spec.init(jax.random.key(0))
 
-    _log("[inner] compiling + warmup (first TPU compile is slow, ~20-60s)...")
-    t0 = time.perf_counter()
-    params, loss = run(params, ids, vals, labels, weights, aux,
-                       jnp.int32(steps_warmup))
-    float(loss)  # d2h fence
-    _log(f"[inner] warmup done in {time.perf_counter() - t0:.1f}s; "
-         f"timing {steps_timed} steps x batch {batch}...")
+        # n_steps is a DYNAMIC argument so the warmup call compiles the
+        # exact program the timed call runs (a static count would
+        # recompile inside the timed region).
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(params, ids, vals, labels, weights, aux, n_steps,
+                body=body):
+            def fbody(i, carry):
+                p, _ = carry
+                return body(p, i, ids, vals, labels, weights, aux)
 
-    t0 = time.perf_counter()
-    params, loss = run(params, ids, vals, labels, weights, aux,
-                       jnp.int32(steps_timed))
-    final_loss = float(loss)  # d2h fence
-    dt = time.perf_counter() - t0
+            return lax.fori_loop(0, n_steps, fbody, (params, jnp.float32(0)))
 
-    n_chips = jax.device_count()
-    samples_per_sec = steps_timed * batch / dt
-    per_chip = samples_per_sec / n_chips
-    print(json.dumps({
-        "metric": METRIC,
-        "value": round(per_chip, 1),
-        "unit": UNIT,
-        "vs_baseline": round(per_chip / TARGET_PER_CHIP, 4),
-    }), flush=True)
-    _log(f"[inner] device={devs[0].device_kind} chips={n_chips} "
-         f"batch={batch} steps={steps_timed} dt={dt:.3f}s "
-         f"loss={final_loss:.4f}")
+        _log(f"[inner] [{label}] compiling + warmup (first TPU compile "
+             "is slow, ~20-60s)...")
+        t0 = time.perf_counter()
+        params, loss = run(params, ids, vals, labels, weights, aux,
+                           jnp.int32(steps_warmup))
+        float(loss)  # d2h fence
+        _log(f"[inner] [{label}] warmup done in "
+             f"{time.perf_counter() - t0:.1f}s; timing {steps_timed} "
+             f"steps x batch {batch}...")
+        t0 = time.perf_counter()
+        params, loss = run(params, ids, vals, labels, weights, aux,
+                           jnp.int32(steps_timed))
+        final_loss = float(loss)  # d2h fence
+        dt = time.perf_counter() - t0
+        rate = steps_timed * batch / dt / jax.device_count()
+        results.append((rate, label, dt, final_loss))
+        _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "
+             f"(dt={dt:.3f}s loss={final_loss:.4f})")
+        del params  # free the donated tables before the next variant
+        # Emit the best-so-far line after EVERY variant: if a later
+        # variant hangs/crashes (flaky attachment), the parent's salvage
+        # scan still finds a valid completed measurement (it takes the
+        # LAST matching line).
+        best_rate, best_label, _, _ = max(results)
+        print(json.dumps({
+            "metric": METRIC,
+            "value": round(best_rate, 1),
+            "unit": UNIT,
+            "vs_baseline": round(best_rate / TARGET_PER_CHIP, 4),
+            "variant": best_label,
+            "all_variants": {l: round(r, 1) for r, l, _, _ in results},
+        }), flush=True)
+
+    rate, label, dt, final_loss = max(results)
+    _log(f"[inner] device={devs[0].device_kind} "
+         f"chips={jax.device_count()} best={label} batch={batch} "
+         f"steps={steps_timed} dt={dt:.3f}s loss={final_loss:.4f}")
     return 0
 
 
@@ -196,6 +233,10 @@ def _run_attempt(argv, timeout_s):
     finally:
         hb_stop.set()
 
+    # LAST matching line wins: the child prints a cumulative-best line
+    # after each variant, so a sweep cut short mid-variant still yields
+    # its completed measurements.
+    found = None
     for line in (out or "").splitlines():
         line = line.strip()
         if line.startswith("{"):
@@ -204,7 +245,9 @@ def _run_attempt(argv, timeout_s):
             except json.JSONDecodeError:
                 continue
             if parsed.get("metric") == METRIC and parsed.get("value") is not None:
-                return line, ""
+                found = line
+    if found is not None:
+        return found, ""
     if timed_out:
         return None, f"child hung: no result within {timeout_s}s (killed)"
     return None, f"child exited rc={proc.returncode} without a result line"
@@ -236,6 +279,11 @@ def main():
     ap.add_argument("--attempt-timeout", type=float, default=600.0,
                     help="hard wall-clock limit per attempt (seconds)")
     args = ap.parse_args()
+
+    if args.host_dedup and args.sparse_update not in ("dedup", "dedup_sr"):
+        ap.error("--host-dedup requires --sparse-update dedup or dedup_sr")
+    if args.host_dedup and args.use_pallas:
+        ap.error("--host-dedup and --use-pallas are exclusive")
 
     if args.inner:
         sys.exit(inner_main(args))
