@@ -499,7 +499,8 @@ def cmd_train(args) -> int:
         args.config,
         num_steps=args.steps, batch_size=args.batch_size,
         learning_rate=args.lr, strategy=args.strategy, seed=args.seed,
-        optimizer=args.optimizer, sparse_update=args.sparse_update,
+        optimizer=args.optimizer, loss=args.loss,
+        sparse_update=args.sparse_update,
         param_dtype=args.param_dtype,
         use_pallas=True if args.use_pallas else None,
     )
@@ -791,6 +792,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--steps", type=int, default=None)
     t.add_argument("--lr", type=float, default=None)
     t.add_argument("--optimizer", default=None)
+    t.add_argument("--loss", default=None,
+                   choices=["logistic", "squared", "hinge"],
+                   help="override the config's loss (task compatibility "
+                        "is validated at spec construction)")
     t.add_argument("--strategy", default=None,
                    choices=["single", "field_sparse", "dp", "row"])
     t.add_argument("--sparse-update", default=None, dest="sparse_update",
