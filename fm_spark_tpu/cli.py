@@ -175,13 +175,43 @@ class StreamingBatches:
 # ----------------------------------------------------------------- train
 
 
-def _resume(checkpointer, params, opt_state, batches):
-    """Restore (params, opt_state, start_step) from the latest checkpoint."""
+def _resume(checkpointer, params, opt_state, batches,
+            layout: str = "canonical"):
+    """Restore (params, opt_state, start_step) from the latest checkpoint.
+
+    ``layout`` names what THIS run will save ("canonical" per-field host
+    trees, or "sharded" live mesh arrays — cli --ckpt-sharded); the
+    checkpoint's recorded layout must match, both ways, or the user gets
+    an actionable message instead of an orbax tree-structure traceback.
+    For ``layout="sharded"`` the examples are the freshly sharded arrays
+    and orbax restores each shard to its owner.
+    """
     if checkpointer is None:
         return params, opt_state, 0
-    restored = checkpointer.restore(params, opt_state)
+    hint = (
+        "add --ckpt-sharded to resume it (or point --checkpoint-dir at "
+        "a fresh directory)"
+        if layout == "canonical"
+        else "drop --ckpt-sharded to resume it (or point "
+        "--checkpoint-dir at a fresh directory)"
+    )
+    try:
+        restored = checkpointer.restore(params, opt_state)
+    except Exception as e:
+        raise SystemExit(
+            f"could not restore the checkpoint as {layout}-layout — the "
+            "directory likely holds the other layout (then: " + hint +
+            "), or a sharded checkpoint is being resumed onto a "
+            f"different device count / mesh: {e}"
+        ) from e
     if restored is None:
         return params, opt_state, 0
+    stored = (restored.get("extra") or {}).get("layout") or "canonical"
+    if stored != layout:
+        raise SystemExit(
+            f"checkpoint at this directory is {stored}-layout but this "
+            f"run saves {layout}-layout; " + hint
+        )
     if restored["pipeline"] is not None:
         batches.restore(restored["pipeline"])
     return restored["params"], restored["opt_state"], restored["step"]
@@ -216,7 +246,8 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
 
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                       eval_source=None, prefetch: int = 0,
-                      row_shards: int = 1, steps_per_call: int = 1):
+                      row_shards: int = 1, steps_per_call: int = 1,
+                      ckpt_sharded: bool = False):
     """Training loop on the fused sparse steps (the CTR fast path).
 
     On one device this is the single-chip fused step; with multiple
@@ -230,6 +261,13 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     into one compiled ``fori_loop`` program over host-stacked batches —
     bench.py's dispatch amortization for the production loop (PERF.md
     fact 1). Logging/eval/checkpoint cadence rounds to call boundaries.
+
+    ``ckpt_sharded`` (multi-device field-sharded runs) checkpoints the
+    STACKED SHARDED arrays directly — orbax writes each shard from its
+    owning process, no full-table host gather per save. Sharded
+    checkpoints resume only onto the same mesh layout; the default
+    canonical (per-field-list) layout remains the topology-portable
+    format.
     """
     import jax
     import jax.numpy as jnp
@@ -258,9 +296,19 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         opt0 = make_optimizer(tconfig).init(
             {"w0": canonical["w0"], "mlp": canonical["mlp"]}
         )
-    # Checkpoints always use the canonical per-field-list layout so a run
-    # can resume on a different device count.
-    canonical, opt0, start = _resume(checkpointer, canonical, opt0, batches)
+    if ckpt_sharded and (n == 1 or isinstance(spec, FieldFFMSpec)):
+        raise SystemExit(
+            "--ckpt-sharded applies to multi-device field-sharded runs "
+            f"(found {n} device(s), {type(spec).__name__}); the default "
+            "canonical layout already serves single-chip runs"
+        )
+    start = 0
+    if not ckpt_sharded:
+        # Default: checkpoints use the canonical per-field-list layout so
+        # a run can resume on a different device count. (Sharded resume
+        # happens AFTER params are placed on the mesh, below.)
+        canonical, opt0, start = _resume(checkpointer, canonical, opt0,
+                                         batches)
 
     def adapt(step_pl):
         """Lift a ``(params, i, *b) → (params, loss)`` step into the
@@ -361,6 +409,10 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         prep = host
         to_canonical = lambda p: p
 
+    if ckpt_sharded:
+        params, opt, start = _resume(checkpointer, params, opt, batches,
+                                     layout="sharded")
+
     maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger)
     log_every = max(tconfig.log_every, 1)
     since = 0
@@ -369,6 +421,17 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     opt_canonical = (
         (lambda o: jax.device_get(o)) if is_deepfm else (lambda o: {})
     )
+    # What a checkpoint stores: canonical host trees (topology-portable,
+    # the default) or the live sharded arrays (--ckpt-sharded; orbax
+    # writes each shard from its owner, no host gather).
+    if ckpt_sharded:
+        ckpt_params = lambda: params
+        ckpt_opt = lambda: opt
+        ckpt_extra = {"layout": "sharded"}
+    else:
+        ckpt_params = lambda: to_canonical(params)
+        ckpt_opt = lambda: opt_canonical(opt)
+        ckpt_extra = None
     if tconfig.host_dedup:
         # BEFORE the prefetcher: the per-field argsorts run in the
         # producer thread, off the device critical path.
@@ -419,11 +482,11 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                     since = 0
                 maybe_eval(i + 1, lambda: to_canonical(params))
                 if checkpointer is not None and checkpointer.due(i + 1):
-                    checkpointer.save(i + 1, to_canonical(params),
-                                      opt_canonical(opt), batches.state())
+                    checkpointer.save(i + 1, ckpt_params(), ckpt_opt(),
+                                      batches.state(), extra=ckpt_extra)
         if checkpointer is not None:
-            checkpointer.save(tconfig.num_steps, to_canonical(params),
-                              opt_canonical(opt), batches.state(),
+            checkpointer.save(tconfig.num_steps, ckpt_params(), ckpt_opt(),
+                              batches.state(), extra=ckpt_extra,
                               force=True)
             checkpointer.wait()
     finally:
@@ -575,6 +638,13 @@ def cmd_train(args) -> int:
             f"--steps-per-call requires strategy 'field_sparse' "
             f"(config {cfg.name!r} resolves to {strategy!r})"
         )
+    if args.ckpt_sharded and (
+        strategy != "field_sparse" or not args.checkpoint_dir
+    ):
+        raise SystemExit(
+            "--ckpt-sharded requires strategy 'field_sparse' and "
+            "--checkpoint-dir"
+        )
     from fm_spark_tpu.data import iterate_once as _iter_once
 
     if te is not None:
@@ -608,7 +678,8 @@ def cmd_train(args) -> int:
                                            eval_source=eval_source,
                                            prefetch=args.prefetch,
                                            row_shards=args.row_shards,
-                                           steps_per_call=args.steps_per_call)
+                                           steps_per_call=args.steps_per_call,
+                                           ckpt_sharded=args.ckpt_sharded)
             elif strategy in ("dp", "row"):
                 params = _fit_parallel(spec, tconfig, batches, strategy,
                                        logger, checkpointer,
@@ -820,6 +891,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="field_sparse strategy: shard each field's bucket "
                         "dimension over this many chips (2-D feat x row "
                         "mesh; row capacity scale-out)")
+    t.add_argument("--ckpt-sharded", action="store_true",
+                   dest="ckpt_sharded",
+                   help="checkpoint the live sharded arrays (each process "
+                        "writes its shards; no host gather). Resumes only "
+                        "onto the same mesh; the default canonical layout "
+                        "is topology-portable")
     t.add_argument("--steps-per-call", type=int, default=1,
                    dest="steps_per_call",
                    help="roll N steps into one compiled program "

@@ -153,3 +153,123 @@ def test_restore_none_on_fresh_dir(tmp_path):
     ck = Checkpointer(str(tmp_path / "empty"), async_save=False)
     assert ck.restore(trainer.params, trainer.opt_state) is None
     ck.close()
+
+
+def test_sharded_checkpoint_kill_and_resume(tmp_path, rng):
+    """--ckpt-sharded: sharded-array checkpoints (no host gather) resume
+    bit-identically on the same mesh, matching an uninterrupted run."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices (fake CPU mesh)")
+    import dataclasses as _dc
+
+    from fm_spark_tpu import cli, configs as configs_lib
+
+    ids = rng.integers(0, 32, size=(512, 5)).astype(np.int32)
+
+    small = _dc.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"],
+        name="shck", bucket=32, num_fields=5, rank=4,
+        batch_size=64, num_steps=8,
+    )
+    configs_lib.CONFIGS["shck"] = small
+    try:
+        def run(ckdir, steps):
+            rc = cli.main([
+                "train", "--config", "shck", "--synthetic", "512",
+                "--steps", str(steps), "--strategy", "field_sparse",
+                "--ckpt-sharded", "--checkpoint-dir", str(ckdir),
+                "--checkpoint-every", "4", "--test-fraction", "0",
+                "--model-out", str(ckdir) + "_model", "--log-every", "4",
+            ])
+            assert rc == 0
+
+        # Uninterrupted 8 steps.
+        run(tmp_path / "full", 8)
+        # Interrupted: 4 steps, then resume to 8 in a fresh process-like
+        # second invocation against the same checkpoint dir.
+        run(tmp_path / "part", 4)
+        run(tmp_path / "part", 8)
+
+        from fm_spark_tpu import models as models_lib
+
+        _, p_full = models_lib.load_model(str(tmp_path / "full_model"))
+        _, p_part = models_lib.load_model(str(tmp_path / "part_model"))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_full),
+            jax.tree_util.tree_leaves(p_part),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        del configs_lib.CONFIGS["shck"]
+
+
+def test_ckpt_sharded_rejects_canonical_checkpoint(tmp_path):
+    import dataclasses as _dc
+
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices (fake CPU mesh)")
+    from fm_spark_tpu import cli, configs as configs_lib
+
+    small = _dc.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"],
+        name="shck2", bucket=32, num_fields=5, rank=4,
+        batch_size=64, num_steps=4,
+    )
+    configs_lib.CONFIGS["shck2"] = small
+    try:
+        ck = str(tmp_path / "ck")
+        assert cli.main([
+            "train", "--config", "shck2", "--synthetic", "512",
+            "--steps", "4", "--strategy", "field_sparse",
+            "--checkpoint-dir", ck, "--checkpoint-every", "2",
+            "--test-fraction", "0",
+        ]) == 0
+        with pytest.raises(SystemExit, match="canonical|mesh"):
+            cli.main([
+                "train", "--config", "shck2", "--synthetic", "512",
+                "--steps", "8", "--strategy", "field_sparse",
+                "--ckpt-sharded", "--checkpoint-dir", ck,
+                "--test-fraction", "0",
+            ])
+    finally:
+        del configs_lib.CONFIGS["shck2"]
+
+
+def test_canonical_resume_rejects_sharded_checkpoint(tmp_path, rng):
+    """Reverse direction of the layout check: a --ckpt-sharded checkpoint
+    resumed WITHOUT the flag gets the actionable hint, not an orbax
+    tree-structure traceback."""
+    import dataclasses as _dc
+
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices (fake CPU mesh)")
+    from fm_spark_tpu import cli, configs as configs_lib
+
+    small = _dc.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"],
+        name="shck3", bucket=32, num_fields=5, rank=4,
+        batch_size=64, num_steps=4,
+    )
+    configs_lib.CONFIGS["shck3"] = small
+    try:
+        ck = str(tmp_path / "ck")
+        assert cli.main([
+            "train", "--config", "shck3", "--synthetic", "512",
+            "--steps", "4", "--strategy", "field_sparse",
+            "--ckpt-sharded", "--checkpoint-dir", ck,
+            "--checkpoint-every", "2", "--test-fraction", "0",
+        ]) == 0
+        with pytest.raises(SystemExit, match="ckpt-sharded"):
+            cli.main([
+                "train", "--config", "shck3", "--synthetic", "512",
+                "--steps", "8", "--strategy", "field_sparse",
+                "--checkpoint-dir", ck, "--test-fraction", "0",
+            ])
+    finally:
+        del configs_lib.CONFIGS["shck3"]
