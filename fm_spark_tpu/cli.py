@@ -217,17 +217,24 @@ def _resume(checkpointer, params, opt_state, batches,
     return restored["params"], restored["opt_state"], restored["step"]
 
 
-def _periodic_evaluator(spec, tconfig, eval_source, logger):
+def _periodic_evaluator(spec, tconfig, eval_source, logger, evaluate=None):
     """Shared periodic-eval hook for the non-FMTrainer loops: returns
-    ``maybe_eval(step, params_canonical)``, a no-op unless ``eval_every``
-    is set; eval wall-clock is excluded from the throughput window."""
+    ``maybe_eval(step, params_thunk)``, a no-op unless ``eval_every`` is
+    set; eval wall-clock is excluded from the throughput window.
+    ``evaluate`` overrides the default canonical-params evaluator (the
+    field-sharded loop passes one that scores on the live sharded arrays
+    — no table gather)."""
     if eval_source is None or tconfig.eval_every <= 0:
         return lambda step, params, window=1: None
     import time as _time
 
-    from fm_spark_tpu.train import evaluate_params, make_eval_step
+    if evaluate is None:
+        from fm_spark_tpu.train import evaluate_params, make_eval_step
 
-    estep = make_eval_step(spec)  # compiled once, reused every eval
+        estep = make_eval_step(spec)  # compiled once, reused every eval
+        evaluate = lambda params_thunk: evaluate_params(
+            spec, params_thunk(), eval_source(), step=estep
+        )
 
     def maybe_eval(step, params_thunk, window=1):
         # Windowed cadence: fire iff a multiple of eval_every falls in
@@ -237,7 +244,7 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger):
         if (step // every) <= ((step - window) // every):
             return
         t0 = _time.perf_counter()
-        em = evaluate_params(spec, params_thunk(), eval_source(), step=estep)
+        em = evaluate(params_thunk)
         logger.log(step, **{f"eval_{k}": v for k, v in em.items()})
         logger.add_pause(_time.perf_counter() - t0)
 
@@ -413,7 +420,22 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         params, opt, start = _resume(checkpointer, params, opt, batches,
                                      layout="sharded")
 
-    maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger)
+    sharded_eval = None
+    if (n > 1 and not is_deepfm and not isinstance(spec, FieldFFMSpec)
+            and eval_source is not None and tconfig.eval_every > 0):
+        # Periodic eval on the live sharded arrays — the multi-GB tables
+        # never leave the mesh (parallel/field_step.py).
+        from fm_spark_tpu.parallel import (
+            evaluate_field_sharded,
+            make_field_sharded_eval_step,
+        )
+
+        _sh_estep = make_field_sharded_eval_step(spec, mesh)
+        sharded_eval = lambda _thunk: evaluate_field_sharded(
+            spec, mesh, params, eval_source(), estep=_sh_estep
+        )
+    maybe_eval = _periodic_evaluator(spec, tconfig, eval_source, logger,
+                                     evaluate=sharded_eval)
     log_every = max(tconfig.log_every, 1)
     since = 0
     from fm_spark_tpu.data import wrap_prefetch

@@ -165,6 +165,94 @@ def shard_field_batch(batch, mesh):
     )
 
 
+def _mesh_geometry(spec, mesh):
+    """Shared layout constants + validity guards for the field-sharded
+    train AND eval paths (single definition so the 2-D divisibility guard
+    and padding math can never diverge between them)."""
+    n_feat = mesh.shape["feat"]
+    n_row = mesh.shape.get("row", 1)
+    two_d = n_row > 1
+    if two_d and spec.bucket % n_row:
+        raise ValueError(
+            f"bucket={spec.bucket} must divide evenly over n_row={n_row} "
+            "row shards"
+        )
+    f_pad = padded_num_fields(spec.num_fields, n_feat)
+    return dict(
+        n_feat=n_feat, n_row=n_row, two_d=two_d,
+        bucket_local=spec.bucket // n_row, f_pad=f_pad,
+        f_local=f_pad // n_feat,
+        score_axes=("feat", "row") if two_d else "feat",
+    )
+
+
+def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights):
+    """The field-sharded forward, shared by the train body and the eval
+    step: example-sharded → field-sharded re-shard (all_to_all over
+    ``feat``; labels/weights ride all_gathers in the SAME collective
+    order so the example permutation stays consistent), 2-D ownership-
+    masked local gathers, and ONE psum group of the partial sums.
+
+    Returns ``(scores, s, xvs, rows, vals_c, uidx, labels, weights)`` —
+    scores replicated across the mesh; the training body additionally
+    consumes the locals for its analytic backward, and ``uidx`` carries
+    the single-owner scatter targets (OOB sentinel for non-owned lanes).
+    """
+    from fm_spark_tpu.sparse import _gather_all
+
+    cd = spec.cdtype
+    k = spec.rank
+    ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
+                         tiled=True)
+    vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
+                          tiled=True)
+    labels = lax.all_gather(labels, "feat", tiled=True)
+    weights = lax.all_gather(weights, "feat", tiled=True)
+    if g["two_d"]:
+        ids = lax.all_gather(ids, "row", tiled=True)
+        vals = lax.all_gather(vals, "row", tiled=True)
+        labels = lax.all_gather(labels, "row", tiled=True)
+        weights = lax.all_gather(weights, "row", tiled=True)
+
+    vals_c = vals.astype(cd)
+    if g["two_d"]:
+        # Each (field, example) id is owned by exactly one row shard:
+        # gather locally where owned, zero elsewhere; the psum over both
+        # axes reconstructs the exact sums. Non-owned update lanes go to
+        # an out-of-bounds sentinel row (XLA scatter drop) — single-owner
+        # writes.
+        lo = lax.axis_index("row") * g["bucket_local"]
+        loc = ids - lo
+        own = (loc >= 0) & (loc < g["bucket_local"])
+        gidx = jnp.clip(loc, 0, g["bucket_local"] - 1)
+        rows = [
+            r * own[:, f, None]
+            for f, r in enumerate(_gather_all(gat, vw, gidx, cd))
+        ]
+        uidx = jnp.where(own, loc, g["bucket_local"])
+    else:
+        rows = _gather_all(gat, vw, ids, cd)
+        uidx = ids
+    xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+    s_p = sum(xvs)
+    sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
+    lin_p = (
+        sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
+        if spec.use_linear
+        else jnp.zeros((ids.shape[0],), cd)
+    )
+    # The scores collective: [B,k] + 2·[B] per step; tables never move.
+    s = lax.psum(s_p, g["score_axes"])
+    sq = lax.psum(sq_p, g["score_axes"])
+    lin = lax.psum(lin_p, g["score_axes"])
+    scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
+    if spec.use_linear:
+        scores = scores + lin
+    if spec.use_bias:
+        scores = scores + w0.astype(cd)
+    return scores, s, xvs, rows, vals_c, uidx, labels, weights
+
+
 def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     """Unjitted ``(params, step_idx, ids, vals, labels, weights) →
     (params, loss)`` over stacked/sharded inputs; same semantics as the
@@ -196,79 +284,22 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank
-    n_feat = mesh.shape["feat"]
-    n_row = mesh.shape.get("row", 1)
-    two_d = n_row > 1
-    if two_d and spec.bucket % n_row:
-        raise ValueError(
-            f"bucket={spec.bucket} must divide evenly over n_row={n_row} "
-            "row shards"
-        )
-    bucket_local = spec.bucket // n_row
-    f_pad = padded_num_fields(spec.num_fields, n_feat)
-    f_local = f_pad // n_feat
-    score_axes = ("feat", "row") if two_d else "feat"
+    g = _mesh_geometry(spec, mesh)
+    f_pad, f_local = g["f_pad"], g["f_local"]
+    two_d = g["two_d"]
     lr_at = _lr_at(config)
 
     def local_step(params, step_idx, ids, vals, labels, weights):
         # Local blocks in: vw [f_local, bucket/n_row, width]; ids/vals
-        # [B/n, F_pad]; labels/weights [B/n].
+        # [B/n, F_pad]; labels/weights [B/n]. The shared forward
+        # (_field_forward) re-shards, gathers, and psums; the backward
+        # below is training-only.
         vw = params["vw"]
         w0 = params["w0"]
-        # Example-sharded → field-sharded: [B/n, F_pad] → [B, f_local].
-        # 2-D: the all_to_all runs per row group ([B/n_row, f_local]),
-        # then an all_gather over 'row' replicates the example axis
-        # within each field group. labels/weights follow the SAME
-        # collective order (feat then row) so the example permutation
-        # stays consistent across all four arrays.
-        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
-                             tiled=True)
-        vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
-                              tiled=True)
-        labels = lax.all_gather(labels, "feat", tiled=True)
-        weights = lax.all_gather(weights, "feat", tiled=True)
-        if two_d:
-            ids = lax.all_gather(ids, "row", tiled=True)
-            vals = lax.all_gather(vals, "row", tiled=True)
-            labels = lax.all_gather(labels, "row", tiled=True)
-            weights = lax.all_gather(weights, "row", tiled=True)
-
-        vals_c = vals.astype(cd)
-        if two_d:
-            # Each (field, example) id is owned by exactly one row shard:
-            # gather locally where owned, zero elsewhere; the psum over
-            # both axes below reconstructs the exact sums.
-            lo = lax.axis_index("row") * bucket_local
-            loc = ids - lo
-            own = (loc >= 0) & (loc < bucket_local)
-            gidx = jnp.clip(loc, 0, bucket_local - 1)
-            rows = [
-                r * own[:, f, None]
-                for f, r in enumerate(_gather_all(gat, vw, gidx, cd))
-            ]
-            # Non-owned update lanes go to an out-of-bounds sentinel row
-            # and are dropped by XLA scatter — single-owner writes.
-            uidx = jnp.where(own, loc, bucket_local)
-        else:
-            rows = _gather_all(gat, vw, ids, cd)
-            uidx = ids
-        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
-        s_p = sum(xvs)
-        sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
-        lin_p = (
-            sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
-            if spec.use_linear
-            else jnp.zeros((ids.shape[0],), cd)
+        scores, s, xvs, rows, vals_c, uidx, labels, weights = (
+            _field_forward(spec, g, gat, vw, w0, ids, vals, labels,
+                           weights)
         )
-        # The scores collective: [B,k] + 2·[B] per step; tables never move.
-        s = lax.psum(s_p, score_axes)
-        sq = lax.psum(sq_p, score_axes)
-        lin = lax.psum(lin_p, score_axes)
-        scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
-        if spec.use_linear:
-            scores = scores + lin
-        if spec.use_bias:
-            scores = scores + w0.astype(cd)
 
         # From here on every chip holds identical full-batch values.
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
@@ -539,3 +570,77 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
 
     step.init_opt_state = init_opt_state
     return step
+
+
+def make_field_sharded_eval_step(spec, mesh):
+    """Metrics-accumulation step on the FIELD-SHARDED layout — periodic
+    eval without gathering the multi-GB tables to the host (the default
+    evaluator reconstructs canonical params per eval; at BASELINE.json:9
+    scale that is ~3 GB of device→host traffic each time).
+
+    Same forward as :func:`make_field_sharded_sgd_body` (all_to_all batch
+    re-shard, masked local gathers on a 2-D mesh, one psum of partial
+    sums), then a replicated :func:`metrics.update_metrics` — every chip
+    sees the full psum'd score vector, so the metrics state stays
+    replicated by construction. FieldFM only (the DeepFM sharded eval
+    would additionally need the replicated-MLP head; it keeps the
+    canonical-gather evaluator for now).
+
+    Returns ``estep(params, mstate, ids, vals, labels, weights) →
+    mstate`` over stacked/sharded params and padded/sharded batches.
+    """
+    from fm_spark_tpu.models import base as model_base
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    if type(spec) is not FieldFMSpec:
+        raise ValueError("expected a FieldFMSpec")
+    if not spec.fused_linear:
+        raise ValueError("field-sharded eval requires fused_linear=True")
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    g = _mesh_geometry(spec, mesh)
+    gat = lambda table, idx: table[idx]  # eval always takes the XLA gather
+
+    def local_eval(params, mstate, ids, vals, labels, weights):
+        scores, _, _, _, _, _, labels, weights = _field_forward(
+            spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
+            weights,
+        )
+        per = per_example_loss(scores, labels)
+        preds = model_base.predict_from_scores(spec, scores)
+        return metrics_lib.update_metrics(
+            mstate, scores, labels, per, weights, predictions=preds
+        )
+
+    mstate_specs = jax.tree_util.tree_map(
+        lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
+    )
+    return jax.jit(jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(field_param_specs(mesh), mstate_specs,
+                  *field_batch_specs(mesh)),
+        out_specs=mstate_specs,
+        check_vma=False,
+    ))
+
+
+def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
+    """Stream host batches through the sharded eval step → finalized
+    metrics. ``params`` are the live stacked/sharded arrays; each batch
+    is padded to the mesh's field multiple and sharded like training
+    batches. Pass a prebuilt ``estep`` to avoid a re-trace per call."""
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    if estep is None:
+        estep = make_field_sharded_eval_step(spec, mesh)
+    n_feat = mesh.shape["feat"]
+    mstate = metrics_lib.init_metrics()
+    for batch in batches:
+        sb = shard_field_batch(
+            pad_field_batch(tuple(batch), spec.num_fields, n_feat), mesh
+        )
+        mstate = estep(params, mstate, *sb)
+    return {
+        k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()
+    }
