@@ -421,16 +421,21 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                                      layout="sharded")
 
     sharded_eval = None
-    if (n > 1 and not is_deepfm and not isinstance(spec, FieldFFMSpec)
+    if (n > 1 and not isinstance(spec, FieldFFMSpec)
             and eval_source is not None and tconfig.eval_every > 0):
         # Periodic eval on the live sharded arrays — the multi-GB tables
         # never leave the mesh (parallel/field_step.py).
         from fm_spark_tpu.parallel import (
             evaluate_field_sharded,
+            make_field_deepfm_sharded_eval_step,
             make_field_sharded_eval_step,
         )
 
-        _sh_estep = make_field_sharded_eval_step(spec, mesh)
+        _sh_estep = (
+            make_field_deepfm_sharded_eval_step(spec, mesh)
+            if is_deepfm
+            else make_field_sharded_eval_step(spec, mesh)
+        )
         sharded_eval = lambda _thunk: evaluate_field_sharded(
             spec, mesh, params, eval_source(), estep=_sh_estep
         )

@@ -441,9 +441,8 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     gat = _gather_fn(config)
     dense_opt = make_optimizer(config)
 
-    mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
-    mlp_specs = jax.tree_util.tree_map(lambda _: P(), mlp_struct)
-    pspecs = {"w0": P(), "vw": P("feat", None, None), "mlp": mlp_specs}
+    pspecs = field_deepfm_param_specs(spec)
+    mlp_specs = pspecs["mlp"]
 
     def local_step(params, step_idx, ids, vals, labels, weights):
         vw = params["vw"]
@@ -582,9 +581,9 @@ def make_field_sharded_eval_step(spec, mesh):
     re-shard, masked local gathers on a 2-D mesh, one psum of partial
     sums), then a replicated :func:`metrics.update_metrics` — every chip
     sees the full psum'd score vector, so the metrics state stays
-    replicated by construction. FieldFM only (the DeepFM sharded eval
-    would additionally need the replicated-MLP head; it keeps the
-    canonical-gather evaluator for now).
+    replicated by construction. FieldFM; the DeepFM analog (replicated
+    MLP head over the all_gathered ``h``) is
+    :func:`make_field_deepfm_sharded_eval_step`.
 
     Returns ``estep(params, mstate, ids, vals, labels, weights) →
     mstate`` over stacked/sharded params and padded/sharded batches.
@@ -630,10 +629,15 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
     metrics. ``params`` are the live stacked/sharded arrays; each batch
     is padded to the mesh's field multiple and sharded like training
     batches. Pass a prebuilt ``estep`` to avoid a re-trace per call."""
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
     from fm_spark_tpu.utils import metrics as metrics_lib
 
     if estep is None:
-        estep = make_field_sharded_eval_step(spec, mesh)
+        estep = (
+            make_field_deepfm_sharded_eval_step(spec, mesh)
+            if isinstance(spec, FieldDeepFMSpec)
+            else make_field_sharded_eval_step(spec, mesh)
+        )
     n_feat = mesh.shape["feat"]
     mstate = metrics_lib.init_metrics()
     for batch in batches:
@@ -644,3 +648,65 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
     return {
         k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()
     }
+
+
+def field_deepfm_param_specs(spec) -> dict:
+    """PartitionSpecs for the stacked sharded DeepFM params (1-D feat
+    mesh): tables field-sharded, bias + MLP replicated. Single definition
+    for the train step and the eval step."""
+    mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
+    mlp_specs = jax.tree_util.tree_map(lambda _: P(), mlp_struct)
+    return {"w0": P(), "vw": P("feat", None, None), "mlp": mlp_specs}
+
+
+def make_field_deepfm_sharded_eval_step(spec, mesh):
+    """Metrics-accumulation step on the sharded DeepFM layout — the FM
+    partial-sum forward plus the replicated-MLP deep head (same shape as
+    :func:`make_field_deepfm_sharded_step`'s forward: local xv columns,
+    one ``all_gather`` of ``h``, every chip runs the identical MLP).
+    1-D ``(feat,)`` mesh, like training."""
+    from fm_spark_tpu.models import base as model_base
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.sparse import _gather_all
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    if type(spec) is not FieldDeepFMSpec:
+        raise ValueError("expected a FieldDeepFMSpec")
+    if set(mesh.axis_names) != {"feat"}:
+        raise ValueError(
+            "sharded DeepFM eval runs on a 1-D ('feat',) mesh"
+        )
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    k = spec.rank
+    F = spec.num_fields
+    g = _mesh_geometry(spec, mesh)
+    gat = lambda table, idx: table[idx]
+    pspecs = field_deepfm_param_specs(spec)
+    mstate_specs = jax.tree_util.tree_map(
+        lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
+    )
+
+    def local_eval(params, mstate, ids, vals, labels, weights):
+        # The shared FM forward (scores incl. linear + bias), then the
+        # deep head exactly as training: local xv columns, one all_gather
+        # of h, the replicated MLP.
+        scores, _, xvs, _, _, _, labels, weights = _field_forward(
+            spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
+            weights,
+        )
+        h_local = jnp.concatenate(xvs, axis=1)
+        h = lax.all_gather(h_local, "feat", axis=1, tiled=True)[:, : F * k]
+        scores = scores + spec.deep_scores(params["mlp"], h)
+        per = per_example_loss(scores, labels)
+        preds = model_base.predict_from_scores(spec, scores)
+        return metrics_lib.update_metrics(
+            mstate, scores, labels, per, weights, predictions=preds
+        )
+
+    return jax.jit(jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(pspecs, mstate_specs, *field_batch_specs(mesh)),
+        out_specs=mstate_specs,
+        check_vma=False,
+    ))

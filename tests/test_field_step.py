@@ -383,3 +383,38 @@ def test_sharded_eval_matches_canonical(rng, n_row):
     for key in ("auc", "logloss", "rmse", "count"):
         np.testing.assert_allclose(got[key], want[key], rtol=1e-5,
                                    atol=1e-6, err_msg=key)
+
+
+def test_deepfm_sharded_eval_matches_canonical(rng):
+    from fm_spark_tpu.data import iterate_once
+    from fm_spark_tpu.parallel.field_step import (
+        evaluate_field_sharded,
+        make_field_mesh,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+    )
+    from fm_spark_tpu.train import evaluate_params
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    F, bucket, k, n = 5, 32, 4, 300
+    spec = models.FieldDeepFMSpec(
+        num_features=F * bucket, rank=k, num_fields=F, bucket=bucket,
+        init_std=0.3, mlp_dims=(8, 8),
+    )
+    params = spec.init(jax.random.key(6))
+    mesh = make_field_mesh(4)
+    sharded = shard_field_deepfm_params(
+        stack_field_deepfm_params(spec, params, mesh.shape["feat"]), mesh
+    )
+    ids = rng.integers(0, bucket, size=(n, F)).astype(np.int32)
+    vals = rng.normal(size=(n, F)).astype(np.float32)
+    labels = rng.integers(0, 2, n).astype(np.float32)
+
+    want = evaluate_params(spec, params, iterate_once(ids, vals, labels, 64))
+    got = evaluate_field_sharded(
+        spec, mesh, sharded, iterate_once(ids, vals, labels, 64)
+    )
+    for key in ("auc", "logloss", "rmse", "count"):
+        np.testing.assert_allclose(got[key], want[key], rtol=1e-5,
+                                   atol=1e-6, err_msg=key)
