@@ -635,7 +635,7 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
     if estep is None:
         estep = (
             make_field_deepfm_sharded_eval_step(spec, mesh)
-            if isinstance(spec, FieldDeepFMSpec)
+            if type(spec) is FieldDeepFMSpec
             else make_field_sharded_eval_step(spec, mesh)
         )
     n_feat = mesh.shape["feat"]
@@ -667,7 +667,6 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
     1-D ``(feat,)`` mesh, like training."""
     from fm_spark_tpu.models import base as model_base
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
-    from fm_spark_tpu.sparse import _gather_all
     from fm_spark_tpu.utils import metrics as metrics_lib
 
     if type(spec) is not FieldDeepFMSpec:
