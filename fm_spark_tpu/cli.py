@@ -382,9 +382,29 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
 
         n_feat = n // row_shards
         mesh = make_field_mesh(n, n_row=row_shards)
-        prep = lambda b: shard_field_batch(
-            pad_field_batch(b, spec.num_fields, n_feat), mesh
-        )
+        if jax.process_count() > 1:
+            from fm_spark_tpu.parallel import shard_field_batch_local
+
+            # Each process feeds only its local slice of the global
+            # batch; the global array is assembled across hosts.
+            prep = lambda b: shard_field_batch_local(
+                pad_field_batch(b, spec.num_fields, n_feat), mesh
+            )
+        else:
+            prep = lambda b: shard_field_batch(
+                pad_field_batch(b, spec.num_fields, n_feat), mesh
+            )
+        if jax.process_count() > 1:
+            # device_get cannot fetch non-addressable shards; the gather
+            # crosses processes (DCN) — used only for canonical
+            # checkpoints/final export (--ckpt-sharded avoids it).
+            from jax.experimental import multihost_utils
+
+            fetch = lambda p: multihost_utils.process_allgather(
+                p, tiled=True
+            )
+        else:
+            fetch = jax.device_get
         if is_deepfm:
             step = make_field_deepfm_sharded_step(spec, tconfig, mesh)
             params = shard_field_deepfm_params(
@@ -392,7 +412,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             )
             opt = jax.device_put(opt0)
             to_canonical = lambda p: unstack_field_deepfm_params(
-                spec, jax.device_get(p)
+                spec, fetch(p)
             )
         else:
             step = adapt(make_field_sharded_sgd_step(spec, tconfig, mesh))
@@ -401,7 +421,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             )
             opt = opt0
             to_canonical = lambda p: unstack_field_params(
-                spec, jax.device_get(p)
+                spec, fetch(p)
             )
     else:
         if is_deepfm:
@@ -448,6 +468,17 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     opt_canonical = (
         (lambda o: jax.device_get(o)) if is_deepfm else (lambda o: {})
     )
+
+    def pipe_state():
+        """Pipeline cursor for checkpoints. Multi-host: strip the
+        per-process row range (lo/hi) — each host re-derives its own on
+        resume and restores only the common (epoch, index) cursor, which
+        stays in lockstep across hosts."""
+        st = batches.state()
+        if jax.process_count() > 1 and isinstance(st, dict):
+            st = {k: v for k, v in st.items() if k not in ("lo", "hi")}
+        return st
+
     # What a checkpoint stores: canonical host trees (topology-portable,
     # the default) or the live sharded arrays (--ckpt-sharded; orbax
     # writes each shard from its owner, no host gather).
@@ -497,7 +528,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 maybe_eval(i, lambda: to_canonical(params), window=m)
                 if checkpointer is not None and checkpointer.due_window(i, m):
                     checkpointer.save(i, to_canonical(params), {},
-                                      batches.state())
+                                      pipe_state())
         else:
             for i in range(start, tconfig.num_steps):
                 batch = batches.next_batch()
@@ -510,10 +541,10 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 maybe_eval(i + 1, lambda: to_canonical(params))
                 if checkpointer is not None and checkpointer.due(i + 1):
                     checkpointer.save(i + 1, ckpt_params(), ckpt_opt(),
-                                      batches.state(), extra=ckpt_extra)
+                                      pipe_state(), extra=ckpt_extra)
         if checkpointer is not None:
             checkpointer.save(tconfig.num_steps, ckpt_params(), ckpt_opt(),
-                              batches.state(), extra=ckpt_extra,
+                              pipe_state(), extra=ckpt_extra,
                               force=True)
             checkpointer.wait()
     finally:
@@ -600,6 +631,26 @@ def cmd_train(args) -> int:
         host_dedup=True if args.host_dedup else None,
     )
 
+    import jax as _jax
+
+    pc = _jax.process_count()
+    if pc > 1:
+        # Only the multi-chip field-sharded loop has cross-host parameter
+        # semantics (collectives inside the step + local batch placement);
+        # every other loop would silently train a DIFFERENT model per
+        # host on its data shard.
+        if cfg.strategy != "field_sparse" or cfg.model == "field_ffm":
+            raise SystemExit(
+                f"multi-process training supports strategy 'field_sparse' "
+                f"(FM/DeepFM) only; config {cfg.name!r} resolves to "
+                f"strategy {cfg.strategy!r}, model {cfg.model!r}"
+            )
+        if tconfig.batch_size % pc:
+            raise SystemExit(
+                f"batch_size={tconfig.batch_size} must be divisible by "
+                f"the process count ({pc})"
+            )
+
     te = None
     te_packed = None
     if cfg.dataset in ("criteo", "avazu") and _is_packed_dir(args.data):
@@ -618,9 +669,21 @@ def cmd_train(args) -> int:
             if args.test_fraction > 0 else len(ds)
         )
         bucket = cfg.bucket if cfg.field_local_ids else 0
+        if pc > 1:
+            # Multi-host ingestion: each process streams ITS contiguous
+            # slice of the train rows and feeds batch_size/pc rows per
+            # step (the Spark partitions-per-executor analog); equal
+            # slices keep the hosts' epoch cursors in lockstep.
+            per = cut // pc
+            pid = _jax.process_index()
+            row_range = (pid * per, (pid + 1) * per)
+            local_bs = tconfig.batch_size // pc
+        else:
+            row_range = (0, cut)
+            local_bs = tconfig.batch_size
         batches = StreamingBatches(
-            PackedBatches(ds, tconfig.batch_size, seed=cfg.seed,
-                          row_range=(0, cut)),
+            PackedBatches(ds, local_bs, seed=cfg.seed,
+                          row_range=row_range),
             bucket=bucket,
         )
         if cut < len(ds):
@@ -634,11 +697,17 @@ def cmd_train(args) -> int:
             if args.test_fraction > 0
             else ((ids, vals, labels), None)
         )
-        batches = Batches(*tr, tconfig.batch_size, seed=cfg.seed)
+        if pc > 1:
+            # Strided per-process split (keeps label mix); local batch =
+            # global / processes, matching the per-host input shard the
+            # field-sharded step's make_array placement expects.
+            pid = _jax.process_index()
+            tr = tuple(a[pid::pc] for a in tr)
+            batches = Batches(*tr, tconfig.batch_size // pc, seed=cfg.seed)
+        else:
+            batches = Batches(*tr, tconfig.batch_size, seed=cfg.seed)
 
     import contextlib
-
-    import jax as _jax
 
     checkpointer = None
     if args.checkpoint_dir:

@@ -40,6 +40,7 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     evaluate_field_sharded,
     pad_field_batch,
     shard_field_batch,
+    shard_field_batch_local,
     shard_field_deepfm_params,
     shard_field_params,
     stack_field_deepfm_params,

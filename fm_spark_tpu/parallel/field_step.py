@@ -165,6 +165,23 @@ def shard_field_batch(batch, mesh):
     )
 
 
+def shard_field_batch_local(batch, mesh):
+    """Multi-host batch placement: each PROCESS supplies only ITS slice
+    of the global batch (local rows = global_batch / process_count — the
+    per-host input shard, SURVEY.md §4 "per-host input shards"), and the
+    global array is assembled without ever replicating host data. The
+    single-process :func:`shard_field_batch` device_puts the full batch
+    instead (host data is already global there)."""
+    import numpy as np
+
+    return tuple(
+        jax.make_array_from_process_local_data(
+            NamedSharding(mesh, s), np.asarray(x)
+        )
+        for x, s in zip(batch, field_batch_specs(mesh))
+    )
+
+
 def _mesh_geometry(spec, mesh):
     """Shared layout constants + validity guards for the field-sharded
     train AND eval paths (single definition so the 2-D divisibility guard
@@ -639,11 +656,30 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
             else make_field_sharded_eval_step(spec, mesh)
         )
     n_feat = mesh.shape["feat"]
+    pc = jax.process_count()
+    if pc > 1:
+        # Every host iterates the SAME eval stream; each feeds only its
+        # row slice of each batch and the global array is assembled
+        # across hosts (mirrors the training-side local placement).
+        import numpy as np
+
+        pid = jax.process_index()
+
+        def place(b):
+            rows = b[0].shape[0]
+            if rows % pc:
+                raise ValueError(
+                    f"eval batch size {rows} must be divisible by the "
+                    f"process count ({pc})"
+                )
+            lo = pid * (rows // pc)
+            local = tuple(np.asarray(x)[lo: lo + rows // pc] for x in b)
+            return shard_field_batch_local(local, mesh)
+    else:
+        place = lambda b: shard_field_batch(b, mesh)
     mstate = metrics_lib.init_metrics()
     for batch in batches:
-        sb = shard_field_batch(
-            pad_field_batch(tuple(batch), spec.num_fields, n_feat), mesh
-        )
+        sb = place(pad_field_batch(tuple(batch), spec.num_fields, n_feat))
         mstate = estep(params, mstate, *sb)
     return {
         k: float(v) for k, v in metrics_lib.finalize_metrics(mstate).items()
