@@ -90,11 +90,13 @@ def inner_main(args):
     steps_warmup = 3
     steps_timed = args.steps
 
-    spec = models.FieldFMSpec(
-        num_features=num_fields * bucket, rank=rank,
-        num_fields=num_fields, bucket=bucket, init_std=0.01,
-        param_dtype=args.param_dtype,
-    )
+    def make_spec(param_dtype):
+        return models.FieldFMSpec(
+            num_features=num_fields * bucket, rank=rank,
+            num_fields=num_fields, bucket=bucket, init_std=0.01,
+            param_dtype=param_dtype,
+        )
+
     rng = np.random.default_rng(0)
     # Criteo-like Zipf skew within each field's bucket.
     ids_np = (rng.zipf(1.3, size=(batch, num_fields)) % bucket).astype(np.int32)
@@ -111,39 +113,53 @@ def inner_main(args):
     explicit = (args.sparse_update != "scatter_add" or args.use_pallas
                 or args.host_dedup or args.param_dtype != "float32"
                 or args.rank != 64 or args.batch != 1 << 17
-                or args.steps != 20)
+                or args.steps != 20 or args.compact_cap)
     variants = [(
         f"{args.param_dtype}/{args.sparse_update}"
         + ("/pallas" if args.use_pallas else "")
-        + ("/hostdedup" if args.host_dedup else ""),
+        + (f"/compact{args.compact_cap}" if args.compact_cap
+           else "/hostdedup" if args.host_dedup else ""),
+        args.param_dtype,
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
-                    use_pallas=args.use_pallas, host_dedup=args.host_dedup),
+                    use_pallas=args.use_pallas, host_dedup=args.host_dedup,
+                    compact_cap=args.compact_cap),
     )]
     if not explicit:
-        variants.append((
-            "float32/dedup/hostdedup",
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup",
-                        host_dedup=True),
-        ))
+        # The COMPACT host-dedup candidates (PERF.md: the round-2 probes
+        # showed scatter cost is per-lane even for dropped lanes, so cap-
+        # lane compaction is the lever; full-B hostdedup measured slower
+        # than the default and left out). Cap 16384 bounds the measured
+        # max per-field unique count (~12k) on this Zipf batch.
+        cap = min(16384, batch)
+        for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
+            variants.append((
+                f"{dt}/{su}/compact{cap}", dt,
+                TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                            optimizer="sgd", sparse_update=su,
+                            host_dedup=True, compact_cap=cap),
+            ))
 
     import functools
 
-    aux_cache = None
+    aux_cache = {}
     results = []
-    for label, config in variants:
+    for label, param_dtype, config in variants:
+        spec = make_spec(param_dtype)
         body = make_field_sparse_sgd_body(spec, config)
         aux = None
         if config.host_dedup:
             # Aux for the (fixed) bench batch is computed once here; in
             # production it rides the prefetch thread (DedupAuxBatches) —
             # bench_input.py --host-dedup measures that host-side rate.
-            if aux_cache is None:
-                from fm_spark_tpu.ops.scatter import dedup_aux
+            akey = config.compact_cap  # 0 = full-B dedup aux
+            if akey not in aux_cache:
+                from fm_spark_tpu.ops.scatter import compact_aux, dedup_aux
 
-                aux_cache = jax.device_put(dedup_aux(ids_np))
-            aux = aux_cache
+                aux_cache[akey] = jax.device_put(
+                    compact_aux(ids_np, akey) if akey else dedup_aux(ids_np)
+                )
+            aux = aux_cache[akey]
         params = spec.init(jax.random.key(0))
 
         # n_steps is a DYNAMIC argument so the warmup call compiles the
@@ -271,6 +287,11 @@ def main():
                     help="host-precomputed dedup aux: device writes each "
                          "unique id once (PERF.md round-3 lever; pair "
                          "with --sparse-update dedup or dedup_sr)")
+    ap.add_argument("--compact-cap", type=int, default=0, dest="compact_cap",
+                    help="COMPACT host-dedup: static per-field unique-id "
+                         "capacity; device touches the big tables with "
+                         "cap lanes instead of B (requires --host-dedup "
+                         "and a dedup --sparse-update)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
@@ -284,6 +305,8 @@ def main():
         ap.error("--host-dedup requires --sparse-update dedup or dedup_sr")
     if args.host_dedup and args.use_pallas:
         ap.error("--host-dedup and --use-pallas are exclusive")
+    if args.compact_cap and not args.host_dedup:
+        ap.error("--compact-cap requires --host-dedup")
 
     if args.inner:
         sys.exit(inner_main(args))
@@ -300,6 +323,8 @@ def main():
         argv.append("--use-pallas")
     if args.host_dedup:
         argv.append("--host-dedup")
+    if args.compact_cap:
+        argv += ["--compact-cap", str(args.compact_cap)]
     failures = []
     for attempt in range(1, args.attempts + 1):
         _log(f"[parent] attempt {attempt}/{args.attempts}")

@@ -82,11 +82,17 @@ def main():
     ap.add_argument("--data-dir", default="/tmp/fmtpu_bench_input",
                     help="packed dir to create/reuse")
     ap.add_argument("--prefetch-depth", type=int, default=4)
+    ap.add_argument("--compact-cap", type=int, default=0, dest="compact_cap",
+                    help="with --host-dedup: measure the COMPACT aux "
+                         "(ops/scatter.compact_aux) at this static "
+                         "per-field capacity instead of the full-B aux")
     ap.add_argument("--host-dedup", action="store_true", dest="host_dedup",
                     help="add the DedupAuxBatches stage (per-batch argsort "
                          "+ segment maps on the host) — the feed-rate cost "
                          "of TrainConfig.host_dedup")
     args = ap.parse_args()
+    if args.compact_cap and not args.host_dedup:
+        ap.error("--compact-cap requires --host-dedup")
 
     num_fields, bucket = 39, 1 << 18
 
@@ -133,7 +139,8 @@ def main():
     from fm_spark_tpu.data import DedupAuxBatches
 
     source = (
-        (lambda: DedupAuxBatches(with_field_local()))
+        (lambda: DedupAuxBatches(with_field_local(),
+                                 cap=args.compact_cap))
         if args.host_dedup else with_field_local
     )
     stages = [

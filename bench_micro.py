@@ -276,6 +276,201 @@ def bench_dedup(args):
     timed("hostaux", dedup_hostaux_all, tables, order, seg_dev, useg)
 
 
+def bench_split(args):
+    """Probe behind the sub-split lever: each headline field table is
+    262144x65 fp32 = 68MB — ABOVE the ~34MB gather cliff (fact 2). Does
+    storing each field as S row-slabs (each under the cliff) win, given
+    gather then costs S x b lanes at the fast rate instead of b at the
+    slow rate, and scatter costs S x b lanes with (S-1)/S of them
+    OOB-dropped?  Run with --n-idx 131072 for the headline shape.
+
+    Emits, for S in {1, 2, 4}: the 39-field gather time and scatter time
+    of one step-equivalent. The OOB question (are dropped scatter lanes
+    charged?) falls out of scatter_s1 vs scatter_s2/s4.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, rows, width, b = args.tables, args.rows, args.width + 1, args.n_idx
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        (rng.zipf(1.3, size=(b, F)) % rows).astype(np.int32)
+    )
+    upd = jnp.full((b, width), 1e-3, jnp.float32)
+
+    def timed(name, fn, *xs, extra=None):
+        f = jax.jit(fn)
+
+        def run():
+            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
+
+        run()  # compile
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        cfg = {"fields": F, "rows": rows, "width": width, "batch": b}
+        if extra:
+            cfg.update(extra)
+        _out(f"split_{name}", cfg, dt * 1e3, "ms/step-equivalent")
+        return dt
+
+    for s in (1, 2, 4):
+        half = rows // s
+        shift = int(np.log2(half))
+        assert half * s == rows and 1 << shift == half
+        slabs = [
+            [jnp.zeros((half, width), jnp.float32) for _ in range(s)]
+            for _ in range(F)
+        ]
+        slab_mb = half * width * 4 / 2**20
+
+        def gather_all(ts, idx, s=s, shift=shift, half=half):
+            # Per field: S masked gathers from slab-local ids + a select
+            # chain — every id has exactly one owning slab.
+            out = []
+            for f, field_slabs in enumerate(ts):
+                i = idx[:, f]
+                hi, lo = i >> shift, i & (half - 1)
+                r = None
+                for j, t in enumerate(field_slabs):
+                    rj = t[jnp.where(hi == j, lo, 0)]
+                    r = rj if r is None else jnp.where(
+                        (hi == j)[:, None], rj, r
+                    )
+                out.append(jnp.sum(r))
+            return out
+
+        def scatter_all(ts, idx, s=s, shift=shift, half=half):
+            # Per field: S drop-scatters; non-owned lanes go OOB.
+            out = []
+            for f, field_slabs in enumerate(ts):
+                i = idx[:, f]
+                hi, lo = i >> shift, i & (half - 1)
+                for j, t in enumerate(field_slabs):
+                    out.append(
+                        t.at[jnp.where(hi == j, lo, half)].add(
+                            upd, mode="drop"
+                        )
+                    )
+            return out
+
+        timed(f"gather_s{s}", gather_all, slabs, ids,
+              extra={"slabs": s, "slab_mb": round(slab_mb, 1)})
+        timed(f"scatter_s{s}", scatter_all, slabs, ids,
+              extra={"slabs": s, "slab_mb": round(slab_mb, 1)})
+
+
+def bench_compact(args):
+    """Probe behind the COMPACT host-dedup lever (round-2 finding: OOB-
+    dropped scatter lanes are charged like live ones — dedup_scatter_
+    dropped_dups ~= dedup_scatter_zipf — so winning requires REDUCING the
+    lane count against the big tables, not masking lanes).
+
+    With host-sorted ids and a static per-field unique-capacity ``cap``:
+      forward:  urows = t[useg]         (cap sorted lanes vs B from 68MB)
+                rows  = urows[inv]      (B lanes from a [cap,w] buffer)
+      backward: sdelta = delta[order]   (B lanes, [B,w] buffer)
+                csum   = cumsum(sdelta) (one streaming pass, no scatter)
+                segsum = csum[seg_end] - csum[seg_end - run_len]
+                t.at[useg].add(segsum, unique + sorted, cap lanes)
+
+    vs the shipped chain: t[ids] gather (B lanes, 68MB table) +
+    t.at[ids].add (B lanes). Run with --n-idx 131072.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, rows, width, b = args.tables, args.rows, args.width + 1, args.n_idx
+    cap = args.cap
+    rng = np.random.default_rng(0)
+    ids_np = (rng.zipf(1.3, size=(b, F)) % rows).astype(np.int32)
+    nu = max(np.unique(ids_np[:, f]).size for f in range(F))
+    if nu > cap:
+        raise SystemExit(f"cap {cap} < max unique {nu}; raise --cap")
+
+    # Host aux from the SHIPPED builder (one implementation of the
+    # useg/segstart/segend/order/inv contract — the probe must measure
+    # the same layout the step consumes).
+    from fm_spark_tpu.ops.scatter import compact_aux
+
+    useg_np, segstart_np, segend_np, order_np, inv_np = compact_aux(
+        ids_np, cap
+    )
+    order = jnp.asarray(order_np.T)   # probe uses [B, F]-major layouts
+    useg = jnp.asarray(useg_np)
+    segend = jnp.asarray(segend_np)
+    segstart = jnp.asarray(segstart_np)
+    inv = jnp.asarray(inv_np.T)
+    ids = jnp.asarray(ids_np)
+    tables = [jnp.zeros((rows, width), jnp.float32) for _ in range(F)]
+    delta = jnp.full((b, width), 1e-3, jnp.float32)
+
+    def timed(name, fn, *xs, extra=None):
+        f = jax.jit(fn)
+
+        def run():
+            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
+
+        run()
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        cfg = {"fields": F, "rows": rows, "width": width, "batch": b,
+               "cap": cap, "max_unique": int(nu)}
+        if extra:
+            cfg.update(extra)
+        _out(f"compact_{name}", cfg, dt * 1e3, "ms/step-equivalent")
+        return dt
+
+    def baseline_chain(ts, idx):
+        out = []
+        for f, t in enumerate(ts):
+            r = t[idx[:, f]]
+            out.append(t.at[idx[:, f]].add(r * 1e-4 + delta, mode="drop"))
+        return out
+
+    timed("baseline_gather_scatter", baseline_chain, tables, ids)
+
+    def compact_chain(ts, useg, inv, order, segend, segstart):
+        out = []
+        for f, t in enumerate(ts):
+            u = useg[f]
+            urows = t[jnp.clip(u, 0, rows - 1)]        # cap sorted lanes
+            r = urows[inv[:, f]]                       # B lanes, tiny buf
+            d = r * 1e-4 + delta                       # stand-in backward
+            sdelta = d[order[:, f]]                    # B lanes, tiny buf
+            csum = jnp.cumsum(sdelta, axis=0)
+            lo = csum[segstart[f]] - sdelta[segstart[f]]
+            segsum = csum[segend[f]] - lo              # exact per-segment
+            out.append(
+                t.at[u].add(segsum, mode="drop",
+                            unique_indices=True, indices_are_sorted=True)
+            )
+        return out
+
+    timed("chain", compact_chain, tables, useg, inv, order, segend,
+          segstart)
+
+    def compact_scatter_only(ts, useg):
+        return [
+            t.at[useg[f]].add(jnp.ones((cap, width), jnp.float32),
+                              mode="drop", unique_indices=True,
+                              indices_are_sorted=True)
+            for f, t in enumerate(ts)
+        ]
+
+    timed("scatter_unique_sorted_only", compact_scatter_only, tables,
+          useg)
+
+    def compact_gather_only(ts, useg):
+        return [jnp.sum(t[jnp.clip(useg[f], 0, rows - 1)])
+                for f, t in enumerate(ts)]
+
+    timed("gather_cap_only", compact_gather_only, tables, useg)
+
+
 BENCHES = {
     "dispatch": bench_dispatch,
     "gather": bench_gather,
@@ -283,6 +478,8 @@ BENCHES = {
     "matmul": bench_matmul,
     "cast": bench_cast,
     "dedup": bench_dedup,
+    "split": bench_split,
+    "compact": bench_compact,
 }
 
 
@@ -297,6 +494,9 @@ def main():
     ap.add_argument("--rows", type=int, default=1 << 18)
     ap.add_argument("--tables", type=int, default=39)
     ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--cap", type=int, default=16384,
+                    help="compact probe: static per-field unique-id "
+                    "capacity")
     args = ap.parse_args()
 
     import os

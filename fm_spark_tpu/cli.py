@@ -495,7 +495,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         # producer thread, off the device critical path.
         from fm_spark_tpu.data import DedupAuxBatches
 
-        batches = DedupAuxBatches(batches)
+        batches = DedupAuxBatches(batches, cap=tconfig.compact_cap)
     if multi:
         from fm_spark_tpu.data import StackedBatches
         from fm_spark_tpu.sparse import make_field_sparse_multistep
@@ -629,6 +629,7 @@ def cmd_train(args) -> int:
         log_every=args.log_every, metrics_path=args.metrics,
         eval_every=args.eval_every,
         host_dedup=True if args.host_dedup else None,
+        compact_cap=args.compact_cap,
     )
 
     import jax as _jax
@@ -982,6 +983,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "the host prefetch thread; device writes each "
                         "unique id once (needs --sparse-update dedup or "
                         "dedup_sr; single-chip FieldFM)")
+    t.add_argument("--compact-cap", type=int, default=None,
+                   dest="compact_cap",
+                   help="COMPACT host-dedup: static per-field unique-id "
+                        "capacity — the device touches the big tables "
+                        "with this many lanes instead of the batch size "
+                        "(the measured headline winner, PERF.md). Must "
+                        "bound every field's per-batch unique-id count "
+                        "(the aux builder raises otherwise). Needs "
+                        "--host-dedup; single-chip FieldFM")
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--row-shards", type=int, default=1, dest="row_shards",
                    help="field_sparse strategy: shard each field's bucket "

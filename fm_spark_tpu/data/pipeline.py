@@ -165,24 +165,29 @@ class BernoulliBatches:
 
 
 class DedupAuxBatches:
-    """Batch-source wrapper that appends host-precomputed dedup aux
-    (:func:`fm_spark_tpu.ops.scatter.dedup_aux`) to each 4-tuple batch,
-    yielding ``(ids, vals, labels, weights, aux)``.
+    """Batch-source wrapper that appends host-precomputed dedup aux to
+    each 4-tuple batch, yielding ``(ids, vals, labels, weights, aux)``:
+    :func:`fm_spark_tpu.ops.scatter.dedup_aux` by default, or the
+    COMPACT variant (:func:`...scatter.compact_aux`) when ``cap > 0`` —
+    pair with ``TrainConfig.compact_cap`` of the same value (the jitted
+    step's aux shapes are static).
 
-    Wrap the source with this BEFORE :class:`Prefetcher` so the argsort
+    Wrap the source with this BEFORE :class:`Prefetcher` so the sort
     work lands in the producer thread, off the device critical path —
     that placement is the entire point of host-assisted dedup
     (PERF.md round-3 lever).
     """
 
-    def __init__(self, source):
+    def __init__(self, source, cap: int = 0):
         self._source = source
+        self._cap = int(cap)
 
     def next_batch(self):
-        from fm_spark_tpu.ops.scatter import dedup_aux
+        from fm_spark_tpu.ops.scatter import compact_aux, dedup_aux
 
         ids, vals, labels, weights = self._source.next_batch()
-        return ids, vals, labels, weights, dedup_aux(ids)
+        aux = compact_aux(ids, self._cap) if self._cap else dedup_aux(ids)
+        return ids, vals, labels, weights, aux
 
     def __iter__(self):
         return self

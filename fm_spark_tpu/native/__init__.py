@@ -75,6 +75,12 @@ def _load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+        lib.fm_compact_aux.restype = ctypes.c_int32
+        lib.fm_compact_aux.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _lib = lib
         return _lib
 
@@ -171,13 +177,20 @@ def parse_criteo_chunk(chunk: bytes, bucket: int, per_field: bool = True,
     return ids[:n], labels[:n], int(consumed.value)
 
 
+# Cap on the counting sort's O(bucket) per-thread scratch (int64
+# entries): 1 << 27 ≈ 1GB per thread — beyond that the numpy argsort
+# fallback is the safer trade.
+_COUNTING_SORT_MAX_BUCKET = 1 << 27
+
+
 def dedup_aux_native(ids: np.ndarray, bucket: int):
     """Native counting-sort dedup precompute (fm_dedup_aux); returns
     ``(order, seg, useg, ord_first)`` int32 ``[F, B]`` arrays, or None
     when the library is unavailable (caller falls back to numpy —
-    ops/scatter.dedup_aux)."""
+    ops/scatter.dedup_aux) or the bucket count would make the O(bucket)
+    per-thread scratch unreasonable."""
     lib = _load()
-    if lib is None:
+    if lib is None or bucket > _COUNTING_SORT_MAX_BUCKET:
         return None
     ids = np.ascontiguousarray(ids, np.int32)
     b, f = ids.shape
@@ -188,3 +201,40 @@ def dedup_aux_native(ids: np.ndarray, bucket: int):
         out[3].ctypes.data,
     )
     return out
+
+
+def compact_aux_native(ids: np.ndarray, cap: int):
+    """Native counting-sort COMPACT aux (fm_compact_aux); returns
+    ``(useg, segstart, segend, order, inv)`` per
+    ops/scatter.compact_aux's contract, or None when the library (or
+    the symbol, for stale builds) is unavailable. Raises ValueError on
+    per-field unique-count overflow, matching the numpy path."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fm_compact_aux"):
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    b, f = ids.shape
+    bucket = int(ids.max()) + 1 if b else 1
+    if bucket > _COUNTING_SORT_MAX_BUCKET:
+        # The C++ counting sort allocates an O(bucket) scratch vector
+        # PER WORKER THREAD; one stray giant id would turn that into
+        # multi-GB allocations inside the prefetch producer. Fall back
+        # to the numpy argsort path, which is O(B) memory.
+        return None
+    useg = np.empty((f, cap), np.int32)
+    segstart = np.empty((f, cap), np.int32)
+    segend = np.empty((f, cap), np.int32)
+    order = np.empty((f, b), np.int32)
+    inv = np.empty((f, b), np.int32)
+    overflow = lib.fm_compact_aux(
+        ids.ctypes.data, b, f, bucket, int(cap),
+        useg.ctypes.data, segstart.ctypes.data, segend.ctypes.data,
+        order.ctypes.data, inv.ctypes.data,
+    )
+    if overflow >= 0:
+        raise ValueError(
+            f"field {overflow}: unique ids > compact cap {cap}; raise "
+            "compact_cap (it must bound the per-field per-batch "
+            "unique-id count)"
+        )
+    return useg, segstart, segend, order, inv

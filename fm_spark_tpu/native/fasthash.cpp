@@ -279,4 +279,73 @@ void fm_dedup_aux(const int32_t* ids, int64_t B, int32_t F, int32_t bucket,
   for (auto& th : threads) th.join();
 }
 
+// COMPACT aux for ops/scatter.compact_aux: same per-field counting sort
+// as fm_dedup_aux, but unique ids / segment bounds land in cap-sized
+// arrays (the device's static scatter width) plus the forward expansion
+// map inv[b] = segment of original lane b. Returns the first field whose
+// unique count exceeds cap (caller raises), or -1 on success. Sentinel
+// padding: distinct ASCENDING out-of-range values so useg stays globally
+// unique and sorted — both XLA scatter promises hold.
+int32_t fm_compact_aux(const int32_t* ids, int64_t B, int32_t F,
+                       int32_t bucket, int32_t cap, int32_t* useg,
+                       int32_t* segstart, int32_t* segend, int32_t* order,
+                       int32_t* inv) {
+  int hw = (int)std::thread::hardware_concurrency();
+  int n_threads = F < (hw > 0 ? hw : 1) ? (int)F : (hw > 0 ? hw : 1);
+  std::vector<int32_t> overflow(n_threads, -1);
+  auto work = [&](int t0) {
+    std::vector<int64_t> starts(static_cast<size_t>(bucket) + 1);
+    std::vector<int32_t> col(static_cast<size_t>(B));
+    for (int32_t f = t0; f < F; f += n_threads) {
+      for (int64_t b = 0; b < B; ++b) col[b] = ids[b * F + f];
+      std::fill(starts.begin(), starts.end(), 0);
+      for (int64_t b = 0; b < B; ++b) ++starts[col[b] + 1];
+      for (int64_t i = 0; i < bucket; ++i) starts[i + 1] += starts[i];
+      int32_t* ord = order + static_cast<int64_t>(f) * B;
+      for (int64_t b = 0; b < B; ++b)
+        ord[starts[col[b]]++] = static_cast<int32_t>(b);
+      int32_t* us = useg + static_cast<int64_t>(f) * cap;
+      int32_t* ss = segstart + static_cast<int64_t>(f) * cap;
+      int32_t* se = segend + static_cast<int64_t>(f) * cap;
+      int32_t* iv = inv + static_cast<int64_t>(f) * B;
+      int64_t s = -1;
+      int32_t prev = -1;
+      for (int64_t p = 0; p < B; ++p) {
+        int32_t b0 = ord[p];
+        int32_t id = col[b0];
+        if (id != prev || s < 0) {
+          ++s;
+          if (s >= cap) {
+            overflow[t0] = f;
+            return;  // this worker stops; other fields' output unused
+          }
+          us[s] = id;
+          ss[s] = static_cast<int32_t>(p);
+          if (s > 0) se[s - 1] = static_cast<int32_t>(p - 1);
+          prev = id;
+        }
+        iv[b0] = static_cast<int32_t>(s);
+      }
+      if (s >= 0) se[s] = static_cast<int32_t>(B - 1);
+      const int32_t pad = B > 0 ? static_cast<int32_t>(B - 1) : 0;
+      for (int64_t p = s + 1; p < cap; ++p) {
+        us[p] = (INT32_MAX - cap) + static_cast<int32_t>(p - (s + 1));
+        ss[p] = pad;
+        se[p] = pad;
+      }
+    }
+  };
+  if (n_threads <= 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) threads.emplace_back(work, t);
+    for (auto& th : threads) th.join();
+  }
+  for (int t = 0; t < n_threads; ++t)
+    if (overflow[t] >= 0) return overflow[t];
+  return -1;
+}
+
 }  // extern "C"

@@ -151,6 +151,125 @@ def dedup_aux(ids):
     return out
 
 
+def compact_aux(ids, cap: int):
+    """HOST-side aux for the COMPACT sparse-update path on a ``[B, F]``
+    id batch: unlike :func:`dedup_aux` (which keeps ``B`` scatter lanes
+    and only masks duplicates), this compacts each field's unique ids
+    into a STATIC capacity ``cap`` so the device touches the big table
+    with ``cap`` lanes instead of ``B``.
+
+    Why it wins (bench_micro.py ``compact``, measured on chip round 2):
+    XLA's scatter cost is per-LANE even for dropped/duplicate lanes, so
+    the only way to make the update cheaper is fewer lanes; and a
+    unique+sorted cap-lane scatter is ~3x cheaper than the B-lane
+    scatter-add at the headline shapes. The per-lane segment reduction
+    that dedup needs is restructured as one ``cumsum`` over the sorted
+    deltas plus cap-lane boundary gathers — no B-lane scatter anywhere.
+
+    Returns ``(useg, segstart, segend, order, inv)``, all int32:
+
+    - ``useg``     [F, cap] — each field's unique ids, ascending, padded
+                   with DISTINCT ascending out-of-range sentinels (so the
+                   index vector is globally unique AND sorted — XLA's
+                   ``unique_indices``/``indices_are_sorted`` promises
+                   hold; dropped via scatter ``mode="drop"``);
+    - ``segstart`` [F, cap] — first sorted-lane index of each segment
+                   (padding: ``B - 1``, harmless — its result lanes are
+                   dropped);
+    - ``segend``   [F, cap] — last sorted-lane index of each segment;
+    - ``order``    [F, B] — per-field stable argsort of the ids;
+    - ``inv``      [F, B] — segment index of each ORIGINAL lane (the
+                   forward expansion map: ``rows = urows[inv]``).
+
+    Raises if any field's unique count exceeds ``cap`` (pick ``cap``
+    from the data: max per-field per-batch unique ids; Zipf-skewed CTR
+    fields run ~10-25% of B).
+    """
+    import numpy as np
+
+    ids = np.asarray(ids)
+    if ids.ndim != 2:
+        raise ValueError("compact_aux expects [B, F] ids")
+    b, f = ids.shape
+    if cap < 1 or cap > max(b, 1):
+        raise ValueError(f"cap must be in [1, B], got {cap} (B={b})")
+    if b and ids.min() < 0:
+        raise ValueError("compact_aux requires non-negative ids")
+    imax = np.iinfo(np.int32).max
+    if b and int(ids.max()) >= imax - cap:
+        raise ValueError("id space collides with the sentinel range")
+
+    from fm_spark_tpu import native
+
+    nat = native.compact_aux_native(ids, cap)
+    if nat is not None:
+        return nat
+
+    useg = np.zeros((f, cap), np.int32)
+    segstart = np.full((f, cap), max(b - 1, 0), np.int32)
+    segend = np.full((f, cap), max(b - 1, 0), np.int32)
+    order = np.argsort(ids, axis=0, kind="stable").astype(np.int32).T
+    inv = np.zeros((f, b), np.int32)
+    sentinel = (imax - cap) + np.arange(cap, dtype=np.int32)
+    for j in range(f):
+        sid = ids[order[j], j]
+        u, first = (np.unique(sid, return_index=True) if b
+                    else (np.empty(0, np.int32), np.empty(0, np.int64)))
+        s = u.size
+        if s > cap:
+            raise ValueError(
+                f"field {j}: {s} unique ids > compact cap {cap}; raise "
+                "compact_cap (it must bound the per-field per-batch "
+                "unique-id count)"
+            )
+        useg[j, :s] = u
+        useg[j, s:] = sentinel[: cap - s]
+        segstart[j, :s] = first
+        segend[j, :s] = np.r_[first[1:] - 1, b - 1] if s else []
+        seg_of_sorted = np.cumsum(
+            np.r_[0, (sid[1:] != sid[:-1]).astype(np.int32)]
+        ) if b else np.empty(0, np.int64)
+        inv[j, order[j]] = seg_of_sorted
+    return useg, segstart, segend, order, inv
+
+
+def compact_gather(table, useg):
+    """Forward half of the compact path: gather each unique id's row
+    once — ``cap`` ascending lanes against the big table (sentinels clip
+    to the last row; those rows are never referenced by ``inv``).
+    Per-lane rows are then ``urows[inv]`` against this [cap, w] buffer,
+    which gathers at the small-operand fast rate (PERF.md fact 2)."""
+    return table.at[useg].get(mode="clip", indices_are_sorted=True)
+
+
+def compact_apply(table, delta, caux, mode, key, urows):
+    """Update half of the compact path (see :func:`compact_aux`): per-
+    segment sums via one fp32 ``cumsum`` over the sorted deltas + cap-
+    lane boundary gathers (``sum[s] = csum[end_s] - csum[start_s] +
+    sdelta[start_s]`` — exact per segment, no cross-segment residue
+    beyond the cumsum's own log-depth rounding), then ONE write per
+    unique id: ``add`` for ``dedup``, stochastic-rounded ``set`` of
+    ``urows + sum`` for ``dedup_sr`` (``urows`` doubles as the old-row
+    operand — no second gather)."""
+    useg, segstart, segend, order, inv = caux
+    del inv
+    sdelta = delta[order].astype(jnp.float32)
+    csum = jnp.cumsum(sdelta, axis=0)
+    segsum = csum[segend] - csum[segstart] + sdelta[segstart]
+    if mode == "dedup":
+        return table.at[useg].add(
+            segsum.astype(table.dtype), mode="drop",
+            unique_indices=True, indices_are_sorted=True,
+        )
+    if key is None or urows is None:
+        raise ValueError("dedup_sr needs key= and urows=")
+    new_rows = urows.astype(jnp.float32) + segsum
+    return table.at[useg].set(
+        stochastic_round(new_rows, table.dtype, key), mode="drop",
+        unique_indices=True, indices_are_sorted=True,
+    )
+
+
 def _aux_apply(table, delta, aux, mode, key, old_rows):
     """Segment-sum + unique-target write from host-precomputed ``aux``
     (see :func:`dedup_aux`; per-field [B] slices here). No device sort,

@@ -47,9 +47,17 @@ def _sr_base_key(config: TrainConfig):
     return jax.random.key(config.seed + 0x5EED)
 
 
-def _check_host_dedup(config: TrainConfig):
+def _check_host_dedup(config: TrainConfig, allow_compact: bool = False):
     """Shared host_dedup preconditions for every fused body (single
     definition so the three factories can never drift)."""
+    if config.compact_cap > 0:
+        if not config.host_dedup:
+            raise ValueError("compact_cap requires host_dedup=True")
+        if not allow_compact:
+            raise ValueError(
+                "compact_cap is implemented for the FieldFM fused step "
+                "only (FFM/DeepFM keep the full-B aux path)"
+            )
     if not config.host_dedup:
         return
     if config.sparse_update not in ("dedup", "dedup_sr"):
@@ -122,7 +130,10 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     if config.use_pallas and not spec.fused_linear:
         raise ValueError("use_pallas requires fused_linear=True")
-    _check_host_dedup(config)
+    _check_host_dedup(config, allow_compact=True)
+    compact = config.compact_cap > 0
+    if compact and not spec.fused_linear:
+        raise ValueError("compact_cap requires fused_linear=True")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -138,7 +149,20 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        if spec.fused_linear:
+        urows = None
+        if compact:
+            # COMPACT path: cap unique rows per field from the big
+            # tables, per-lane rows expanded from the small buffers
+            # (the [B]-lane work never touches table-sized operands).
+            from fm_spark_tpu.ops import scatter as scatter_lib
+
+            useg, inv = aux[0], aux[4]
+            urows = [
+                scatter_lib.compact_gather(params["vw"][f], useg[f])
+                for f in range(F)
+            ]
+            rows = [u.astype(cd)[inv[f]] for f, u in enumerate(urows)]
+        elif spec.fused_linear:
             rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, k+1]
         else:
             rows = spec.gather_rows(params, ids)        # F × [B, width]
@@ -189,10 +213,28 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                     else jnp.zeros((dscores.shape[0], 1), cd)
                 )
                 g_fulls.append(jnp.concatenate([factor_grad(f), g_lin], axis=1))
-            new_vw = _apply_field_updates(
-                params["vw"], ids, g_fulls, rows, config, sr_base_key,
-                step_idx, lr, aux=aux,
-            )
+            if compact:
+                from fm_spark_tpu.ops import scatter as scatter_lib
+
+                new_vw = []
+                for f in range(F):
+                    key = (
+                        scatter_lib.sr_key(sr_base_key, step_idx, f)
+                        if config.sparse_update == "dedup_sr"
+                        else None
+                    )
+                    new_vw.append(
+                        scatter_lib.compact_apply(
+                            params["vw"][f], -lr * g_fulls[f],
+                            tuple(a[f] for a in aux),
+                            config.sparse_update, key, urows[f],
+                        )
+                    )
+            else:
+                new_vw = _apply_field_updates(
+                    params["vw"], ids, g_fulls, rows, config, sr_base_key,
+                    step_idx, lr, aux=aux,
+                )
             out = {"w0": w0, "vw": new_vw}
         else:
             new_v = [

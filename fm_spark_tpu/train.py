@@ -63,6 +63,17 @@ class TrainConfig:
     # Requires a dedup sparse_update mode; the fused FieldFM step then
     # takes a trailing ``aux`` operand.
     host_dedup: bool = False
+    # COMPACT host-dedup (round-2 on-chip finding: XLA charges scatter
+    # per LANE, dropped or not, so masking duplicates can't win — only
+    # fewer lanes can). When > 0, the host aux compacts each field's
+    # unique ids into this static capacity (ops/scatter.compact_aux) and
+    # the device touches the big tables with ``compact_cap`` lanes
+    # instead of B: unique rows gathered once, per-lane rows expanded
+    # from the [cap, w] buffer, segment sums via one cumsum (no B-lane
+    # scatter), one unique+sorted write per id. Must bound the per-field
+    # per-batch unique-id count (the aux builder raises otherwise).
+    # Requires host_dedup=True and a dedup sparse_update mode.
+    compact_cap: int = 0
 
 
 def _group_reg(config: TrainConfig):

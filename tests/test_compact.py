@@ -1,0 +1,225 @@
+"""COMPACT host-dedup (`TrainConfig.compact_cap`): the cap-lane path —
+unique-row gather, inv expansion, cumsum segment sums, one unique+sorted
+write per id — must match the scatter_add step up to fp32 reassociation
+(the cumsum reorders the additions, so equality is allclose, not
+bitwise; everything else in the step is identical math).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.ops.scatter import compact_aux
+from fm_spark_tpu.sparse import (
+    make_field_sparse_multistep,
+    make_field_sparse_sgd_body,
+    make_field_sparse_sgd_step,
+)
+from fm_spark_tpu.train import TrainConfig
+
+F, BUCKET, K, B, CAP = 5, 64, 4, 48, 48
+
+
+def _batch(rng, b=B, f=F, bucket=BUCKET):
+    ids = rng.integers(0, bucket, size=(b, f)).astype(np.int32)
+    ids[:, 0] = rng.integers(0, 3, b)          # heavy duplication
+    vals = rng.normal(size=(b, f)).astype(np.float32)
+    labels = rng.integers(0, 2, b).astype(np.float32)
+    weights = np.ones(b, np.float32)
+    weights[::7] = 0.0                          # inert rows
+    return ids, vals, labels, weights
+
+
+def _spec(**kw):
+    kw.setdefault("param_dtype", "float32")
+    return models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, **kw
+    )
+
+
+def test_compact_aux_semantics(rng):
+    ids = rng.integers(0, 17, size=(40, 3)).astype(np.int32)
+    cap = 24
+    useg, segstart, segend, order, inv = compact_aux(ids, cap)
+    assert useg.shape == segstart.shape == segend.shape == (3, cap)
+    assert order.shape == inv.shape == (3, 40)
+    for f in range(3):
+        uniq = np.unique(ids[:, f])
+        s = uniq.size
+        np.testing.assert_array_equal(useg[f, :s], uniq)
+        # Padding: distinct ascending out-of-range sentinels — the whole
+        # vector stays sorted and unique (the XLA scatter promises).
+        assert (np.diff(useg[f].astype(np.int64)) > 0).all()
+        assert (useg[f, s:] >= np.iinfo(np.int32).max - cap).all()
+        sid = ids[order[f], f]
+        np.testing.assert_array_equal(sid, np.sort(ids[:, f]))
+        for seg in range(s):
+            lo, hi = segstart[f, seg], segend[f, seg]
+            assert (sid[lo : hi + 1] == useg[f, seg]).all()
+            if hi + 1 < 40:
+                assert sid[hi + 1] != useg[f, seg]
+        # inv maps each original lane to its id's segment.
+        np.testing.assert_array_equal(useg[f, inv[f]], ids[:, f])
+
+
+def test_compact_aux_overflow_raises(rng):
+    ids = rng.integers(0, 40, size=(64, 2)).astype(np.int32)
+    with pytest.raises(ValueError, match="compact cap"):
+        compact_aux(ids, 4)
+
+
+def test_compact_aux_native_matches_numpy(rng):
+    from fm_spark_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native library unavailable: {native.build_error()}")
+    ids = (rng.zipf(1.3, size=(257, 7)) % 50).astype(np.int32)
+    ids[:, 3] = 5  # constant field
+    got = native.compact_aux_native(ids, 128)
+    assert got is not None
+    import unittest.mock as mock
+
+    with mock.patch.object(native, "compact_aux_native", lambda *a: None):
+        want = compact_aux(ids, 128)
+    names = ("useg", "segstart", "segend", "order", "inv")
+    for g, w, name in zip(got, want, names):
+        np.testing.assert_array_equal(g, w, err_msg=name)
+    with pytest.raises(ValueError, match="compact cap"):
+        native.compact_aux_native(ids, 4)
+
+
+def _run_pair(rng, cfg_kw=None, spec_kw=None, step_idx=3):
+    ids, vals, labels, weights = _batch(rng)
+    spec = _spec(**(spec_kw or {}))
+    params = spec.init(jax.random.key(1))
+    base = dict(learning_rate=0.05, optimizer="sgd",
+                reg_factors=1e-4, reg_linear=1e-4)
+    base.update(cfg_kw or {})
+    ref_step = make_field_sparse_sgd_step(spec, TrainConfig(**base))
+    cmp_step = make_field_sparse_sgd_step(
+        spec,
+        TrainConfig(**base, sparse_update="dedup", host_dedup=True,
+                    compact_cap=CAP),
+    )
+    aux = tuple(jnp.asarray(a) for a in compact_aux(ids, CAP))
+    args = (jnp.int32(step_idx), jnp.asarray(ids), jnp.asarray(vals),
+            jnp.asarray(labels), jnp.asarray(weights))
+    p_ref, l_ref = ref_step(jax.tree.map(jnp.copy, params), *args)
+    p_cmp, l_cmp = cmp_step(params, *args, aux)
+    return p_ref, l_ref, p_cmp, l_cmp
+
+
+def test_compact_step_matches_scatter_add(rng):
+    p_ref, l_ref, p_cmp, l_cmp = _run_pair(rng)
+    assert float(l_ref) == float(l_cmp)  # same forward math
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7),
+        p_ref, p_cmp,
+    )
+
+
+def test_compact_dedup_sr_fp32_matches_dedup(rng):
+    """For fp32 tables SR is the identity, and set(urows + sum) must hit
+    the same values as add(sum) bitwise — pins the urows plumbing."""
+    ids, vals, labels, weights = _batch(rng)
+    spec = _spec()
+    params = spec.init(jax.random.key(2))
+    mk = lambda su: make_field_sparse_sgd_step(
+        spec,
+        TrainConfig(learning_rate=0.05, optimizer="sgd", sparse_update=su,
+                    host_dedup=True, compact_cap=CAP),
+    )
+    aux = tuple(jnp.asarray(a) for a in compact_aux(ids, CAP))
+    args = (jnp.int32(0), jnp.asarray(ids), jnp.asarray(vals),
+            jnp.asarray(labels), jnp.asarray(weights))
+    p_a, _ = mk("dedup")(jax.tree.map(jnp.copy, params), *args, aux)
+    p_b, _ = mk("dedup_sr")(params, *args, aux)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), p_a, p_b
+    )
+
+
+def test_compact_bf16_sr_learns(rng):
+    """bf16 + compact dedup_sr: loss decreases over a few steps (the
+    quality envelope itself is pinned by bench_quality/QUALITY.md)."""
+    ids, vals, labels, weights = _batch(rng, b=256)
+    spec = _spec(param_dtype="bfloat16")
+    params = spec.init(jax.random.key(3))
+    step = make_field_sparse_sgd_step(
+        spec,
+        TrainConfig(learning_rate=0.3, lr_schedule="constant",
+                    optimizer="sgd", sparse_update="dedup_sr",
+                    host_dedup=True, compact_cap=B_CAP256),
+    )
+    aux = tuple(jnp.asarray(a) for a in compact_aux(ids, B_CAP256))
+    losses = []
+    for i in range(25):
+        params, loss = step(params, jnp.int32(i), jnp.asarray(ids),
+                            jnp.asarray(vals), jnp.asarray(labels),
+                            jnp.asarray(weights), aux)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.01
+
+
+B_CAP256 = 128
+
+
+def test_compact_multistep_matches_single(rng):
+    """compact aux stacks on the leading axis like every other batch
+    leaf; N fori_loop steps == N separate calls."""
+    spec = _spec()
+    cfg = TrainConfig(learning_rate=0.05, optimizer="sgd",
+                      sparse_update="dedup", host_dedup=True,
+                      compact_cap=CAP)
+    params = spec.init(jax.random.key(4))
+    batches = []
+    for _ in range(3):
+        ids, vals, labels, weights = _batch(rng)
+        aux = compact_aux(ids, CAP)
+        batches.append((ids, vals, labels, weights, aux))
+
+    single = make_field_sparse_sgd_step(spec, cfg)
+    p1 = jax.tree.map(jnp.copy, params)
+    for j, (ids, vals, labels, weights, aux) in enumerate(batches):
+        p1, _ = single(p1, jnp.int32(j), jnp.asarray(ids),
+                       jnp.asarray(vals), jnp.asarray(labels),
+                       jnp.asarray(weights),
+                       tuple(jnp.asarray(a) for a in aux))
+
+    mstep = make_field_sparse_multistep(spec, cfg, 3)
+    stack = lambda xs: jnp.asarray(np.stack(xs))
+    ids_s = stack([b[0] for b in batches])
+    vals_s = stack([b[1] for b in batches])
+    labels_s = stack([b[2] for b in batches])
+    weights_s = stack([b[3] for b in batches])
+    aux_s = tuple(
+        stack([b[4][i] for b in batches]) for i in range(5)
+    )
+    p2, _ = mstep(params, jnp.int32(0), jnp.int32(3), ids_s, vals_s,
+                  labels_s, weights_s, aux_s)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), p1, p2
+    )
+
+
+def test_compact_validation():
+    spec = _spec()
+    with pytest.raises(ValueError, match="host_dedup"):
+        make_field_sparse_sgd_body(
+            spec, TrainConfig(optimizer="sgd", sparse_update="dedup",
+                              compact_cap=8)
+        )
+    from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_body
+
+    ffm = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    with pytest.raises(ValueError, match="FieldFM"):
+        make_field_ffm_sparse_sgd_body(
+            ffm, TrainConfig(optimizer="sgd", sparse_update="dedup",
+                             host_dedup=True, compact_cap=8)
+        )
