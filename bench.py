@@ -90,11 +90,12 @@ def inner_main(args):
     steps_warmup = 3
     steps_timed = args.steps
 
-    def make_spec(param_dtype):
+    def make_spec(param_dtype, compute_dtype=None):
         return models.FieldFMSpec(
             num_features=num_fields * bucket, rank=rank,
             num_fields=num_fields, bucket=bucket, init_std=0.01,
             param_dtype=param_dtype,
+            compute_dtype=compute_dtype or args.compute_dtype,
         )
 
     rng = np.random.default_rng(0)
@@ -112,14 +113,16 @@ def inner_main(args):
     # default frozen before the chip could confirm it.
     explicit = (args.sparse_update != "scatter_add" or args.use_pallas
                 or args.host_dedup or args.param_dtype != "float32"
+                or args.compute_dtype != "float32"
                 or args.rank != 64 or args.batch != 1 << 17
                 or args.steps != 20 or args.compact_cap)
     variants = [(
         f"{args.param_dtype}/{args.sparse_update}"
         + ("/pallas" if args.use_pallas else "")
         + (f"/compact{args.compact_cap}" if args.compact_cap
-           else "/hostdedup" if args.host_dedup else ""),
-        args.param_dtype,
+           else "/hostdedup" if args.host_dedup else "")
+        + ("/cd-bf16" if args.compute_dtype == "bfloat16" else ""),
+        (args.param_dtype, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
                     use_pallas=args.use_pallas, host_dedup=args.host_dedup,
@@ -134,18 +137,29 @@ def inner_main(args):
         cap = min(16384, batch)
         for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
             variants.append((
-                f"{dt}/{su}/compact{cap}", dt,
+                f"{dt}/{su}/compact{cap}", (dt, None),
                 TrainConfig(learning_rate=0.05, lr_schedule="constant",
                             optimizer="sgd", sparse_update=su,
                             host_dedup=True, compact_cap=cap),
             ))
+        # bf16 COMPUTE buffers on top of the compact bf16 path (the
+        # [B, w] forward/backward passes halve their bytes; reductions
+        # and the segment cumsum stay fp32 — quality pinned by
+        # bench_quality.py's bf16_compact_cdbf16 variant).
+        variants.append((
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
+            ("bfloat16", "bfloat16"),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap),
+        ))
 
     import functools
 
     aux_cache = {}
     results = []
-    for label, param_dtype, config in variants:
-        spec = make_spec(param_dtype)
+    for label, dtypes, config in variants:
+        spec = make_spec(*dtypes)
         body = make_field_sparse_sgd_body(spec, config)
         aux = None
         if config.host_dedup:
@@ -278,6 +292,11 @@ def main():
                     help="internal: run the measurement in-process")
     ap.add_argument("--param-dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--compute-dtype", default="float32",
+                    dest="compute_dtype",
+                    choices=["float32", "bfloat16"],
+                    help="forward/backward buffer dtype (the [B, w] "
+                         "passes; storage stays --param-dtype)")
     ap.add_argument("--sparse-update", default="scatter_add",
                     choices=["scatter_add", "dedup", "dedup_sr"])
     ap.add_argument("--use-pallas", action="store_true", dest="use_pallas",
@@ -314,6 +333,7 @@ def main():
     # Re-build the child argv from the variant knobs only.
     argv = [
         "--param-dtype", args.param_dtype,
+        "--compute-dtype", args.compute_dtype,
         "--sparse-update", args.sparse_update,
         "--rank", str(args.rank),
         "--batch", str(args.batch),

@@ -43,6 +43,33 @@ def _fence(x):
     return float(jnp.ravel(x)[0])
 
 
+def _make_timed(prefix, base_cfg, unit):
+    """Shared timing protocol for every probe: jit the thunk, run once
+    (compile + warmup), time one fenced run, emit one JSON line. Single
+    definition so a protocol change (extra warmup, median-of-N) lands in
+    every probe at once. The jitted fn must RETURN everything it touches
+    (nothing may be DCE'd)."""
+    import jax
+
+    def timed(name, fn, *xs, extra=None):
+        f = jax.jit(fn)
+
+        def run():
+            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
+
+        run()  # compile
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        cfg = dict(base_cfg)
+        if extra:
+            cfg.update(extra)
+        _out(f"{prefix}_{name}", cfg, dt * 1e3, unit)
+        return dt
+
+    return timed
+
+
 def bench_dispatch(args):
     import jax
     import jax.numpy as jnp
@@ -223,22 +250,12 @@ def bench_dedup(args):
     seg_dev = jnp.asarray(seg_np)
     useg = jnp.asarray(useg_np)
 
-    def timed(name, fn, *xs, extra=None):
-        f = jax.jit(fn)  # returns ALL tables — nothing is DCE'd
-
-        def run():
-            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
-
-        run()  # compile
-        t0 = time.perf_counter()
-        run()
-        dt = time.perf_counter() - t0
-        cfg = {"fields": F, "rows": rows, "width": width, "batch": b,
-               "uniq_frac": round(float(uniq_frac), 3)}
-        if extra:
-            cfg.update(extra)
-        _out(f"dedup_{name}", cfg, dt * 1e3, "ms/step-equivalent")
-        return dt
+    timed = _make_timed(
+        "dedup",
+        {"fields": F, "rows": rows, "width": width, "batch": b,
+         "uniq_frac": round(float(uniq_frac), 3)},
+        "ms/step-equivalent",
+    )
 
     def scatter_all(ts, idx):
         return [t.at[idx[:, f]].add(upd, mode="drop")
@@ -299,21 +316,10 @@ def bench_split(args):
     )
     upd = jnp.full((b, width), 1e-3, jnp.float32)
 
-    def timed(name, fn, *xs, extra=None):
-        f = jax.jit(fn)
-
-        def run():
-            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
-
-        run()  # compile
-        t0 = time.perf_counter()
-        run()
-        dt = time.perf_counter() - t0
-        cfg = {"fields": F, "rows": rows, "width": width, "batch": b}
-        if extra:
-            cfg.update(extra)
-        _out(f"split_{name}", cfg, dt * 1e3, "ms/step-equivalent")
-        return dt
+    timed = _make_timed(
+        "split", {"fields": F, "rows": rows, "width": width, "batch": b},
+        "ms/step-equivalent",
+    )
 
     for s in (1, 2, 4):
         half = rows // s
@@ -384,6 +390,7 @@ def bench_compact(args):
 
     F, rows, width, b = args.tables, args.rows, args.width + 1, args.n_idx
     cap = args.cap
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     rng = np.random.default_rng(0)
     ids_np = (rng.zipf(1.3, size=(b, F)) % rows).astype(np.int32)
     nu = max(np.unique(ids_np[:, f]).size for f in range(F))
@@ -404,58 +411,68 @@ def bench_compact(args):
     segstart = jnp.asarray(segstart_np)
     inv = jnp.asarray(inv_np.T)
     ids = jnp.asarray(ids_np)
-    tables = [jnp.zeros((rows, width), jnp.float32) for _ in range(F)]
+    tables = [jnp.zeros((rows, width), dtype) for _ in range(F)]
     delta = jnp.full((b, width), 1e-3, jnp.float32)
 
-    def timed(name, fn, *xs, extra=None):
-        f = jax.jit(fn)
-
-        def run():
-            return _fence(jax.tree_util.tree_leaves(f(*xs))[0])
-
-        run()
-        t0 = time.perf_counter()
-        run()
-        dt = time.perf_counter() - t0
-        cfg = {"fields": F, "rows": rows, "width": width, "batch": b,
-               "cap": cap, "max_unique": int(nu)}
-        if extra:
-            cfg.update(extra)
-        _out(f"compact_{name}", cfg, dt * 1e3, "ms/step-equivalent")
-        return dt
+    timed = _make_timed(
+        "compact",
+        {"fields": F, "rows": rows, "width": width, "batch": b,
+         "cap": cap, "max_unique": int(nu), "dtype": args.dtype},
+        "ms/step-equivalent",
+    )
 
     def baseline_chain(ts, idx):
         out = []
         for f, t in enumerate(ts):
-            r = t[idx[:, f]]
-            out.append(t.at[idx[:, f]].add(r * 1e-4 + delta, mode="drop"))
+            r = t[idx[:, f]].astype(jnp.float32)
+            out.append(t.at[idx[:, f]].add(
+                (r * 1e-4 + delta).astype(t.dtype), mode="drop"))
         return out
 
     timed("baseline_gather_scatter", baseline_chain, tables, ids)
 
-    def compact_chain(ts, useg, inv, order, segend, segstart):
+    def compact_chain(ts, useg, inv, order, segend, segstart, skip=()):
+        # ``skip`` disables pieces so their marginal cost can be
+        # bracketed on chip: 'expand' (per-lane row expansion),
+        # 'reorder' (the delta[order] gather), 'cumsum' (the segment
+        # reduction).
         out = []
         for f, t in enumerate(ts):
             u = useg[f]
             urows = t[jnp.clip(u, 0, rows - 1)]        # cap sorted lanes
-            r = urows[inv[:, f]]                       # B lanes, tiny buf
-            d = r * 1e-4 + delta                       # stand-in backward
-            sdelta = d[order[:, f]]                    # B lanes, tiny buf
-            csum = jnp.cumsum(sdelta, axis=0)
-            lo = csum[segstart[f]] - sdelta[segstart[f]]
-            segsum = csum[segend[f]] - lo              # exact per-segment
+            if "expand" in skip:
+                d = delta
+            else:
+                r = urows[inv[:, f]]                   # B lanes, tiny buf
+                d = r.astype(jnp.float32) * 1e-4 + delta
+            sdelta = d if "reorder" in skip else d[order[:, f]]
+            if "cumsum" in skip:
+                segsum = sdelta[segstart[f]]
+            else:
+                csum = jnp.cumsum(sdelta, axis=0)
+                lo = csum[segstart[f]] - sdelta[segstart[f]]
+                segsum = csum[segend[f]] - lo          # exact per-segment
             out.append(
-                t.at[u].add(segsum, mode="drop",
+                t.at[u].add(segsum.astype(t.dtype), mode="drop",
                             unique_indices=True, indices_are_sorted=True)
             )
         return out
 
     timed("chain", compact_chain, tables, useg, inv, order, segend,
           segstart)
+    import functools
+
+    for piece in ("expand", "reorder", "cumsum"):
+        timed(
+            f"chain_minus_{piece}",
+            functools.partial(compact_chain, skip=(piece,)),
+            tables, useg, inv, order, segend, segstart,
+            extra={"skipped": piece},
+        )
 
     def compact_scatter_only(ts, useg):
         return [
-            t.at[useg[f]].add(jnp.ones((cap, width), jnp.float32),
+            t.at[useg[f]].add(jnp.ones((cap, width), t.dtype),
                               mode="drop", unique_indices=True,
                               indices_are_sorted=True)
             for f, t in enumerate(ts)
@@ -465,10 +482,268 @@ def bench_compact(args):
           useg)
 
     def compact_gather_only(ts, useg):
-        return [jnp.sum(t[jnp.clip(useg[f], 0, rows - 1)])
+        return [jnp.sum(t[jnp.clip(useg[f], 0, rows - 1)]
+                        .astype(jnp.float32))
                 for f, t in enumerate(ts)]
 
     timed("gather_cap_only", compact_gather_only, tables, useg)
+
+
+def bench_cumsum(args):
+    """The compact chain's cumsum is its biggest removable piece (~46ms
+    of the 127ms bf16 chain — `compact` probe, chain vs chain_minus_
+    cumsum). This probe isolates how the prefix cost responds to width
+    (TPU minor-dim lane padding: widths 1..128 should cost the SAME
+    physical bandwidth), dtype, orientation, and the blocked two-level
+    formulation, plus the totals-only lower bound (one read pass).
+    Shapes: 39 x [131072, w] like the headline backward buffers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    F, b = args.tables, args.n_idx
+    timed = _make_timed("cumsum", {"fields": F, "batch": b},
+                        "ms/39-field")
+
+    for w, dt_ in ((65, jnp.float32), (64, jnp.float32),
+                   (128, jnp.float32), (33, jnp.float32),
+                   (65, jnp.bfloat16)):
+        xs = [jnp.full((b, w), 1e-3, dt_) for _ in range(F)]
+        timed(
+            f"w{w}_{dt_.__name__}",
+            lambda ts: [jnp.cumsum(t, axis=0) for t in ts], xs,
+            extra={"width": w, "dtype": dt_.__name__},
+        )
+
+    xs65 = [jnp.full((b, 65), 1e-3, jnp.float32) for _ in range(F)]
+    # Totals-only lower bound: one read pass, [w] out per field.
+    timed("sum_only_w65", lambda ts: [jnp.sum(t, axis=0) for t in ts],
+          xs65, extra={"width": 65, "dtype": "float32"})
+
+    # Blocked two-level prefix: per-block local cumsum -> tiny cumsum of
+    # block totals -> add offsets. Same output as cumsum.
+    blk = 512
+
+    def blocked(ts):
+        out = []
+        for t in ts:
+            r = t.reshape(b // blk, blk, -1)
+            bl = jnp.cumsum(r, axis=1)
+            off = jnp.cumsum(bl[:, -1, :], axis=0)
+            off = jnp.concatenate(
+                [jnp.zeros_like(off[:1]), off[:-1]], axis=0
+            )
+            out.append((bl + off[:, None, :]).reshape(b, -1))
+        return out
+
+    timed("blocked512_w65", blocked, xs65,
+          extra={"width": 65, "dtype": "float32"})
+
+    # Transposed orientation: prefix along the LANE-major axis.
+    xsT = [jnp.full((65, b), 1e-3, jnp.float32) for _ in range(F)]
+    timed("transposed_w65",
+          lambda ts: [jnp.cumsum(t, axis=1) for t in ts],
+          xsT, extra={"width": 65, "dtype": "float32", "layout": "[w,B]"})
+
+
+def bench_merge(args):
+    """Is the compact chain's per-field gather/scatter cost a FIXED
+    per-op overhead (x39 fields) rather than per-lane or per-byte? The
+    `compact` probe measured ~1.7ms/table for a 16k-lane cap-gather —
+    barely cheaper than 131k lanes — suggesting op-count or table-scan
+    cost, not lane count, is what the cap path still pays. If per-op,
+    ONE gather over a stacked monolith at cap*F lanes should crush 39
+    per-field gathers even at the monolith's slow per-lane rate.
+    Scatter is probed both ways too — the >128MB operand cliff (fact 3)
+    predicts the merged scatter LOSES; per-field writes should stay.
+
+    Index construction: the monolith has ``cap`` PADDING rows appended
+    per field (shape [(rows+cap)*F, w]); field f's real ids live at
+    ``f*(rows+cap) + id`` and its sentinel lanes map to the padding
+    rows ``f*(rows+cap) + rows + s`` — so the flattened index vector is
+    genuinely ascending AND unique (both XLA promises hold; padding
+    rows absorb the sentinel writes, which is timing-equivalent to
+    dropping them).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, rows, width = args.tables, args.rows, args.width + 1
+    cap = args.cap
+    b = args.n_idx
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    rng = np.random.default_rng(0)
+    ids_np = (rng.zipf(1.3, size=(b, F)) % rows).astype(np.int32)
+    from fm_spark_tpu.ops.scatter import compact_aux
+
+    useg_np = compact_aux(ids_np, cap)[0]             # [F, cap]
+    useg = jnp.asarray(useg_np)
+    stride = rows + cap
+    sent = useg_np >= rows                             # sentinel lanes
+    within = np.where(
+        sent,
+        rows + (useg_np.argsort(axis=1).argsort(axis=1)),  # stable slots
+        useg_np,
+    )
+    # Per-field ascending (real ids ascend below rows; sentinel slots
+    # ascend from rows), plus field-major strides => globally ascending
+    # and unique.
+    gids = jnp.asarray(
+        (within + (np.arange(F)[:, None] * stride)).astype(np.int32)
+        .reshape(-1)
+    )
+    tables = [jnp.zeros((rows, width), dtype) for _ in range(F)]
+    mono = jnp.zeros((F * stride, width), dtype)
+    upd = jnp.full((F * cap, width), 1e-3, jnp.float32)
+
+    timed = _make_timed(
+        "merge",
+        {"fields": F, "rows": rows, "width": width, "cap": cap,
+         "dtype": args.dtype},
+        "ms",
+    )
+
+    timed("gather_per_field",
+          lambda ts, u: [t[jnp.clip(u[f], 0, rows - 1)]
+                         for f, t in enumerate(ts)],
+          tables, useg)
+    timed("gather_monolith",
+          lambda m, g: m.at[g].get(mode="clip", indices_are_sorted=True,
+                                   unique_indices=True),
+          mono, gids)
+    timed("scatter_per_field",
+          lambda ts, u: [t.at[u[f]].add(
+              upd[f * cap:(f + 1) * cap].astype(t.dtype), mode="drop",
+              unique_indices=True, indices_are_sorted=True)
+              for f, t in enumerate(ts)],
+          tables, useg)
+    timed("scatter_monolith",
+          lambda m, g: m.at[g].add(upd.astype(m.dtype), mode="drop",
+                                   unique_indices=True,
+                                   indices_are_sorted=True),
+          mono, gids)
+
+
+def bench_stackfuse(args):
+    """Does issuing the chain's buffer work as 39 per-field ops cost
+    more than ONE op over the stacked [39, B, w] array? (It did not on
+    this chip — sum/cumsum/boundary came out equal, refuting the
+    per-fusion-overhead hypothesis; the cost is per-work. Kept so the
+    conclusion stays reproducible.)
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, width, b = args.tables, args.width + 1, args.n_idx
+    cap = args.cap
+    rng = np.random.default_rng(0)
+    xs = [jnp.full((b, width), 1e-3, jnp.float32) for _ in range(F)]
+    xstk = jnp.stack(xs)                              # [F, B, w]
+    small = [jnp.full((cap, width), 1e-3, jnp.float32) for _ in range(F)]
+    smallstk = jnp.stack(small)                       # [F, cap, w]
+    inv = jnp.asarray(rng.integers(0, cap, size=(F, b)), jnp.int32)
+    bnd = jnp.asarray(rng.integers(0, b, size=(F, cap)), jnp.int32)
+
+    timed = _make_timed(
+        "stackfuse",
+        {"fields": F, "batch": b, "width": width, "cap": cap},
+        "ms",
+    )
+
+    timed("sum_per_field",
+          lambda ts: [jnp.sum(t, axis=0) for t in ts], xs)
+    timed("sum_stacked", lambda t: jnp.sum(t, axis=1), xstk)
+    timed("cumsum_per_field",
+          lambda ts: [jnp.cumsum(t, axis=0) for t in ts], xs)
+    timed("cumsum_stacked", lambda t: jnp.cumsum(t, axis=1), xstk)
+    timed("expand_per_field",
+          lambda ss, iv: [s[iv[f]] for f, s in enumerate(ss)],
+          small, inv)
+    timed("expand_stacked",
+          lambda s, iv: jnp.take_along_axis(s, iv[:, :, None], axis=1),
+          smallstk, inv)
+    timed("boundary_per_field",
+          lambda ts, bd: [t[bd[f]] for f, t in enumerate(ts)], xs, bnd)
+    timed("boundary_stacked",
+          lambda t, bd: jnp.take_along_axis(t, bd[:, :, None], axis=1),
+          xstk, bnd)
+
+
+def bench_scanmodel(args):
+    """Pins the round-2 cost model: big-table ops cost ~= stream(operand
+    bytes)/BW + lanes * ~20ns, i.e. gather SCANS the table no matter how
+    few lanes it fetches. Probes (39 fields, headline rows/width):
+
+    - cap-gather at cap in {1024, 16384, B}: flat => scan confirmed;
+    - gather at fp8 / bf16 / fp32 tables: scan cost should track BYTES;
+    - sorted segment_sum into cap segments (tiny [cap, w] operand) vs
+      the cumsum+boundary formulation the chain ships;
+    - cumsum with bf16 INPUT, fp32 accumulation (halves the read side).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, rows, width, b = args.tables, args.rows, args.width + 1, args.n_idx
+    rng = np.random.default_rng(0)
+    timed = _make_timed(
+        "scanmodel", {"fields": F, "rows": rows, "width": width}, "ms",
+    )
+
+    for cap_try in (1024, 16384, min(b, rows)):
+        ids = jnp.asarray(
+            np.sort(rng.choice(rows, size=(F, cap_try))).astype(np.int32),
+            jnp.int32,
+        )
+        tables = [jnp.zeros((rows, width), jnp.bfloat16)
+                  for _ in range(F)]
+        timed(f"gather_cap{cap_try}_bf16",
+              lambda ts, u: [jnp.sum(t[u[f]].astype(jnp.float32))
+                             for f, t in enumerate(ts)],
+              tables, ids, extra={"cap": cap_try, "table_dtype": "bf16"})
+
+    for dt_name in ("float8_e4m3fn", "bfloat16", "float32"):
+        dt_ = getattr(jnp, dt_name)
+        ids = jnp.asarray(
+            np.sort(rng.choice(rows, size=(F, 16384))).astype(np.int32),
+            jnp.int32,
+        )
+        tables = [jnp.zeros((rows, width), dt_) for _ in range(F)]
+        timed(f"gather_cap16384_{dt_name}",
+              lambda ts, u: [jnp.sum(t[u[f]].astype(jnp.float32))
+                             for f, t in enumerate(ts)],
+              tables, ids, extra={"cap": 16384, "table_dtype": dt_name})
+
+    # Segment reduction alternatives at the chain's shapes.
+    cap = args.cap
+    seg = jnp.asarray(
+        np.sort(rng.integers(0, cap, size=(F, b)), axis=1).astype(np.int32)
+    )
+    sdelta = [jnp.full((b, width), 1e-3, jnp.float32) for _ in range(F)]
+
+    timed("segsum_sorted_capsegs",
+          lambda ds, sg: [
+              jax.ops.segment_sum(d, sg[f], num_segments=cap,
+                                  indices_are_sorted=True)
+              for f, d in enumerate(ds)
+          ],
+          sdelta, seg, extra={"cap": cap})
+
+    bnd = jnp.asarray(rng.integers(0, b, size=(F, cap)), jnp.int32)
+    timed("cumsum_boundary_fp32",
+          lambda ds, bd: [
+              jnp.cumsum(d, axis=0)[bd[f]] for f, d in enumerate(ds)
+          ],
+          sdelta, bnd, extra={"cap": cap})
+    sdelta_bf = [d.astype(jnp.bfloat16) for d in sdelta]
+    timed("cumsum_boundary_bf16in",
+          lambda ds, bd: [
+              jnp.cumsum(d, axis=0, dtype=jnp.float32)[bd[f]]
+              for f, d in enumerate(ds)
+          ],
+          sdelta_bf, bnd, extra={"cap": cap})
 
 
 BENCHES = {
@@ -480,6 +755,10 @@ BENCHES = {
     "dedup": bench_dedup,
     "split": bench_split,
     "compact": bench_compact,
+    "cumsum": bench_cumsum,
+    "merge": bench_merge,
+    "stackfuse": bench_stackfuse,
+    "scanmodel": bench_scanmodel,
 }
 
 
@@ -494,6 +773,9 @@ def main():
     ap.add_argument("--rows", type=int, default=1 << 18)
     ap.add_argument("--tables", type=int, default=39)
     ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="compact/merge probes: table storage dtype")
     ap.add_argument("--cap", type=int, default=16384,
                     help="compact probe: static per-field unique-id "
                     "capacity")

@@ -136,7 +136,7 @@ def _jax():
 
 def framework_variant(tr, te, param_dtype="float32",
                       sparse_update="scatter_add", host_dedup=False,
-                      compact_cap=0):
+                      compact_cap=0, compute_dtype="float32"):
     jax = _jax()
     import jax.numpy as jnp
 
@@ -148,7 +148,7 @@ def framework_variant(tr, te, param_dtype="float32",
     spec = models.FieldFMSpec(
         num_features=TASK["num_fields"] * TASK["bucket"], rank=TASK["rank"],
         num_fields=TASK["num_fields"], bucket=TASK["bucket"], init_std=0.05,
-        param_dtype=param_dtype,
+        param_dtype=param_dtype, compute_dtype=compute_dtype,
     )
     config = TrainConfig(
         learning_rate=TRAIN["lr"], lr_schedule="constant", optimizer="sgd",
@@ -192,6 +192,12 @@ VARIANTS = {
     "bf16_dedup_sr_compact": dict(param_dtype="bfloat16",
                                   sparse_update="dedup_sr",
                                   host_dedup=True, compact_cap=128),
+    # bf16 COMPUTE buffers on top of the compact bf16 path (the [B, w]
+    # forward/backward passes in bf16; reductions/cumsum stay fp32).
+    "bf16_compact_cdbf16": dict(param_dtype="bfloat16",
+                                sparse_update="dedup_sr",
+                                host_dedup=True, compact_cap=128,
+                                compute_dtype="bfloat16"),
 }
 
 # The committed protocol budgets (QUALITY.md): fp32-vs-oracle is expected
@@ -205,6 +211,7 @@ BUDGET_VS_FP32 = {
     "bf16_dedup_sr_host": 5e-3,
     "fp32_dedup_compact": 1e-3,
     "bf16_dedup_sr_compact": 5e-3,
+    "bf16_compact_cdbf16": 5e-3,
 }
 
 

@@ -75,12 +75,17 @@ def _load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p,
         ]
-        lib.fm_compact_aux.restype = ctypes.c_int32
-        lib.fm_compact_aux.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ]
+        # Guard newer symbols so a stale-but-fresh-looking .so (cached
+        # artifact) degrades to the numpy fallback instead of raising
+        # AttributeError out of every native entry point.
+        if hasattr(lib, "fm_compact_aux"):
+            lib.fm_compact_aux.restype = ctypes.c_int32
+            lib.fm_compact_aux.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
         _lib = lib
         return _lib
 
