@@ -288,8 +288,11 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         _gather_all,
         _gather_fn,
         _lr_at,
+        _reject_host_aux,
         _sr_base_key,
     )
+
+    _reject_host_aux(config, "the field-sharded step")
 
     sr_base_key = _sr_base_key(config)
     gat = _gather_fn(config)
@@ -435,6 +438,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         _gather_all,
         _gather_fn,
         _lr_at,
+        _reject_host_aux,
         _sr_base_key,
     )
     from fm_spark_tpu.train import make_optimizer
@@ -446,6 +450,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
             "field-sharded DeepFM runs on a 1-D ('feat',) mesh (row "
             "sharding of the shared embedding is a follow-on)"
         )
+    _reject_host_aux(config, "the field-sharded DeepFM step")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank

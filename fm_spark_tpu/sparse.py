@@ -55,8 +55,9 @@ def _check_host_dedup(config: TrainConfig, allow_compact: bool = False):
             raise ValueError("compact_cap requires host_dedup=True")
         if not allow_compact:
             raise ValueError(
-                "compact_cap is implemented for the FieldFM fused step "
-                "only (FFM/DeepFM keep the full-B aux path)"
+                "compact_cap is implemented for the single-chip fused "
+                "FieldFM/FieldFFM/FieldDeepFM steps only (the field-"
+                "sharded steps keep their own lane-reduction: B·F/n)"
             )
     if not config.host_dedup:
         return
@@ -66,6 +67,86 @@ def _check_host_dedup(config: TrainConfig, allow_compact: bool = False):
         )
     if config.use_pallas:
         raise ValueError("host_dedup and use_pallas are exclusive")
+
+
+def _compact_gather_all(tables, aux, cd):
+    """COMPACT forward table access (``config.compact_cap`` > 0): gather
+    each field's ``cap`` unique rows once from the big table, expand
+    per-lane rows from the small [cap, w] buffer via the host-built
+    inverse map (ops/scatter.compact_aux). Returns ``(urows, rows)`` —
+    ``urows`` in storage dtype (the dedup_sr old-row operand), ``rows``
+    in compute dtype, shaped exactly like :func:`_gather_all`'s output
+    so the bodies' math is unchanged."""
+    from fm_spark_tpu.ops import scatter as scatter_lib
+
+    useg, inv = aux[0], aux[4]
+    urows = [
+        scatter_lib.compact_gather(t, useg[f]) for f, t in enumerate(tables)
+    ]
+    rows = [u.astype(cd)[inv[f]] for f, u in enumerate(urows)]
+    return urows, rows
+
+
+def _compact_apply_all(tables, g_fulls, urows, config: TrainConfig,
+                       sr_base_key, step_idx, lr, aux):
+    """COMPACT update: one cumsum-derived segment total and one
+    unique+sorted cap-lane write per field (ops/scatter.compact_apply);
+    the counterpart of :func:`_apply_field_updates` for
+    ``config.compact_cap`` > 0. ``urows`` is :func:`_compact_gather_all`'s
+    first output (no second gather for the SR write-back)."""
+    from fm_spark_tpu.ops import scatter as scatter_lib
+
+    new = []
+    for f, g_full in enumerate(g_fulls):
+        key = (
+            scatter_lib.sr_key(sr_base_key, step_idx, f)
+            if config.sparse_update == "dedup_sr"
+            else None
+        )
+        new.append(
+            scatter_lib.compact_apply(
+                tables[f], -lr * g_full, tuple(a[f] for a in aux),
+                config.sparse_update, key, urows[f],
+            )
+        )
+    return new
+
+
+def _rows_for(compact, tables, aux, cd, gat, ids):
+    """The fused bodies' shared forward table access: the compact
+    cap-lane path or the plain per-lane gather. Returns ``(urows,
+    rows)`` — ``urows`` is None on the plain path. One definition so
+    the three fused factories (FM/FFM/DeepFM) can never drift."""
+    if compact:
+        return _compact_gather_all(tables, aux, cd)
+    return None, _gather_all(gat, tables, ids, cd)
+
+
+def _updates_for(compact, tables, ids, g_fulls, rows, urows,
+                 config: TrainConfig, sr_base_key, step_idx, lr, aux):
+    """The fused bodies' shared update dispatch, counterpart of
+    :func:`_rows_for` (same single-definition rationale)."""
+    if compact:
+        return _compact_apply_all(
+            tables, g_fulls, urows, config, sr_base_key, step_idx, lr,
+            aux,
+        )
+    return _apply_field_updates(
+        tables, ids, g_fulls, rows, config, sr_base_key, step_idx, lr,
+        aux=aux,
+    )
+
+
+def _reject_host_aux(config: TrainConfig, what: str):
+    """Guard for step factories that take no aux operand (the sharded
+    steps): hard-fail an explicit fast-path request rather than
+    silently training without it. Single definition so a future
+    factory cannot forget the check's wording or semantics."""
+    if config.host_dedup or config.compact_cap:
+        raise ValueError(
+            f"host_dedup/compact_cap are single-chip fused-step levers; "
+            f"{what} does not consume the aux operand"
+        )
 
 
 def _apply_field_updates(tables, ids, g_fulls, rows, config: TrainConfig,
@@ -149,22 +230,14 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        urows = None
-        if compact:
-            # COMPACT path: cap unique rows per field from the big
-            # tables, per-lane rows expanded from the small buffers
-            # (the [B]-lane work never touches table-sized operands).
-            from fm_spark_tpu.ops import scatter as scatter_lib
-
-            useg, inv = aux[0], aux[4]
-            urows = [
-                scatter_lib.compact_gather(params["vw"][f], useg[f])
-                for f in range(F)
-            ]
-            rows = [u.astype(cd)[inv[f]] for f, u in enumerate(urows)]
-        elif spec.fused_linear:
-            rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, k+1]
+        if spec.fused_linear:
+            # Compact = cap unique rows per field from the big tables,
+            # per-lane rows expanded from the small buffers (the
+            # [B]-lane work never touches table-sized operands).
+            urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
+                                    ids)            # F × [B, k+1]
         else:
+            urows = None
             rows = spec.gather_rows(params, ids)        # F × [B, width]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)                                    # [B, k]
@@ -213,28 +286,10 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                     else jnp.zeros((dscores.shape[0], 1), cd)
                 )
                 g_fulls.append(jnp.concatenate([factor_grad(f), g_lin], axis=1))
-            if compact:
-                from fm_spark_tpu.ops import scatter as scatter_lib
-
-                new_vw = []
-                for f in range(F):
-                    key = (
-                        scatter_lib.sr_key(sr_base_key, step_idx, f)
-                        if config.sparse_update == "dedup_sr"
-                        else None
-                    )
-                    new_vw.append(
-                        scatter_lib.compact_apply(
-                            params["vw"][f], -lr * g_fulls[f],
-                            tuple(a[f] for a in aux),
-                            config.sparse_update, key, urows[f],
-                        )
-                    )
-            else:
-                new_vw = _apply_field_updates(
-                    params["vw"], ids, g_fulls, rows, config, sr_base_key,
-                    step_idx, lr, aux=aux,
-                )
+            new_vw = _updates_for(
+                compact, params["vw"], ids, g_fulls, rows, urows, config,
+                sr_base_key, step_idx, lr, aux,
+            )
             out = {"w0": w0, "vw": new_vw}
         else:
             new_v = [
@@ -333,7 +388,8 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldFFMSpec")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
-    _check_host_dedup(config)
+    _check_host_dedup(config, allow_compact=True)
+    compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F, k = spec.num_fields, spec.rank
@@ -348,7 +404,8 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, F·k+1]
+        urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
+                                ids)                # F × [B, F·k+1]
         sel = spec._sel(rows, vals_c)                   # [B, F, F, k]
         a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)
         diag = jnp.trace(a, axis1=1, axis2=2)
@@ -389,9 +446,9 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
-        new_vw = _apply_field_updates(
-            params["vw"], ids, g_fulls, rows, config, sr_base_key, step_idx,
-            lr, aux=aux,
+        new_vw = _updates_for(
+            compact, params["vw"], ids, g_fulls, rows, urows, config,
+            sr_base_key, step_idx, lr, aux,
         )
         out = {"w0": w0, "vw": new_vw}
         if spec.use_bias:
@@ -433,7 +490,8 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    _check_host_dedup(config)
+    _check_host_dedup(config, allow_compact=True)
+    compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F, k = spec.num_fields, spec.rank
@@ -459,7 +517,8 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        rows = _gather_all(gat, params["vw"], ids, cd)  # F × [B, k+1]
+        urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
+                                ids)                # F × [B, k+1]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
@@ -509,9 +568,9 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
-        new_vw = _apply_field_updates(
-            params["vw"], ids, g_fulls, rows, config, sr_base_key,
-            step_idx, lr, aux=aux,
+        new_vw = _updates_for(
+            compact, params["vw"], ids, g_fulls, rows, urows, config,
+            sr_base_key, step_idx, lr, aux,
         )
 
         # Dense side: optax on {"w0", "mlp"} only (+ L2 per group).

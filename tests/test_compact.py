@@ -212,14 +212,92 @@ def test_compact_validation():
             spec, TrainConfig(optimizer="sgd", sparse_update="dedup",
                               compact_cap=8)
         )
-    from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_body
+    # The field-SHARDED bodies take no aux operand — they must reject
+    # the single-chip host-aux levers rather than silently ignore them.
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_mesh,
+        make_field_sharded_sgd_body,
+    )
 
-    ffm = models.FieldFFMSpec(
-        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET,
+    mesh = make_field_mesh(1)
+    with pytest.raises(ValueError, match="single-chip"):
+        make_field_sharded_sgd_body(
+            spec,
+            TrainConfig(optimizer="sgd", sparse_update="dedup",
+                        host_dedup=True, compact_cap=8),
+            mesh,
+        )
+
+
+@pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
+def test_ffm_compact_matches_plain(rng, mode):
+    """FieldFFM fused step: compact aux path == plain path (fp32; SR is
+    the identity there so dedup_sr pins the urows plumbing too)."""
+    from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
+
+    spec = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=3, num_fields=F, bucket=BUCKET,
         init_std=0.1,
     )
-    with pytest.raises(ValueError, match="FieldFM"):
-        make_field_ffm_sparse_sgd_body(
-            ffm, TrainConfig(optimizer="sgd", sparse_update="dedup",
-                             host_dedup=True, compact_cap=8)
+    ids_np = rng.integers(0, 8, size=(B, F)).astype(np.int32)
+    batch = (jnp.asarray(ids_np),
+             jnp.asarray(rng.normal(size=(B, F)).astype(np.float32)),
+             jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+             jnp.ones((B,)))
+    cfg = dict(learning_rate=0.2, optimizer="sgd", sparse_update=mode)
+    params = spec.init(jax.random.key(1))
+    params_c = jax.tree.map(jnp.copy, params)
+    step_p = make_field_ffm_sparse_sgd_step(spec, TrainConfig(**cfg))
+    step_c = make_field_ffm_sparse_sgd_step(
+        spec, TrainConfig(host_dedup=True, compact_cap=CAP, **cfg)
+    )
+    aux = tuple(jnp.asarray(a) for a in compact_aux(ids_np, CAP))
+    for i in range(2):
+        params, _ = step_p(params, jnp.int32(i), *batch)
+        params_c, _ = step_c(params_c, jnp.int32(i), *batch, aux)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_c["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-5, atol=1e-7,
         )
+
+
+@pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
+def test_deepfm_compact_matches_plain(rng, mode):
+    """FieldDeepFM hybrid step: compact embedding updates == plain; the
+    dense MLP/w0 side (optax) must be bitwise-unaffected."""
+    from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
+
+    spec = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, mlp_dims=(8, 8),
+    )
+    ids_np = rng.integers(0, 8, size=(B, F)).astype(np.int32)
+    batch = (jnp.asarray(ids_np),
+             jnp.asarray(rng.normal(size=(B, F)).astype(np.float32)),
+             jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+             jnp.ones((B,)))
+    cfg = dict(learning_rate=0.05, optimizer="adam", sparse_update=mode)
+    params = spec.init(jax.random.key(2))
+    params_c = jax.tree.map(jnp.copy, params)
+    step_p = make_field_deepfm_sparse_step(spec, TrainConfig(**cfg))
+    step_c = make_field_deepfm_sparse_step(
+        spec, TrainConfig(host_dedup=True, compact_cap=CAP, **cfg)
+    )
+    opt_p = step_p.init_opt_state(params)
+    opt_c = step_c.init_opt_state(params_c)
+    aux = tuple(jnp.asarray(a) for a in compact_aux(ids_np, CAP))
+    for i in range(2):
+        params, opt_p, _ = step_p(params, opt_p, jnp.int32(i), *batch)
+        params_c, opt_c, _ = step_c(params_c, opt_c, jnp.int32(i), *batch,
+                                    aux)
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(params_c["vw"][f]), np.asarray(params["vw"][f]),
+            rtol=1e-5, atol=1e-7,
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8),
+        {"w0": params_c["w0"], "mlp": params_c["mlp"]},
+        {"w0": params["w0"], "mlp": params["mlp"]},
+    )
