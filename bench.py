@@ -133,8 +133,19 @@ def inner_main(args):
         # showed scatter cost is per-lane even for dropped lanes, so cap-
         # lane compaction is the lever; full-B hostdedup measured slower
         # than the default and left out). Cap 16384 bounds the measured
-        # max per-field unique count (~12k) on this Zipf batch.
+        # max per-field unique count (~12k) on this Zipf batch. The
+        # MEASURED-BEST variant (bf16 tables + bf16 compute buffers +
+        # compact — quality pinned by bench_quality.py) runs FIRST: if
+        # the flaky attachment dies mid-sweep, the best-so-far salvage
+        # line already carries the headline number.
         cap = min(16384, batch)
+        variants.insert(0, (
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
+            ("bfloat16", "bfloat16"),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap),
+        ))
         for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
             variants.append((
                 f"{dt}/{su}/compact{cap}", (dt, None),
@@ -142,17 +153,6 @@ def inner_main(args):
                             optimizer="sgd", sparse_update=su,
                             host_dedup=True, compact_cap=cap),
             ))
-        # bf16 COMPUTE buffers on top of the compact bf16 path (the
-        # [B, w] forward/backward passes halve their bytes; reductions
-        # and the segment cumsum stay fp32 — quality pinned by
-        # bench_quality.py's bf16_compact_cdbf16 variant).
-        variants.append((
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
-            ("bfloat16", "bfloat16"),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap),
-        ))
 
     import functools
 

@@ -623,6 +623,7 @@ def cmd_train(args) -> int:
         optimizer=args.optimizer, loss=args.loss,
         sparse_update=args.sparse_update,
         param_dtype=args.param_dtype,
+        compute_dtype=args.compute_dtype,
         use_pallas=True if args.use_pallas else None,
     )
     tconfig = cfg.train_config(
@@ -974,6 +975,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="table storage dtype (bfloat16 halves gather bytes; "
                         "pair with --sparse-update dedup_sr)")
+    t.add_argument("--compute-dtype", default=None, dest="compute_dtype",
+                   choices=["float32", "bfloat16"],
+                   help="forward/backward buffer dtype for the [B, w] "
+                        "passes (storage stays --param-dtype; reductions "
+                        "and the compact cumsum stay fp32 — the measured "
+                        "+6%% lever, quality pinned in QUALITY.md)")
     t.add_argument("--use-pallas", action="store_true", dest="use_pallas",
                    help="route fused-step row gather/update through the "
                         "Pallas pipelined-DMA kernels (TPU; interpret mode "

@@ -45,6 +45,10 @@ class RunConfig:
     task: str = "classification"
     loss: str | None = None
     param_dtype: str = "float32"
+    # Forward/backward buffer dtype for the [B, w] passes (storage stays
+    # param_dtype); the bench-measured +6% lever, quality pinned by
+    # bench_quality.py's bf16_compact_cdbf16 variant.
+    compute_dtype: str = "float32"
     mlp_dims: tuple = (400, 400, 400)
     # Training recipe (TrainConfig subset).
     num_steps: int = 1000
@@ -90,6 +94,7 @@ class RunConfig:
         common = dict(
             num_features=n, rank=self.rank, task=self.task, loss=self.loss,
             init_std=0.01, param_dtype=self.param_dtype,
+            compute_dtype=self.compute_dtype,
         )
         if self.model == "fm":
             return models.FMSpec(**common)
@@ -157,7 +162,9 @@ CONFIGS = {
             " automatically, and --row-shards adds bucket row-sharding"
             " (2-D feat×row mesh). The generic 'row' strategy materializes"
             " dense gradients (optax path) — correctness fallback, not the"
-            " at-scale path.",
+            " at-scale path. Measured-best single-chip flags (PERF.md,"
+            " +45%): --param-dtype bfloat16 --compute-dtype bfloat16"
+            " --sparse-update dedup_sr --host-dedup --compact-cap 16384.",
             model="field_fm", dataset="criteo", rank=64, num_fields=39,
             bucket=1 << 18, strategy="field_sparse", num_steps=1_000_000,
             batch_size=1 << 17, learning_rate=0.05, lr_schedule="constant",

@@ -206,6 +206,9 @@ def make_parallel_train_step(
     (params, opt_state, {loss, grad_norm})``. Inputs must be placed with
     :func:`shard_params` / :func:`shard_batch`.
     """
+    from fm_spark_tpu.sparse import _reject_host_aux
+
+    _reject_host_aux(config, "the dense optax parallel step")
     _check_divisibility(spec, mesh, strategy)
     optimizer = optimizer or make_optimizer(config)
     add_reg = _group_reg(config)

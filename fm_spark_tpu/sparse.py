@@ -47,18 +47,11 @@ def _sr_base_key(config: TrainConfig):
     return jax.random.key(config.seed + 0x5EED)
 
 
-def _check_host_dedup(config: TrainConfig, allow_compact: bool = False):
-    """Shared host_dedup preconditions for every fused body (single
-    definition so the three factories can never drift)."""
-    if config.compact_cap > 0:
-        if not config.host_dedup:
-            raise ValueError("compact_cap requires host_dedup=True")
-        if not allow_compact:
-            raise ValueError(
-                "compact_cap is implemented for the single-chip fused "
-                "FieldFM/FieldFFM/FieldDeepFM steps only (the field-"
-                "sharded steps keep their own lane-reduction: B·F/n)"
-            )
+def _check_host_dedup(config: TrainConfig):
+    """Shared host_dedup/compact preconditions for the three single-chip
+    fused bodies (single definition so the factories can never drift)."""
+    if config.compact_cap > 0 and not config.host_dedup:
+        raise ValueError("compact_cap requires host_dedup=True")
     if not config.host_dedup:
         return
     if config.sparse_update not in ("dedup", "dedup_sr"):
@@ -211,7 +204,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     if config.use_pallas and not spec.fused_linear:
         raise ValueError("use_pallas requires fused_linear=True")
-    _check_host_dedup(config, allow_compact=True)
+    _check_host_dedup(config)
     compact = config.compact_cap > 0
     if compact and not spec.fused_linear:
         raise ValueError("compact_cap requires fused_linear=True")
@@ -388,7 +381,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldFFMSpec")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
-    _check_host_dedup(config, allow_compact=True)
+    _check_host_dedup(config)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
@@ -490,7 +483,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    _check_host_dedup(config, allow_compact=True)
+    _check_host_dedup(config)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype

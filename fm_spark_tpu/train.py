@@ -136,6 +136,9 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
     Returns ``step(params, opt_state, ids, vals, labels, weights) →
     (params, opt_state, metrics_dict)`` with donated params/opt_state.
     """
+    from fm_spark_tpu.sparse import _reject_host_aux
+
+    _reject_host_aux(config, "the dense optax train step")
     optimizer = optimizer or make_optimizer(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     add_reg = _group_reg(config)
