@@ -326,14 +326,26 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         return wrapped
 
     host = lambda b: jax.tree_util.tree_map(jnp.asarray, tuple(b))
-    if tconfig.host_dedup and n > 1 and not isinstance(spec, FieldFFMSpec):
-        # All three single-chip fused bodies consume the aux operand; the
-        # SHARDED steps do not (their all_to_all re-shards the batch, so
-        # host-side per-field maps would be wrong) — hard-fail rather
-        # than silently ignore the fast-path request.
+    compact_sharded = (
+        tconfig.host_dedup and tconfig.compact_cap > 0 and n > 1
+        and isinstance(spec, FieldFMSpec)
+    )
+    if compact_sharded and (row_shards > 1 or jax.process_count() > 1):
+        # 2-D meshes split segments across row owners; multi-host
+        # processes hold only their row slice of the batch, but the aux
+        # must be built from every field's FULL global column.
         raise SystemExit(
-            f"--host-dedup supports the single-chip fused steps only "
-            f"(found {n} devices; drop --host-dedup or run on 1 chip)"
+            "--compact-cap on multiple chips requires a 1-D field mesh "
+            "(no --row-shards) and a single process"
+        )
+    if (tconfig.host_dedup and n > 1 and not compact_sharded
+            and not isinstance(spec, FieldFFMSpec)):
+        # The sharded steps consume only the COMPACT aux format (FieldFM,
+        # 1-D mesh); every other multi-device host-dedup request would
+        # silently train without the fast path — hard-fail instead.
+        raise SystemExit(
+            f"--host-dedup on {n} devices requires --compact-cap with a "
+            "FieldFM config (or drop --host-dedup / run on 1 chip)"
         )
     if steps_per_call < 1:
         raise SystemExit(
@@ -423,6 +435,17 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             to_canonical = lambda p: unstack_field_params(
                 spec, fetch(p)
             )
+        if compact_sharded:
+            # DedupAuxBatches (installed below) appends the compact aux;
+            # the F_pad padding (stack_compact_aux) rides the producer
+            # thread via the _PadAuxBatches wrapper, so prep only
+            # device-places it field-wise alongside the padded batch.
+            from fm_spark_tpu.parallel import place_compact_aux
+
+            _data_prep = prep
+            prep = lambda b: (
+                *_data_prep(b[:4]), place_compact_aux(b[4], mesh),
+            )
     else:
         if is_deepfm:
             from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
@@ -496,6 +519,31 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         from fm_spark_tpu.data import DedupAuxBatches
 
         batches = DedupAuxBatches(batches, cap=tconfig.compact_cap)
+        if compact_sharded:
+            # F_pad-padding of the aux also belongs in the producer.
+            from fm_spark_tpu.parallel import stack_compact_aux
+
+            class _PadAuxBatches:
+                def __init__(self, src):
+                    self._src = src
+
+                def next_batch(self):
+                    ids, vals, labels, weights, aux = self._src.next_batch()
+                    return (ids, vals, labels, weights,
+                            stack_compact_aux(aux, n_feat))
+
+                def __iter__(self):
+                    return self
+
+                __next__ = next_batch
+
+                def state(self):
+                    return self._src.state()
+
+                def restore(self, st):
+                    self._src.restore(st)
+
+            batches = _PadAuxBatches(batches)
     if multi:
         from fm_spark_tpu.data import StackedBatches
         from fm_spark_tpu.sparse import make_field_sparse_multistep

@@ -41,8 +41,11 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     pad_field_batch,
     shard_field_batch,
     shard_field_batch_local,
+    place_compact_aux,
+    shard_compact_aux,
     shard_field_deepfm_params,
     shard_field_params,
+    stack_compact_aux,
     stack_field_deepfm_params,
     stack_field_params,
     unstack_field_deepfm_params,

@@ -203,19 +203,29 @@ def _mesh_geometry(spec, mesh):
     )
 
 
-def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights):
+def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
+                   caux=None):
     """The field-sharded forward, shared by the train body and the eval
     step: example-sharded → field-sharded re-shard (all_to_all over
     ``feat``; labels/weights ride all_gathers in the SAME collective
     order so the example permutation stays consistent), 2-D ownership-
     masked local gathers, and ONE psum group of the partial sums.
 
-    Returns ``(scores, s, xvs, rows, vals_c, uidx, labels, weights)`` —
-    scores replicated across the mesh; the training body additionally
-    consumes the locals for its analytic backward, and ``uidx`` carries
-    the single-owner scatter targets (OOB sentinel for non-owned lanes).
+    ``caux`` (1-D mesh only) is the chip's LOCAL slice of the compact
+    host-dedup aux (ops/scatter.compact_aux over the GLOBAL batch,
+    stacked [F_pad, ...] and sharded over ``feat``): the all_to_all
+    reconstructs each local field's full-B column in global host row
+    order — exactly the order the host built the aux from — so the
+    compact expansion applies per local field unchanged.
+
+    Returns ``(scores, s, xvs, rows, vals_c, uidx, urows, labels,
+    weights)`` — scores replicated across the mesh; the training body
+    additionally consumes the locals for its analytic backward;
+    ``uidx`` carries the single-owner scatter targets (OOB sentinel for
+    non-owned lanes) and ``urows`` the compact unique-row buffers (None
+    on the plain path).
     """
-    from fm_spark_tpu.sparse import _gather_all
+    from fm_spark_tpu.sparse import _compact_gather_all, _gather_all
 
     cd = spec.cdtype
     k = spec.rank
@@ -232,6 +242,7 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights):
         weights = lax.all_gather(weights, "row", tiled=True)
 
     vals_c = vals.astype(cd)
+    urows = None
     if g["two_d"]:
         # Each (field, example) id is owned by exactly one row shard:
         # gather locally where owned, zero elsewhere; the psum over both
@@ -247,6 +258,11 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights):
             for f, r in enumerate(_gather_all(gat, vw, gidx, cd))
         ]
         uidx = jnp.where(own, loc, g["bucket_local"])
+    elif caux is not None:
+        urows, rows = _compact_gather_all(
+            [vw[f] for f in range(g["f_local"])], caux, cd
+        )
+        uidx = ids
     else:
         rows = _gather_all(gat, vw, ids, cd)
         uidx = ids
@@ -267,7 +283,7 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights):
         scores = scores + lin
     if spec.use_bias:
         scores = scores + w0.astype(cd)
-    return scores, s, xvs, rows, vals_c, uidx, labels, weights
+    return scores, s, xvs, rows, vals_c, uidx, urows, labels, weights
 
 
 def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
@@ -285,6 +301,8 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         raise ValueError("sparse step implements plain SGD only")
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
+        _check_host_dedup,
+        _compact_apply_all,
         _gather_all,
         _gather_fn,
         _lr_at,
@@ -292,33 +310,56 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         _sr_base_key,
     )
 
-    _reject_host_aux(config, "the field-sharded step")
-
-    sr_base_key = _sr_base_key(config)
-    gat = _gather_fn(config)
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded step runs on a ('feat',) or ('feat', 'row') "
             "mesh; see module docstring (use make_field_mesh)"
         )
+    g = _mesh_geometry(spec, mesh)
+    compact = config.compact_cap > 0
+    if compact:
+        # Compact host-dedup on the sharded step: supported on the 1-D
+        # feat mesh — the aux is built from the GLOBAL batch and shards
+        # field-wise (see _field_forward). The 2-D mesh's row-ownership
+        # masking is incompatible with the single-owner cap-lane write
+        # (a segment may span row shards), and plain full-B host_dedup
+        # is a measured loser — both rejected.
+        _check_host_dedup(config)
+        if g["two_d"]:
+            raise ValueError(
+                "compact_cap on the sharded step requires a 1-D "
+                "('feat',) mesh (row sharding splits segments across "
+                "owners)"
+            )
+    elif config.host_dedup:
+        _reject_host_aux(config, "the field-sharded step (non-compact)")
+
+    sr_base_key = _sr_base_key(config)
+    gat = _gather_fn(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank
-    g = _mesh_geometry(spec, mesh)
     f_pad, f_local = g["f_pad"], g["f_local"]
     two_d = g["two_d"]
     lr_at = _lr_at(config)
 
-    def local_step(params, step_idx, ids, vals, labels, weights):
+    def local_step(params, step_idx, ids, vals, labels, weights,
+                   caux=None):
         # Local blocks in: vw [f_local, bucket/n_row, width]; ids/vals
-        # [B/n, F_pad]; labels/weights [B/n]. The shared forward
-        # (_field_forward) re-shards, gathers, and psums; the backward
-        # below is training-only.
+        # [B/n, F_pad]; labels/weights [B/n]; caux (compact) the
+        # [f_local, ...] aux slices. The shared forward (_field_forward)
+        # re-shards, gathers, and psums; the backward below is
+        # training-only.
+        if compact and caux is None:
+            raise ValueError(
+                "compact sharded step needs the batch's compact_aux "
+                "operand (stacked [F_pad, ...], sharded over feat)"
+            )
         vw = params["vw"]
         w0 = params["w0"]
-        scores, s, xvs, rows, vals_c, uidx, labels, weights = (
+        scores, s, xvs, rows, vals_c, uidx, urows, labels, weights = (
             _field_forward(spec, g, gat, vw, w0, ids, vals, labels,
-                           weights)
+                           weights, caux=caux)
         )
 
         # From here on every chip holds identical full-batch values.
@@ -351,10 +392,18 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         field_offset = lax.axis_index("feat") * f_local
         if two_d:
             field_offset = field_offset + lax.axis_index("row") * f_pad
-        new_slices = _apply_field_updates(
-            [vw[f] for f in range(f_local)], uidx, g_fulls, rows, config,
-            sr_base_key, step_idx, lr, field_offset=field_offset,
-        )
+        if compact:
+            new_slices = _compact_apply_all(
+                [vw[f] for f in range(f_local)], g_fulls, urows, config,
+                sr_base_key, step_idx, lr, caux,
+                field_offset=field_offset,
+            )
+        else:
+            new_slices = _apply_field_updates(
+                [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
+                config, sr_base_key, step_idx, lr,
+                field_offset=field_offset,
+            )
         new_vw = jnp.stack(new_slices, axis=0)
         out = {"w0": w0, "vw": new_vw}
         if spec.use_bias:
@@ -362,6 +411,16 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
         return out, loss
 
+    if compact:
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(field_param_specs(mesh), P(),
+                      *field_batch_specs(mesh),
+                      (P("feat", None),) * 5),
+            out_specs=(field_param_specs(mesh), P()),
+            check_vma=False,
+        )
     return jax.shard_map(
         local_step,
         mesh=mesh,
@@ -375,6 +434,56 @@ def make_field_sharded_sgd_step(spec, config: TrainConfig, mesh):
     """Jitted field-sharded fused sparse-SGD step; params donated."""
     return jax.jit(
         make_field_sharded_sgd_body(spec, config, mesh), donate_argnums=(0,)
+    )
+
+
+def place_compact_aux(aux_padded, mesh):
+    """Device-place an already-padded compact aux tuple for the sharded
+    compact step (each [F_pad, ...] leaf sharded field-wise). Split from
+    :func:`shard_compact_aux` so the CPU-side padding
+    (:func:`stack_compact_aux`) can run in the prefetch producer thread
+    while only this device_put stays on the consumer side."""
+    sh = NamedSharding(mesh, P("feat", None))
+    return tuple(jax.device_put(a, sh) for a in aux_padded)
+
+
+def shard_compact_aux(aux, mesh, n_feat: int):
+    """One-shot pad + device-place of a GLOBAL-batch compact aux tuple
+    (:func:`fm_spark_tpu.ops.scatter.compact_aux`) for the sharded
+    compact step."""
+    return place_compact_aux(stack_compact_aux(aux, n_feat), mesh)
+
+
+def stack_compact_aux(aux, n_feat: int):
+    """Pad a GLOBAL-batch :func:`fm_spark_tpu.ops.scatter.compact_aux`
+    tuple ([F, ...] arrays) to ``F_pad`` field slots for the sharded
+    compact step. Padded fields get all-zero-id aux (1 segment holding
+    every lane) — they write only into the zero padding tables, exactly
+    like the plain path's padded columns. Place the result with
+    :func:`place_compact_aux` (or use :func:`shard_compact_aux` for
+    both halves at once)."""
+    import numpy as np
+
+    useg, segstart, segend, order, inv = (np.asarray(a) for a in aux)
+    f, cap = useg.shape
+    b = order.shape[1]
+    f_pad = padded_num_fields(f, n_feat)
+    pad = f_pad - f
+    if not pad:
+        return useg, segstart, segend, order, inv
+    imax = np.iinfo(np.int32).max
+    pu = np.zeros((pad, cap), np.int32)
+    pu[:, 1:] = (imax - cap) + np.arange(1, cap, dtype=np.int32)
+    ps = np.full((pad, cap), max(b - 1, 0), np.int32)
+    pe = np.full((pad, cap), max(b - 1, 0), np.int32)
+    ps[:, 0] = 0
+    pe[:, 0] = max(b - 1, 0)
+    po = np.broadcast_to(np.arange(b, dtype=np.int32), (pad, b)).copy()
+    pi = np.zeros((pad, b), np.int32)
+    return (
+        np.concatenate([useg, pu]), np.concatenate([segstart, ps]),
+        np.concatenate([segend, pe]), np.concatenate([order, po]),
+        np.concatenate([inv, pi]),
     )
 
 
@@ -623,7 +732,7 @@ def make_field_sharded_eval_step(spec, mesh):
     gat = lambda table, idx: table[idx]  # eval always takes the XLA gather
 
     def local_eval(params, mstate, ids, vals, labels, weights):
-        scores, _, _, _, _, _, labels, weights = _field_forward(
+        scores, _, _, _, _, _, _, labels, weights = _field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
         )
@@ -730,7 +839,7 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
         # The shared FM forward (scores incl. linear + bias), then the
         # deep head exactly as training: local xv columns, one all_gather
         # of h, the replicated MLP.
-        scores, _, xvs, _, _, _, labels, weights = _field_forward(
+        scores, _, xvs, _, _, _, _, labels, weights = _field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
         )

@@ -81,18 +81,21 @@ def _compact_gather_all(tables, aux, cd):
 
 
 def _compact_apply_all(tables, g_fulls, urows, config: TrainConfig,
-                       sr_base_key, step_idx, lr, aux):
+                       sr_base_key, step_idx, lr, aux, field_offset=0):
     """COMPACT update: one cumsum-derived segment total and one
     unique+sorted cap-lane write per field (ops/scatter.compact_apply);
     the counterpart of :func:`_apply_field_updates` for
     ``config.compact_cap`` > 0. ``urows`` is :func:`_compact_gather_all`'s
-    first output (no second gather for the SR write-back)."""
+    first output (no second gather for the SR write-back).
+    ``field_offset`` shifts the SR key stream for the field-sharded
+    caller (global field = offset + local f), exactly like
+    :func:`_apply_field_updates`."""
     from fm_spark_tpu.ops import scatter as scatter_lib
 
     new = []
     for f, g_full in enumerate(g_fulls):
         key = (
-            scatter_lib.sr_key(sr_base_key, step_idx, f)
+            scatter_lib.sr_key(sr_base_key, step_idx, field_offset + f)
             if config.sparse_update == "dedup_sr"
             else None
         )

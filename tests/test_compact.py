@@ -212,8 +212,9 @@ def test_compact_validation():
             spec, TrainConfig(optimizer="sgd", sparse_update="dedup",
                               compact_cap=8)
         )
-    # The field-SHARDED bodies take no aux operand — they must reject
-    # the single-chip host-aux levers rather than silently ignore them.
+    # The field-sharded body supports COMPACT aux (1-D mesh) but must
+    # still reject plain full-B host_dedup rather than silently ignore
+    # it (it consumes only the compact aux format).
     from fm_spark_tpu.parallel.field_step import (
         make_field_mesh,
         make_field_sharded_sgd_body,
@@ -221,6 +222,91 @@ def test_compact_validation():
 
     mesh = make_field_mesh(1)
     with pytest.raises(ValueError, match="single-chip"):
+        make_field_sharded_sgd_body(
+            spec,
+            TrainConfig(optimizer="sgd", sparse_update="dedup",
+                        host_dedup=True),
+            mesh,
+        )
+
+
+@pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
+@pytest.mark.parametrize("n_feat,num_fields", [(4, 5), (2, 5), (4, 4)])
+def test_sharded_compact_matches_single(rng, mode, n_feat, num_fields):
+    """Field-sharded compact (1-D feat mesh, incl. padded fields) must
+    match the single-chip compact step exactly: same aux, same SR key
+    stream (global field offsets), single-owner cap-lane writes."""
+    import jax.numpy as jnp
+
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_mesh,
+        make_field_sharded_sgd_step,
+        pad_field_batch,
+        shard_compact_aux,
+        shard_field_batch,
+        shard_field_params,
+        stack_field_params,
+        unstack_field_params,
+    )
+
+    bucket, rank, b, cap = 32, 4, 64, 64
+    spec = models.FieldFMSpec(
+        num_features=num_fields * bucket, rank=rank,
+        num_fields=num_fields, bucket=bucket, init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.3, lr_schedule="inv_sqrt",
+                         optimizer="sgd", reg_factors=1e-3,
+                         reg_linear=1e-4, reg_bias=1e-4,
+                         sparse_update=mode, host_dedup=True,
+                         compact_cap=cap)
+    mesh = make_field_mesh(n_feat)
+    params = spec.init(jax.random.key(0))
+    ref_params = jax.tree.map(jnp.copy, params)
+    sharded = shard_field_params(
+        stack_field_params(spec, params, n_feat), mesh
+    )
+    step_sharded = make_field_sharded_sgd_step(spec, config, mesh)
+    step_single = make_field_sparse_sgd_step(spec, config)
+
+    for i in range(3):
+        ids = rng.integers(0, bucket, size=(b, num_fields)).astype(np.int32)
+        ids[:, 0] = rng.integers(0, 3, b)
+        vals = rng.normal(size=(b, num_fields)).astype(np.float32)
+        labels = rng.integers(0, 2, b).astype(np.float32)
+        weights = np.ones(b, np.float32)
+        weights[::5] = 0.0
+        batch = (ids, vals, labels, weights)
+        aux = compact_aux(ids, cap)
+        paux = shard_compact_aux(aux, mesh, n_feat)
+        sb = shard_field_batch(
+            pad_field_batch(batch, num_fields, n_feat), mesh
+        )
+        sharded, loss_sh = step_sharded(sharded, jnp.int32(i), *sb, paux)
+        ref_params, loss_ref = step_single(
+            ref_params, jnp.int32(i), *map(jnp.asarray, batch),
+            tuple(jnp.asarray(a) for a in aux),
+        )
+        np.testing.assert_allclose(
+            float(loss_sh), float(loss_ref), rtol=1e-6
+        )
+    got = unstack_field_params(spec, jax.device_get(sharded))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        got, jax.device_get(ref_params),
+    )
+
+
+def test_sharded_compact_rejects_2d_mesh():
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_mesh,
+        make_field_sharded_sgd_body,
+    )
+
+    spec = _spec()
+    mesh = make_field_mesh(4, n_row=2)
+    with pytest.raises(ValueError, match="1-D"):
         make_field_sharded_sgd_body(
             spec,
             TrainConfig(optimizer="sgd", sparse_update="dedup",
