@@ -90,12 +90,13 @@ def inner_main(args):
     steps_warmup = 3
     steps_timed = args.steps
 
-    def make_spec(param_dtype, compute_dtype=None):
+    def make_spec(param_dtype, compute_dtype=None, table_layout=None):
         return models.FieldFMSpec(
             num_features=num_fields * bucket, rank=rank,
             num_fields=num_fields, bucket=bucket, init_std=0.01,
             param_dtype=param_dtype,
             compute_dtype=compute_dtype or args.compute_dtype,
+            table_layout=table_layout or args.table_layout,
         )
 
     rng = np.random.default_rng(0)
@@ -114,6 +115,7 @@ def inner_main(args):
     explicit = (args.sparse_update != "scatter_add" or args.use_pallas
                 or args.host_dedup or args.param_dtype != "float32"
                 or args.compute_dtype != "float32"
+                or args.table_layout != "row"
                 or args.rank != 64 or args.batch != 1 << 17
                 or args.steps != 20 or args.compact_cap)
     variants = [(
@@ -121,8 +123,9 @@ def inner_main(args):
         + ("/pallas" if args.use_pallas else "")
         + (f"/compact{args.compact_cap}" if args.compact_cap
            else "/hostdedup" if args.host_dedup else "")
-        + ("/cd-bf16" if args.compute_dtype == "bfloat16" else ""),
-        (args.param_dtype, None),
+        + ("/cd-bf16" if args.compute_dtype == "bfloat16" else "")
+        + ("/colT" if args.table_layout == "col" else ""),
+        (args.param_dtype, None, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
                     use_pallas=args.use_pallas, host_dedup=args.host_dedup,
@@ -141,14 +144,24 @@ def inner_main(args):
         cap = min(16384, batch)
         variants.insert(0, (
             f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
-            ("bfloat16", "bfloat16"),
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap),
+        ))
+        # TRANSPOSED-table candidate (PERF.md "transpose" probe: the
+        # col layout halves physical table bytes and the cap-gather
+        # scan with it; donated scatter measured layout-neutral).
+        variants.insert(1, (
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT",
+            ("bfloat16", "bfloat16", "col"),
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
                         optimizer="sgd", sparse_update="dedup_sr",
                         host_dedup=True, compact_cap=cap),
         ))
         for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
             variants.append((
-                f"{dt}/{su}/compact{cap}", (dt, None),
+                f"{dt}/{su}/compact{cap}", (dt, None, None),
                 TrainConfig(learning_rate=0.05, lr_schedule="constant",
                             optimizer="sgd", sparse_update=su,
                             host_dedup=True, compact_cap=cap),
@@ -297,6 +310,12 @@ def main():
                     choices=["float32", "bfloat16"],
                     help="forward/backward buffer dtype (the [B, w] "
                          "passes; storage stays --param-dtype)")
+    ap.add_argument("--table-layout", default="row", dest="table_layout",
+                    choices=["row", "col"],
+                    help="physical table orientation; col = transposed "
+                         "[width, bucket] (no minor-dim lane padding -> "
+                         "~2x fewer physical table bytes; needs the "
+                         "compact path)")
     ap.add_argument("--sparse-update", default="scatter_add",
                     choices=["scatter_add", "dedup", "dedup_sr"])
     ap.add_argument("--use-pallas", action="store_true", dest="use_pallas",
@@ -334,6 +353,7 @@ def main():
     argv = [
         "--param-dtype", args.param_dtype,
         "--compute-dtype", args.compute_dtype,
+        "--table-layout", args.table_layout,
         "--sparse-update", args.sparse_update,
         "--rank", str(args.rank),
         "--batch", str(args.batch),

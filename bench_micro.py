@@ -746,6 +746,74 @@ def bench_scanmodel(args):
           sdelta_bf, bnd, extra={"cap": cap})
 
 
+def bench_transpose(args):
+    """Table-layout probe: [rows, 65] pads the minor dim to 128 lanes
+    (physical bytes ~2x nominal), and the scan model says big-table ops
+    track OPERAND bytes. A transposed [65, rows] table has no lane
+    padding (rows % 128 == 0) — if the scan really tracks physical
+    bytes, column-gather/scatter on the transposed layout should cost
+    about half. Also probes width 256 on the row layout (2 lane-tiles)
+    to confirm the padding model itself.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, rows, width = args.tables, args.rows, args.width + 1
+    cap = args.cap
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    rng = np.random.default_rng(0)
+    from fm_spark_tpu.ops.scatter import compact_aux
+
+    ids_np = (rng.zipf(1.3, size=(args.n_idx, F)) % rows).astype(np.int32)
+    useg = jnp.asarray(compact_aux(ids_np, cap)[0])
+    upd_row = jnp.full((cap, width), 1e-3, jnp.float32)
+    upd_col = jnp.full((width, cap), 1e-3, jnp.float32)
+
+    timed = _make_timed(
+        "transpose",
+        {"fields": F, "rows": rows, "width": width, "cap": cap,
+         "dtype": args.dtype},
+        "ms",
+    )
+
+    tables = [jnp.zeros((rows, width), dtype) for _ in range(F)]
+    timed("row_gather_cap",
+          lambda ts, u: [jnp.sum(t[jnp.clip(u[f], 0, rows - 1)]
+                                 .astype(jnp.float32))
+                         for f, t in enumerate(ts)],
+          tables, useg)
+    timed("row_scatter_cap",
+          lambda ts, u: [t.at[u[f]].add(upd_row.astype(t.dtype),
+                                        mode="drop", unique_indices=True,
+                                        indices_are_sorted=True)
+                         for f, t in enumerate(ts)],
+          tables, useg)
+    del tables
+
+    tablesT = [jnp.zeros((width, rows), dtype) for _ in range(F)]
+    timed("col_gather_cap",
+          lambda ts, u: [jnp.sum(t[:, jnp.clip(u[f], 0, rows - 1)]
+                                 .astype(jnp.float32))
+                         for f, t in enumerate(ts)],
+          tablesT, useg)
+    timed("col_scatter_cap",
+          lambda ts, u: [t.at[:, u[f]].add(upd_col.astype(t.dtype),
+                                           mode="drop",
+                                           unique_indices=True,
+                                           indices_are_sorted=True)
+                         for f, t in enumerate(ts)],
+          tablesT, useg)
+    del tablesT
+
+    tables256 = [jnp.zeros((rows, 256), dtype) for _ in range(F)]
+    timed("row_gather_cap_w256",
+          lambda ts, u: [jnp.sum(t[jnp.clip(u[f], 0, rows - 1)]
+                                 .astype(jnp.float32))
+                         for f, t in enumerate(ts)],
+          tables256, useg, extra={"width": 256})
+
+
 BENCHES = {
     "dispatch": bench_dispatch,
     "gather": bench_gather,
@@ -759,6 +827,7 @@ BENCHES = {
     "merge": bench_merge,
     "stackfuse": bench_stackfuse,
     "scanmodel": bench_scanmodel,
+    "transpose": bench_transpose,
 }
 
 

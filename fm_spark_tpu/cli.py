@@ -521,29 +521,13 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         batches = DedupAuxBatches(batches, cap=tconfig.compact_cap)
         if compact_sharded:
             # F_pad-padding of the aux also belongs in the producer.
+            from fm_spark_tpu.data import MappedBatches
             from fm_spark_tpu.parallel import stack_compact_aux
 
-            class _PadAuxBatches:
-                def __init__(self, src):
-                    self._src = src
-
-                def next_batch(self):
-                    ids, vals, labels, weights, aux = self._src.next_batch()
-                    return (ids, vals, labels, weights,
-                            stack_compact_aux(aux, n_feat))
-
-                def __iter__(self):
-                    return self
-
-                __next__ = next_batch
-
-                def state(self):
-                    return self._src.state()
-
-                def restore(self, st):
-                    self._src.restore(st)
-
-            batches = _PadAuxBatches(batches)
+            batches = MappedBatches(
+                batches,
+                lambda b: (*b[:4], stack_compact_aux(b[4], n_feat)),
+            )
     if multi:
         from fm_spark_tpu.data import StackedBatches
         from fm_spark_tpu.sparse import make_field_sparse_multistep
@@ -672,6 +656,7 @@ def cmd_train(args) -> int:
         sparse_update=args.sparse_update,
         param_dtype=args.param_dtype,
         compute_dtype=args.compute_dtype,
+        table_layout=args.table_layout,
         use_pallas=True if args.use_pallas else None,
     )
     tconfig = cfg.train_config(
@@ -1029,6 +1014,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "passes (storage stays --param-dtype; reductions "
                         "and the compact cumsum stay fp32 — the measured "
                         "+6%% lever, quality pinned in QUALITY.md)")
+    t.add_argument("--table-layout", default=None, dest="table_layout",
+                   choices=["row", "col"],
+                   help="FieldFM physical table orientation; col = "
+                        "transposed [width, bucket] storage (bitwise-"
+                        "equivalent; needs --compact-cap; measured a "
+                        "wash on this chip — see PERF.md)")
     t.add_argument("--use-pallas", action="store_true", dest="use_pallas",
                    help="route fused-step row gather/update through the "
                         "Pallas pipelined-DMA kernels (TPU; interpret mode "

@@ -49,6 +49,9 @@ class RunConfig:
     # param_dtype); the bench-measured +6% lever, quality pinned by
     # bench_quality.py's bf16_compact_cdbf16 variant.
     compute_dtype: str = "float32"
+    # FieldFM physical table orientation ("row" | "col"); col = transposed
+    # [width, bucket] storage, bitwise-equivalent, compact-path only.
+    table_layout: str = "row"
     mlp_dims: tuple = (400, 400, 400)
     # Training recipe (TrainConfig subset).
     num_steps: int = 1000
@@ -102,7 +105,8 @@ class RunConfig:
             if num_features is not None and num_features != self.num_features:
                 raise ValueError("field_fm shapes are fixed by num_fields*bucket")
             return models.FieldFMSpec(
-                **common, num_fields=self.num_fields, bucket=self.bucket
+                **common, num_fields=self.num_fields, bucket=self.bucket,
+                table_layout=self.table_layout,
             )
         if self.model == "field_ffm":
             if num_features is not None and num_features != self.num_features:

@@ -12,6 +12,7 @@ from fm_spark_tpu.data.pipeline import (  # noqa: F401
     Batches,
     BernoulliBatches,
     DedupAuxBatches,
+    MappedBatches,
     Prefetcher,
     StackedBatches,
     iterate_once,

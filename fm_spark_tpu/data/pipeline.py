@@ -202,6 +202,33 @@ class DedupAuxBatches:
         self._source.restore(state)
 
 
+class MappedBatches:
+    """Batch-source wrapper applying ``fn`` to each yielded batch in the
+    PRODUCER thread (wrap before :class:`Prefetcher`). The generic glue
+    for per-batch host transforms that belong off the device critical
+    path — e.g. the sharded-compact F_pad aux padding (cli) — without
+    re-implementing the source protocol per call site."""
+
+    def __init__(self, source, fn):
+        self._source = source
+        self._fn = fn
+
+    def next_batch(self):
+        return self._fn(self._source.next_batch())
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, state) -> None:
+        self._source.restore(state)
+
+
 class StackedBatches:
     """Batch-source wrapper that stacks ``n`` consecutive batches on a
     leading axis — the input shape for

@@ -233,16 +233,28 @@ def compact_aux(ids, cap: int):
     return useg, segstart, segend, order, inv
 
 
-def compact_gather(table, useg):
+def compact_gather(table, useg, col: bool = False):
     """Forward half of the compact path: gather each unique id's row
     once — ``cap`` ascending lanes against the big table (sentinels clip
     to the last row; those rows are never referenced by ``inv``).
     Per-lane rows are then ``urows[inv]`` against this [cap, w] buffer,
-    which gathers at the small-operand fast rate (PERF.md fact 2)."""
+    which gathers at the small-operand fast rate (PERF.md fact 2).
+
+    ``col`` = the table is stored TRANSPOSED ([w, bucket] — FieldFMSpec
+    ``table_layout='col'``): column-gather then transpose the tiny
+    [w, cap] buffer back to row orientation, so callers see identical
+    shapes either way. The col gather is ~2x cheaper at big-table shapes
+    because the scan tracks PHYSICAL bytes and the col layout has no
+    minor-dim lane padding (PERF.md "transpose" probe)."""
+    if col:
+        n = table.shape[1]
+        return table.at[:, jnp.clip(useg, 0, n - 1)].get(
+            indices_are_sorted=True
+        ).T
     return table.at[useg].get(mode="clip", indices_are_sorted=True)
 
 
-def compact_apply(table, delta, caux, mode, key, urows):
+def compact_apply(table, delta, caux, mode, key, urows, col: bool = False):
     """Update half of the compact path (see :func:`compact_aux`): per-
     segment sums via one fp32 ``cumsum`` over the sorted deltas + cap-
     lane boundary gathers (``sum[s] = csum[end_s] - csum[start_s] +
@@ -250,22 +262,36 @@ def compact_apply(table, delta, caux, mode, key, urows):
     beyond the cumsum's own log-depth rounding), then ONE write per
     unique id: ``add`` for ``dedup``, stochastic-rounded ``set`` of
     ``urows + sum`` for ``dedup_sr`` (``urows`` doubles as the old-row
-    operand — no second gather)."""
+    operand — no second gather). ``col`` = transposed table storage
+    (see :func:`compact_gather`): the cap-sized update transposes before
+    the column write; values are identical."""
     useg, segstart, segend, order, inv = caux
     del inv
     sdelta = delta[order].astype(jnp.float32)
     csum = jnp.cumsum(sdelta, axis=0)
     segsum = csum[segend] - csum[segstart] + sdelta[segstart]
     if mode == "dedup":
+        upd = segsum.astype(table.dtype)
+        if col:
+            return table.at[:, useg].add(
+                upd.T, mode="drop",
+                unique_indices=True, indices_are_sorted=True,
+            )
         return table.at[useg].add(
-            segsum.astype(table.dtype), mode="drop",
+            upd, mode="drop",
             unique_indices=True, indices_are_sorted=True,
         )
     if key is None or urows is None:
         raise ValueError("dedup_sr needs key= and urows=")
     new_rows = urows.astype(jnp.float32) + segsum
+    vals = stochastic_round(new_rows, table.dtype, key)
+    if col:
+        return table.at[:, useg].set(
+            vals.T, mode="drop",
+            unique_indices=True, indices_are_sorted=True,
+        )
     return table.at[useg].set(
-        stochastic_round(new_rows, table.dtype, key), mode="drop",
+        vals, mode="drop",
         unique_indices=True, indices_are_sorted=True,
     )
 

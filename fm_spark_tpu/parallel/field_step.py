@@ -91,6 +91,11 @@ def stack_field_params(spec, params, n_feat: int) -> dict:
     """Per-field table list → ``{"w0", "vw": [F_pad, bucket, width]}``."""
     if not spec.fused_linear:
         raise ValueError("field-sharded step requires fused_linear=True")
+    if getattr(spec, "table_layout", "row") != "row":
+        raise ValueError(
+            "the field-sharded layout requires table_layout='row' "
+            "(transposed tables are a single-chip compact-path option)"
+        )
     f_pad = padded_num_fields(spec.num_fields, n_feat)
     tables = list(params["vw"])
     pad = f_pad - len(tables)
@@ -471,6 +476,23 @@ def stack_compact_aux(aux, n_feat: int):
     pad = f_pad - f
     if not pad:
         return useg, segstart, segend, order, inv
+    pu, ps, pe, po, pi = _pad_aux_blocks(pad, cap, b)
+    return (
+        np.concatenate([useg, pu]), np.concatenate([segstart, ps]),
+        np.concatenate([segend, pe]), np.concatenate([order, po]),
+        np.concatenate([inv, pi]),
+    )
+
+
+def _pad_aux_blocks(pad: int, cap: int, b: int):
+    """The padded fields' aux blocks depend only on (pad, cap, b) —
+    cached so the per-batch producer-thread call (stack_compact_aux via
+    cli's MappedBatches) doesn't rebuild them every step."""
+    import numpy as np
+
+    cached = _PAD_AUX_CACHE.get((pad, cap, b))
+    if cached is not None:
+        return cached
     imax = np.iinfo(np.int32).max
     pu = np.zeros((pad, cap), np.int32)
     pu[:, 1:] = (imax - cap) + np.arange(1, cap, dtype=np.int32)
@@ -478,13 +500,17 @@ def stack_compact_aux(aux, n_feat: int):
     pe = np.full((pad, cap), max(b - 1, 0), np.int32)
     ps[:, 0] = 0
     pe[:, 0] = max(b - 1, 0)
-    po = np.broadcast_to(np.arange(b, dtype=np.int32), (pad, b)).copy()
-    pi = np.zeros((pad, b), np.int32)
-    return (
-        np.concatenate([useg, pu]), np.concatenate([segstart, ps]),
-        np.concatenate([segend, pe]), np.concatenate([order, po]),
-        np.concatenate([inv, pi]),
+    po = np.ascontiguousarray(
+        np.broadcast_to(np.arange(b, dtype=np.int32), (pad, b))
     )
+    pi = np.zeros((pad, b), np.int32)
+    blocks = (pu, ps, pe, po, pi)
+    _PAD_AUX_CACHE.clear()  # one live shape per run is the norm
+    _PAD_AUX_CACHE[(pad, cap, b)] = blocks
+    return blocks
+
+
+_PAD_AUX_CACHE: dict = {}
 
 
 # ---------------------------------------------------------------- DeepFM

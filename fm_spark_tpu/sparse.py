@@ -62,7 +62,7 @@ def _check_host_dedup(config: TrainConfig):
         raise ValueError("host_dedup and use_pallas are exclusive")
 
 
-def _compact_gather_all(tables, aux, cd):
+def _compact_gather_all(tables, aux, cd, col=False):
     """COMPACT forward table access (``config.compact_cap`` > 0): gather
     each field's ``cap`` unique rows once from the big table, expand
     per-lane rows from the small [cap, w] buffer via the host-built
@@ -74,14 +74,16 @@ def _compact_gather_all(tables, aux, cd):
 
     useg, inv = aux[0], aux[4]
     urows = [
-        scatter_lib.compact_gather(t, useg[f]) for f, t in enumerate(tables)
+        scatter_lib.compact_gather(t, useg[f], col=col)
+        for f, t in enumerate(tables)
     ]
     rows = [u.astype(cd)[inv[f]] for f, u in enumerate(urows)]
     return urows, rows
 
 
 def _compact_apply_all(tables, g_fulls, urows, config: TrainConfig,
-                       sr_base_key, step_idx, lr, aux, field_offset=0):
+                       sr_base_key, step_idx, lr, aux, field_offset=0,
+                       col=False):
     """COMPACT update: one cumsum-derived segment total and one
     unique+sorted cap-lane write per field (ops/scatter.compact_apply);
     the counterpart of :func:`_apply_field_updates` for
@@ -102,30 +104,31 @@ def _compact_apply_all(tables, g_fulls, urows, config: TrainConfig,
         new.append(
             scatter_lib.compact_apply(
                 tables[f], -lr * g_full, tuple(a[f] for a in aux),
-                config.sparse_update, key, urows[f],
+                config.sparse_update, key, urows[f], col=col,
             )
         )
     return new
 
 
-def _rows_for(compact, tables, aux, cd, gat, ids):
+def _rows_for(compact, tables, aux, cd, gat, ids, col=False):
     """The fused bodies' shared forward table access: the compact
     cap-lane path or the plain per-lane gather. Returns ``(urows,
     rows)`` — ``urows`` is None on the plain path. One definition so
     the three fused factories (FM/FFM/DeepFM) can never drift."""
     if compact:
-        return _compact_gather_all(tables, aux, cd)
+        return _compact_gather_all(tables, aux, cd, col=col)
     return None, _gather_all(gat, tables, ids, cd)
 
 
 def _updates_for(compact, tables, ids, g_fulls, rows, urows,
-                 config: TrainConfig, sr_base_key, step_idx, lr, aux):
+                 config: TrainConfig, sr_base_key, step_idx, lr, aux,
+                 col=False):
     """The fused bodies' shared update dispatch, counterpart of
     :func:`_rows_for` (same single-definition rationale)."""
     if compact:
         return _compact_apply_all(
             tables, g_fulls, urows, config, sr_base_key, step_idx, lr,
-            aux,
+            aux, col=col,
         )
     return _apply_field_updates(
         tables, ids, g_fulls, rows, config, sr_base_key, step_idx, lr,
@@ -211,6 +214,15 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
     compact = config.compact_cap > 0
     if compact and not spec.fused_linear:
         raise ValueError("compact_cap requires fused_linear=True")
+    col = getattr(spec, "table_layout", "row") == "col"
+    if col and not compact:
+        raise ValueError(
+            "table_layout='col' requires the compact path (compact_cap "
+            "> 0): the plain per-lane gather/scatter assumes row-major "
+            "tables"
+        )
+    if col and config.use_pallas:
+        raise ValueError("table_layout='col' and use_pallas are exclusive")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -231,7 +243,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             # per-lane rows expanded from the small buffers (the
             # [B]-lane work never touches table-sized operands).
             urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
-                                    ids)            # F × [B, k+1]
+                                    ids, col=col)   # F × [B, k+1]
         else:
             urows = None
             rows = spec.gather_rows(params, ids)        # F × [B, width]
@@ -284,7 +296,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                 g_fulls.append(jnp.concatenate([factor_grad(f), g_lin], axis=1))
             new_vw = _updates_for(
                 compact, params["vw"], ids, g_fulls, rows, urows, config,
-                sr_base_key, step_idx, lr, aux,
+                sr_base_key, step_idx, lr, aux, col=col,
             )
             out = {"w0": w0, "vw": new_vw}
         else:
