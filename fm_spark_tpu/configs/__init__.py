@@ -94,6 +94,13 @@ class RunConfig:
         """Build the model spec; ``num_features`` overrides the hashed size
         (required for dense-id datasets like MovieLens)."""
         n = num_features if num_features is not None else self.num_features
+        if self.table_layout != "row" and self.model != "field_fm":
+            # Never silently ignore an explicit layout request: only
+            # FieldFMSpec implements transposed storage.
+            raise ValueError(
+                f"table_layout={self.table_layout!r} is a field_fm "
+                f"option (config {self.name!r} is model {self.model!r})"
+            )
         common = dict(
             num_features=n, rank=self.rank, task=self.task, loss=self.loss,
             init_std=0.01, param_dtype=self.param_dtype,

@@ -230,6 +230,41 @@ def test_compact_validation():
         )
 
 
+def test_cli_measured_best_flags_smoke(tmp_path):
+    """End-to-end: the full measured-best flag set (PERF.md headline —
+    bf16 tables, bf16 compute, compact host-dedup, dedup_sr) trains,
+    evals, and saves through the CLI. Subprocess with ONE cpu device so
+    field_sparse routes to the single-chip fused step."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(__file__))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "fm_spark_tpu.cli",
+         "train", "--config", "criteo1tb_fm_r64", "--synthetic", "4096",
+         "--steps", "15", "--batch-size", "512",
+         "--strategy", "field_sparse",
+         "--param-dtype", "bfloat16", "--compute-dtype", "bfloat16",
+         "--sparse-update", "dedup_sr", "--host-dedup",
+         "--compact-cap", "512", "--prefetch", "2",
+         "--test-fraction", "0.2", "--log-every", "5",
+         "--model-out", str(tmp_path / "m")],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert '"eval"' in proc.stdout or "auc" in proc.stdout
+    from fm_spark_tpu.models.io import load_model
+
+    spec2, params2 = load_model(str(tmp_path / "m"))
+    assert spec2.param_dtype == "bfloat16"
+
+
 @pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
 @pytest.mark.parametrize("param_dtype", ["float32", "bfloat16"])
 def test_col_layout_matches_row_bitwise(rng, mode, param_dtype):
