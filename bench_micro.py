@@ -835,9 +835,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("bench", choices=[*BENCHES, "all"])
     ap.add_argument("--calls", type=int, default=30)
-    ap.add_argument("--n-idx", type=int, default=5_242_880,
-                    help="gather/scatter index count (~B*F at the "
-                    "headline batch)")
+    ap.add_argument("--n-idx", type=int, default=None,
+                    help="index count. Default depends on the probe: the "
+                    "single-table probes (gather/scatter) use B*F = "
+                    "5242880 (the headline step's total index count); "
+                    "the per-field batch probes (dedup/split/compact/"
+                    "cumsum/merge/stackfuse/scanmodel/transpose) use "
+                    "B = 131072 (the headline batch) — passing the B*F "
+                    "default to those would build a 204M-id host aux")
     ap.add_argument("--width", type=int, default=64)
     ap.add_argument("--rows", type=int, default=1 << 18)
     ap.add_argument("--tables", type=int, default=39)
@@ -860,9 +865,14 @@ def main():
         except Exception:
             pass
     _log(f"device: {jax.devices()[0].device_kind}")
+    import copy
+
     for name in (BENCHES if args.bench == "all" else [args.bench]):
+        a = copy.copy(args)
+        if a.n_idx is None:
+            a.n_idx = 5_242_880 if name in ("gather", "scatter") else 1 << 17
         _log(f"running {name}...")
-        BENCHES[name](args)
+        BENCHES[name](a)
 
 
 if __name__ == "__main__":
