@@ -438,8 +438,9 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         if compact_sharded:
             # DedupAuxBatches (installed below) appends the compact aux;
             # the F_pad padding (stack_compact_aux) rides the producer
-            # thread via the _PadAuxBatches wrapper, so prep only
-            # device-places it field-wise alongside the padded batch.
+            # thread via the MappedBatches wrapper installed alongside
+            # it, so prep only device-places it field-wise with the
+            # padded batch.
             from fm_spark_tpu.parallel import place_compact_aux
 
             _data_prep = prep

@@ -182,23 +182,32 @@ def parse_criteo_chunk(chunk: bytes, bucket: int, per_field: bool = True,
     return ids[:n], labels[:n], int(consumed.value)
 
 
-# Cap on the counting sort's O(bucket) per-thread scratch (int64
-# entries): 1 << 27 ≈ 1GB per thread — beyond that the numpy argsort
-# fallback is the safer trade.
+# Cap on the counting sort's O(bucket) scratch (int64 entries),
+# AGGREGATE across the min(F, hw) worker threads that each hold one
+# O(bucket) vector: 1 << 27 ≈ 1GB total — beyond that the numpy argsort
+# fallback is the safer trade. (Dividing the cap by the thread count is
+# what keeps F parallel workers from multiplying a "reasonable"
+# per-thread scratch into tens of host GB.)
 _COUNTING_SORT_MAX_BUCKET = 1 << 27
+
+
+def _counting_sort_fits(bucket: int, f: int) -> bool:
+    n_threads = max(1, min(f, os.cpu_count() or 1))
+    return bucket * n_threads <= _COUNTING_SORT_MAX_BUCKET
 
 
 def dedup_aux_native(ids: np.ndarray, bucket: int):
     """Native counting-sort dedup precompute (fm_dedup_aux); returns
     ``(order, seg, useg, ord_first)`` int32 ``[F, B]`` arrays, or None
     when the library is unavailable (caller falls back to numpy —
-    ops/scatter.dedup_aux) or the bucket count would make the O(bucket)
-    per-thread scratch unreasonable."""
+    ops/scatter.dedup_aux) or the bucket count would make the aggregate
+    O(bucket)-per-worker scratch unreasonable."""
     lib = _load()
-    if lib is None or bucket > _COUNTING_SORT_MAX_BUCKET:
+    ids = np.asarray(ids)
+    b, f = ids.shape
+    if lib is None or not _counting_sort_fits(bucket, f):
         return None
     ids = np.ascontiguousarray(ids, np.int32)
-    b, f = ids.shape
     out = tuple(np.empty((f, b), np.int32) for _ in range(4))
     lib.fm_dedup_aux(
         ids.ctypes.data, b, f, int(bucket),
@@ -220,11 +229,11 @@ def compact_aux_native(ids: np.ndarray, cap: int):
     ids = np.ascontiguousarray(ids, np.int32)
     b, f = ids.shape
     bucket = int(ids.max()) + 1 if b else 1
-    if bucket > _COUNTING_SORT_MAX_BUCKET:
+    if not _counting_sort_fits(bucket, f):
         # The C++ counting sort allocates an O(bucket) scratch vector
-        # PER WORKER THREAD; one stray giant id would turn that into
-        # multi-GB allocations inside the prefetch producer. Fall back
-        # to the numpy argsort path, which is O(B) memory.
+        # PER WORKER THREAD (min(F, hw) workers); one stray giant id
+        # would turn that into multi-GB allocations inside the prefetch
+        # producer. Fall back to the numpy argsort path, O(B) memory.
         return None
     useg = np.empty((f, cap), np.int32)
     segstart = np.empty((f, cap), np.int32)

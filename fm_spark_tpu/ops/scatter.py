@@ -233,6 +233,22 @@ def compact_aux(ids, cap: int):
     return useg, segstart, segend, order, inv
 
 
+def _check_sentinel_range(bucket: int, cap: int) -> None:
+    """The compact aux's OOB padding sentinels live in
+    ``[INT32_MAX - cap, INT32_MAX)`` (compact_aux). The aux builder
+    guards the ID side (ids < INT32_MAX - cap); this trace-time check
+    guards the TABLE side — a bucket dimension reaching into the
+    sentinel range would make padding lanes in-bounds and ``mode="drop"``
+    writes would corrupt real rows."""
+    imax = 2**31 - 1
+    if bucket > imax - cap:
+        raise ValueError(
+            f"table bucket dim {bucket} collides with the compact "
+            f"sentinel range [{imax - cap}, {imax}); shard or split the "
+            "table below INT32_MAX - cap rows"
+        )
+
+
 def compact_gather(table, useg, col: bool = False):
     """Forward half of the compact path: gather each unique id's row
     once — ``cap`` ascending lanes against the big table (sentinels clip
@@ -246,6 +262,8 @@ def compact_gather(table, useg, col: bool = False):
     shapes either way. The col gather is ~2x cheaper at big-table shapes
     because the scan tracks PHYSICAL bytes and the col layout has no
     minor-dim lane padding (PERF.md "transpose" probe)."""
+    _check_sentinel_range(table.shape[1] if col else table.shape[0],
+                          useg.shape[-1])
     if col:
         n = table.shape[1]
         return table.at[:, jnp.clip(useg, 0, n - 1)].get(
@@ -266,6 +284,8 @@ def compact_apply(table, delta, caux, mode, key, urows, col: bool = False):
     (see :func:`compact_gather`): the cap-sized update transposes before
     the column write; values are identical."""
     useg, segstart, segend, order, inv = caux
+    _check_sentinel_range(table.shape[1] if col else table.shape[0],
+                          useg.shape[-1])
     del inv
     sdelta = delta[order].astype(jnp.float32)
     csum = jnp.cumsum(sdelta, axis=0)

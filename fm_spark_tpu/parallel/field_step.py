@@ -227,15 +227,20 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     weights)`` — scores replicated across the mesh; the training body
     additionally consumes the locals for its analytic backward;
     ``uidx`` carries the single-owner scatter targets (OOB sentinel for
-    non-owned lanes) and ``urows`` the compact unique-row buffers (None
+    non-owned lanes; None on the compact path, whose writes target the
+    aux's cap lanes) and ``urows`` the compact unique-row buffers (None
     on the plain path).
     """
     from fm_spark_tpu.sparse import _compact_gather_all, _gather_all
 
     cd = spec.cdtype
     k = spec.rank
-    ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
-                         tiled=True)
+    if caux is None:
+        # The compact path never consumes per-lane ids (the aux carries
+        # the gather/scatter targets), so its ids all_to_all is skipped
+        # outright rather than left for XLA DCE to (maybe) elide.
+        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
+                             tiled=True)
     vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
                           tiled=True)
     labels = lax.all_gather(labels, "feat", tiled=True)
@@ -267,7 +272,7 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
         urows, rows = _compact_gather_all(
             [vw[f] for f in range(g["f_local"])], caux, cd
         )
-        uidx = ids
+        uidx = None  # compact writes target the aux's cap lanes, not ids
     else:
         rows = _gather_all(gat, vw, ids, cd)
         uidx = ids
@@ -277,7 +282,7 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     lin_p = (
         sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
         if spec.use_linear
-        else jnp.zeros((ids.shape[0],), cd)
+        else jnp.zeros((vals.shape[0],), cd)  # vals is post-all_to_all
     )
     # The scores collective: [B,k] + 2·[B] per step; tables never move.
     s = lax.psum(s_p, g["score_axes"])
