@@ -117,19 +117,22 @@ def inner_main(args):
                 or args.compute_dtype != "float32"
                 or args.table_layout != "row"
                 or args.rank != 64 or args.batch != 1 << 17
-                or args.steps != 20 or args.compact_cap)
+                or args.steps != 20 or args.compact_cap
+                or args.compact_device)
     variants = [(
         f"{args.param_dtype}/{args.sparse_update}"
         + ("/pallas" if args.use_pallas else "")
         + (f"/compact{args.compact_cap}" if args.compact_cap
            else "/hostdedup" if args.host_dedup else "")
+        + ("/devaux" if args.compact_device else "")
         + ("/cd-bf16" if args.compute_dtype == "bfloat16" else "")
         + ("/colT" if args.table_layout == "col" else ""),
         (args.param_dtype, None, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
                     use_pallas=args.use_pallas, host_dedup=args.host_dedup,
-                    compact_cap=args.compact_cap),
+                    compact_cap=args.compact_cap,
+                    compact_device=args.compact_device),
     )]
     if not explicit:
         # The COMPACT host-dedup candidates (PERF.md: the round-2 probes
@@ -158,6 +161,17 @@ def inner_main(args):
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
                         optimizer="sgd", sparse_update="dedup_sr",
                         host_dedup=True, compact_cap=cap),
+        ))
+        # DEVICE-built aux form of the winner (round-3): no host aux
+        # shipping/sort, F on-device sorts instead — the variant that
+        # composes with 2-D meshes and multi-process scale-out. Measured
+        # here so the single-chip cost of the in-step sort is on record.
+        variants.insert(2, (
+            f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        compact_device=True, compact_cap=cap),
         ))
         for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
             variants.append((
@@ -329,7 +343,13 @@ def main():
                     help="COMPACT host-dedup: static per-field unique-id "
                          "capacity; device touches the big tables with "
                          "cap lanes instead of B (requires --host-dedup "
-                         "and a dedup --sparse-update)")
+                         "or --compact-device, and a dedup "
+                         "--sparse-update)")
+    ap.add_argument("--compact-device", action="store_true",
+                    dest="compact_device",
+                    help="build the compact aux on device inside the "
+                         "step (the scale-out form of --compact-cap; "
+                         "exclusive with --host-dedup)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
@@ -339,12 +359,20 @@ def main():
                     help="hard wall-clock limit per attempt (seconds)")
     args = ap.parse_args()
 
-    if args.host_dedup and args.sparse_update not in ("dedup", "dedup_sr"):
-        ap.error("--host-dedup requires --sparse-update dedup or dedup_sr")
-    if args.host_dedup and args.use_pallas:
-        ap.error("--host-dedup and --use-pallas are exclusive")
-    if args.compact_cap and not args.host_dedup:
-        ap.error("--compact-cap requires --host-dedup")
+    if (args.host_dedup or args.compact_device) and (
+        args.sparse_update not in ("dedup", "dedup_sr")
+    ):
+        ap.error("--host-dedup/--compact-device require --sparse-update "
+                 "dedup or dedup_sr")
+    if (args.host_dedup or args.compact_device) and args.use_pallas:
+        ap.error("--host-dedup/--compact-device and --use-pallas are "
+                 "exclusive")
+    if args.compact_cap and not (args.host_dedup or args.compact_device):
+        ap.error("--compact-cap requires --host-dedup or --compact-device")
+    if args.compact_device and args.host_dedup:
+        ap.error("--compact-device and --host-dedup are exclusive")
+    if args.compact_device and not args.compact_cap:
+        ap.error("--compact-device requires --compact-cap")
 
     if args.inner:
         sys.exit(inner_main(args))
@@ -365,6 +393,8 @@ def main():
         argv.append("--host-dedup")
     if args.compact_cap:
         argv += ["--compact-cap", str(args.compact_cap)]
+    if args.compact_device:
+        argv.append("--compact-device")
     failures = []
     for attempt in range(1, args.attempts + 1):
         _log(f"[parent] attempt {attempt}/{args.attempts}")

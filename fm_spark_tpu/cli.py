@@ -331,12 +331,24 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         and isinstance(spec, FieldFMSpec)
     )
     if compact_sharded and (row_shards > 1 or jax.process_count() > 1):
-        # 2-D meshes split segments across row owners; multi-host
-        # processes hold only their row slice of the batch, but the aux
-        # must be built from every field's FULL global column.
+        # The HOST-built aux needs some host to hold every field's full
+        # global column (excludes multi-process) and raw global ids
+        # (excludes 2-D row ownership). The device-built aux has neither
+        # constraint.
         raise SystemExit(
-            "--compact-cap on multiple chips requires a 1-D field mesh "
-            "(no --row-shards) and a single process"
+            "host-built --compact-cap on multiple chips requires a 1-D "
+            "field mesh (no --row-shards) and a single process; add "
+            "--compact-device to build the aux in-step, which composes "
+            "with both"
+        )
+    if tconfig.compact_device and n > 1 and not isinstance(spec,
+                                                           FieldFMSpec):
+        # Sharded FFM/DeepFM steps don't take the device-compact path
+        # yet — hard-fail rather than silently train without the lever.
+        raise SystemExit(
+            f"--compact-device on {n} devices supports FieldFM configs "
+            f"(found {type(spec).__name__}); single-chip supports "
+            "FM/FFM/DeepFM"
         )
     if (tconfig.host_dedup and n > 1 and not compact_sharded
             and not isinstance(spec, FieldFFMSpec)):
@@ -503,6 +515,27 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             st = {k: v for k, v in st.items() if k not in ("lo", "hi")}
         return st
 
+    def fetch_loss(loss) -> float:
+        """The periodic loss fetch IS the overflow detector for the
+        device-compact 'error' policy (_fold_overflow poisons the loss
+        to +inf; no extra device→host sync per step). Detection
+        granularity is the log cadence; the poisoned step's updates
+        already landed with drops — restart from the last checkpoint
+        after raising the cap."""
+        lf = float(loss)
+        import math as _math
+
+        if (tconfig.compact_device and tconfig.compact_overflow == "error"
+                and _math.isinf(lf) and lf > 0):
+            raise SystemExit(
+                "compact_cap overflow: a field's per-batch unique-id "
+                f"count exceeded --compact-cap {tconfig.compact_cap} "
+                "(loss poisoned to +inf by the 'error' policy). Raise "
+                "--compact-cap, or pick --compact-overflow drop; "
+                "restart from the last checkpoint."
+            )
+        return lf
+
     # What a checkpoint stores: canonical host trees (topology-portable,
     # the default) or the live sharded arrays (--ckpt-sharded; orbax
     # writes each shard from its owner, no host gather).
@@ -519,7 +552,11 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         # producer thread, off the device critical path.
         from fm_spark_tpu.data import DedupAuxBatches
 
-        batches = DedupAuxBatches(batches, cap=tconfig.compact_cap)
+        batches = DedupAuxBatches(
+            batches, cap=tconfig.compact_cap,
+            overflow=("split" if tconfig.compact_overflow == "split"
+                      else "error"),
+        )
         if compact_sharded:
             # F_pad-padding of the aux also belongs in the producer.
             from fm_spark_tpu.data import MappedBatches
@@ -556,7 +593,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 if (i // log_every) > ((i - m) // log_every) or (
                     i >= tconfig.num_steps
                 ):
-                    logger.log(i, samples=since, loss=float(loss))
+                    logger.log(i, samples=since, loss=fetch_loss(loss))
                     since = 0
                 maybe_eval(i, lambda: to_canonical(params), window=m)
                 if checkpointer is not None and checkpointer.due_window(i, m):
@@ -569,7 +606,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                                          *prep(batch))
                 since += len(batch[2])
                 if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
-                    logger.log(i + 1, samples=since, loss=float(loss))
+                    logger.log(i + 1, samples=since, loss=fetch_loss(loss))
                     since = 0
                 maybe_eval(i + 1, lambda: to_canonical(params))
                 if checkpointer is not None and checkpointer.due(i + 1):
@@ -665,6 +702,8 @@ def cmd_train(args) -> int:
         eval_every=args.eval_every,
         host_dedup=True if args.host_dedup else None,
         compact_cap=args.compact_cap,
+        compact_device=True if args.compact_device else None,
+        compact_overflow=args.compact_overflow,
     )
 
     import jax as _jax
@@ -758,12 +797,15 @@ def cmd_train(args) -> int:
         else contextlib.nullcontext()
     )
     strategy = cfg.strategy
-    if tconfig.host_dedup and strategy != "field_sparse":
+    if (tconfig.host_dedup or tconfig.compact_device) and (
+        strategy != "field_sparse"
+    ):
         # Never silently ignore an explicit fast-path request: only the
-        # fused field_sparse loop consumes the aux operand.
+        # fused field_sparse loop takes the compact/dedup paths.
         raise SystemExit(
-            f"--host-dedup requires strategy 'field_sparse' "
-            f"(config {cfg.name!r} resolves to {strategy!r})"
+            f"--host-dedup/--compact-device require strategy "
+            f"'field_sparse' (config {cfg.name!r} resolves to "
+            f"{strategy!r})"
         )
     if args.steps_per_call > 1 and strategy != "field_sparse":
         raise SystemExit(
@@ -1038,7 +1080,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the measured headline winner, PERF.md). Must "
                         "bound every field's per-batch unique-id count "
                         "(the aux builder raises otherwise). Needs "
-                        "--host-dedup; single-chip FieldFM")
+                        "--host-dedup or --compact-device")
+    t.add_argument("--compact-device", action="store_true",
+                   dest="compact_device",
+                   help="build the compact aux ON DEVICE inside the step "
+                        "(no host aux shipping) — the scale-out form of "
+                        "--compact-cap: composes with --row-shards 2-D "
+                        "meshes and multi-process runs. Needs "
+                        "--compact-cap and a dedup --sparse-update; "
+                        "exclusive with --host-dedup")
+    t.add_argument("--compact-overflow", default=None,
+                   dest="compact_overflow",
+                   choices=["error", "drop", "split"],
+                   help="policy when a field's per-batch unique ids "
+                        "exceed --compact-cap: error (default; host aux "
+                        "raises before the step, device aux poisons the "
+                        "loss), drop (device: overflow ids behave as "
+                        "absent features), split (host: split the batch "
+                        "until every field fits — exact, more steps)")
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--row-shards", type=int, default=1, dest="row_shards",
                    help="field_sparse strategy: shard each field's bucket "

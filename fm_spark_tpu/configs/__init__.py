@@ -175,7 +175,11 @@ CONFIGS = {
             " dense gradients (optax path) — correctness fallback, not the"
             " at-scale path. Measured-best single-chip flags (PERF.md,"
             " +45%): --param-dtype bfloat16 --compute-dtype bfloat16"
-            " --sparse-update dedup_sr --host-dedup --compact-cap 16384.",
+            " --sparse-update dedup_sr --host-dedup --compact-cap 16384."
+            " Multi-chip / multi-host / --row-shards: swap --host-dedup"
+            " for --compact-device (the in-step aux build; ~11% slower"
+            " on ONE chip, the only form that composes with scale-out —"
+            " PERF.md round 3).",
             model="field_fm", dataset="criteo", rank=64, num_fields=39,
             bucket=1 << 18, strategy="field_sparse", num_steps=1_000_000,
             batch_size=1 << 17, learning_rate=0.05, lr_schedule="constant",

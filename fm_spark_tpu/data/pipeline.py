@@ -176,18 +176,92 @@ class DedupAuxBatches:
     work lands in the producer thread, off the device critical path —
     that placement is the entire point of host-assisted dedup
     (PERF.md round-3 lever).
+
+    ``overflow`` (compact only) picks what happens when a field's
+    unique count exceeds ``cap`` mid-run (a DATA property that can drift
+    hours into training):
+
+    - ``'error'`` (default) — propagate
+      :class:`~fm_spark_tpu.ops.scatter.CompactCapOverflow`; the run
+      dies with an actionable message (the round-2 behavior).
+    - ``'split'`` — recursively halve the offending batch until every
+      field fits, padding each half back to the full batch size with
+      INERT lanes (val=0, weight=0, ids copied from the half's first
+      row so padding never adds a unique id). Semantics stay exact —
+      each half is a correct smaller SGD step — at the cost of extra
+      step indices for that batch. While split halves are pending,
+      ``state()`` reports the cursor from BEFORE the split batch, so a
+      checkpoint-resume replays the WHOLE source batch (already-trained
+      halves repeat — no data is ever silently skipped).
     """
 
-    def __init__(self, source, cap: int = 0):
+    def __init__(self, source, cap: int = 0, overflow: str = "error"):
+        from collections import deque
+
+        if overflow not in ("error", "split"):
+            raise ValueError(
+                f"DedupAuxBatches overflow must be 'error' or 'split', "
+                f"got {overflow!r}"
+            )
         self._source = source
         self._cap = int(cap)
+        self._overflow = overflow
+        self._pending = deque()
+        self._pre_split_state = None
+
+    def _expand(self, batch, b_full: int):
+        """``batch`` holds the REAL rows only (possibly fewer than
+        ``b_full`` after splits); padding to the step's static batch
+        shape happens at each aux-build attempt, and the recursion
+        halves the real rows — strict progress, guaranteed
+        termination."""
+        from fm_spark_tpu.ops.scatter import (
+            CompactCapOverflow,
+            compact_aux,
+            dedup_aux,
+        )
+
+        ids, vals, labels, weights = (np.asarray(a) for a in batch)
+        r = ids.shape[0]
+        pad = b_full - r
+        if pad:
+            # Inert padding: repeat the part's first row's ids (no new
+            # uniques), zero vals/labels/weights (no forward, loss, or
+            # gradient contribution; delta 0 into existing segments).
+            ids = np.concatenate(
+                [ids, np.broadcast_to(ids[:1], (pad,) + ids.shape[1:])]
+            )
+            zero = lambda a: np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+            )
+            vals, labels, weights = zero(vals), zero(labels), zero(weights)
+        try:
+            aux = (compact_aux(ids, self._cap) if self._cap
+                   else dedup_aux(ids))
+            return [(ids, vals, labels, weights, aux)]
+        except CompactCapOverflow:
+            if self._overflow != "split" or r < 2:
+                raise
+        h = r // 2
+        return (
+            self._expand(tuple(a[:h] for a in batch), b_full)
+            + self._expand(tuple(a[h:r] for a in batch), b_full)
+        )
 
     def next_batch(self):
-        from fm_spark_tpu.ops.scatter import compact_aux, dedup_aux
-
-        ids, vals, labels, weights = self._source.next_batch()
-        aux = compact_aux(ids, self._cap) if self._cap else dedup_aux(ids)
-        return ids, vals, labels, weights, aux
+        if not self._pending:
+            pre = (self._source.state() if self._overflow == "split"
+                   else None)
+            batch = tuple(
+                np.asarray(a) for a in self._source.next_batch()
+            )
+            parts = self._expand(batch, batch[0].shape[0])
+            self._pending.extend(parts)
+            self._pre_split_state = pre if len(parts) > 1 else None
+        out = self._pending.popleft()
+        if not self._pending:
+            self._pre_split_state = None  # split batch fully consumed
+        return out
 
     def __iter__(self):
         return self
@@ -196,9 +270,13 @@ class DedupAuxBatches:
         return self.next_batch()
 
     def state(self):
+        if self._pre_split_state is not None:
+            return self._pre_split_state
         return self._source.state()
 
     def restore(self, state) -> None:
+        self._pending.clear()
+        self._pre_split_state = None
         self._source.restore(state)
 
 

@@ -246,7 +246,9 @@ def compact_aux_native(ids: np.ndarray, cap: int):
         order.ctypes.data, inv.ctypes.data,
     )
     if overflow >= 0:
-        raise ValueError(
+        from fm_spark_tpu.ops.scatter import CompactCapOverflow
+
+        raise CompactCapOverflow(
             f"field {overflow}: unique ids > compact cap {cap}; raise "
             "compact_cap (it must bound the per-field per-batch "
             "unique-id count)"

@@ -32,6 +32,15 @@ import jax.numpy as jnp
 SPARSE_UPDATE_MODES = ("scatter_add", "dedup", "dedup_sr")
 
 
+class CompactCapOverflow(ValueError):
+    """A field's per-batch unique-id count exceeded ``compact_cap``.
+
+    Dedicated type so the pipeline's ``compact_overflow='split'`` policy
+    (data/pipeline.DedupAuxBatches) can catch exactly this condition and
+    split the batch, while any other aux-builder error still propagates.
+    """
+
+
 def sr_key(base: jax.Array, step_idx, field: jax.Array | int) -> jax.Array:
     """The SR noise key schedule: one stream per (step, field).
 
@@ -217,7 +226,7 @@ def compact_aux(ids, cap: int):
                     else (np.empty(0, np.int32), np.empty(0, np.int64)))
         s = u.size
         if s > cap:
-            raise ValueError(
+            raise CompactCapOverflow(
                 f"field {j}: {s} unique ids > compact cap {cap}; raise "
                 "compact_cap (it must bound the per-field per-batch "
                 "unique-id count)"
@@ -247,6 +256,71 @@ def _check_sentinel_range(bucket: int, cap: int) -> None:
             f"sentinel range [{imax - cap}, {imax}); shard or split the "
             "table below INT32_MAX - cap rows"
         )
+
+
+def device_compact_aux(ids_col, cap: int):
+    """DEVICE-side :func:`compact_aux` for ONE field's full-batch id
+    column — jit/shard_map-safe (static shapes, no host round-trip).
+
+    Why it exists (PERF.md round-3): the host-built aux composes only
+    with layouts where some host holds every field's full global column
+    — which excludes multi-process feeds (each process holds a row
+    slice) and 2-D ``(feat, row)`` meshes (a segment's lanes span hosts'
+    slices but exactly one ROW SHARD owns the segment). Building the aux
+    on device AFTER the batch re-shard sidesteps both: each chip
+    compacts only the ``F/n`` columns it owns, so the per-chip sort cost
+    that made device-side dedup lose on ONE chip (PERF.md round-2 A/B:
+    39 sorts) shrinks by the mesh size.
+
+    Returns ``((useg, segstart, segend, order, inv), nseg)`` matching
+    the host builder's per-field contract bit-for-bit (both use a STABLE
+    sort, so downstream cumsum segment totals are bitwise identical —
+    pinned in tests/test_compact_device.py), plus the segment count for
+    overflow accounting. Unlike the host builder this cannot raise on
+    overflow: segments beyond ``cap`` (the LARGEST ids, since segments
+    are ascending) simply get no ``useg`` slot — their updates are never
+    written, and callers must zero their forward rows via
+    ``inv >= cap`` masking (``sparse._compact_gather_all`` with
+    ``mask_overflow=True``). That is the documented
+    ``compact_overflow='drop'`` semantics: overflow ids behave as
+    absent features for the overflowing batch.
+    """
+    b = ids_col.shape[0]
+    imax = 2**31 - 1
+    order = jnp.argsort(ids_col, stable=True).astype(jnp.int32)
+    sid = ids_col[order]
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]]
+    )
+    run_end = jnp.concatenate([run_start[1:], jnp.ones((1,), bool)])
+    seg = (jnp.cumsum(run_start) - 1).astype(jnp.int32)
+    nseg = seg[-1] + 1
+    lane = jnp.arange(b, dtype=jnp.int32)
+    # Scatters against [cap]-sized outputs: small-operand fast rate;
+    # segments past cap target index `cap` → dropped (overflow). NOTE:
+    # no sorted/unique promises here — the OOB drop value `cap` is
+    # interleaved between (and duplicates among) the ascending segment
+    # targets, so neither promise holds and claiming them would be
+    # undefined behavior XLA may exploit.
+    start_tgt = jnp.where(run_start, seg, cap)
+    end_tgt = jnp.where(run_end, seg, cap)
+    useg = jnp.zeros((cap,), jnp.int32).at[start_tgt].set(
+        sid, mode="drop"
+    )
+    segstart = jnp.full((cap,), b - 1, jnp.int32).at[start_tgt].set(
+        lane, mode="drop"
+    )
+    segend = jnp.full((cap,), b - 1, jnp.int32).at[end_tgt].set(
+        lane, mode="drop"
+    )
+    # Padding slots (pos >= nseg) carry the host builder's ascending OOB
+    # sentinels so the sorted+unique scatter promises keep holding.
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    useg = jnp.where(pos < nseg, useg, (imax - cap) + (pos - nseg))
+    segstart = jnp.where(pos < nseg, segstart, b - 1)
+    segend = jnp.where(pos < nseg, segend, b - 1)
+    inv = jnp.zeros((b,), jnp.int32).at[order].set(seg, unique_indices=True)
+    return (useg, segstart, segend, order, inv), nseg
 
 
 def compact_gather(table, useg, col: bool = False):

@@ -209,7 +209,7 @@ def _mesh_geometry(spec, mesh):
 
 
 def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
-                   caux=None):
+                   caux=None, device_cap: int = 0):
     """The field-sharded forward, shared by the train body and the eval
     step: example-sharded → field-sharded re-shard (all_to_all over
     ``feat``; labels/weights ride all_gathers in the SAME collective
@@ -223,22 +223,37 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     order — exactly the order the host built the aux from — so the
     compact expansion applies per local field unchanged.
 
+    ``device_cap`` > 0 selects the DEVICE-built compact aux instead
+    (ops/scatter.device_compact_aux on each owned column, after the
+    re-shard): no host aux operand, so it composes with multi-process
+    feeds, and on a 2-D mesh each row shard compacts its ownership-
+    masked ids (non-owned lanes collapse into one out-of-range segment
+    whose writes drop — note that segment consumes one of the ``cap``
+    slots). Exclusive with ``caux``.
+
     Returns ``(scores, s, xvs, rows, vals_c, uidx, urows, labels,
-    weights)`` — scores replicated across the mesh; the training body
-    additionally consumes the locals for its analytic backward;
-    ``uidx`` carries the single-owner scatter targets (OOB sentinel for
-    non-owned lanes; None on the compact path, whose writes target the
-    aux's cap lanes) and ``urows`` the compact unique-row buffers (None
-    on the plain path).
+    weights, aux, ovf)`` — scores replicated across the mesh; the
+    training body additionally consumes the locals for its analytic
+    backward; ``uidx`` carries the single-owner scatter targets (OOB
+    sentinel for non-owned lanes; None on the compact paths, whose
+    writes target the aux's cap lanes); ``urows`` the compact
+    unique-row buffers (None on the plain path); ``aux`` the compact
+    aux actually in effect (host or device-built); ``ovf`` the
+    device path's per-chip overflow count (None otherwise).
     """
-    from fm_spark_tpu.sparse import _compact_gather_all, _gather_all
+    from fm_spark_tpu.sparse import (
+        _compact_gather_all,
+        _device_compact_aux_all,
+        _gather_all,
+    )
 
     cd = spec.cdtype
     k = spec.rank
     if caux is None:
-        # The compact path never consumes per-lane ids (the aux carries
-        # the gather/scatter targets), so its ids all_to_all is skipped
-        # outright rather than left for XLA DCE to (maybe) elide.
+        # The host-compact path never consumes per-lane ids (the aux
+        # carries the gather/scatter targets), so its ids all_to_all is
+        # skipped outright rather than left for XLA DCE to (maybe)
+        # elide. The device-compact path needs the ids to build the aux.
         ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
                              tiled=True)
     vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
@@ -253,7 +268,37 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
 
     vals_c = vals.astype(cd)
     urows = None
-    if g["two_d"]:
+    aux = caux
+    ovf = None
+    if device_cap > 0:
+        own = None
+        cids = ids
+        extra = None
+        if g["two_d"]:
+            # Ownership masking BEFORE the sort: every non-owned lane
+            # takes the out-of-range id ``bucket_local``, so all of them
+            # collapse into the tail segment — its useg entry is OOB
+            # (writes drop) and its expanded rows are zeroed below.
+            # Each real segment is wholly owned by exactly one row shard
+            # (ids in [lo, lo+bucket_local)), so owned segment sums are
+            # complete without any cross-shard reduction. The sentinel
+            # segment is discounted from overflow accounting (dropping
+            # it is the point, not data loss).
+            lo = lax.axis_index("row") * g["bucket_local"]
+            loc = ids - lo
+            own = (loc >= 0) & (loc < g["bucket_local"])
+            cids = jnp.where(own, loc, g["bucket_local"])
+            extra = jnp.any(~own, axis=0).astype(jnp.int32)
+        aux, ovf = _device_compact_aux_all(cids, device_cap, g["f_local"],
+                                           extra_segs=extra)
+        urows, rows = _compact_gather_all(
+            [vw[f] for f in range(g["f_local"])], aux, cd,
+            mask_overflow=True,
+        )
+        if own is not None:
+            rows = [r * own[:, f, None] for f, r in enumerate(rows)]
+        uidx = None
+    elif g["two_d"]:
         # Each (field, example) id is owned by exactly one row shard:
         # gather locally where owned, zero elsewhere; the psum over both
         # axes reconstructs the exact sums. Non-owned update lanes go to
@@ -293,7 +338,8 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
         scores = scores + lin
     if spec.use_bias:
         scores = scores + w0.astype(cd)
-    return scores, s, xvs, rows, vals_c, uidx, urows, labels, weights
+    return (scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
+            aux, ovf)
 
 
 def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
@@ -327,19 +373,23 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         )
     g = _mesh_geometry(spec, mesh)
     compact = config.compact_cap > 0
+    device_cap = config.compact_cap if config.compact_device else 0
+    host_compact = compact and not config.compact_device
     if compact:
-        # Compact host-dedup on the sharded step: supported on the 1-D
+        _check_host_dedup(config)
+    if host_compact:
+        # Compact HOST-dedup on the sharded step: supported on the 1-D
         # feat mesh — the aux is built from the GLOBAL batch and shards
         # field-wise (see _field_forward). The 2-D mesh's row-ownership
-        # masking is incompatible with the single-owner cap-lane write
-        # (a segment may span row shards), and plain full-B host_dedup
-        # is a measured loser — both rejected.
-        _check_host_dedup(config)
+        # masking is incompatible with a host aux built from raw global
+        # ids (a segment's owner depends on the row shard), and plain
+        # full-B host_dedup is a measured loser — both rejected. The
+        # DEVICE-built aux (config.compact_device) lifts both limits.
         if g["two_d"]:
             raise ValueError(
-                "compact_cap on the sharded step requires a 1-D "
-                "('feat',) mesh (row sharding splits segments across "
-                "owners)"
+                "host-built compact_cap on the sharded step requires a "
+                "1-D ('feat',) mesh; use compact_device=True for 2-D "
+                "(feat, row) meshes"
             )
     elif config.host_dedup:
         _reject_host_aux(config, "the field-sharded step (non-compact)")
@@ -356,20 +406,21 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     def local_step(params, step_idx, ids, vals, labels, weights,
                    caux=None):
         # Local blocks in: vw [f_local, bucket/n_row, width]; ids/vals
-        # [B/n, F_pad]; labels/weights [B/n]; caux (compact) the
+        # [B/n, F_pad]; labels/weights [B/n]; caux (host compact) the
         # [f_local, ...] aux slices. The shared forward (_field_forward)
         # re-shards, gathers, and psums; the backward below is
         # training-only.
-        if compact and caux is None:
+        if host_compact and caux is None:
             raise ValueError(
                 "compact sharded step needs the batch's compact_aux "
                 "operand (stacked [F_pad, ...], sharded over feat)"
             )
         vw = params["vw"]
         w0 = params["w0"]
-        scores, s, xvs, rows, vals_c, uidx, urows, labels, weights = (
-            _field_forward(spec, g, gat, vw, w0, ids, vals, labels,
-                           weights, caux=caux)
+        (scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
+         aux, ovf) = _field_forward(
+            spec, g, gat, vw, w0, ids, vals, labels, weights, caux=caux,
+            device_cap=device_cap,
         )
 
         # From here on every chip holds identical full-batch values.
@@ -405,7 +456,7 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         if compact:
             new_slices = _compact_apply_all(
                 [vw[f] for f in range(f_local)], g_fulls, urows, config,
-                sr_base_key, step_idx, lr, caux,
+                sr_base_key, step_idx, lr, aux,
                 field_offset=field_offset,
             )
         else:
@@ -419,9 +470,17 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         if spec.use_bias:
             # dscores is replicated — a plain sum is the global bias grad.
             out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
+        if ovf is not None:
+            # Worst overflow anywhere on the mesh; the fold (policy
+            # 'error') poisons the replicated loss so every host sees it.
+            from fm_spark_tpu.sparse import _fold_overflow
+
+            loss = _fold_overflow(
+                loss, lax.pmax(ovf, g["score_axes"]), config
+            )
         return out, loss
 
-    if compact:
+    if host_compact:
         return jax.shard_map(
             local_step,
             mesh=mesh,
@@ -763,7 +822,7 @@ def make_field_sharded_eval_step(spec, mesh):
     gat = lambda table, idx: table[idx]  # eval always takes the XLA gather
 
     def local_eval(params, mstate, ids, vals, labels, weights):
-        scores, _, _, _, _, _, _, labels, weights = _field_forward(
+        scores, _, _, _, _, _, _, labels, weights, _, _ = _field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
         )
@@ -870,7 +929,7 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
         # The shared FM forward (scores incl. linear + bias), then the
         # deep head exactly as training: local xv columns, one all_gather
         # of h, the replicated MLP.
-        scores, _, xvs, _, _, _, _, labels, weights = _field_forward(
+        scores, _, xvs, _, _, _, _, labels, weights, _, _ = _field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
         )

@@ -1,0 +1,117 @@
+"""Multi-chip projection model for the field-sharded fused step.
+
+No multi-chip hardware is reachable from this environment (one tunneled
+v5e chip — PERF.md), so the 8-chip aggregate cannot be measured. What
+CAN be committed is (a) exact per-chip work and collective-traffic
+counts for the sharded program, derivable from its construction
+(parallel/field_step.py), and (b) a time model whose every input is a
+measured single-chip number or a named assumption — so a reviewer can
+audit the arithmetic and swap assumptions. VERDICT r2 #6 asked for
+exactly this; ``__graft_entry__.dryrun_multichip`` prints the result so
+the driver's MULTICHIP artifact carries it.
+
+Model (1-D ``feat`` mesh, the config-3 layout):
+
+- Each chip owns ``F_pad/n`` fields and performs only their big-table
+  index ops: ``cap`` gather + ``cap`` scatter lanes per owned field on
+  the compact path (B lanes each on the plain path).
+- The per-field [B]-lane work (expand, reorder, cumsum) also shards by
+  ``n`` — it is per owned field.
+- What does NOT shard: per-dispatch overhead, the replicated score /
+  dscores math ([B, k] reductions), and the collectives.
+- ICI traffic per chip per step: the batch all_to_all (ids+vals),
+  labels/weights all_gathers, and the ring-allreduce psum of
+  ``(s[B,k], sq[B], lin[B])`` — tables never move (single-owner
+  design).
+
+Time decomposition: the measured single-chip step time ``T1 = B/rate``
+splits into ``t_fixed`` (dispatch + replicated score math, measured /
+estimated from bench_micro probes) and ``t_sharded = T1 - t_fixed``
+(everything that divides by ``n``). Then
+
+    t(n) = t_fixed + t_sharded / n + ici_bytes(n) / ici_bw
+    aggregate(n) = B / t(n)        # global samples per second
+"""
+
+from __future__ import annotations
+
+
+def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
+                        device_aux: bool = False) -> dict:
+    """Exact per-chip work + ICI traffic counts for one step of the
+    1-D field-sharded fused step (see module docstring). ``cap=0`` =
+    plain (non-compact) path. Byte counts assume int32 ids, fp32 vals/
+    labels/weights and fp32 compute buffers for the psum (the compact
+    path's cumsum stays fp32 by design)."""
+    f_pad = -(-F // n) * n
+    f_local = f_pad // n
+    lanes = cap if cap else B
+    per_chip = {
+        # Index ops against the BIG tables — the measured bottleneck
+        # (PERF.md facts 2-3). This is the n-fold reduction scale-out
+        # buys.
+        "big_table_gather_lanes": lanes * f_local,
+        "big_table_scatter_lanes": lanes * f_local,
+        # [B]-lane work per owned field against SMALL (cap- or B-sized)
+        # operands: compact expand + delta reorder + cumsum.
+        "small_operand_lanes": (3 * B * f_local) if cap else 0,
+        # Device-built aux only: one [B] stable sort per owned field.
+        "aux_sort_lanes": (B * f_local) if (cap and device_aux) else 0,
+    }
+    ring = 2 * (n - 1) / n  # ring all-reduce traffic factor
+    recv = (n - 1) / n      # fraction of an all_to_all/all_gather that
+    #                         crosses ICI (the rest is already local)
+    a2a_cols = f_local * (8 if device_aux or not cap else 4)
+    # host-compact skips the ids all_to_all (field_step._field_forward);
+    # its aux arrives host->device, not over ICI.
+    ici = {
+        "a2a_batch": int(B * a2a_cols * recv),
+        "allgather_labels_weights": int(8 * B * recv),
+        "psum_scores": int(ring * 4 * B * (k + 2)),
+    }
+    ici["total"] = sum(v for kk, v in ici.items() if kk != "total")
+    per_chip["ici_bytes_per_step"] = ici
+    per_chip["f_local"] = f_local
+    return per_chip
+
+
+def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
+                      n: int, cap: int = 0, device_aux: bool = False,
+                      dispatch_ms: float = 2.5,
+                      replicated_score_ms: float = 2.0,
+                      ici_gbps: float = 100.0) -> dict:
+    """Projected n-chip aggregate throughput from a MEASURED single-chip
+    rate. Every assumption is a named argument echoed in the output:
+
+    - ``dispatch_ms``: per-step dispatch overhead (bench_micro
+      ``dispatch``, measured 2.5ms this attachment; ~0.1ms expected on
+      a direct-attached host).
+    - ``replicated_score_ms``: the [B, k] score/dscores math every chip
+      repeats on the full batch (≈ one read pass over s·s + loss grads;
+      estimated from the measured 35-90 GB/s effective stream rate).
+    - ``ici_gbps``: assumed effective per-chip ICI bandwidth. Not
+      measurable here; 100 GB/s is conservative for a v5e torus link
+      set (nominal is several hundred GB/s).
+    """
+    costs = field_sharded_costs(B, F, k, n, cap, device_aux)
+    t1 = B / single_chip_rate
+    t_fixed = (dispatch_ms + replicated_score_ms) / 1e3
+    t_sharded = max(t1 - t_fixed, 0.0)
+    t_ici = costs["ici_bytes_per_step"]["total"] / (ici_gbps * 1e9)
+    t_n = t_fixed + t_sharded / n + t_ici
+    return {
+        "model": "t(n) = t_fixed + (T1 - t_fixed)/n + ici/bw",
+        "inputs": {
+            "single_chip_rate": round(single_chip_rate),
+            "B": B, "F": F, "k": k, "n": n, "cap": cap,
+            "device_aux": device_aux,
+            "dispatch_ms": dispatch_ms,
+            "replicated_score_ms": replicated_score_ms,
+            "ici_gbps": ici_gbps,
+        },
+        "per_chip": costs,
+        "t_single_chip_ms": round(t1 * 1e3, 2),
+        "t_projected_ms": round(t_n * 1e3, 2),
+        "projected_aggregate_samples_per_sec": round(B / t_n),
+        "projected_per_chip_samples_per_sec": round(B / t_n / n),
+    }

@@ -48,36 +48,79 @@ def _sr_base_key(config: TrainConfig):
 
 
 def _check_host_dedup(config: TrainConfig):
-    """Shared host_dedup/compact preconditions for the three single-chip
-    fused bodies (single definition so the factories can never drift)."""
-    if config.compact_cap > 0 and not config.host_dedup:
-        raise ValueError("compact_cap requires host_dedup=True")
-    if not config.host_dedup:
+    """Shared host_dedup/compact preconditions for the fused bodies
+    (single definition so the factories can never drift)."""
+    if config.compact_device:
+        if config.compact_cap <= 0:
+            raise ValueError("compact_device requires compact_cap > 0")
+        if config.host_dedup:
+            raise ValueError(
+                "compact_device builds the aux in-step; host_dedup is "
+                "exclusive with it"
+            )
+    if config.compact_cap > 0 and not (
+        config.host_dedup or config.compact_device
+    ):
+        raise ValueError(
+            "compact_cap requires host_dedup=True or compact_device=True"
+        )
+    if config.compact_overflow not in ("error", "drop", "split"):
+        raise ValueError(
+            f"unknown compact_overflow {config.compact_overflow!r}"
+        )
+    if config.compact_overflow == "drop" and not config.compact_device:
+        raise ValueError(
+            "compact_overflow='drop' is the device-side policy; the "
+            "host aux builder detects overflow before the step (use "
+            "'error' or 'split')"
+        )
+    if config.compact_overflow == "split" and config.compact_device:
+        raise ValueError(
+            "compact_overflow='split' is the host-pipeline policy; the "
+            "device path cannot reshape a batch in-step (use 'error' "
+            "or 'drop')"
+        )
+    if not (config.host_dedup or config.compact_device):
         return
     if config.sparse_update not in ("dedup", "dedup_sr"):
         raise ValueError(
-            "host_dedup requires sparse_update='dedup' or 'dedup_sr'"
+            "host_dedup/compact_device require sparse_update='dedup' "
+            "or 'dedup_sr'"
         )
     if config.use_pallas:
-        raise ValueError("host_dedup and use_pallas are exclusive")
+        raise ValueError("host_dedup/compact_device and use_pallas are "
+                         "exclusive")
 
 
-def _compact_gather_all(tables, aux, cd, col=False):
+def _compact_gather_all(tables, aux, cd, col=False, mask_overflow=False):
     """COMPACT forward table access (``config.compact_cap`` > 0): gather
     each field's ``cap`` unique rows once from the big table, expand
-    per-lane rows from the small [cap, w] buffer via the host-built
-    inverse map (ops/scatter.compact_aux). Returns ``(urows, rows)`` —
-    ``urows`` in storage dtype (the dedup_sr old-row operand), ``rows``
-    in compute dtype, shaped exactly like :func:`_gather_all`'s output
-    so the bodies' math is unchanged."""
+    per-lane rows from the small [cap, w] buffer via the inverse map
+    (ops/scatter.compact_aux or device_compact_aux). Returns ``(urows,
+    rows)`` — ``urows`` in storage dtype (the dedup_sr old-row operand),
+    ``rows`` in compute dtype, shaped exactly like :func:`_gather_all`'s
+    output so the bodies' math is unchanged.
+
+    ``mask_overflow`` (device-built aux only): lanes whose segment index
+    reached past ``cap`` — possible because the device builder cannot
+    raise — expand to ZERO rows (absent-feature drop semantics) instead
+    of whatever the clipped expansion gather returns. The host builder
+    guarantees ``inv < cap``, so its callers skip the extra [B, w]
+    multiply."""
     from fm_spark_tpu.ops import scatter as scatter_lib
 
     useg, inv = aux[0], aux[4]
+    cap = useg.shape[-1]
     urows = [
         scatter_lib.compact_gather(t, useg[f], col=col)
         for f, t in enumerate(tables)
     ]
-    rows = [u.astype(cd)[inv[f]] for f, u in enumerate(urows)]
+    rows = []
+    for f, u in enumerate(urows):
+        r = u.astype(cd).at[inv[f]].get(mode="clip")
+        if mask_overflow:
+            r = r * (inv[f] < cap)[:, None].astype(cd)
+        rows.append(r)
     return urows, rows
 
 
@@ -110,14 +153,61 @@ def _compact_apply_all(tables, g_fulls, urows, config: TrainConfig,
     return new
 
 
-def _rows_for(compact, tables, aux, cd, gat, ids, col=False):
+def _device_compact_aux_all(ids, cap: int, f_count: int,
+                            extra_segs=None):
+    """In-step compact aux for ``f_count`` local id columns
+    (ops/scatter.device_compact_aux per field, stacked to the host
+    builder's ``[F, ...]`` layout so every downstream compact helper is
+    shared verbatim). Returns ``(aux, ovf)`` — ``ovf`` is the worst
+    per-field REAL-segment overflow past ``cap`` (0 = every field fit).
+    ``extra_segs`` ([f_count] int) discounts segments that are dropped
+    BY DESIGN — the 2-D mesh's ownership-mask sentinel segment sorts
+    last, so when it spills past ``cap`` that is correct masking, not
+    data loss."""
+    from fm_spark_tpu.ops import scatter as scatter_lib
+
+    auxs, nsegs = [], []
+    for f in range(f_count):
+        a, nseg = scatter_lib.device_compact_aux(ids[:, f], cap)
+        auxs.append(a)
+        nsegs.append(nseg)
+    aux = tuple(jnp.stack([a[i] for a in auxs]) for i in range(5))
+    nsegs = jnp.stack(nsegs)
+    if extra_segs is not None:
+        nsegs = nsegs - extra_segs
+    ovf = jnp.maximum(jnp.max(nsegs) - cap, 0)
+    return aux, ovf
+
+
+def _fold_overflow(loss, ovf, config: TrainConfig):
+    """Overflow policy for the device-compact path: 'error' poisons the
+    loss to +inf (the training loop's periodic loss fetch turns that
+    into an actionable failure — no extra device→host sync per step);
+    'drop' accepts the documented absent-feature semantics silently."""
+    if ovf is None or config.compact_overflow == "drop":
+        return loss
+    return jnp.where(ovf > 0, jnp.float32(jnp.inf), loss)
+
+
+def _rows_for(compact, tables, aux, cd, gat, ids, col=False,
+              device_cap: int = 0):
     """The fused bodies' shared forward table access: the compact
-    cap-lane path or the plain per-lane gather. Returns ``(urows,
-    rows)`` — ``urows`` is None on the plain path. One definition so
-    the three fused factories (FM/FFM/DeepFM) can never drift."""
+    cap-lane path (host- or device-built aux) or the plain per-lane
+    gather. Returns ``(urows, rows, aux, ovf)`` — ``urows``/``ovf`` are
+    None on the plain path; ``aux`` is echoed (host) or freshly built
+    (device) so the update half consumes one object either way. One
+    definition so the three fused factories (FM/FFM/DeepFM) can never
+    drift."""
+    if device_cap > 0:
+        aux, ovf = _device_compact_aux_all(ids, device_cap,
+                                           len(tables))
+        urows, rows = _compact_gather_all(tables, aux, cd, col=col,
+                                          mask_overflow=True)
+        return urows, rows, aux, ovf
     if compact:
-        return _compact_gather_all(tables, aux, cd, col=col)
-    return None, _gather_all(gat, tables, ids, cd)
+        urows, rows = _compact_gather_all(tables, aux, cd, col=col)
+        return urows, rows, aux, None
+    return None, _gather_all(gat, tables, ids, cd), aux, None
 
 
 def _updates_for(compact, tables, ids, g_fulls, rows, urows,
@@ -143,8 +233,9 @@ def _reject_host_aux(config: TrainConfig, what: str):
     factory cannot forget the check's wording or semantics."""
     if config.host_dedup or config.compact_cap:
         raise ValueError(
-            f"host_dedup/compact_cap are single-chip fused-step levers; "
-            f"{what} does not consume the aux operand"
+            f"host_dedup/compact_cap (host- or device-built) are not "
+            f"supported by {what}; drop the flags or pick a supported "
+            "layout"
         )
 
 
@@ -230,6 +321,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
     lr_at = _lr_at(config)
     gat = _gather_fn(config)
     k = spec.rank
+    device_cap = config.compact_cap if config.compact_device else 0
 
     def step(params, step_idx, ids, vals, labels, weights, aux=None):
         if config.host_dedup and aux is None:
@@ -238,12 +330,15 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
+        ovf = None
         if spec.fused_linear:
             # Compact = cap unique rows per field from the big tables,
             # per-lane rows expanded from the small buffers (the
             # [B]-lane work never touches table-sized operands).
-            urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
-                                    ids, col=col)   # F × [B, k+1]
+            urows, rows, aux, ovf = _rows_for(
+                compact, params["vw"], aux, cd, gat, ids, col=col,
+                device_cap=device_cap,
+            )                                           # F × [B, k+1]
         else:
             urows = None
             rows = spec.gather_rows(params, ids)        # F × [B, width]
@@ -319,7 +414,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             out = {"w0": w0, "w": new_w, "v": new_v}
         if spec.use_bias:
             out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
-        return out, loss
+        return out, _fold_overflow(loss, ovf, config)
 
     return step
 
@@ -363,13 +458,18 @@ def make_field_sparse_multistep(spec, config: TrainConfig, n: int):
     @functools.partial(jax.jit, donate_argnums=(0,))
     def mstep(params, step0, m, ids, vals, labels, weights, aux=None):
         def fbody(j, carry):
-            p, _ = carry
+            p, prev = carry
             a = (
                 None if aux is None
                 else jax.tree_util.tree_map(lambda x: x[j], aux)
             )
-            return body(p, step0 + j, ids[j], vals[j], labels[j],
-                        weights[j], a)
+            p, loss = body(p, step0 + j, ids[j], vals[j], labels[j],
+                           weights[j], a)
+            # Sticky +inf: the compact-overflow 'error' poison
+            # (_fold_overflow) must survive to the returned loss even
+            # when a later inner step is clean — otherwise a fori roll
+            # would silently swallow the failure signal.
+            return p, jnp.where(jnp.isposinf(prev), prev, loss)
 
         return jax.lax.fori_loop(0, m, fbody, (params, jnp.float32(0)))
 
@@ -412,8 +512,10 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
-                                ids)                # F × [B, F·k+1]
+        urows, rows, aux, ovf = _rows_for(
+            compact, params["vw"], aux, cd, gat, ids,
+            device_cap=config.compact_cap if config.compact_device else 0,
+        )                                               # F × [B, F·k+1]
         sel = spec._sel(rows, vals_c)                   # [B, F, F, k]
         a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)
         diag = jnp.trace(a, axis1=1, axis2=2)
@@ -461,7 +563,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         out = {"w0": w0, "vw": new_vw}
         if spec.use_bias:
             out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
-        return out, loss
+        return out, _fold_overflow(loss, ovf, config)
 
     return step
 
@@ -525,8 +627,10 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
             )
         w0 = params["w0"]
         vals_c = vals.astype(cd)
-        urows, rows = _rows_for(compact, params["vw"], aux, cd, gat,
-                                ids)                # F × [B, k+1]
+        urows, rows, aux, ovf = _rows_for(
+            compact, params["vw"], aux, cd, gat, ids,
+            device_cap=config.compact_cap if config.compact_device else 0,
+        )                                           # F × [B, k+1]
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
@@ -596,7 +700,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
         return (
             {"w0": new_dense["w0"], "vw": new_vw, "mlp": new_dense["mlp"]},
             opt_state,
-            loss,
+            _fold_overflow(loss, ovf, config),
         )
 
     def step(params, opt_state, step_idx, ids, vals, labels, weights,

@@ -72,8 +72,32 @@ class TrainConfig:
     # from the [cap, w] buffer, segment sums via one cumsum (no B-lane
     # scatter), one unique+sorted write per id. Must bound the per-field
     # per-batch unique-id count (the aux builder raises otherwise).
-    # Requires host_dedup=True and a dedup sparse_update mode.
+    # Requires host_dedup=True (or compact_device) and a dedup
+    # sparse_update mode.
     compact_cap: int = 0
+    # Build the compact aux ON DEVICE inside the step (one stable
+    # argsort + cap-lane scatters per field — ops/scatter.
+    # device_compact_aux) instead of shipping a host-built aux with the
+    # batch. This is the scale-out form of the compact lever: it
+    # composes with 2-D (feat, row) meshes and multi-process feeds
+    # (each chip compacts only the F/n columns it owns after the
+    # all_to_all), where the host aux structurally cannot. Single-chip
+    # it trades the 47MB/batch aux transfer + host sort for F on-device
+    # sorts — measure per attachment (bench.py sweep). Exclusive with
+    # host_dedup; requires compact_cap > 0 and a dedup sparse_update.
+    compact_device: bool = False
+    # What happens when a field's per-batch unique-id count exceeds
+    # compact_cap:
+    #  'error' — host aux: raise before the step (the r2 behavior);
+    #            device aux: poison the loss to +inf, which the training
+    #            loop's periodic loss fetch turns into a hard error.
+    #  'drop'  — device aux only: ids past the cap-th unique (the
+    #            largest ids) behave as absent features for that batch —
+    #            bounded, documented degradation instead of a crash.
+    #  'split' — host aux only: the pipeline splits the offending batch
+    #            into halves (zero-weight padded) until every field
+    #            fits — exact semantics, more (smaller) steps.
+    compact_overflow: str = "error"
 
 
 def _group_reg(config: TrainConfig):

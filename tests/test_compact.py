@@ -221,7 +221,7 @@ def test_compact_validation():
     )
 
     mesh = make_field_mesh(1)
-    with pytest.raises(ValueError, match="single-chip"):
+    with pytest.raises(ValueError, match="not supported"):
         make_field_sharded_sgd_body(
             spec,
             TrainConfig(optimizer="sgd", sparse_update="dedup",
