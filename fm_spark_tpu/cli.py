@@ -251,6 +251,85 @@ def _periodic_evaluator(spec, tconfig, eval_source, logger, evaluate=None):
     return maybe_eval
 
 
+def _single_fm_step(spec, tconfig):
+    from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+
+    return make_field_sparse_sgd_step(spec, tconfig)
+
+
+def _single_ffm_step(spec, tconfig):
+    from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
+
+    return make_field_ffm_sparse_sgd_step(spec, tconfig)
+
+
+def _single_deepfm_step(spec, tconfig):
+    from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
+
+    return make_field_deepfm_sparse_step(spec, tconfig)
+
+
+def _sharded_fm_step(spec, tconfig, mesh):
+    from fm_spark_tpu.parallel import make_field_sharded_sgd_step
+
+    return make_field_sharded_sgd_step(spec, tconfig, mesh)
+
+
+def _sharded_ffm_step(spec, tconfig, mesh):
+    from fm_spark_tpu.parallel import make_field_ffm_sharded_step
+
+    return make_field_ffm_sharded_step(spec, tconfig, mesh)
+
+
+def _sharded_deepfm_step(spec, tconfig, mesh):
+    from fm_spark_tpu.parallel import make_field_deepfm_sharded_step
+
+    return make_field_deepfm_sharded_step(spec, tconfig, mesh)
+
+
+@dataclasses.dataclass(frozen=True)
+class _FieldCap:
+    """One row of the field_sparse CAPABILITY TABLE: which step builder
+    serves a model family in each layout, and which levers that
+    family's steps actually consume. Every guard in
+    :func:`_fit_field_sparse` reads THIS row instead of open-coding a
+    type/flag test — adding a capability (or a family) means editing
+    one row, and an unsupported request hard-fails with the row as the
+    single source of truth (the project's no-silent-fallback rule)."""
+
+    single_step: callable            # (spec, tconfig) -> step
+    sharded_step: callable | None    # (spec, tconfig, mesh) -> step
+    carries_opt: bool                # optax state rides the step (DeepFM)
+    sharded_2d: bool                 # 2-D (feat, row) mesh (--row-shards)
+    sharded_host_compact: bool       # host-built compact aux when sharded
+    sharded_device_compact: bool     # in-step compact aux when sharded
+    sharded_multiproc: bool          # multi-process pseudo-cluster / pods
+    multistep_single: bool           # --steps-per-call fori roll (1 chip)
+
+
+_FIELD_CAPS = {
+    "FieldFMSpec": _FieldCap(
+        single_step=_single_fm_step, sharded_step=_sharded_fm_step,
+        carries_opt=False, sharded_2d=True, sharded_host_compact=True,
+        sharded_device_compact=True, sharded_multiproc=True,
+        multistep_single=True,
+    ),
+    "FieldFFMSpec": _FieldCap(
+        single_step=_single_ffm_step, sharded_step=_sharded_ffm_step,
+        carries_opt=False, sharded_2d=False, sharded_host_compact=True,
+        sharded_device_compact=True, sharded_multiproc=True,
+        multistep_single=True,
+    ),
+    "FieldDeepFMSpec": _FieldCap(
+        single_step=_single_deepfm_step,
+        sharded_step=_sharded_deepfm_step,
+        carries_opt=True, sharded_2d=False, sharded_host_compact=False,
+        sharded_device_compact=False, sharded_multiproc=True,
+        multistep_single=False,
+    ),
+}
+
+
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                       eval_source=None, prefetch: int = 0,
                       row_shards: int = 1, steps_per_call: int = 1,
@@ -279,20 +358,97 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
     import jax
     import jax.numpy as jnp
 
-    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
-    from fm_spark_tpu.models.field_fm import FieldFMSpec
-    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
-
     n = jax.device_count()
-    is_deepfm = isinstance(spec, FieldDeepFMSpec)
+    pc = jax.process_count()
+    cap = _FIELD_CAPS.get(type(spec).__name__)
+    if cap is None:
+        raise SystemExit(
+            f"field_sparse strategy has no capability row for "
+            f"{type(spec).__name__}"
+        )
+    sharded = n > 1
+    is_deepfm = cap.carries_opt
+
+    # ---- validation: every guard reads the capability row -------------
     if row_shards < 1:
         raise SystemExit(f"--row-shards must be >= 1, got {row_shards}")
-    if row_shards > 1 and (n == 1 or not isinstance(spec, FieldFMSpec)):
+    if row_shards > 1 and not (sharded and cap.sharded_2d):
         # Never silently ignore an explicit sharding request.
         raise SystemExit(
             f"--row-shards={row_shards} needs multiple devices and a "
-            f"FieldFM model (found {n} device(s), {type(spec).__name__})"
+            f"model family with a 2-D (feat, row) sharded step "
+            f"(found {n} device(s), {type(spec).__name__})"
         )
+    if ckpt_sharded and not sharded:
+        raise SystemExit(
+            "--ckpt-sharded applies to multi-device field-sharded runs "
+            f"(found {n} device(s)); the default canonical layout "
+            "already serves single-chip runs"
+        )
+    compact_sharded = (
+        tconfig.host_dedup and tconfig.compact_cap > 0 and sharded
+    )
+    if compact_sharded and not cap.sharded_host_compact:
+        raise SystemExit(
+            f"host-built --compact-cap is not supported by the sharded "
+            f"{type(spec).__name__} step"
+        )
+    if compact_sharded and (row_shards > 1 or pc > 1):
+        # The HOST-built aux needs some host to hold every field's full
+        # global column (excludes multi-process) and raw global ids
+        # (excludes 2-D row ownership). The device-built aux has neither
+        # constraint.
+        raise SystemExit(
+            "host-built --compact-cap on multiple chips requires a 1-D "
+            "field mesh (no --row-shards) and a single process; add "
+            "--compact-device to build the aux in-step, which composes "
+            "with both"
+        )
+    if (tconfig.compact_device and sharded
+            and not cap.sharded_device_compact):
+        raise SystemExit(
+            f"--compact-device on {n} devices is not supported by the "
+            f"sharded {type(spec).__name__} step"
+        )
+    if tconfig.host_dedup and sharded and not compact_sharded:
+        # The sharded steps consume only the COMPACT aux format; every
+        # other multi-device host-dedup request would silently train
+        # without the fast path — hard-fail instead.
+        raise SystemExit(
+            f"--host-dedup on {n} devices requires --compact-cap "
+            "(or drop --host-dedup / run on 1 chip)"
+        )
+    if pc > 1 and not cap.sharded_multiproc:
+        raise SystemExit(
+            f"multi-process training is not supported for "
+            f"{type(spec).__name__}"
+        )
+    if steps_per_call < 1:
+        raise SystemExit(
+            f"--steps-per-call must be >= 1, got {steps_per_call}"
+        )
+    multi = steps_per_call > 1
+    if multi and (sharded or not cap.multistep_single):
+        # DeepFM carries optax state through the call and the sharded
+        # steps take mesh-prepped operands — neither rolls into the
+        # pure-SGD fori body. Hard-fail, never silently run one-by-one.
+        raise SystemExit(
+            "--steps-per-call > 1 supports the single-chip FM/FFM fused "
+            f"steps only (found {type(spec).__name__}, {n} device(s))"
+        )
+    if sharded:
+        if tconfig.batch_size % n:
+            raise SystemExit(
+                f"batch_size={tconfig.batch_size} must be divisible by "
+                f"the device count ({n}) for the field-sharded strategy"
+            )
+        if n % row_shards:
+            raise SystemExit(
+                f"--row-shards={row_shards} must divide the device "
+                f"count ({n})"
+            )
+
+    # ---- state init ---------------------------------------------------
     canonical = spec.init(jax.random.key(tconfig.seed))
     opt0 = {}
     if is_deepfm:
@@ -302,12 +458,6 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         # independent, so checkpoints resume on any mesh).
         opt0 = make_optimizer(tconfig).init(
             {"w0": canonical["w0"], "mlp": canonical["mlp"]}
-        )
-    if ckpt_sharded and (n == 1 or isinstance(spec, FieldFFMSpec)):
-        raise SystemExit(
-            "--ckpt-sharded applies to multi-device field-sharded runs "
-            f"(found {n} device(s), {type(spec).__name__}); the default "
-            "canonical layout already serves single-chip runs"
         )
     start = 0
     if not ckpt_sharded:
@@ -326,87 +476,19 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         return wrapped
 
     host = lambda b: jax.tree_util.tree_map(jnp.asarray, tuple(b))
-    compact_sharded = (
-        tconfig.host_dedup and tconfig.compact_cap > 0 and n > 1
-        and isinstance(spec, FieldFMSpec)
-    )
-    if compact_sharded and (row_shards > 1 or jax.process_count() > 1):
-        # The HOST-built aux needs some host to hold every field's full
-        # global column (excludes multi-process) and raw global ids
-        # (excludes 2-D row ownership). The device-built aux has neither
-        # constraint.
-        raise SystemExit(
-            "host-built --compact-cap on multiple chips requires a 1-D "
-            "field mesh (no --row-shards) and a single process; add "
-            "--compact-device to build the aux in-step, which composes "
-            "with both"
-        )
-    if tconfig.compact_device and n > 1 and not isinstance(spec,
-                                                           FieldFMSpec):
-        # Sharded FFM/DeepFM steps don't take the device-compact path
-        # yet — hard-fail rather than silently train without the lever.
-        raise SystemExit(
-            f"--compact-device on {n} devices supports FieldFM configs "
-            f"(found {type(spec).__name__}); single-chip supports "
-            "FM/FFM/DeepFM"
-        )
-    if (tconfig.host_dedup and n > 1 and not compact_sharded
-            and not isinstance(spec, FieldFFMSpec)):
-        # The sharded steps consume only the COMPACT aux format (FieldFM,
-        # 1-D mesh); every other multi-device host-dedup request would
-        # silently train without the fast path — hard-fail instead.
-        raise SystemExit(
-            f"--host-dedup on {n} devices requires --compact-cap with a "
-            "FieldFM config (or drop --host-dedup / run on 1 chip)"
-        )
-    if steps_per_call < 1:
-        raise SystemExit(
-            f"--steps-per-call must be >= 1, got {steps_per_call}"
-        )
-    multi = steps_per_call > 1
-    if multi and (
-        is_deepfm or (n > 1 and not isinstance(spec, FieldFFMSpec))
-    ):
-        # DeepFM carries optax state through the call and the sharded
-        # steps take mesh-prepped operands — neither rolls into the
-        # pure-SGD fori body. Hard-fail, never silently run one-by-one.
-        raise SystemExit(
-            "--steps-per-call > 1 supports the single-chip FM/FFM fused "
-            f"steps only (found {type(spec).__name__}, {n} device(s))"
-        )
-    if isinstance(spec, FieldFFMSpec):
-        # Fused field-aware step; single-chip execution (the FFM
-        # field-sharded layout is a follow-on — cross-field factors make
-        # its partials [B, F, k] per chip, not [B, k]).
-        from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
 
-        step = adapt(make_field_ffm_sparse_sgd_step(spec, tconfig))
-        params, opt = canonical, opt0
-        prep = host
-        to_canonical = lambda p: p
-    elif n > 1:
-        if tconfig.batch_size % n:
-            raise SystemExit(
-                f"batch_size={tconfig.batch_size} must be divisible by the "
-                f"device count ({n}) for the field-sharded strategy"
-            )
-        if n % row_shards:
-            raise SystemExit(
-                f"--row-shards={row_shards} must divide the device "
-                f"count ({n})"
-            )
+    # ---- step + placement, from the capability row --------------------
+    if sharded:
         from fm_spark_tpu.parallel import (
-            make_field_deepfm_sharded_step, make_field_mesh,
-            make_field_sharded_sgd_step, pad_field_batch,
-            shard_field_batch, shard_field_deepfm_params,
-            shard_field_params, stack_field_deepfm_params,
-            stack_field_params, unstack_field_deepfm_params,
-            unstack_field_params,
+            make_field_mesh, pad_field_batch, shard_field_batch,
+            shard_field_deepfm_params, shard_field_params,
+            stack_field_deepfm_params, stack_field_params,
+            unstack_field_deepfm_params, unstack_field_params,
         )
 
         n_feat = n // row_shards
         mesh = make_field_mesh(n, n_row=row_shards)
-        if jax.process_count() > 1:
+        if pc > 1:
             from fm_spark_tpu.parallel import shard_field_batch_local
 
             # Each process feeds only its local slice of the global
@@ -414,11 +496,6 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             prep = lambda b: shard_field_batch_local(
                 pad_field_batch(b, spec.num_fields, n_feat), mesh
             )
-        else:
-            prep = lambda b: shard_field_batch(
-                pad_field_batch(b, spec.num_fields, n_feat), mesh
-            )
-        if jax.process_count() > 1:
             # device_get cannot fetch non-addressable shards; the gather
             # crosses processes (DCN) — used only for canonical
             # checkpoints/final export (--ckpt-sharded avoids it).
@@ -428,9 +505,12 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 p, tiled=True
             )
         else:
+            prep = lambda b: shard_field_batch(
+                pad_field_batch(b, spec.num_fields, n_feat), mesh
+            )
             fetch = jax.device_get
         if is_deepfm:
-            step = make_field_deepfm_sharded_step(spec, tconfig, mesh)
+            step = cap.sharded_step(spec, tconfig, mesh)
             params = shard_field_deepfm_params(
                 stack_field_deepfm_params(spec, canonical, n_feat), mesh
             )
@@ -439,7 +519,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 spec, fetch(p)
             )
         else:
-            step = adapt(make_field_sharded_sgd_step(spec, tconfig, mesh))
+            step = adapt(cap.sharded_step(spec, tconfig, mesh))
             params = shard_field_params(
                 stack_field_params(spec, canonical, n_feat), mesh
             )
@@ -460,14 +540,8 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 *_data_prep(b[:4]), place_compact_aux(b[4], mesh),
             )
     else:
-        if is_deepfm:
-            from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
-
-            step = make_field_deepfm_sparse_step(spec, tconfig)
-        else:
-            from fm_spark_tpu.sparse import make_field_sparse_sgd_step
-
-            step = adapt(make_field_sparse_sgd_step(spec, tconfig))
+        built = cap.single_step(spec, tconfig)
+        step = built if is_deepfm else adapt(built)
         params, opt = canonical, opt0
         prep = host
         to_canonical = lambda p: p
@@ -477,21 +551,26 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                                      layout="sharded")
 
     sharded_eval = None
-    if (n > 1 and not isinstance(spec, FieldFFMSpec)
-            and eval_source is not None and tconfig.eval_every > 0):
+    if (sharded and eval_source is not None and tconfig.eval_every > 0):
         # Periodic eval on the live sharded arrays — the multi-GB tables
-        # never leave the mesh (parallel/field_step.py).
+        # never leave the mesh. evaluate_field_sharded dispatches the
+        # family-specific eval step (FM / FFM / DeepFM); build it once
+        # here so every eval reuses the compiled program.
+        from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+        from fm_spark_tpu.models.field_ffm import FieldFFMSpec
         from fm_spark_tpu.parallel import (
             evaluate_field_sharded,
             make_field_deepfm_sharded_eval_step,
+            make_field_ffm_sharded_eval_step,
             make_field_sharded_eval_step,
         )
 
-        _sh_estep = (
-            make_field_deepfm_sharded_eval_step(spec, mesh)
-            if is_deepfm
-            else make_field_sharded_eval_step(spec, mesh)
-        )
+        if isinstance(spec, FieldDeepFMSpec):
+            _sh_estep = make_field_deepfm_sharded_eval_step(spec, mesh)
+        elif isinstance(spec, FieldFFMSpec):
+            _sh_estep = make_field_ffm_sharded_eval_step(spec, mesh)
+        else:
+            _sh_estep = make_field_sharded_eval_step(spec, mesh)
         sharded_eval = lambda _thunk: evaluate_field_sharded(
             spec, mesh, params, eval_source(), estep=_sh_estep
         )
@@ -713,12 +792,13 @@ def cmd_train(args) -> int:
         # Only the multi-chip field-sharded loop has cross-host parameter
         # semantics (collectives inside the step + local batch placement);
         # every other loop would silently train a DIFFERENT model per
-        # host on its data shard.
-        if cfg.strategy != "field_sparse" or cfg.model == "field_ffm":
+        # host on its data shard. Family support comes from the
+        # capability table (_FIELD_CAPS.sharded_multiproc).
+        if cfg.strategy != "field_sparse":
             raise SystemExit(
-                f"multi-process training supports strategy 'field_sparse' "
-                f"(FM/DeepFM) only; config {cfg.name!r} resolves to "
-                f"strategy {cfg.strategy!r}, model {cfg.model!r}"
+                f"multi-process training supports strategy "
+                f"'field_sparse' only; config {cfg.name!r} resolves to "
+                f"strategy {cfg.strategy!r}"
             )
         if tconfig.batch_size % pc:
             raise SystemExit(

@@ -32,6 +32,9 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     field_batch_specs,
     field_param_specs,
     make_field_deepfm_sharded_step,
+    make_field_ffm_sharded_body,
+    make_field_ffm_sharded_eval_step,
+    make_field_ffm_sharded_step,
     make_field_mesh,
     make_field_sharded_sgd_body,
     make_field_deepfm_sharded_eval_step,

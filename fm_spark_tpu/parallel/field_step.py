@@ -375,8 +375,10 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     compact = config.compact_cap > 0
     device_cap = config.compact_cap if config.compact_device else 0
     host_compact = compact and not config.compact_device
-    if compact:
-        _check_host_dedup(config)
+    # Unconditional, like the single-chip factories: compact_device
+    # without compact_cap (or a mismatched overflow policy) must fail
+    # loudly here too, never silently train the plain path.
+    _check_host_dedup(config)
     if host_compact:
         # Compact HOST-dedup on the sharded step: supported on the 1-D
         # feat mesh — the aux is built from the GLOBAL batch and shards
@@ -792,6 +794,288 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     return step
 
 
+# ---------------------------------------------------------------- FFM
+
+
+def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
+                       caux=None, device_cap: int = 0):
+    """The field-sharded FFM forward, shared by the train body and the
+    eval step (config 4's multi-chip fast path, VERDICT r2 #3).
+
+    Cross-field factors make this structurally different from FM: the
+    chip owning field ``i`` holds ``sel[b, i, j] = v[id_i][j]·x_i`` for
+    every target ``j`` locally (the packed [B, F·k+1] row carries all
+    targets — field_ffm.py), but the pairwise term needs the TRANSPOSED
+    blocks ``sel[b, j, i]``. ONE ``all_to_all`` of the sel activations
+    over ``feat`` (split the target axis, concat the owner axis)
+    delivers exactly those — activation traffic, never tables, the same
+    pattern as DeepFM's ``h`` all_gather but n× cheaper than gathering
+    the full [B, F, F, k] tensor on every chip.
+
+    Returns ``(scores, rows, sel_loc, selT, vals_c, uidx, urows, aux,
+    ovf, labels, weights)`` — scores replicated; sel_loc/selT are this
+    chip's [B, f_local, F_pad, k] owner/transposed blocks for the
+    analytic backward.
+    """
+    from fm_spark_tpu.sparse import (
+        _compact_gather_all,
+        _device_compact_aux_all,
+        _gather_all,
+    )
+
+    cd = spec.cdtype
+    k = spec.rank
+    F = spec.num_fields
+    f_local, f_pad = g["f_local"], g["f_pad"]
+
+    if caux is None:
+        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
+                             tiled=True)
+    vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
+                          tiled=True)
+    labels = lax.all_gather(labels, "feat", tiled=True)
+    weights = lax.all_gather(weights, "feat", tiled=True)
+    vals_c = vals.astype(cd)
+
+    urows = None
+    aux = caux
+    ovf = None
+    if device_cap > 0:
+        aux, ovf = _device_compact_aux_all(ids, device_cap, f_local)
+        urows, rows = _compact_gather_all(
+            [vw[f] for f in range(f_local)], aux, cd, mask_overflow=True
+        )
+        uidx = None
+    elif caux is not None:
+        urows, rows = _compact_gather_all(
+            [vw[f] for f in range(f_local)], caux, cd
+        )
+        uidx = None
+    else:
+        rows = _gather_all(lambda t, i: t[i], vw, ids, cd)
+        uidx = ids
+
+    b = vals.shape[0]
+    # sel_loc[b, p, j, :] = v[id_p][target j] · x_p for this chip's
+    # owned fields p; the target axis padded F → F_pad so the
+    # all_to_all splits evenly (padding targets are zero columns).
+    sel_loc = jnp.stack(
+        [
+            jnp.pad(
+                r[:, : F * k].reshape(b, F, k) * vals_c[:, p, None, None],
+                ((0, 0), (0, f_pad - F), (0, 0)),
+            )
+            for p, r in enumerate(rows)
+        ],
+        axis=1,
+    )                                           # [B, f_local, F_pad, k]
+    # selT[b, p, j, :] = sel[b, j, i_p] — every other chip's view of
+    # this chip's fields as TARGETS, re-sharded in one collective.
+    selT = jnp.swapaxes(
+        lax.all_to_all(sel_loc, "feat", split_axis=2, concat_axis=1,
+                       tiled=True),
+        1, 2,
+    )                                           # [B, f_local, F_pad, k]
+
+    # Partial pairwise sum over owned i: Σ_j ⟨sel[i,j], sel[j,i]⟩ minus
+    # the i==j diagonal; psum over feat completes Σ_{i≠j}.
+    pair_p = jnp.sum(sel_loc * selT, axis=(1, 2, 3))
+    feat0 = lax.axis_index("feat") * f_local
+    diag_p = sum(
+        jnp.sum(sel_loc[:, p, feat0 + p, :] ** 2, axis=-1)
+        for p in range(f_local)
+    )
+    lin_p = (
+        sum(r[:, F * k] * vals_c[:, p] for p, r in enumerate(rows))
+        if spec.use_linear
+        else jnp.zeros((b,), cd)
+    )
+    pair = lax.psum(pair_p - diag_p, "feat")
+    scores = 0.5 * pair
+    if spec.use_linear:
+        scores = scores + lax.psum(lin_p, "feat")
+    if spec.use_bias:
+        scores = scores + w0.astype(cd)
+    return (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
+            labels, weights)
+
+
+def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
+    """Unjitted field-sharded fused FFM step (1-D ``feat`` mesh) —
+    config 4's multi-chip layout. Same math as the single-chip
+    :func:`fm_spark_tpu.sparse.make_field_ffm_sparse_sgd_body`
+    (equivalence-tested); tables single-owner per field, one sel
+    ``all_to_all`` instead of table movement. Supports the compact
+    paths: host-built aux (single-process) and the device-built aux
+    (composes with multi-process)."""
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+    from fm_spark_tpu.sparse import (
+        _apply_field_updates,
+        _check_host_dedup,
+        _compact_apply_all,
+        _fold_overflow,
+        _lr_at,
+        _reject_host_aux,
+        _sr_base_key,
+    )
+
+    if type(spec) is not FieldFFMSpec:
+        raise ValueError("expected a FieldFFMSpec")
+    if config.optimizer != "sgd":
+        raise ValueError("sparse step implements plain SGD only")
+    if set(mesh.axis_names) != {"feat"}:
+        raise ValueError(
+            "field-sharded FFM runs on a 1-D ('feat',) mesh (row "
+            "sharding of cross-field tables is a follow-on)"
+        )
+    if config.use_pallas:
+        raise ValueError("use_pallas is a single-chip experiment")
+    g = _mesh_geometry(spec, mesh)
+    compact = config.compact_cap > 0
+    device_cap = config.compact_cap if config.compact_device else 0
+    host_compact = compact and not config.compact_device
+    # Unconditional, like the single-chip factories (see the FM body).
+    _check_host_dedup(config)
+    if not compact and config.host_dedup:
+        _reject_host_aux(config, "the field-sharded FFM step (non-compact)")
+
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    k = spec.rank
+    F = spec.num_fields
+    f_local = g["f_local"]
+    sr_base_key = _sr_base_key(config)
+    lr_at = _lr_at(config)
+
+    def local_step(params, step_idx, ids, vals, labels, weights,
+                   caux=None):
+        if host_compact and caux is None:
+            raise ValueError(
+                "compact sharded FFM step needs the batch's compact_aux "
+                "operand (stacked [F_pad, ...], sharded over feat)"
+            )
+        vw = params["vw"]
+        w0 = params["w0"]
+        (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
+         labels, weights) = _ffm_field_forward(
+            spec, g, vw, w0, ids, vals, labels, weights, caux=caux,
+            device_cap=device_cap,
+        )
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        loss, dscores = jax.value_and_grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        # ∂L/∂sel[b, i_p, j] = ds · sel[b, j, i_p] = ds · selT (zeroed
+        # diagonal), then ∂L/∂v[id_p, j] = ∂sel · x_p — all local.
+        feat0 = lax.axis_index("feat") * f_local
+        dsel = dscores[:, None, None, None] * selT
+        own_col = jax.nn.one_hot(
+            feat0 + jnp.arange(f_local), g["f_pad"], dtype=cd
+        )                                        # [f_local, F_pad]
+        dsel = dsel * (1.0 - own_col)[None, :, :, None]
+        g_fulls = []
+        for p in range(f_local):
+            g_v = (
+                dsel[:, p, :F, :] * vals_c[:, p, None, None]
+            ).reshape(-1, F * k)
+            if config.reg_factors:
+                g_v = g_v + config.reg_factors * rows[p][:, : F * k] * touched[:, None]
+            if spec.use_linear:
+                g_l = dscores * vals_c[:, p]
+                if config.reg_linear:
+                    g_l = g_l + config.reg_linear * rows[p][:, F * k] * touched
+            else:
+                g_l = jnp.zeros_like(dscores)
+            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        if compact:
+            new_slices = _compact_apply_all(
+                [vw[f] for f in range(f_local)], g_fulls, urows, config,
+                sr_base_key, step_idx, lr, aux, field_offset=feat0,
+            )
+        else:
+            new_slices = _apply_field_updates(
+                [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
+                config, sr_base_key, step_idx, lr, field_offset=feat0,
+            )
+        out = {"w0": w0, "vw": jnp.stack(new_slices, axis=0)}
+        if spec.use_bias:
+            out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
+        if ovf is not None:
+            loss = _fold_overflow(loss, lax.pmax(ovf, "feat"), config)
+        return out, loss
+
+    if host_compact:
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(field_param_specs(mesh), P(),
+                      *field_batch_specs(mesh),
+                      (P("feat", None),) * 5),
+            out_specs=(field_param_specs(mesh), P()),
+            check_vma=False,
+        )
+    return jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(field_param_specs(mesh), P(), *field_batch_specs(mesh)),
+        out_specs=(field_param_specs(mesh), P()),
+        check_vma=False,
+    )
+
+
+def make_field_ffm_sharded_step(spec, config: TrainConfig, mesh):
+    """Jitted field-sharded fused FFM step; params donated."""
+    return jax.jit(
+        make_field_ffm_sharded_body(spec, config, mesh),
+        donate_argnums=(0,),
+    )
+
+
+def make_field_ffm_sharded_eval_step(spec, mesh):
+    """Metrics-accumulation step on the field-sharded FFM layout —
+    the shared forward (:func:`_ffm_field_forward`), then a replicated
+    :func:`metrics.update_metrics` exactly like the FM eval step."""
+    from fm_spark_tpu.models import base as model_base
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    if type(spec) is not FieldFFMSpec:
+        raise ValueError("expected a FieldFFMSpec")
+    if set(mesh.axis_names) != {"feat"}:
+        raise ValueError("sharded FFM eval runs on a 1-D ('feat',) mesh")
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    g = _mesh_geometry(spec, mesh)
+    mstate_specs = jax.tree_util.tree_map(
+        lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
+    )
+
+    def local_eval(params, mstate, ids, vals, labels, weights):
+        scores, _, _, _, _, _, _, _, _, labels, weights = (
+            _ffm_field_forward(spec, g, params["vw"], params["w0"], ids,
+                               vals, labels, weights)
+        )
+        per = per_example_loss(scores, labels)
+        preds = model_base.predict_from_scores(spec, scores)
+        return metrics_lib.update_metrics(
+            mstate, scores, labels, per, weights, predictions=preds
+        )
+
+    return jax.jit(jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(field_param_specs(mesh), mstate_specs,
+                  *field_batch_specs(mesh)),
+        out_specs=mstate_specs,
+        check_vma=False,
+    ))
+
+
 def make_field_sharded_eval_step(spec, mesh):
     """Metrics-accumulation step on the FIELD-SHARDED layout — periodic
     eval without gathering the multi-GB tables to the host (the default
@@ -851,14 +1135,16 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
     is padded to the mesh's field multiple and sharded like training
     batches. Pass a prebuilt ``estep`` to avoid a re-trace per call."""
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
     from fm_spark_tpu.utils import metrics as metrics_lib
 
     if estep is None:
-        estep = (
-            make_field_deepfm_sharded_eval_step(spec, mesh)
-            if type(spec) is FieldDeepFMSpec
-            else make_field_sharded_eval_step(spec, mesh)
-        )
+        if type(spec) is FieldDeepFMSpec:
+            estep = make_field_deepfm_sharded_eval_step(spec, mesh)
+        elif type(spec) is FieldFFMSpec:
+            estep = make_field_ffm_sharded_eval_step(spec, mesh)
+        else:
+            estep = make_field_sharded_eval_step(spec, mesh)
     n_feat = mesh.shape["feat"]
     pc = jax.process_count()
     if pc > 1:

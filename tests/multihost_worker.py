@@ -244,8 +244,45 @@ def main() -> int:
     # compact path (dedup fp32 = exact up to cumsum reassociation).
     np.testing.assert_allclose(dlosses, flosses, rtol=1e-5)
 
+    # ---- Phase 5: the field-sharded FFM step across process
+    # boundaries — the sel all_to_all (transposed cross-field blocks)
+    # with real cross-process collectives (config 4's multi-chip path).
+    from fm_spark_tpu.parallel import make_field_ffm_sharded_step
+
+    ffspec = models.FieldFFMSpec(
+        num_features=F * bucket, rank=3, num_fields=F, bucket=bucket,
+        init_std=0.05,
+    )
+    ffstep = make_field_ffm_sharded_step(
+        ffspec, TrainConfig(learning_rate=0.3, optimizer="sgd",
+                            sparse_update="dedup"), fmesh
+    )
+    ffparams = {
+        k: make_global(v, fmesh, pspecs2[k])
+        for k, v in stack_field_params(
+            ffspec, ffspec.init(jax.random.key(5)), fmesh.shape["feat"]
+        ).items()
+    }
+    fflosses = []
+    for i in range(6):
+        sl = slice(i * b_global, (i + 1) * b_global)
+        fb = pad_field_batch(
+            (fids[sl], fvals[sl], flabels[sl],
+             np.ones((b_global,), np.float32)),
+            F, fmesh.shape["feat"],
+        )
+        gb = [
+            make_global(a, fmesh, sp)
+            for a, sp in zip(fb, field_batch_specs(fmesh))
+        ]
+        ffparams, ffl = ffstep(ffparams, jnp.int32(i), *gb)
+        fflosses.append(float(ffl))
+    assert all(np.isfinite(fflosses)), fflosses
+    assert np.mean(fflosses[-3:]) < np.mean(fflosses[:3]), fflosses
+
     print(f"MULTIHOST_OK process={process_id} "
-          f"losses={losses}+{flosses}+{plosses}+{dlosses}+digest={digest}")
+          f"losses={losses}+{flosses}+{plosses}+{dlosses}+{fflosses}"
+          f"+digest={digest}")
     return 0
 
 

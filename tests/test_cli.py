@@ -354,3 +354,50 @@ def test_eval_every_field_sparse_strategy(capsys):
         assert len(eval_lines) == 3  # steps 8, 16, 24
     finally:
         del configs_lib.CONFIGS["ee_small"]
+
+
+def test_field_sparse_capability_guards():
+    """The _FIELD_CAPS table drives every field_sparse guard: requests a
+    family's steps can't serve must hard-fail (never silently fall back)
+    — one test per capability column."""
+    import pytest
+
+    def run(name, base, extra, small_kw):
+        small = dataclasses.replace(
+            configs_lib.CONFIGS[base], name=name,
+            strategy="field_sparse", **small_kw
+        )
+        configs_lib.CONFIGS[name] = small
+        try:
+            return cli.main([
+                "train", "--config", name, "--synthetic", "512",
+                "--steps", "4", "--batch-size", "128", *extra,
+            ])
+        finally:
+            del configs_lib.CONFIGS[name]
+
+    ffm_kw = dict(bucket=32, num_fields=4, rank=4)
+    deepfm_kw = dict(bucket=32, num_fields=4, rank=4,
+                     mlp_dims=(8, 8))
+    # FFM has no 2-D sharded step.
+    with pytest.raises(SystemExit, match="2-D"):
+        run("g1", "avazu_ffm_r16", ["--row-shards", "2"], ffm_kw)
+    # steps-per-call only rolls the single-chip pure-SGD bodies; on the
+    # 8-fake-device env field_sparse shards.
+    with pytest.raises(SystemExit, match="steps-per-call"):
+        run("g2", "avazu_ffm_r16", ["--steps-per-call", "2"], ffm_kw)
+    # Sharded DeepFM consumes no compact aux.
+    with pytest.raises(SystemExit, match="compact-device"):
+        run("g3", "criteo1tb_deepfm",
+            ["--compact-device", "--compact-cap", "64",
+             "--sparse-update", "dedup"], deepfm_kw)
+    # Host-built compact aux + --row-shards (2-D) cannot compose.
+    fm_kw = dict(bucket=64, num_fields=4, rank=4)
+    with pytest.raises(SystemExit, match="compact-device"):
+        run("g4", "criteo1tb_fm_r64",
+            ["--host-dedup", "--compact-cap", "64", "--sparse-update",
+             "dedup", "--row-shards", "2"], fm_kw)
+    # Sharded device-compact FFM is SUPPORTED — must run clean.
+    assert run("g5", "avazu_ffm_r16",
+               ["--compact-device", "--compact-cap", "128",
+                "--sparse-update", "dedup"], ffm_kw) == 0

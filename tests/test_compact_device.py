@@ -467,3 +467,25 @@ def test_multistep_poison_is_sticky(rng):
     )
     # Step 0 overflowed, step 1 was clean — the poison must survive.
     assert np.isposinf(float(loss))
+
+
+def test_sharded_builders_validate_unconditionally():
+    """compact_device without compact_cap must fail at BUILD time on the
+    sharded factories exactly as on the single-chip ones (review r3
+    finding: the sharded builders used to validate only when
+    compact_cap > 0, silently training the plain path)."""
+    from fm_spark_tpu.parallel import (
+        make_field_ffm_sharded_body,
+        make_field_sharded_sgd_body,
+    )
+
+    mesh = make_field_mesh(8)
+    cfg = _base_cfg(sparse_update="dedup", compact_device=True)
+    with pytest.raises(ValueError, match="compact_device requires"):
+        make_field_sharded_sgd_body(_spec(), cfg, mesh)
+    ffm_spec = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=3, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    with pytest.raises(ValueError, match="compact_device requires"):
+        make_field_ffm_sharded_body(ffm_spec, cfg, mesh)

@@ -1,0 +1,173 @@
+"""Field-sharded FieldFFM (config 4's multi-chip fast path, VERDICT r2
+#3): the 1-D feat-mesh step — one sel all_to_all for the transposed
+cross-field blocks, single-owner table writes — must match the
+single-chip fused FFM body step-for-step, with and without the compact
+paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.ops.scatter import compact_aux
+from fm_spark_tpu.parallel import (
+    evaluate_field_sharded,
+    make_field_ffm_sharded_step,
+    make_field_mesh,
+    pad_field_batch,
+    shard_compact_aux,
+    shard_field_batch,
+    shard_field_params,
+    stack_field_params,
+    unstack_field_params,
+)
+from fm_spark_tpu.sparse import make_field_ffm_sparse_sgd_step
+from fm_spark_tpu.train import TrainConfig
+
+F, BUCKET, K, B = 5, 32, 3, 64
+
+
+def _spec(**kw):
+    kw.setdefault("param_dtype", "float32")
+    return models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, **kw
+    )
+
+
+def _batch(rng, b=B):
+    ids = rng.integers(0, BUCKET, size=(b, F)).astype(np.int32)
+    ids[:, 0] = rng.integers(0, 3, b)
+    vals = rng.normal(size=(b, F)).astype(np.float32)
+    labels = rng.integers(0, 2, b).astype(np.float32)
+    weights = np.ones(b, np.float32)
+    weights[::7] = 0.0
+    return ids, vals, labels, weights
+
+
+def _run_pair(rng, config, n_feat=8, steps=3, caux_builder=None):
+    ids, vals, labels, weights = _batch(rng)
+    spec = _spec()
+    canonical = spec.init(jax.random.key(1))
+    single = make_field_ffm_sparse_sgd_step(spec, config)
+    mesh = make_field_mesh(n_feat)
+    sharded = make_field_ffm_sharded_step(spec, config, mesh)
+    sp = shard_field_params(
+        stack_field_params(spec, jax.tree.map(jnp.copy, canonical),
+                           n_feat),
+        mesh,
+    )
+    batch = pad_field_batch((ids, vals, labels, weights), F, n_feat)
+    aux_single = None
+    caux = None
+    if caux_builder is not None:
+        aux_np = caux_builder(ids)
+        aux_single = tuple(jnp.asarray(a) for a in aux_np)
+        caux = shard_compact_aux(aux_np, mesh, n_feat)
+    for i in range(steps):
+        args = (jnp.int32(i), jnp.asarray(ids), jnp.asarray(vals),
+                jnp.asarray(labels), jnp.asarray(weights))
+        if aux_single is not None:
+            canonical, l1 = single(canonical, *args, aux_single)
+        else:
+            canonical, l1 = single(canonical, *args)
+        sargs = (jnp.int32(i), *shard_field_batch(batch, mesh))
+        if caux is not None:
+            sp, l2 = sharded(sp, *sargs, caux)
+        else:
+            sp, l2 = sharded(sp, *sargs)
+        assert float(l1) == pytest.approx(float(l2), rel=2e-5), i
+    got = unstack_field_params(spec, jax.device_get(sp))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-5, atol=1e-6,
+        ),
+        canonical, got,
+    )
+
+
+@pytest.mark.parametrize("mode", ["scatter_add", "dedup"])
+def test_sharded_ffm_matches_single_chip(rng, mode):
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update=mode, reg_factors=1e-4,
+                    reg_linear=1e-4),
+    )
+
+
+def test_sharded_ffm_host_compact_matches_single_chip(rng):
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update="dedup", host_dedup=True,
+                    compact_cap=B),
+        caux_builder=lambda ids: compact_aux(ids, B),
+    )
+
+
+def test_sharded_ffm_device_compact_matches_single_chip(rng):
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update="dedup", compact_device=True,
+                    compact_cap=B),
+    )
+
+
+def test_sharded_ffm_uneven_fields(rng):
+    # F=5 on 4 chips: f_pad=8, padded fields + padded sel targets must
+    # stay inert.
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update="dedup"),
+        n_feat=4,
+    )
+
+
+def test_sharded_ffm_eval(rng):
+    ids, vals, labels, weights = _batch(rng)
+    spec = _spec()
+    mesh = make_field_mesh(8)
+    sp = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(1)), 8), mesh
+    )
+    em = evaluate_field_sharded(
+        spec, mesh, sp, [(ids, vals, labels, weights)]
+    )
+    assert float(em["count"]) == float(weights.sum())
+    # Scores must agree with the canonical single-chip forward.
+    canonical = unstack_field_params(spec, jax.device_get(sp))
+    want = np.asarray(
+        spec.scores(canonical, jnp.asarray(ids), jnp.asarray(vals))
+    )
+    from fm_spark_tpu.ops import losses as losses_lib
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    per = losses_lib.loss_fn(spec.loss)(jnp.asarray(want),
+                                        jnp.asarray(labels))
+    m = metrics_lib.init_metrics()
+    m = metrics_lib.update_metrics(
+        m, jnp.asarray(want), jnp.asarray(labels), per,
+        jnp.asarray(weights),
+        predictions=jax.nn.sigmoid(jnp.asarray(want)),
+    )
+    got = metrics_lib.finalize_metrics(m)
+    assert float(em["logloss"]) == pytest.approx(float(got["logloss"]),
+                                                 rel=1e-5)
+    assert float(em["auc"]) == pytest.approx(float(got["auc"]), abs=1e-6)
+
+
+def test_sharded_ffm_rejects_2d_mesh():
+    from fm_spark_tpu.parallel import make_field_ffm_sharded_body
+
+    spec = _spec()
+    mesh = make_field_mesh(8, n_row=2)
+    with pytest.raises(ValueError, match="1-D"):
+        make_field_ffm_sharded_body(
+            spec, TrainConfig(optimizer="sgd"), mesh
+        )
