@@ -346,24 +346,47 @@ def compact_gather(table, useg, col: bool = False):
     return table.at[useg].get(mode="clip", indices_are_sorted=True)
 
 
+# Block size of the two-level prefix in compact_apply. Measured
+# (bench_micro `cumsum`, round 3): a plain [131072, 65] fp32 jnp.cumsum
+# costs 73ms/39-field on this attachment while the blocked two-level
+# form costs 53ms — and compact_apply never needs the full prefix
+# ARRAY, only its values at the 2·cap segment boundaries, so keeping
+# the block-local prefix and block offsets SEPARATE (gathered at the
+# boundary positions) also skips the final full-buffer add pass the
+# probe still paid.
+_CSUM_BLOCK = 512
+
+
 def compact_apply(table, delta, caux, mode, key, urows, col: bool = False):
     """Update half of the compact path (see :func:`compact_aux`): per-
-    segment sums via one fp32 ``cumsum`` over the sorted deltas + cap-
-    lane boundary gathers (``sum[s] = csum[end_s] - csum[start_s] +
-    sdelta[start_s]`` — exact per segment, no cross-segment residue
-    beyond the cumsum's own log-depth rounding), then ONE write per
-    unique id: ``add`` for ``dedup``, stochastic-rounded ``set`` of
-    ``urows + sum`` for ``dedup_sr`` (``urows`` doubles as the old-row
-    operand — no second gather). ``col`` = transposed table storage
-    (see :func:`compact_gather`): the cap-sized update transposes before
-    the column write; values are identical."""
+    segment sums via a two-level blocked fp32 prefix over the sorted
+    deltas + cap-lane boundary gathers (``sum[s] = csum(end_s) −
+    csum(start_s) + sdelta[start_s]`` — exact per segment, no
+    cross-segment residue beyond the prefix's own reassociation), then
+    ONE write per unique id: ``add`` for ``dedup``, stochastic-rounded
+    ``set`` of ``urows + sum`` for ``dedup_sr`` (``urows`` doubles as
+    the old-row operand — no second gather). ``col`` = transposed table
+    storage (see :func:`compact_gather`): the cap-sized update
+    transposes before the column write; values are identical."""
     useg, segstart, segend, order, inv = caux
     _check_sentinel_range(table.shape[1] if col else table.shape[0],
                           useg.shape[-1])
     del inv
     sdelta = delta[order].astype(jnp.float32)
-    csum = jnp.cumsum(sdelta, axis=0)
-    segsum = csum[segend] - csum[segstart] + sdelta[segstart]
+    b, w = sdelta.shape
+    blk = _CSUM_BLOCK
+    pad = (-b) % blk
+    padded = jnp.pad(sdelta, ((0, pad), (0, 0))) if pad else sdelta
+    nb = padded.shape[0] // blk
+    bl = jnp.cumsum(padded.reshape(nb, blk, w), axis=1)  # within-block
+    off = jnp.cumsum(bl[:, -1, :], axis=0)               # inclusive
+    off = jnp.concatenate([jnp.zeros_like(off[:1]), off[:-1]], axis=0)
+
+    def csum_at(pos):
+        # Boundary positions are < b, so padding rows never enter.
+        return bl[pos // blk, pos % blk] + off[pos // blk]
+
+    segsum = csum_at(segend) - csum_at(segstart) + sdelta[segstart]
     if mode == "dedup":
         upd = segsum.astype(table.dtype)
         if col:

@@ -9,6 +9,7 @@ pin the quality envelope on the planted-FM synthetic task.
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from fm_spark_tpu import models
@@ -43,6 +44,7 @@ def _train_auc(param_dtype, seed=0, steps=800, batch=256,
     return evaluate_params(spec, params, iterate_once(*te, batch))["auc"]
 
 
+@pytest.mark.slow
 def test_bf16_tables_track_fp32_auc():
     auc32 = _train_auc("float32")
     auc16 = _train_auc("bfloat16")
@@ -56,6 +58,7 @@ def test_bf16_tables_track_fp32_auc():
     assert auc16 > auc32 - 0.03, f"bf16 {auc16} vs fp32 {auc32}"
 
 
+@pytest.mark.slow
 def test_bf16_with_stochastic_rounding_recovers_fp32_quality():
     auc32 = _train_auc("float32")
     auc_sr = _train_auc("bfloat16", sparse_update="dedup_sr")

@@ -134,6 +134,7 @@ def test_cli_train_field_sparse(tmp_path, capsys):
         del configs_lib.CONFIGS["criteo_small"]
 
 
+@pytest.mark.slow
 def test_cli_train_field_deepfm(tmp_path, capsys):
     # Config 5's CTR fast path (field-partitioned embedding + dense Adam
     # head), shrunk; exercises the sharded deepfm loop on the fake mesh
@@ -263,6 +264,7 @@ def test_libfm_rejects_ffm():
         save_libfm("/tmp/x.libfm", spec, params)
 
 
+@pytest.mark.slow
 def test_compat_positional_train_signatures():
     from fm_spark_tpu.compat import FFMWithSGD, FMWithLBFGS
     from fm_spark_tpu.data import synthetic_ctr
@@ -274,6 +276,7 @@ def test_compat_positional_train_signatures():
     assert m2.predict(data[0][:4], data[1][:4]).shape == (4,)
 
 
+@pytest.mark.slow
 def test_cli_preprocess_and_packed_streaming_train(tmp_path, capsys):
     from fm_spark_tpu.data import criteo
 

@@ -230,6 +230,7 @@ def test_compact_validation():
         )
 
 
+@pytest.mark.slow
 def test_cli_measured_best_flags_smoke(tmp_path):
     """End-to-end: the full measured-best flag set (PERF.md headline —
     bf16 tables, bf16 compute, compact host-dedup, dedup_sr) trains,
@@ -265,6 +266,7 @@ def test_cli_measured_best_flags_smoke(tmp_path):
     assert spec2.param_dtype == "bfloat16"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
 @pytest.mark.parametrize("param_dtype", ["float32", "bfloat16"])
 def test_col_layout_matches_row_bitwise(rng, mode, param_dtype):
@@ -464,6 +466,7 @@ def test_ffm_compact_matches_plain(rng, mode):
 
 
 @pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
+@pytest.mark.slow
 def test_deepfm_compact_matches_plain(rng, mode):
     """FieldDeepFM hybrid step: compact embedding updates == plain; the
     dense MLP/w0 side (optax) must be bitwise-unaffected."""

@@ -177,6 +177,7 @@ def test_sharded_2d_device_matches_single_chip(rng):
     )
 
 
+@pytest.mark.slow
 def test_overflow_drop_semantics(rng):
     # One near-unique field overflows cap; policy 'drop' must train
     # through and act exactly as if the overflow ids (the LARGEST ids
@@ -316,6 +317,7 @@ def test_ffm_device_matches_host_compact(rng, mode):
     )
 
 
+@pytest.mark.slow
 def test_deepfm_device_matches_host_compact(rng):
     """FieldDeepFM hybrid step: device-built aux == host-built aux."""
     from fm_spark_tpu.sparse import make_field_deepfm_sparse_step

@@ -407,6 +407,7 @@ def test_libsvm_overflow_raises(tmp_path):
     assert ids.shape == (2, 2)
 
 
+@pytest.mark.slow
 def test_packed_end_to_end_training(tmp_path):
     """Criteo TSV → packed → PackedBatches → FMTrainer: the full L2 path."""
     import jax

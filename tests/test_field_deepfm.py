@@ -97,6 +97,7 @@ def _assert_params_close(got, ref, F):
                                    rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_step_matches_autodiff_optax():
     F, bucket = 4, 32
     spec = _spec(F, bucket)
@@ -120,6 +121,7 @@ def test_fused_step_matches_autodiff_optax():
     _assert_params_close(params, ref, F)
 
 
+@pytest.mark.slow
 def test_fused_step_weighted_rows():
     # Zero-weight (epoch-padding) rows must not touch tables or head.
     F, bucket = 3, 16
@@ -143,6 +145,7 @@ def test_fused_step_weighted_rows():
     _assert_params_close(params, ref, F)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_feat,num_fields", [(4, 6), (8, 5), (2, 4)])
 def test_sharded_matches_single_chip(eight_devices, n_feat, num_fields):
     from fm_spark_tpu.parallel import (

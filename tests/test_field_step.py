@@ -348,6 +348,7 @@ def test_field_sharded_dedup_sr_runs_and_learns(eight_devices):
 
 
 @pytest.mark.parametrize("n_row", [1, 2], ids=["feat4", "feat2xrow2"])
+@pytest.mark.slow
 def test_sharded_eval_matches_canonical(rng, n_row):
     """evaluate_field_sharded must equal evaluate_params on the canonical
     params — same histogram-AUC metric, no table gather."""

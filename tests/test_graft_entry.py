@@ -4,6 +4,7 @@ import sys
 import os
 
 import jax
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -18,9 +19,11 @@ def test_entry_compiles_and_runs():
     jax.jit(fn).lower(*args).compile()
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8(eight_devices):
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_odd():
     __graft_entry__.dryrun_multichip(3)  # falls back to pure DP mesh

@@ -209,6 +209,7 @@ def test_dedup_aux_batches_wrapper(rng):
     np.testing.assert_array_equal(useg, u2)
 
 
+@pytest.mark.slow
 def test_cli_train_host_dedup_smoke(tmp_path):
     """End-to-end: fmtpu train --host-dedup trains via the aux fast path.
 

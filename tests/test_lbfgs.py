@@ -41,6 +41,7 @@ def test_lbfgs_convergence_tol_stops_early():
     assert info["iterations"] < 500
 
 
+@pytest.mark.slow
 def test_lbfgs_regularization_shrinks_weights():
     ids, vals, labels = synthetic_ctr(1000, 100, 3, seed=3)
     spec = models.FMSpec(num_features=100, rank=3, init_std=0.05)
@@ -54,6 +55,7 @@ def test_lbfgs_regularization_shrinks_weights():
     assert float(np.square(reg["w"]).sum()) < float(np.square(free["w"]).sum())
 
 
+@pytest.mark.slow
 def test_compat_fmwithlbfgs_beats_chance_and_roughly_matches_sgd():
     data = synthetic_ctr(3000, 150, 4, rank=3, seed=4)
     m_lbfgs = FMWithLBFGS.train(
@@ -69,6 +71,7 @@ def test_compat_fmwithlbfgs_beats_chance_and_roughly_matches_sgd():
     assert auc_lbfgs > auc_sgd - 0.05  # same model class, same ballpark
 
 
+@pytest.mark.slow
 def test_compat_fmwithlbfgs_regression_clips():
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 50, size=(400, 3)).astype(np.int32)

@@ -144,6 +144,7 @@ def test_stacked_batches_wrapper(rng):
         np.testing.assert_array_equal(got[0][j], ref.next_batch()[0])
 
 
+@pytest.mark.slow
 def test_cli_steps_per_call_smoke():
     """fmtpu train --steps-per-call 4 runs end-to-end (single device)."""
     import os

@@ -86,6 +86,7 @@ def test_sharded_step_matches_single_device(problem, strategy, mesh_shape, eight
         )
 
 
+@pytest.mark.slow
 def test_dp_supports_ffm_and_deepfm(problem, eight_devices):
     ids, vals, labels = problem
     mesh = make_mesh(8, 1, devices=eight_devices)

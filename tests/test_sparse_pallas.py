@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from fm_spark_tpu import models
 from fm_spark_tpu.sparse import (
     make_field_deepfm_sparse_step,

@@ -11,6 +11,7 @@ from fm_spark_tpu.data import Batches, iterate_once, synthetic_ctr, train_test_s
 from fm_spark_tpu.train import FMTrainer, TrainConfig, make_train_step
 
 
+@pytest.mark.slow
 def test_e2e_synthetic_auc_floor():
     """A correct FM trainer must recover planted structure: AUC > 0.70."""
     ids, vals, labels = synthetic_ctr(8000, 200, 5, rank=3, seed=0)
