@@ -105,6 +105,14 @@ class _SGDEntryPoint:
         # keeps one shape, loss averaged over the realized sample like
         # MLlib's grad/miniBatchSize). fraction=1.0 degenerates to full
         # batch either way; use the plain cycler there (no mask cost).
+        #
+        # SCALE LIMIT: this exactness costs O(N) per step — the step
+        # operand is the WHOLE dataset with a fresh mask (the
+        # reference's own cost shape: its sample() scans every
+        # partition per iteration). Right for MovieLens-class compat
+        # runs; a 45M-row dataset would device-put ~N·50B per step.
+        # At that scale use the native pipeline (cli field_sparse:
+        # epoch-shuffled fixed batches) instead of the compat wrapper.
         if self.miniBatchFraction < 1.0:
             batches = BernoulliBatches(
                 ids, vals, labels, self.miniBatchFraction, seed=self.seed
