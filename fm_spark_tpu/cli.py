@@ -324,7 +324,7 @@ _FIELD_CAPS = {
         single_step=_single_deepfm_step,
         sharded_step=_sharded_deepfm_step,
         carries_opt=True, sharded_2d=False, sharded_host_compact=False,
-        sharded_device_compact=False, sharded_multiproc=True,
+        sharded_device_compact=True, sharded_multiproc=True,
         multistep_single=False,
     ),
 }

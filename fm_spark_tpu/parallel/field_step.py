@@ -624,6 +624,8 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     replicated weights (MLP FLOPs are negligible next to the index ops,
     PERF.md fact 4), so the dense gradient is replicated by construction
     and one optax update outside the shard_map keeps the head in sync.
+    ``config.compact_device`` composes exactly as in the FM step (the
+    aux is built in-step from each chip's owned columns).
 
     Returns ``step(params, opt_state, step_idx, ids, vals, labels,
     weights) → (params, opt_state, loss)`` with ``step.init_opt_state``;
@@ -636,10 +638,13 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
-        _gather_all,
+        _check_host_dedup,
+        _compact_apply_all,
+        _fold_overflow,
         _gather_fn,
         _lr_at,
         _reject_host_aux,
+        _rows_for,
         _sr_base_key,
     )
     from fm_spark_tpu.train import make_optimizer
@@ -651,7 +656,16 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
             "field-sharded DeepFM runs on a 1-D ('feat',) mesh (row "
             "sharding of the shared embedding is a follow-on)"
         )
-    _reject_host_aux(config, "the field-sharded DeepFM step")
+    # Device-built compact aux composes here exactly as in the FM step
+    # (the deep head touches activations, not tables); the HOST aux does
+    # not ride this step — reject it rather than silently ignore.
+    _check_host_dedup(config)
+    device_cap = config.compact_cap if config.compact_device else 0
+    if config.host_dedup:
+        # _check_host_dedup guarantees any compact_cap without
+        # compact_device implies host_dedup, so this one test covers
+        # every host-aux request.
+        _reject_host_aux(config, "the field-sharded DeepFM step")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank
@@ -679,7 +693,12 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         weights = lax.all_gather(weights, "feat", tiled=True)
 
         vals_c = vals.astype(cd)
-        rows = _gather_all(gat, vw, ids, cd)
+        # The shared forward table access (sparse._rows_for): plain
+        # per-lane gather, or the in-step device-compact aux build.
+        urows, rows, aux, ovf = _rows_for(
+            False, [vw[f] for f in range(f_local)], None, cd, gat, ids,
+            device_cap=device_cap,
+        )
         xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s_p = sum(xvs)
         sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
@@ -743,11 +762,19 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
-        new_slices = _apply_field_updates(
-            [vw[f] for f in range(f_local)], ids, g_fulls, rows, config,
-            sr_base_key, step_idx, lr,
-            field_offset=lax.axis_index("feat") * f_local,
-        )
+        if device_cap > 0:
+            new_slices = _compact_apply_all(
+                [vw[f] for f in range(f_local)], g_fulls, urows, config,
+                sr_base_key, step_idx, lr, aux,
+                field_offset=lax.axis_index("feat") * f_local,
+            )
+            loss = _fold_overflow(loss, lax.pmax(ovf, "feat"), config)
+        else:
+            new_slices = _apply_field_updates(
+                [vw[f] for f in range(f_local)], ids, g_fulls, rows,
+                config, sr_base_key, step_idx, lr,
+                field_offset=lax.axis_index("feat") * f_local,
+            )
         return jnp.stack(new_slices, axis=0), g_dense, loss
 
     sharded = jax.shard_map(

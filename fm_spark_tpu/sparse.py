@@ -233,9 +233,9 @@ def _reject_host_aux(config: TrainConfig, what: str):
     factory cannot forget the check's wording or semantics."""
     if config.host_dedup or config.compact_cap:
         raise ValueError(
-            f"host_dedup/compact_cap (host- or device-built) are not "
-            f"supported by {what}; drop the flags or pick a supported "
-            "layout"
+            f"the HOST-built dedup/compact aux is not supported by "
+            f"{what}; drop host_dedup (compact_device=True is the "
+            "form that composes with sharded layouts where supported)"
         )
 
 

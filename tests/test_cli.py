@@ -389,10 +389,14 @@ def test_field_sparse_capability_guards():
     # 8-fake-device env field_sparse shards.
     with pytest.raises(SystemExit, match="steps-per-call"):
         run("g2", "avazu_ffm_r16", ["--steps-per-call", "2"], ffm_kw)
-    # Sharded DeepFM consumes no compact aux.
-    with pytest.raises(SystemExit, match="compact-device"):
-        run("g3", "criteo1tb_deepfm",
-            ["--compact-device", "--compact-cap", "64",
+    # Sharded DeepFM takes the DEVICE-built compact aux (round 3) but
+    # still rejects the host-built one.
+    assert run("g3", "criteo1tb_deepfm",
+               ["--compact-device", "--compact-cap", "64",
+                "--sparse-update", "dedup"], deepfm_kw) == 0
+    with pytest.raises(SystemExit, match="not supported"):
+        run("g3b", "criteo1tb_deepfm",
+            ["--host-dedup", "--compact-cap", "64",
              "--sparse-update", "dedup"], deepfm_kw)
     # Host-built compact aux + --row-shards (2-D) cannot compose.
     fm_kw = dict(bucket=64, num_fields=4, rank=4)

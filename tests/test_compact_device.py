@@ -491,3 +491,85 @@ def test_sharded_builders_validate_unconditionally():
     )
     with pytest.raises(ValueError, match="compact_device requires"):
         make_field_ffm_sharded_body(ffm_spec, cfg, mesh)
+
+
+def test_sharded_deepfm_device_matches_single_chip(rng):
+    """Sharded DeepFM with the device-built compact aux must match the
+    single-chip device-compact DeepFM step (round-3 capability cell)."""
+    from fm_spark_tpu.parallel import (
+        make_field_deepfm_sharded_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+        unstack_field_deepfm_params,
+    )
+    from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
+
+    ids, vals, labels, weights = _batch(rng, b=64)
+    spec = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, mlp_dims=(8, 8),
+    )
+    config = _base_cfg(sparse_update="dedup", compact_device=True,
+                       compact_cap=CAP, optimizer="adam")
+    canonical = spec.init(jax.random.key(2))
+    single = make_field_deepfm_sparse_step(spec, config)
+    mesh = make_field_mesh(8)
+    sharded = make_field_deepfm_sharded_step(spec, config, mesh)
+    sp = shard_field_deepfm_params(
+        stack_field_deepfm_params(
+            spec, jax.tree.map(jnp.copy, canonical), 8
+        ),
+        mesh,
+    )
+    opt_s = single.init_opt_state(canonical)
+    opt_sh = sharded.init_opt_state(sp)
+    batch = pad_field_batch((ids, vals, labels, weights), F, 8)
+    for i in range(3):
+        canonical, opt_s, l1 = single(
+            canonical, opt_s, jnp.int32(i), jnp.asarray(ids),
+            jnp.asarray(vals), jnp.asarray(labels), jnp.asarray(weights),
+        )
+        sp, opt_sh, l2 = sharded(
+            sp, opt_sh, jnp.int32(i), *shard_field_batch(batch, mesh)
+        )
+        assert float(l1) == pytest.approx(float(l2), rel=1e-5)
+    got = unstack_field_deepfm_params(spec, jax.device_get(sp))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-5, atol=1e-6,
+        ),
+        canonical, got,
+    )
+
+
+def test_sharded_deepfm_device_overflow_error(rng):
+    """The overflow poison must propagate through the sharded DeepFM
+    step's dense-optimizer wrapper too."""
+    from fm_spark_tpu.parallel import (
+        make_field_deepfm_sharded_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+    )
+
+    b, cap = 64, 8
+    ids, vals, labels, weights = _batch(rng, b=b)
+    ids[:, 2] = rng.permutation(b).astype(np.int32)
+    spec = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, mlp_dims=(8, 8),
+    )
+    config = _base_cfg(sparse_update="dedup", compact_device=True,
+                       compact_cap=cap, optimizer="adam")
+    mesh = make_field_mesh(8)
+    sharded = make_field_deepfm_sharded_step(spec, config, mesh)
+    sp = shard_field_deepfm_params(
+        stack_field_deepfm_params(spec, spec.init(jax.random.key(2)), 8),
+        mesh,
+    )
+    opt = sharded.init_opt_state(sp)
+    batch = pad_field_batch((ids, vals, labels, weights), F, 8)
+    sp, opt, loss = sharded(
+        sp, opt, jnp.int32(0), *shard_field_batch(batch, mesh)
+    )
+    assert np.isposinf(float(loss))
