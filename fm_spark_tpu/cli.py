@@ -323,7 +323,7 @@ _FIELD_CAPS = {
     "FieldDeepFMSpec": _FieldCap(
         single_step=_single_deepfm_step,
         sharded_step=_sharded_deepfm_step,
-        carries_opt=True, sharded_2d=False, sharded_host_compact=False,
+        carries_opt=True, sharded_2d=True, sharded_host_compact=False,
         sharded_device_compact=True, sharded_multiproc=True,
         multistep_single=False,
     ),

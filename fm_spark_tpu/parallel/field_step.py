@@ -209,7 +209,7 @@ def _mesh_geometry(spec, mesh):
 
 
 def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
-                   caux=None, device_cap: int = 0):
+                   caux=None, device_cap: int = 0, add_bias: bool = True):
     """The field-sharded forward, shared by the train body and the eval
     step: example-sharded → field-sharded re-shard (all_to_all over
     ``feat``; labels/weights ride all_gathers in the SAME collective
@@ -336,7 +336,9 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
     if spec.use_linear:
         scores = scores + lin
-    if spec.use_bias:
+    if spec.use_bias and add_bias:
+        # DeepFM's caller folds the bias into its head loss instead
+        # (add_bias=False) so the dense-side vjp sees it.
         scores = scores + w0.astype(cd)
     return (scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
             aux, ovf)
@@ -600,11 +602,12 @@ def unstack_field_deepfm_params(spec, stacked: dict) -> dict:
 
 
 def shard_field_deepfm_params(stacked: dict, mesh) -> dict:
-    """vw field-sharded over ``feat``; the dense head replicated."""
+    """vw field-sharded over ``feat`` (and, 2-D, bucket rows over
+    ``row``); the dense head replicated."""
+    vw_spec = field_param_specs(mesh)["vw"]
     out = {
         "w0": jax.device_put(stacked["w0"], NamedSharding(mesh, P())),
-        "vw": jax.device_put(stacked["vw"],
-                             NamedSharding(mesh, P("feat", None, None))),
+        "vw": jax.device_put(stacked["vw"], NamedSharding(mesh, vw_spec)),
         "mlp": jax.tree_util.tree_map(
             lambda x: jax.device_put(x, NamedSharding(mesh, P())),
             stacked["mlp"],
@@ -614,18 +617,21 @@ def shard_field_deepfm_params(stacked: dict, mesh) -> dict:
 
 
 def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
-    """Field-sharded fused DeepFM step (1-D ``feat`` mesh).
+    """Field-sharded fused DeepFM step (1-D ``feat`` or 2-D
+    ``(feat, row)`` mesh).
 
     Embedding tables are single-owner per field exactly as in the FM
-    step; the deep head additionally needs the FULL ``h = concat(xv)``
-    on every chip, obtained with one ``all_gather`` of the local xv
-    columns over ``feat`` ([B, F·k] activations — the tables still never
-    move). Every chip then runs the identical MLP forward/backward on
-    replicated weights (MLP FLOPs are negligible next to the index ops,
-    PERF.md fact 4), so the dense gradient is replicated by construction
-    and one optax update outside the shard_map keeps the head in sync.
-    ``config.compact_device`` composes exactly as in the FM step (the
-    aux is built in-step from each chip's owned columns).
+    step (same shared forward — :func:`_field_forward` — so the 2-D
+    row-ownership masking and the device-built compact aux compose
+    unchanged); the deep head additionally needs the FULL ``h =
+    concat(xv)`` on every chip: one ``psum`` over ``row`` (2-D only —
+    each row shard holds ownership-masked partial columns) and one
+    ``all_gather`` of the local xv columns over ``feat`` ([B, F·k]
+    activations — the tables still never move). Every chip then runs
+    the identical MLP forward/backward on replicated weights (MLP FLOPs
+    are negligible next to the index ops, PERF.md fact 4), so the dense
+    gradient is replicated by construction and one optax update outside
+    the shard_map keeps the head in sync.
 
     Returns ``step(params, opt_state, step_idx, ids, vals, labels,
     weights) → (params, opt_state, loss)`` with ``step.init_opt_state``;
@@ -644,17 +650,16 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         _gather_fn,
         _lr_at,
         _reject_host_aux,
-        _rows_for,
         _sr_base_key,
     )
     from fm_spark_tpu.train import make_optimizer
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    if set(mesh.axis_names) != {"feat"}:
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
-            "field-sharded DeepFM runs on a 1-D ('feat',) mesh (row "
-            "sharding of the shared embedding is a follow-on)"
+            "field-sharded DeepFM runs on a ('feat',) or ('feat', 'row') "
+            "mesh (use make_field_mesh)"
         )
     # Device-built compact aux composes here exactly as in the FM step
     # (the deep head touches activations, not tables); the HOST aux does
@@ -666,58 +671,41 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         # compact_device implies host_dedup, so this one test covers
         # every host-aux request.
         _reject_host_aux(config, "the field-sharded DeepFM step")
+    g = _mesh_geometry(spec, mesh)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank
     F = spec.num_fields
-    n_feat = mesh.shape["feat"]
-    f_pad = padded_num_fields(F, n_feat)
-    f_local = f_pad // n_feat
+    f_pad, f_local = g["f_pad"], g["f_local"]
+    two_d = g["two_d"]
     sr_base_key = _sr_base_key(config)
     lr_at = _lr_at(config)
     gat = _gather_fn(config)
     dense_opt = make_optimizer(config)
 
-    pspecs = field_deepfm_param_specs(spec)
+    pspecs = field_deepfm_param_specs(spec, mesh)
     mlp_specs = pspecs["mlp"]
 
     def local_step(params, step_idx, ids, vals, labels, weights):
         vw = params["vw"]
         w0 = params["w0"]
         mlp = params["mlp"]
-        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
-                             tiled=True)
-        vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
-                              tiled=True)
-        labels = lax.all_gather(labels, "feat", tiled=True)
-        weights = lax.all_gather(weights, "feat", tiled=True)
-
-        vals_c = vals.astype(cd)
-        # The shared forward table access (sparse._rows_for): plain
-        # per-lane gather, or the in-step device-compact aux build.
-        urows, rows, aux, ovf = _rows_for(
-            False, [vw[f] for f in range(f_local)], None, cd, gat, ids,
-            device_cap=device_cap,
+        # Shared forward: batch re-shard, (2-D) ownership masking,
+        # optional in-step compact aux, one psum of the partial sums.
+        # add_bias=False — the bias rides the dense head's vjp below.
+        (fm_scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
+         aux, ovf) = _field_forward(
+            spec, g, gat, vw, w0, ids, vals, labels, weights,
+            device_cap=device_cap, add_bias=False,
         )
-        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
-        s_p = sum(xvs)
-        sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
-        lin_p = (
-            sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
-            if spec.use_linear
-            else jnp.zeros((ids.shape[0],), cd)
-        )
-        s = lax.psum(s_p, "feat")
-        sq = lax.psum(sq_p, "feat")
-        lin = lax.psum(lin_p, "feat")
-        fm_scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
-        if spec.use_linear:
-            fm_scores = fm_scores + lin
 
-        # Deep head input: local xv columns gathered into global field
-        # order ([B, f_pad·k], padding columns are zero), trimmed to the
-        # MLP's F·k input.
+        # Deep head input: local xv columns — partial on a 2-D mesh
+        # (ownership-masked), completed by one psum over `row` — then
+        # gathered into global field order ([B, f_pad·k], padding
+        # columns zero) and trimmed to the MLP's F·k input.
         h_local = jnp.concatenate(xvs, axis=1)
+        if two_d:
+            h_local = lax.psum(h_local, "row")
         h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
         h = h_full[:, : F * k]
 
@@ -749,6 +737,9 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
 
         g_fulls = []
         for f in range(f_local):
+            # s − xvs[f] is exact for owned lanes; non-owned lanes (2-D)
+            # produce garbage that the sentinel index / dropped segment
+            # discards — same contract as the FM body.
             g_v = (
                 dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
                 + g_h_loc[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
@@ -762,18 +753,23 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        field_offset = lax.axis_index("feat") * f_local
+        if two_d:
+            field_offset = field_offset + lax.axis_index("row") * f_pad
         if device_cap > 0:
             new_slices = _compact_apply_all(
                 [vw[f] for f in range(f_local)], g_fulls, urows, config,
                 sr_base_key, step_idx, lr, aux,
-                field_offset=lax.axis_index("feat") * f_local,
+                field_offset=field_offset,
             )
-            loss = _fold_overflow(loss, lax.pmax(ovf, "feat"), config)
+            loss = _fold_overflow(
+                loss, lax.pmax(ovf, g["score_axes"]), config
+            )
         else:
             new_slices = _apply_field_updates(
-                [vw[f] for f in range(f_local)], ids, g_fulls, rows,
+                [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
                 config, sr_base_key, step_idx, lr,
-                field_offset=lax.axis_index("feat") * f_local,
+                field_offset=field_offset,
             )
         return jnp.stack(new_slices, axis=0), g_dense, loss
 
@@ -781,7 +777,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         local_step,
         mesh=mesh,
         in_specs=(pspecs, P(), *field_batch_specs(mesh)),
-        out_specs=(P("feat", None, None),
+        out_specs=(pspecs["vw"],
                    {"w0": P(), "mlp": mlp_specs}, P()),
         check_vma=False,
     )
@@ -1203,37 +1199,40 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
     }
 
 
-def field_deepfm_param_specs(spec) -> dict:
-    """PartitionSpecs for the stacked sharded DeepFM params (1-D feat
-    mesh): tables field-sharded, bias + MLP replicated. Single definition
-    for the train step and the eval step."""
+def field_deepfm_param_specs(spec, mesh) -> dict:
+    """PartitionSpecs for the stacked sharded DeepFM params: tables
+    field-sharded (and bucket-row-sharded on a 2-D mesh), bias + MLP
+    replicated. Single definition for the train step and the eval
+    step."""
     mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
     mlp_specs = jax.tree_util.tree_map(lambda _: P(), mlp_struct)
-    return {"w0": P(), "vw": P("feat", None, None), "mlp": mlp_specs}
+    return {"w0": P(), "vw": field_param_specs(mesh)["vw"],
+            "mlp": mlp_specs}
 
 
 def make_field_deepfm_sharded_eval_step(spec, mesh):
     """Metrics-accumulation step on the sharded DeepFM layout — the FM
     partial-sum forward plus the replicated-MLP deep head (same shape as
     :func:`make_field_deepfm_sharded_step`'s forward: local xv columns,
-    one ``all_gather`` of ``h``, every chip runs the identical MLP).
-    1-D ``(feat,)`` mesh, like training."""
+    (2-D) one ``psum`` over ``row``, one ``all_gather`` of ``h``, every
+    chip runs the identical MLP)."""
     from fm_spark_tpu.models import base as model_base
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
     from fm_spark_tpu.utils import metrics as metrics_lib
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    if set(mesh.axis_names) != {"feat"}:
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
-            "sharded DeepFM eval runs on a 1-D ('feat',) mesh"
+            "sharded DeepFM eval runs on a ('feat',) or ('feat', 'row') "
+            "mesh"
         )
     per_example_loss = losses_lib.loss_fn(spec.loss)
     k = spec.rank
     F = spec.num_fields
     g = _mesh_geometry(spec, mesh)
     gat = lambda table, idx: table[idx]
-    pspecs = field_deepfm_param_specs(spec)
+    pspecs = field_deepfm_param_specs(spec, mesh)
     mstate_specs = jax.tree_util.tree_map(
         lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
     )
@@ -1247,6 +1246,8 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
             weights,
         )
         h_local = jnp.concatenate(xvs, axis=1)
+        if g["two_d"]:
+            h_local = lax.psum(h_local, "row")
         h = lax.all_gather(h_local, "feat", axis=1, tiled=True)[:, : F * k]
         scores = scores + spec.deep_scores(params["mlp"], h)
         per = per_example_loss(scores, labels)

@@ -408,3 +408,10 @@ def test_field_sparse_capability_guards():
     assert run("g5", "avazu_ffm_r16",
                ["--compact-device", "--compact-cap", "128",
                 "--sparse-update", "dedup"], ffm_kw) == 0
+    # DeepFM on the 2-D (feat, row) mesh with the device-built compact
+    # aux (round 3) — must run clean, eval included.
+    assert run("g6", "criteo1tb_deepfm",
+               ["--row-shards", "2", "--compact-device",
+                "--compact-cap", "128", "--sparse-update", "dedup",
+                "--eval-every", "2", "--test-fraction", "0.2"],
+               deepfm_kw) == 0

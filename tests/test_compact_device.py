@@ -573,3 +573,58 @@ def test_sharded_deepfm_device_overflow_error(rng):
         sp, opt, jnp.int32(0), *shard_field_batch(batch, mesh)
     )
     assert np.isposinf(float(loss))
+
+
+@pytest.mark.parametrize("dev_compact", [False, True])
+def test_sharded_deepfm_2d_matches_single_chip(rng, dev_compact):
+    """DeepFM on the 2-D (feat, row) mesh — shared-forward refactor
+    (round 3): ownership-masked gathers + row-psum'd deep-head input
+    must match the single-chip step, with and without the device-built
+    compact aux."""
+    from fm_spark_tpu.parallel import (
+        make_field_deepfm_sharded_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+        unstack_field_deepfm_params,
+    )
+    from fm_spark_tpu.sparse import make_field_deepfm_sparse_step
+
+    ids, vals, labels, weights = _batch(rng, b=64)
+    spec = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1, mlp_dims=(8, 8),
+    )
+    kw = dict(sparse_update="dedup", optimizer="adam")
+    if dev_compact:
+        kw.update(compact_device=True, compact_cap=CAP)
+    config = _base_cfg(**kw)
+    canonical = spec.init(jax.random.key(2))
+    single = make_field_deepfm_sparse_step(spec, config)
+    mesh = make_field_mesh(8, n_row=2)    # 4 feat x 2 row
+    sharded = make_field_deepfm_sharded_step(spec, config, mesh)
+    sp = shard_field_deepfm_params(
+        stack_field_deepfm_params(
+            spec, jax.tree.map(jnp.copy, canonical), 4
+        ),
+        mesh,
+    )
+    opt_s = single.init_opt_state(canonical)
+    opt_sh = sharded.init_opt_state(sp)
+    batch = pad_field_batch((ids, vals, labels, weights), F, 4)
+    for i in range(3):
+        canonical, opt_s, l1 = single(
+            canonical, opt_s, jnp.int32(i), jnp.asarray(ids),
+            jnp.asarray(vals), jnp.asarray(labels), jnp.asarray(weights),
+        )
+        sp, opt_sh, l2 = sharded(
+            sp, opt_sh, jnp.int32(i), *shard_field_batch(batch, mesh)
+        )
+        assert float(l1) == pytest.approx(float(l2), rel=2e-5)
+    got = unstack_field_deepfm_params(spec, jax.device_get(sp))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-5, atol=2e-6,
+        ),
+        canonical, got,
+    )
