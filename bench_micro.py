@@ -848,6 +848,71 @@ def bench_transpose(args):
           tables256, useg, extra={"width": 256})
 
 
+def bench_gfull(args):
+    """The g_full construction A/B (PERF.md round-4 lever): per-field
+    ``concat([g_v, g_l])`` vs the fused ``ds·x·(s1 − mask·xv_full)``
+    form (one s1 concat total). Both arms start from (rows, vals, ds, s)
+    — including the xv recompute each form implies — and are timed two
+    ways: bare construction (sum consumer) and with the compact chain's
+    first consumer, a per-field reorder gather, so fusion INTO the
+    gather is captured. If XLA already fuses the concats away, the arms
+    tie and the lever is refuted.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, k, b = args.tables, args.width, args.n_idx
+    w = k + 1
+    rng = np.random.default_rng(0)
+    rows = [jnp.asarray(rng.normal(size=(b, w)), jnp.float32)
+            for _ in range(F)]
+    vals = jnp.asarray(rng.uniform(0.5, 1.5, size=(b, F)), jnp.float32)
+    ds = jnp.asarray(rng.normal(size=(b,)), jnp.float32)
+    s = jnp.asarray(rng.normal(size=(b, k)), jnp.float32)
+    order = jnp.asarray(
+        np.stack([rng.permutation(b) for _ in range(F)]), jnp.int32)
+
+    timed = _make_timed(
+        "gfull", {"fields": F, "batch": b, "width": w}, "ms",
+    )
+
+    def g_concat(rows, vals, ds, s):
+        out = []
+        for f in range(F):
+            xv = rows[f][:, :k] * vals[:, f : f + 1]
+            g_v = ds[:, None] * vals[:, f : f + 1] * (s - xv)
+            g_l = ds * vals[:, f]
+            out.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        return out
+
+    def g_fused(rows, vals, ds, s):
+        s1 = jnp.concatenate(
+            [s, jnp.ones((ds.shape[0], 1), jnp.float32)], axis=1)
+        colmask = jnp.arange(w) < k
+        out = []
+        for f in range(F):
+            xvf = rows[f] * vals[:, f : f + 1]
+            out.append(ds[:, None] * vals[:, f : f + 1] * (
+                s1 - jnp.where(colmask, xvf, jnp.zeros((), jnp.float32))))
+        return out
+
+    timed("concat_sum",
+          lambda *xs: [jnp.sum(g) for g in g_concat(*xs)],
+          rows, vals, ds, s)
+    timed("fused_sum",
+          lambda *xs: [jnp.sum(g) for g in g_fused(*xs)],
+          rows, vals, ds, s)
+    timed("concat_reorder",
+          lambda o, *xs: [jnp.sum(g[o[f]])
+                          for f, g in enumerate(g_concat(*xs))],
+          order, rows, vals, ds, s)
+    timed("fused_reorder",
+          lambda o, *xs: [jnp.sum(g[o[f]])
+                          for f, g in enumerate(g_fused(*xs))],
+          order, rows, vals, ds, s)
+
+
 BENCHES = {
     "dispatch": bench_dispatch,
     "gather": bench_gather,
@@ -862,6 +927,7 @@ BENCHES = {
     "stackfuse": bench_stackfuse,
     "scanmodel": bench_scanmodel,
     "transpose": bench_transpose,
+    "gfull": bench_gfull,
 }
 
 
@@ -874,7 +940,7 @@ def main():
                     "single-table probes (gather/scatter) use B*F = "
                     "5242880 (the headline step's total index count); "
                     "the per-field batch probes (dedup/split/compact/"
-                    "cumsum/merge/stackfuse/scanmodel/transpose) use "
+                    "cumsum/merge/stackfuse/scanmodel/transpose/gfull) use "
                     "B = 131072 (the headline batch) — passing the B*F "
                     "default to those would build a 204M-id host aux")
     ap.add_argument("--width", type=int, default=64)

@@ -51,6 +51,8 @@ property-tested on the fake 8-device CPU mesh (tests/test_field_step.py).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -208,8 +210,29 @@ def _mesh_geometry(spec, mesh):
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class _Fwd:
+    """:func:`_field_forward`'s result (named fields instead of the old
+    positional 11-tuple — VERDICT r3: positional contracts break silently
+    on extension). Traced values only; never crosses a jit boundary."""
+
+    scores: object       # [B] replicated across the mesh
+    s: object            # [B, k] psum'd factor sums
+    xvs: object          # f_local × [B, k] local xv terms
+    xv_fulls: object     # f_local × [B, k+1] (gfull=True only, else None)
+    rows: object         # f_local × [B, width] gathered rows
+    vals_c: object       # [B, F_pad] compute-dtype vals (post re-shard)
+    uidx: object         # single-owner scatter targets (None on compact)
+    urows: object        # compact unique-row buffers (None on plain)
+    labels: object       # [B] full-batch labels (post all_gather)
+    weights: object      # [B] full-batch weights
+    aux: object          # compact aux in effect (host or device-built)
+    ovf: object          # device-compact overflow count (None otherwise)
+
+
 def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
-                   caux=None, device_cap: int = 0, add_bias: bool = True):
+                   caux=None, device_cap: int = 0, add_bias: bool = True,
+                   gfull: bool = False):
     """The field-sharded forward, shared by the train body and the eval
     step: example-sharded → field-sharded re-shard (all_to_all over
     ``feat``; labels/weights ride all_gathers in the SAME collective
@@ -231,15 +254,13 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     whose writes drop — note that segment consumes one of the ``cap``
     slots). Exclusive with ``caux``.
 
-    Returns ``(scores, s, xvs, rows, vals_c, uidx, urows, labels,
-    weights, aux, ovf)`` — scores replicated across the mesh; the
-    training body additionally consumes the locals for its analytic
-    backward; ``uidx`` carries the single-owner scatter targets (OOB
-    sentinel for non-owned lanes; None on the compact paths, whose
-    writes target the aux's cap lanes); ``urows`` the compact
-    unique-row buffers (None on the plain path); ``aux`` the compact
-    aux actually in effect (host or device-built); ``ovf`` the
-    device path's per-chip overflow count (None otherwise).
+    Returns an :class:`_Fwd` (see its field docs) — scores replicated
+    across the mesh; the training body additionally consumes the locals
+    for its analytic backward. ``gfull=True`` computes the full-width
+    ``xv_fulls = rows·x`` products once and derives ``xvs`` (and the
+    linear partial sum) from them — bitwise-identical forward values,
+    and the backward can then build each g_full without a per-field
+    concat (TrainConfig.gfull_fused).
     """
     from fm_spark_tpu.sparse import (
         _compact_gather_all,
@@ -321,14 +342,20 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     else:
         rows = _gather_all(gat, vw, ids, cd)
         uidx = ids
-    xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+    xv_fulls = None
+    if gfull:
+        xv_fulls = [r * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        xvs = [x[:, :k] for x in xv_fulls]
+    else:
+        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
     s_p = sum(xvs)
     sq_p = sum(jnp.sum(x * x, axis=1) for x in xvs)
-    lin_p = (
-        sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
-        if spec.use_linear
-        else jnp.zeros((vals.shape[0],), cd)  # vals is post-all_to_all
-    )
+    if not spec.use_linear:
+        lin_p = jnp.zeros((vals.shape[0],), cd)  # vals is post-all_to_all
+    elif gfull:
+        lin_p = sum(x[:, k] for x in xv_fulls)
+    else:
+        lin_p = sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
     # The scores collective: [B,k] + 2·[B] per step; tables never move.
     s = lax.psum(s_p, g["score_axes"])
     sq = lax.psum(sq_p, g["score_axes"])
@@ -340,8 +367,9 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
         # DeepFM's caller folds the bias into its head loss instead
         # (add_bias=False) so the dense-side vjp sees it.
         scores = scores + w0.astype(cd)
-    return (scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
-            aux, ovf)
+    return _Fwd(scores=scores, s=s, xvs=xvs, xv_fulls=xv_fulls, rows=rows,
+                vals_c=vals_c, uidx=uidx, urows=urows, labels=labels,
+                weights=weights, aux=aux, ovf=ovf)
 
 
 def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
@@ -421,11 +449,13 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             )
         vw = params["vw"]
         w0 = params["w0"]
-        (scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
-         aux, ovf) = _field_forward(
+        fwd = _field_forward(
             spec, g, gat, vw, w0, ids, vals, labels, weights, caux=caux,
-            device_cap=device_cap,
+            device_cap=device_cap, gfull=config.gfull_fused,
         )
+        s, xvs, rows, vals_c = fwd.s, fwd.xvs, fwd.rows, fwd.vals_c
+        uidx, urows, aux, ovf = fwd.uidx, fwd.urows, fwd.aux, fwd.ovf
+        labels, weights = fwd.labels, fwd.weights
 
         # From here on every chip holds identical full-batch values.
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
@@ -433,25 +463,37 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         def batch_loss(sc):
             return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
 
-        loss, dscores = jax.value_and_grad(batch_loss)(scores)
+        loss, dscores = jax.value_and_grad(batch_loss)(fwd.scores)
         lr = lr_at(step_idx)
         touched = weights > 0
 
-        g_fulls = []
-        for f in range(f_local):
-            # s − xvs[f] is exactly s_{-f} for OWNED lanes (their xv is in
-            # the psum); non-owned lanes produce garbage that the sentinel
-            # index drops.
-            g_v = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
-            if config.reg_factors:
-                g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
-            if spec.use_linear:
-                g_l = dscores * vals_c[:, f]
-                if config.reg_linear:
-                    g_l = g_l + config.reg_linear * rows[f][:, k] * touched
-            else:
-                g_l = jnp.zeros_like(dscores)
-            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        if config.gfull_fused:
+            # Shared construction (sparse.py:_gfull_grads) — same
+            # numerics as the single-chip body by definition. Non-owned
+            # lanes still produce garbage that the sentinel index /
+            # dropped segment discards.
+            from fm_spark_tpu.sparse import _gfull_grads
+
+            g_fulls = _gfull_grads(
+                dscores, vals_c, s, fwd.xv_fulls, rows, touched, k, cd,
+                spec.use_linear, config,
+            )
+        else:
+            g_fulls = []
+            for f in range(f_local):
+                # s − xvs[f] is exactly s_{-f} for OWNED lanes (their xv
+                # is in the psum); non-owned lanes produce garbage that
+                # the sentinel index drops.
+                g_v = dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+                if config.reg_factors:
+                    g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+                if spec.use_linear:
+                    g_l = dscores * vals_c[:, f]
+                    if config.reg_linear:
+                        g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+                else:
+                    g_l = jnp.zeros_like(dscores)
+                g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
         # SR keys: one stream per (global field, row shard) so noise never
         # correlates across the chips sharing a field.
         field_offset = lax.axis_index("feat") * f_local
@@ -656,6 +698,9 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
+    from fm_spark_tpu.sparse import _reject_gfull
+
+    _reject_gfull(config, "the field-sharded DeepFM step")
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded DeepFM runs on a ('feat',) or ('feat', 'row') "
@@ -693,11 +738,14 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         # Shared forward: batch re-shard, (2-D) ownership masking,
         # optional in-step compact aux, one psum of the partial sums.
         # add_bias=False — the bias rides the dense head's vjp below.
-        (fm_scores, s, xvs, rows, vals_c, uidx, urows, labels, weights,
-         aux, ovf) = _field_forward(
+        fwd = _field_forward(
             spec, g, gat, vw, w0, ids, vals, labels, weights,
             device_cap=device_cap, add_bias=False,
         )
+        fm_scores, s, xvs, rows = fwd.scores, fwd.s, fwd.xvs, fwd.rows
+        vals_c, uidx, urows = fwd.vals_c, fwd.uidx, fwd.urows
+        labels, weights, aux, ovf = (fwd.labels, fwd.weights, fwd.aux,
+                                     fwd.ovf)
 
         # Deep head input: local xv columns — partial on a 2-D mesh
         # (ownership-masked), completed by one psum over `row` — then
@@ -946,6 +994,9 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
         raise ValueError("expected a FieldFFMSpec")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
+    from fm_spark_tpu.sparse import _reject_gfull
+
+    _reject_gfull(config, "the field-sharded FFM step")
     if set(mesh.axis_names) != {"feat"}:
         raise ValueError(
             "field-sharded FFM runs on a 1-D ('feat',) mesh (row "
@@ -1129,14 +1180,15 @@ def make_field_sharded_eval_step(spec, mesh):
     gat = lambda table, idx: table[idx]  # eval always takes the XLA gather
 
     def local_eval(params, mstate, ids, vals, labels, weights):
-        scores, _, _, _, _, _, _, labels, weights, _, _ = _field_forward(
+        fwd = _field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
         )
-        per = per_example_loss(scores, labels)
-        preds = model_base.predict_from_scores(spec, scores)
+        per = per_example_loss(fwd.scores, fwd.labels)
+        preds = model_base.predict_from_scores(spec, fwd.scores)
         return metrics_lib.update_metrics(
-            mstate, scores, labels, per, weights, predictions=preds
+            mstate, fwd.scores, fwd.labels, per, fwd.weights,
+            predictions=preds
         )
 
     mstate_specs = jax.tree_util.tree_map(
@@ -1241,15 +1293,16 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
         # The shared FM forward (scores incl. linear + bias), then the
         # deep head exactly as training: local xv columns, one all_gather
         # of h, the replicated MLP.
-        scores, _, xvs, _, _, _, _, labels, weights, _, _ = _field_forward(
+        fwd = _field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
         )
-        h_local = jnp.concatenate(xvs, axis=1)
+        labels, weights = fwd.labels, fwd.weights
+        h_local = jnp.concatenate(fwd.xvs, axis=1)
         if g["two_d"]:
             h_local = lax.psum(h_local, "row")
         h = lax.all_gather(h_local, "feat", axis=1, tiled=True)[:, : F * k]
-        scores = scores + spec.deep_scores(params["mlp"], h)
+        scores = fwd.scores + spec.deep_scores(params["mlp"], h)
         per = per_example_loss(scores, labels)
         preds = model_base.predict_from_scores(spec, scores)
         return metrics_lib.update_metrics(

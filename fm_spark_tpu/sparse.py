@@ -226,6 +226,53 @@ def _updates_for(compact, tables, ids, g_fulls, rows, urows,
     )
 
 
+def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
+                 use_linear: bool, config: TrainConfig):
+    """The fused g_full construction (``config.gfull_fused``), shared by
+    the single-chip and field-sharded FM bodies so the numerics can
+    never diverge: per field,
+
+        g_full = ds·x·(s1 − mask·xv_full) + rv·rows·touched
+
+    with ``s1 = [s, lin_on]`` built ONCE — col f<k gives
+    ``ds·x·(s_f − xv_f)`` (the reference's computeGradient rule), col k
+    gives ``ds·x·lin_on`` — the SAME arithmetic as the per-field
+    ``concat([g_v, g_l])`` construction (×1.0 and a select are exact;
+    XLA contraction may still differ by ~1 ULP, tests/test_gfull.py),
+    with no per-field concat copy pass. ``jnp.where`` (not ·mask) so a
+    non-finite factor row cannot poison the linear column. ``rv`` is
+    the per-column reg vector (factor cols → reg_factors, col k →
+    reg_linear), so every reg split stays column-exact."""
+    lin_on = 1.0 if use_linear else 0.0
+    s1 = jnp.concatenate(
+        [s, jnp.full((dscores.shape[0], 1), lin_on, cd)], axis=1)
+    colmask = jnp.arange(k + 1) < k
+    rv = None
+    if config.reg_factors or config.reg_linear:
+        rv = jnp.asarray(
+            [config.reg_factors] * k
+            + [config.reg_linear if use_linear else 0.0], cd)
+    g_fulls = []
+    for f in range(len(rows)):
+        g = dscores[:, None] * vals_c[:, f : f + 1] * (
+            s1 - jnp.where(colmask, xv_fulls[f], jnp.zeros((), cd)))
+        if rv is not None:
+            g = g + rv * rows[f] * touched[:, None]
+        g_fulls.append(g)
+    return g_fulls
+
+
+def _reject_gfull(config: TrainConfig, what: str):
+    """Guard for step factories that do not implement the gfull_fused
+    backward: hard-fail instead of silently training with the concat
+    construction (no-silent-fallback rule)."""
+    if config.gfull_fused:
+        raise ValueError(
+            f"gfull_fused is implemented for the FieldFM fused bodies "
+            f"only, not {what}"
+        )
+
+
 def _reject_host_aux(config: TrainConfig, what: str):
     """Guard for step factories that take no aux operand (the sharded
     steps): hard-fail an explicit fast-path request rather than
@@ -314,6 +361,9 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         )
     if col and config.use_pallas:
         raise ValueError("table_layout='col' and use_pallas are exclusive")
+    if config.gfull_fused and not spec.fused_linear:
+        raise ValueError("gfull_fused targets the fused-linear g_full "
+                         "construction; it requires fused_linear=True")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -342,18 +392,32 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         else:
             urows = None
             rows = spec.gather_rows(params, ids)        # F × [B, width]
-        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        gfull_fused = config.gfull_fused
+        if gfull_fused:
+            # Full-width x·row products, computed once: cols [:k] are the
+            # interaction xv terms, col k is the linear term's l·x — the
+            # backward reuses the same buffers so g_full needs no
+            # per-field concat (see below). Values are bitwise-identical
+            # to the sliced formulation (same elementwise products).
+            xv_fulls = [r * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+            xvs = [x[:, :k] for x in xv_fulls]
+        else:
+            xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
         s = sum(xvs)                                    # [B, k]
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
         scores = 0.5 * (jnp.sum(s * s, axis=1) - sum_sq)
         if spec.use_linear:
-            if spec.fused_linear:
-                lins = [r[:, k] for r in rows]
+            if gfull_fused:
+                scores = scores + sum(x[:, k] for x in xv_fulls)
             else:
-                lins = [params["w"][f][ids[:, f]].astype(cd) for f in range(F)]
-            scores = scores + sum(
-                l * vals_c[:, f] for f, l in enumerate(lins)
-            )
+                if spec.fused_linear:
+                    lins = [r[:, k] for r in rows]
+                else:
+                    lins = [params["w"][f][ids[:, f]].astype(cd)
+                            for f in range(F)]
+                scores = scores + sum(
+                    l * vals_c[:, f] for f, l in enumerate(lins)
+                )
         if spec.use_bias:
             scores = scores + w0.astype(cd)
 
@@ -381,14 +445,21 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         if spec.fused_linear:
             # ONE row-update per field: interaction grads in cols [:k], the
             # linear grad in col k (zeroed if the linear term is disabled).
-            g_fulls = []
-            for f in range(F):
-                g_lin = (
-                    linear_grad(f)[:, None]
-                    if spec.use_linear
-                    else jnp.zeros((dscores.shape[0], 1), cd)
+            if gfull_fused:
+                g_fulls = _gfull_grads(
+                    dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
+                    spec.use_linear, config,
                 )
-                g_fulls.append(jnp.concatenate([factor_grad(f), g_lin], axis=1))
+            else:
+                g_fulls = []
+                for f in range(F):
+                    g_lin = (
+                        linear_grad(f)[:, None]
+                        if spec.use_linear
+                        else jnp.zeros((dscores.shape[0], 1), cd)
+                    )
+                    g_fulls.append(
+                        jnp.concatenate([factor_grad(f), g_lin], axis=1))
             new_vw = _updates_for(
                 compact, params["vw"], ids, g_fulls, rows, urows, config,
                 sr_base_key, step_idx, lr, aux, col=col,
@@ -496,6 +567,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldFFMSpec")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
+    _reject_gfull(config, "the FieldFFM body")
     _check_host_dedup(config)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -600,6 +672,7 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
+    _reject_gfull(config, "the FieldDeepFM body")
     _check_host_dedup(config)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -725,6 +798,8 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
         raise ValueError("sparse step supports the plain FM family only")
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
+    _reject_gfull(config, "the flat-table FM step (it has no fused "
+                  "g_full concat to eliminate)")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
 

@@ -98,6 +98,16 @@ class TrainConfig:
     #            into halves (zero-weight padded) until every field
     #            fits — exact semantics, more (smaller) steps.
     compact_overflow: str = "error"
+    # Build each field's fused row update g_full as ONE elementwise
+    # expression ``ds·x·(s1 − mask·xv_full) + rv·rows·touched`` (with
+    # ``s1 = [s, 1]`` built once) instead of per-field
+    # ``concat([g_v, g_l])`` — eliminates F × [B, k+1] concat copy
+    # passes if XLA was not fusing them into the update's reorder
+    # gather (PERF.md round-4 lever). Bitwise-identical results
+    # (tests/test_sparse.py pins it); FieldFM fused-linear bodies only.
+    # Off by default until the on-chip A/B decides (bench.py
+    # --gfull-fused).
+    gfull_fused: bool = False
 
 
 def _group_reg(config: TrainConfig):
