@@ -21,13 +21,31 @@ overhead (~66ms on this setup) is amortized, matching production use where
 the host only feeds data. Data is device-resident; the host input pipeline
 is benchmarked separately by ``bench_input.py``.
 
-Reliability design (round-2): the TPU attachment on this setup is flaky --
-backend init can fail ("Unable to initialize backend") or hang
-indefinitely, and a failed init poisons the process. So the measurement
-runs in a CHILD process with a hard wall-clock timeout; the parent retries
-with backoff on failure/hang and emits the error JSON only after all
-attempts are exhausted. The child prints stage heartbeats to stderr so a
-slow first compile (~20-60s) is distinguishable from a hang.
+Reliability design (round-2, reworked round-4): the TPU attachment on
+this setup is flaky -- backend init can fail ("Unable to initialize
+backend") or hang indefinitely, and a failed init poisons the process. So
+the measurement runs in a CHILD process with a hard wall-clock timeout;
+the parent retries with backoff on failure/hang. The child prints stage
+heartbeats to stderr so a slow first compile (~20-60s) is distinguishable
+from a hang.
+
+Round-4 hardening (VERDICT r3 #1 -- round 3 ended rc=124 with NO
+parseable line because the 4 x 600s retry budget exceeded the driver's
+~30min kill window):
+  * ``--total-deadline`` (default 1500s) bounds the WHOLE parent run,
+    comfortably under the observed outer window; attempt timeouts are
+    clamped to the remaining budget.
+  * The child runs an init watchdog: a backend init that has not finished
+    within ``--init-timeout`` (default 240s) never finishes on this
+    attachment, so the child prints a provisional error JSON and exits
+    early instead of burning the full attempt timeout.
+  * A provisional error JSON is printed after EVERY failed attempt, so
+    the final stdout line is parseable no matter where an outer kill
+    lands.
+  * SIGTERM/SIGINT in the parent (what ``timeout(1)`` sends) emits the
+    best-so-far result line -- or the error JSON -- before exiting; the
+    parent streams the child's stdout live so a mid-sweep cumulative-best
+    line is salvageable at any instant.
 
 Timing note: on this TPU attachment, ``block_until_ready`` returns before
 execution completes; a device->host transfer of the loss is the reliable
@@ -56,10 +74,34 @@ def _log(msg):
 # backend init can be killed and retried by the parent.
 # --------------------------------------------------------------------------
 
+def _error_line(msg):
+    return json.dumps({
+        "metric": METRIC, "value": None, "unit": UNIT,
+        "vs_baseline": None, "error": msg,
+    })
+
+
 def inner_main(args):
     t_start = time.perf_counter()
     _log("[inner] importing jax + initializing backend "
          "(a hang here = flaky TPU attachment)...")
+
+    # Init watchdog: on this attachment an init that has not completed in
+    # ~4 minutes never completes; exiting early lets the parent retry
+    # within its total deadline instead of burning the full attempt
+    # timeout on a known-dead hang.
+    init_done = threading.Event()
+
+    def _init_watchdog():
+        if not init_done.wait(args.init_timeout):
+            print(_error_line(
+                f"backend init exceeded {args.init_timeout:.0f}s "
+                "(init watchdog; flaky TPU attachment)"), flush=True)
+            _log(f"[inner] init watchdog fired at {args.init_timeout:.0f}s"
+                 " -- exiting for parent retry")
+            os._exit(3)
+
+    threading.Thread(target=_init_watchdog, daemon=True).start()
     import jax
 
     # The installed TPU plugin ignores the JAX_PLATFORMS env var; honor an
@@ -74,6 +116,7 @@ def inner_main(args):
     from jax import lax
 
     devs = jax.devices()  # forces backend init
+    init_done.set()
     _log(f"[inner] backend up in {time.perf_counter() - t_start:.1f}s: "
          f"{len(devs)} x {devs[0].device_kind}")
 
@@ -118,7 +161,7 @@ def inner_main(args):
                 or args.table_layout != "row"
                 or args.rank != 64 or args.batch != 1 << 17
                 or args.steps != 20 or args.compact_cap
-                or args.compact_device)
+                or args.compact_device or args.gfull_fused)
     variants = [(
         f"{args.param_dtype}/{args.sparse_update}"
         + ("/pallas" if args.use_pallas else "")
@@ -126,13 +169,15 @@ def inner_main(args):
            else "/hostdedup" if args.host_dedup else "")
         + ("/devaux" if args.compact_device else "")
         + ("/cd-bf16" if args.compute_dtype == "bfloat16" else "")
-        + ("/colT" if args.table_layout == "col" else ""),
+        + ("/colT" if args.table_layout == "col" else "")
+        + ("/gfull" if args.gfull_fused else ""),
         (args.param_dtype, None, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
                     use_pallas=args.use_pallas, host_dedup=args.host_dedup,
                     compact_cap=args.compact_cap,
-                    compact_device=args.compact_device),
+                    compact_device=args.compact_device,
+                    gfull_fused=args.gfull_fused),
     )]
     if not explicit:
         # The COMPACT host-dedup candidates (PERF.md: the round-2 probes
@@ -151,6 +196,18 @@ def inner_main(args):
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
                         optimizer="sgd", sparse_update="dedup_sr",
                         host_dedup=True, compact_cap=cap),
+        ))
+        # The round-4 gfull A/B: the winning combo with the fused g_full
+        # construction (PERF.md "g_full concatenate elimination"). Runs
+        # SECOND so the A/B pair lands even if the attachment dies
+        # mid-sweep.
+        variants.insert(1, (
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap,
+                        gfull_fused=True),
         ))
         # TRANSPOSED-table candidate (PERF.md "transpose" probe: the
         # col layout halves physical table bytes and the cap-gather
@@ -256,16 +313,76 @@ def inner_main(args):
 
 
 # --------------------------------------------------------------------------
-# Parent: spawn the child with a hard timeout, retry with backoff, emit an
-# error JSON artifact if every attempt fails.
+# Parent: spawn the child with a hard timeout, retry with backoff under a
+# TOTAL wall-clock deadline, emit a provisional error JSON after every
+# failed attempt, and salvage the best-so-far line even on SIGTERM.
 # --------------------------------------------------------------------------
 
+# Shared with the signal handler: the last valid cumulative-best result
+# line streamed from any child, the failure log so far, and the live
+# child process (so the handler can kill it before exiting — an orphaned
+# child would keep holding the exclusive TPU attachment). RLock: the
+# handler runs on the main thread, which may already hold the lock when
+# the signal lands.
+_SALVAGE = {"line": None, "failures": [], "emitted": False, "proc": None}
+_SALVAGE_LOCK = threading.RLock()
+
+
+def _emit_final():
+    """Print the authoritative last line exactly once (result or error)."""
+    with _SALVAGE_LOCK:
+        if _SALVAGE["emitted"]:
+            return
+        _SALVAGE["emitted"] = True
+        if _SALVAGE["line"] is not None:
+            print(_SALVAGE["line"], flush=True)
+        else:
+            print(_error_line("; ".join(_SALVAGE["failures"])
+                              or "no attempt completed"), flush=True)
+
+
+def _parse_result_line(line):
+    line = line.strip()
+    if not line.startswith("{"):
+        return None
+    try:
+        parsed = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    if parsed.get("metric") == METRIC and parsed.get("value") is not None:
+        return line
+    return None
+
+
 def _run_attempt(argv, timeout_s):
-    """One child run. Returns (json_line_or_None, diagnostic_str)."""
+    """One child run. Returns (json_line_or_None, diagnostic_str).
+
+    The child's stdout is STREAMED (not buffered in communicate()): each
+    cumulative-best line is recorded into _SALVAGE the moment it appears,
+    so an outer SIGTERM landing mid-sweep still finds the newest
+    completed measurement.
+    """
     cmd = [sys.executable, os.path.abspath(__file__), "--inner"] + argv
-    # stderr inherited -> child heartbeats stream live; stdout captured for
-    # the JSON result line.
+    # stderr inherited -> child heartbeats stream live.
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    with _SALVAGE_LOCK:
+        _SALVAGE["proc"] = proc
+
+    found_holder = {"line": None}
+
+    def reader():
+        for line in proc.stdout:
+            got = _parse_result_line(line)
+            if got is not None:
+                # LAST matching line wins: the child prints a
+                # cumulative-best line after each variant.
+                found_holder["line"] = got
+                with _SALVAGE_LOCK:
+                    _SALVAGE["line"] = got
+        proc.stdout.close()
+
+    rd = threading.Thread(target=reader, daemon=True)
+    rd.start()
 
     hb_stop = threading.Event()
 
@@ -279,34 +396,22 @@ def _run_attempt(argv, timeout_s):
     hb.start()
     timed_out = False
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
+        proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        # A hang can happen AFTER the result line was printed (e.g. in
-        # backend teardown) — kill, then still scan the buffered stdout
-        # for a completed measurement before declaring the attempt dead.
         timed_out = True
         proc.kill()
-        out, _ = proc.communicate()
+        proc.wait()
     finally:
         hb_stop.set()
+        rd.join(timeout=10)
+        with _SALVAGE_LOCK:
+            _SALVAGE["proc"] = None
 
-    # LAST matching line wins: the child prints a cumulative-best line
-    # after each variant, so a sweep cut short mid-variant still yields
-    # its completed measurements.
-    found = None
-    for line in (out or "").splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                parsed = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if parsed.get("metric") == METRIC and parsed.get("value") is not None:
-                found = line
+    found = found_holder["line"]
     if found is not None:
         return found, ""
     if timed_out:
-        return None, f"child hung: no result within {timeout_s}s (killed)"
+        return None, f"child hung: no result within {timeout_s:.0f}s (killed)"
     return None, f"child exited rc={proc.returncode} without a result line"
 
 
@@ -350,13 +455,28 @@ def main():
                     help="build the compact aux on device inside the "
                          "step (the scale-out form of --compact-cap; "
                          "exclusive with --host-dedup)")
+    ap.add_argument("--gfull-fused", action="store_true",
+                    dest="gfull_fused",
+                    help="fused g_full construction (no per-field "
+                         "concat([g_v, g_l]); PERF.md round-4 lever)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--attempts", type=int, default=4,
-                    help="max child attempts before emitting the error JSON")
+    ap.add_argument("--attempts", type=int, default=6,
+                    help="max child attempts before emitting the error JSON "
+                         "(the total deadline usually binds first)")
     ap.add_argument("--attempt-timeout", type=float, default=600.0,
                     help="hard wall-clock limit per attempt (seconds)")
+    ap.add_argument("--total-deadline", type=float, default=1500.0,
+                    dest="total_deadline",
+                    help="hard wall-clock limit for the WHOLE run incl. "
+                         "retries; kept under the driver's ~30min outer "
+                         "kill window so the final JSON line always lands")
+    ap.add_argument("--init-timeout", type=float, default=240.0,
+                    dest="init_timeout",
+                    help="child-side backend init watchdog: an init that "
+                         "has not finished by then never finishes here; "
+                         "the child exits early for a cheap retry")
     args = ap.parse_args()
 
     if (args.host_dedup or args.compact_device) and (
@@ -386,6 +506,7 @@ def main():
         "--rank", str(args.rank),
         "--batch", str(args.batch),
         "--steps", str(args.steps),
+        "--init-timeout", str(args.init_timeout),
     ]
     if args.use_pallas:
         argv.append("--use-pallas")
@@ -395,28 +516,67 @@ def main():
         argv += ["--compact-cap", str(args.compact_cap)]
     if args.compact_device:
         argv.append("--compact-device")
-    failures = []
-    for attempt in range(1, args.attempts + 1):
-        _log(f"[parent] attempt {attempt}/{args.attempts}")
-        line, diag = _run_attempt(argv, args.attempt_timeout)
-        if line is not None:
-            print(line, flush=True)
-            return 0
-        failures.append(f"attempt {attempt}: {diag}")
-        _log(f"[parent] {diag}")
-        if attempt < args.attempts:
-            backoff = 10 * attempt
-            _log(f"[parent] backing off {backoff}s before retry "
-                 "(flaky TPU attachment)")
-            time.sleep(backoff)
+    if args.gfull_fused:
+        argv.append("--gfull-fused")
+    # An outer kill (timeout(1) sends SIGTERM) must still leave a
+    # parseable final line: best-so-far result if any child printed one,
+    # otherwise the error JSON with the failure log.
+    import signal
 
-    print(json.dumps({
-        "metric": METRIC,
-        "value": None,
-        "unit": UNIT,
-        "vs_baseline": None,
-        "error": "; ".join(failures),
-    }), flush=True)
+    def _on_signal(signum, frame):
+        with _SALVAGE_LOCK:
+            _SALVAGE["failures"].append(
+                f"parent received signal {signum} before completion")
+            proc = _SALVAGE["proc"]
+        if proc is not None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        _emit_final()
+        os._exit(1)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
+
+    deadline = time.perf_counter() + args.total_deadline
+    for attempt in range(1, args.attempts + 1):
+        remaining = deadline - time.perf_counter()
+        if remaining < 90:
+            with _SALVAGE_LOCK:
+                _SALVAGE["failures"].append(
+                    f"total deadline {args.total_deadline:.0f}s reached "
+                    f"after {attempt - 1} attempts")
+            break
+        # Reserve 15s so the final emit always beats the deadline.
+        timeout_s = min(args.attempt_timeout, remaining - 15)
+        _log(f"[parent] attempt {attempt}/{args.attempts} "
+             f"(timeout {timeout_s:.0f}s, {remaining:.0f}s of total "
+             "budget left)")
+        line, diag = _run_attempt(argv, timeout_s)
+        if line is not None:
+            with _SALVAGE_LOCK:
+                _SALVAGE["line"] = line
+            _emit_final()
+            return 0
+        with _SALVAGE_LOCK:
+            _SALVAGE["failures"].append(f"attempt {attempt}: {diag}")
+        _log(f"[parent] {diag}")
+        # Provisional artifact NOW: if the outer window kills us later,
+        # the last stdout line is already parseable.
+        with _SALVAGE_LOCK:
+            print(_error_line(
+                "provisional after failed attempt "
+                f"{attempt}: " + "; ".join(_SALVAGE["failures"])),
+                flush=True)
+        if attempt < args.attempts:
+            backoff = min(10 * attempt, max(0, deadline - time.perf_counter() - 90))
+            if backoff > 0:
+                _log(f"[parent] backing off {backoff:.0f}s before retry "
+                     "(flaky TPU attachment)")
+                time.sleep(backoff)
+
+    _emit_final()
     return 1
 
 
