@@ -269,7 +269,8 @@ def _jax():
 def framework_variant(tr, te, model="fm", param_dtype="float32",
                       sparse_update="scatter_add", host_dedup=False,
                       compact_cap=0, compute_dtype="float32",
-                      compact_device=False):
+                      compact_device=False, sharded=False,
+                      collective_dtype="float32", score_sharded=False):
     jax = _jax()
     import jax.numpy as jnp
 
@@ -292,9 +293,55 @@ def framework_variant(tr, te, model="fm", param_dtype="float32",
         learning_rate=TRAIN["lr"], lr_schedule="constant", optimizer="sgd",
         sparse_update=sparse_update, host_dedup=host_dedup,
         compact_cap=compact_cap, compact_device=compact_device,
-        seed=TASK["seed"],
+        seed=TASK["seed"], collective_dtype=collective_dtype,
+        score_sharded=score_sharded,
     )
     opt = None
+    if sharded:
+        # The wire-precision rows (collective_dtype / score_sharded)
+        # exist only on the sharded step — run it on every available
+        # device (the 8-fake-device CPU mesh in CI; a real slice on
+        # hardware). FM only: the budget isolates the wire numerics.
+        if model != "fm":
+            raise ValueError("sharded quality rows are FM-only")
+        from fm_spark_tpu.parallel import (
+            make_field_mesh,
+            make_field_sharded_sgd_step,
+            pad_field_batch,
+            shard_field_batch,
+            shard_field_params,
+            stack_field_params,
+            unstack_field_params,
+        )
+
+        n = jax.device_count()
+        if n < 2:
+            raise ValueError(
+                "sharded quality rows need >1 device (set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            )
+        spec = models.FieldFMSpec(**common)
+        mesh = make_field_mesh(n)
+        step_sh = make_field_sharded_sgd_step(spec, config, mesh)
+        params = shard_field_params(
+            stack_field_params(spec, spec.init(jax.random.key(TASK["seed"])),
+                               n),
+            mesh,
+        )
+        batches = Batches(*tr, TRAIN["batch"], seed=TASK["seed"])
+        nf = TASK["num_fields"]
+        for i in range(TRAIN["steps"]):
+            b = shard_field_batch(
+                pad_field_batch(tuple(batches.next_batch()), nf, n), mesh
+            )
+            params, _ = step_sh(params, jnp.int32(i), *b)
+        params = unstack_field_params(spec, jax.device_get(params))
+        ids_te, vals_te, y_te = te
+        scores = np.asarray(
+            spec.scores(params, jnp.asarray(ids_te), jnp.asarray(vals_te)),
+            np.float64,
+        )
+        return _auc(scores, np.asarray(y_te))
     if model == "fm":
         spec = models.FieldFMSpec(**common)
         step = make_field_sparse_sgd_step(spec, config)
@@ -356,6 +403,15 @@ VARIANTS = {
                                 sparse_update="dedup_sr",
                                 host_dedup=True, compact_cap=128,
                                 compute_dtype="bfloat16"),
+    # The round-4 wire-precision rows (multi-device only — skipped on a
+    # single device): fp32-wire sharded pins the sharded step's own
+    # numerics; the bf16-wire rows budget the collective_dtype lever and
+    # its composition with the exact score-sharded path.
+    "sharded_fp32_wire": dict(sharded=True),
+    "sharded_bf16_wire": dict(sharded=True, collective_dtype="bfloat16"),
+    "sharded_bf16_wire_ss": dict(sharded=True,
+                                 collective_dtype="bfloat16",
+                                 score_sharded=True),
 }
 
 # The committed protocol budgets (QUALITY.md): fp32-vs-oracle is expected
@@ -380,6 +436,9 @@ BUDGET_VS_FP32 = {
     "fp32_dedup_compact": 1e-3,
     "bf16_dedup_sr_compact": 5e-3,
     "bf16_compact_cdbf16": 5e-3,
+    "sharded_fp32_wire": 1e-3,
+    "sharded_bf16_wire": 5e-3,
+    "sharded_bf16_wire_ss": 5e-3,
 }
 
 
@@ -403,9 +462,14 @@ def main():
     names = args.variants
     if names is None:
         # Full-B host_dedup rows are FM-only history; the shared compact
-        # machinery is what FFM/DeepFM exercise.
+        # machinery is what FFM/DeepFM exercise. Sharded wire rows need
+        # devices to shard over.
+        jax = _jax()
+        multi = jax.device_count() > 1
         names = [n for n in VARIANTS
-                 if args.model == "fm" or "host" not in n]
+                 if (args.model == "fm" or "host" not in n)
+                 and (args.model == "fm" or "sharded" not in n)
+                 and (multi or "sharded" not in n)]
     tr, te = _data()
     out = {}
     if not args.skip_oracle:

@@ -305,6 +305,7 @@ class _FieldCap:
     sharded_device_compact: bool     # in-step compact aux when sharded
     sharded_multiproc: bool          # multi-process pseudo-cluster / pods
     multistep_single: bool           # --steps-per-call fori roll (1 chip)
+    sharded_score: bool              # --score-sharded example-sharded dscores
 
 
 _FIELD_CAPS = {
@@ -312,22 +313,76 @@ _FIELD_CAPS = {
         single_step=_single_fm_step, sharded_step=_sharded_fm_step,
         carries_opt=False, sharded_2d=True, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=True,
+        multistep_single=True, sharded_score=True,
     ),
     "FieldFFMSpec": _FieldCap(
         single_step=_single_ffm_step, sharded_step=_sharded_ffm_step,
         carries_opt=False, sharded_2d=False, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=True,
+        multistep_single=True, sharded_score=False,
     ),
     "FieldDeepFMSpec": _FieldCap(
         single_step=_single_deepfm_step,
         sharded_step=_sharded_deepfm_step,
         carries_opt=True, sharded_2d=True, sharded_host_compact=False,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=False,
+        multistep_single=True, sharded_score=False,
     ),
 }
+
+
+def _make_overflow_guard(tconfig):
+    """Sticky overflow detection for the device-compact 'error' policy.
+
+    ``_fold_overflow`` poisons the STEP loss to −inf (unreachable by any
+    shipped loss — they are non-negative — so a genuinely diverging
+    run's +inf is never mistaken for a cap overflow). A single step's
+    loss is NOT a sufficient detector though: an overflow at step i
+    followed by clean steps would go unseen at the next boundary, and a
+    checkpoint would snapshot the drop-corrupted tables (ADVICE r3 +
+    round-4 review). So the training loop calls ``note_loss`` on EVERY
+    step's loss, maintaining a device-side RUNNING MIN — one fused
+    ``jnp.minimum``, no device→host sync — and the boundary calls
+    (``check_poison`` before every checkpoint save; ``fetch_loss`` at
+    log cadence) read that: −inf is sticky from the first poisoned step
+    onward. Returns ``(note_loss, check_poison, fetch_loss)``; all are
+    no-ops/plain-float when the policy is inactive.
+    """
+    import math as _math
+
+    import jax.numpy as jnp
+
+    guard_active = (tconfig.compact_device
+                    and tconfig.compact_overflow == "error")
+    poison_box = {"v": jnp.float32(jnp.inf) if guard_active else None}
+
+    def note_loss(loss):
+        if guard_active:
+            # fmin, not minimum: a later NaN loss (genuine divergence)
+            # must not launder the −inf sentinel into NaN and slip past
+            # the isinf check.
+            poison_box["v"] = jnp.fmin(poison_box["v"], loss)
+
+    def check_poison():
+        if guard_active:
+            pv = float(poison_box["v"])
+            if _math.isinf(pv) and pv < 0:
+                raise SystemExit(
+                    "compact_cap overflow: a field's per-batch "
+                    "unique-id count exceeded --compact-cap "
+                    f"{tconfig.compact_cap} at some step since the "
+                    "last clean checkpoint (loss poisoned to −inf by "
+                    "the 'error' policy; the running-min detector is "
+                    "sticky). Raise --compact-cap, or pick "
+                    "--compact-overflow drop; restart from the last "
+                    "checkpoint."
+                )
+
+    def fetch_loss(loss) -> float:
+        check_poison()
+        return float(loss)
+
+    return note_loss, check_poison, fetch_loss
 
 
 def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
@@ -418,6 +473,17 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             f"--host-dedup on {n} devices requires --compact-cap "
             "(or drop --host-dedup / run on 1 chip)"
         )
+    if tconfig.collective_dtype != "float32" and not sharded:
+        raise SystemExit(
+            f"--collective-dtype {tconfig.collective_dtype} is a wire-"
+            f"precision knob for multi-device runs (found {n} device(s))"
+        )
+    if tconfig.score_sharded and not (sharded and cap.sharded_score):
+        raise SystemExit(
+            f"--score-sharded needs multiple devices and a model family "
+            f"with the example-sharded score path "
+            f"(found {n} device(s), {type(spec).__name__})"
+        )
     if pc > 1 and not cap.sharded_multiproc:
         raise SystemExit(
             f"multi-process training is not supported for "
@@ -429,11 +495,12 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         )
     multi = steps_per_call > 1
     if multi and (sharded or not cap.multistep_single):
-        # DeepFM carries optax state through the call and the sharded
-        # steps take mesh-prepped operands — neither rolls into the
-        # pure-SGD fori body. Hard-fail, never silently run one-by-one.
+        # The sharded steps take mesh-prepped operands, which do not
+        # roll into the fori body. Hard-fail, never silently run
+        # one-by-one. (DeepFM's optax state threads through the carry
+        # since round 4 — make_field_deepfm_multistep.)
         raise SystemExit(
-            "--steps-per-call > 1 supports the single-chip FM/FFM fused "
+            "--steps-per-call > 1 supports the single-chip fused "
             f"steps only (found {type(spec).__name__}, {n} device(s))"
         )
     if sharded:
@@ -594,26 +661,7 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             st = {k: v for k, v in st.items() if k not in ("lo", "hi")}
         return st
 
-    def fetch_loss(loss) -> float:
-        """The periodic loss fetch IS the overflow detector for the
-        device-compact 'error' policy (_fold_overflow poisons the loss
-        to +inf; no extra device→host sync per step). Detection
-        granularity is the log cadence; the poisoned step's updates
-        already landed with drops — restart from the last checkpoint
-        after raising the cap."""
-        lf = float(loss)
-        import math as _math
-
-        if (tconfig.compact_device and tconfig.compact_overflow == "error"
-                and _math.isinf(lf) and lf > 0):
-            raise SystemExit(
-                "compact_cap overflow: a field's per-batch unique-id "
-                f"count exceeded --compact-cap {tconfig.compact_cap} "
-                "(loss poisoned to +inf by the 'error' policy). Raise "
-                "--compact-cap, or pick --compact-overflow drop; "
-                "restart from the last checkpoint."
-            )
-        return lf
+    note_loss, check_poison, fetch_loss = _make_overflow_guard(tconfig)
 
     # What a checkpoint stores: canonical host trees (topology-portable,
     # the default) or the live sharded arrays (--ckpt-sharded; orbax
@@ -654,7 +702,14 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         # reading batches that would never train (exact-resume cursor).
         batches = StackedBatches(batches, steps_per_call,
                                  total=tconfig.num_steps - start)
-        mstep = make_field_sparse_multistep(spec, tconfig, steps_per_call)
+        if is_deepfm:
+            from fm_spark_tpu.sparse import make_field_deepfm_multistep
+
+            mstep = make_field_deepfm_multistep(spec, tconfig,
+                                                steps_per_call)
+        else:
+            mstep = make_field_sparse_multistep(spec, tconfig,
+                                                steps_per_call)
     batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
         if multi:
@@ -662,8 +717,14 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             while i < tconfig.num_steps:
                 m = min(steps_per_call, tconfig.num_steps - i)
                 stacked = batches.next_batch()
-                params, loss = mstep(params, jnp.int32(i), jnp.int32(m),
-                                     *prep(stacked))
+                if is_deepfm:
+                    params, opt, loss = mstep(
+                        params, opt, jnp.int32(i), jnp.int32(m),
+                        *prep(stacked))
+                else:
+                    params, loss = mstep(params, jnp.int32(i),
+                                         jnp.int32(m), *prep(stacked))
+                note_loss(loss)
                 i += m
                 since += m * stacked[2].shape[1]
                 # Windowed cadences: a multiple of the interval inside
@@ -676,22 +737,27 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                     since = 0
                 maybe_eval(i, lambda: to_canonical(params), window=m)
                 if checkpointer is not None and checkpointer.due_window(i, m):
-                    checkpointer.save(i, to_canonical(params), {},
-                                      pipe_state())
+                    check_poison()
+                    checkpointer.save(i, to_canonical(params),
+                                      opt_canonical(opt), pipe_state())
         else:
             for i in range(start, tconfig.num_steps):
                 batch = batches.next_batch()
                 params, opt, loss = step(params, opt, jnp.int32(i),
                                          *prep(batch))
+                note_loss(loss)
                 since += len(batch[2])
                 if (i + 1) % log_every == 0 or i == tconfig.num_steps - 1:
                     logger.log(i + 1, samples=since, loss=fetch_loss(loss))
                     since = 0
                 maybe_eval(i + 1, lambda: to_canonical(params))
                 if checkpointer is not None and checkpointer.due(i + 1):
+                    check_poison()
                     checkpointer.save(i + 1, ckpt_params(), ckpt_opt(),
                                       pipe_state(), extra=ckpt_extra)
         if checkpointer is not None:
+            if start < tconfig.num_steps:
+                check_poison()
             checkpointer.save(tconfig.num_steps, ckpt_params(), ckpt_opt(),
                               pipe_state(), extra=ckpt_extra,
                               force=True)
@@ -765,9 +831,19 @@ def cmd_train(args) -> int:
     from fm_spark_tpu.train import FMTrainer, evaluate_params
     from fm_spark_tpu.utils.logging import MetricsLogger
 
+    batch_size = args.batch_size
+    if args.batch_per_chip is not None:
+        if batch_size is not None:
+            raise SystemExit(
+                "--batch-per-chip and --batch-size are exclusive "
+                "(weak scaling derives the global batch from the mesh)"
+            )
+        import jax as _jax0
+
+        batch_size = args.batch_per_chip * _jax0.device_count()
     cfg = configs_lib.get_config(
         args.config,
-        num_steps=args.steps, batch_size=args.batch_size,
+        num_steps=args.steps, batch_size=batch_size,
         learning_rate=args.lr, strategy=args.strategy, seed=args.seed,
         optimizer=args.optimizer, loss=args.loss,
         sparse_update=args.sparse_update,
@@ -783,6 +859,8 @@ def cmd_train(args) -> int:
         compact_cap=args.compact_cap,
         compact_device=True if args.compact_device else None,
         compact_overflow=args.compact_overflow,
+        collective_dtype=args.collective_dtype,
+        score_sharded=True if args.score_sharded else None,
     )
 
     import jax as _jax
@@ -1178,6 +1256,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "loss), drop (device: overflow ids behave as "
                         "absent features), split (host: split the batch "
                         "until every field fits — exact, more steps)")
+    t.add_argument("--collective-dtype", default=None,
+                   dest="collective_dtype",
+                   choices=["float32", "bfloat16"],
+                   help="wire dtype for the sharded steps' activation "
+                        "collectives (score psums, DeepFM h, FFM sel "
+                        "all_to_all) — bfloat16 halves the dominant ICI "
+                        "bytes (parallel/projection.py); multi-device "
+                        "field_sparse only")
+    t.add_argument("--score-sharded", action="store_true",
+                   dest="score_sharded",
+                   help="shard the [B,k] score/dscores math over "
+                        "examples on the sharded FM step (exact; one "
+                        "tiny [B] dscores all_gather) — removes the "
+                        "only non-shardable batch-proportional term "
+                        "(parallel/projection.py)")
+    t.add_argument("--batch-per-chip", type=int, default=None,
+                   dest="batch_per_chip",
+                   help="WEAK-SCALING batch sizing: global batch = N x "
+                        "device_count (per-chip feed constant as the "
+                        "mesh grows); exclusive with --batch-size")
     t.add_argument("--seed", type=int, default=None)
     t.add_argument("--row-shards", type=int, default=1, dest="row_shards",
                    help="field_sparse strategy: shard each field's bucket "

@@ -284,6 +284,14 @@ def device_compact_aux(ids_col, cap: int):
     ``mask_overflow=True``). That is the documented
     ``compact_overflow='drop'`` semantics: overflow ids behave as
     absent features for the overflowing batch.
+
+    The drop selection is ID-BIASED, not uniform (ADVICE r3): segments
+    sort id-ascending, so it is deterministically the LARGEST ids that
+    drop — under hashed/Zipf id spaces the same high-id features are
+    dropped on every overflowing batch rather than a random subset.
+    Operators sizing ``cap`` near the unique-count envelope should
+    expect systematic (not uniformly-spread) degradation on those
+    features; see QUALITY.md.
     """
     b = ids_col.shape[0]
     imax = 2**31 - 1

@@ -216,7 +216,7 @@ class _Fwd:
     positional 11-tuple — VERDICT r3: positional contracts break silently
     on extension). Traced values only; never crosses a jit boundary."""
 
-    scores: object       # [B] replicated across the mesh
+    scores: object       # [B] replicated (or [B/n] local, score_shard)
     s: object            # [B, k] psum'd factor sums
     xvs: object          # f_local × [B, k] local xv terms
     xv_fulls: object     # f_local × [B, k+1] (gfull=True only, else None)
@@ -230,9 +230,24 @@ class _Fwd:
     ovf: object          # device-compact overflow count (None otherwise)
 
 
+def _score_block(g):
+    """(chip linear index, chip count) over the score axes, feat-major /
+    row-minor — the SAME order ``lax.all_gather`` over
+    ``g["score_axes"]`` concatenates, so a sliced-then-gathered [B]
+    vector reconstructs the global example order (equivalence-tested on
+    the 2-D mesh in tests/test_score_sharded.py)."""
+    idx = lax.axis_index("feat")
+    nsh = g["n_feat"]
+    if g["two_d"]:
+        idx = idx * g["n_row"] + lax.axis_index("row")
+        nsh = nsh * g["n_row"]
+    return idx, nsh
+
+
 def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
                    caux=None, device_cap: int = 0, add_bias: bool = True,
-                   gfull: bool = False):
+                   gfull: bool = False, psum_dtype=None,
+                   score_shard: bool = False):
     """The field-sharded forward, shared by the train body and the eval
     step: example-sharded → field-sharded re-shard (all_to_all over
     ``feat``; labels/weights ride all_gathers in the SAME collective
@@ -357,12 +372,38 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
     else:
         lin_p = sum(r[:, k] * vals_c[:, f] for f, r in enumerate(rows))
     # The scores collective: [B,k] + 2·[B] per step; tables never move.
-    s = lax.psum(s_p, g["score_axes"])
-    sq = lax.psum(sq_p, g["score_axes"])
-    lin = lax.psum(lin_p, g["score_axes"])
-    scores = 0.5 * (jnp.sum(s * s, axis=1) - sq)
+    # ``psum_dtype`` (TrainConfig.collective_dtype) halves the wire
+    # bytes of this — the projection model's dominant ICI term — at
+    # bf16 wire precision; results come back in compute dtype.
+    from fm_spark_tpu.sparse import _psum_wire
+
+    s = _psum_wire(s_p, g["score_axes"], psum_dtype, cd)
+    sq = _psum_wire(sq_p, g["score_axes"], psum_dtype, cd)
+    lin = _psum_wire(lin_p, g["score_axes"], psum_dtype, cd)
+    if score_shard:
+        # Score-sharded (TrainConfig.score_sharded): each chip reduces
+        # the [B, k] score math for ITS example block only — the one
+        # B-proportional term that does not otherwise shard
+        # (projection.py). Per-example ops are elementwise, so the
+        # sliced values are exactly the replicated computation's.
+        # ``s`` stays fully replicated (the backward needs it for every
+        # example); the caller all_gathers dscores.
+        idx, nsh = _score_block(g)
+        b_full = s.shape[0]
+        if b_full % nsh:
+            raise ValueError(
+                f"score_sharded requires the global batch ({b_full}) "
+                f"to divide by the mesh size ({nsh})"
+            )
+        bs = b_full // nsh
+        s_red = lax.dynamic_slice_in_dim(s, idx * bs, bs)
+        sq_red = lax.dynamic_slice_in_dim(sq, idx * bs, bs)
+        lin_red = lax.dynamic_slice_in_dim(lin, idx * bs, bs)
+    else:
+        s_red, sq_red, lin_red = s, sq, lin
+    scores = 0.5 * (jnp.sum(s_red * s_red, axis=1) - sq_red)
     if spec.use_linear:
-        scores = scores + lin
+        scores = scores + lin_red
     if spec.use_bias and add_bias:
         # DeepFM's caller folds the bias into its head loss instead
         # (add_bias=False) so the dense-side vjp sees it.
@@ -388,6 +429,7 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
         _check_host_dedup,
+        _collective_dtype,
         _compact_apply_all,
         _gather_all,
         _gather_fn,
@@ -401,6 +443,7 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             "field-sharded step runs on a ('feat',) or ('feat', 'row') "
             "mesh; see module docstring (use make_field_mesh)"
         )
+    wire = _collective_dtype(config)
     g = _mesh_geometry(spec, mesh)
     compact = config.compact_cap > 0
     device_cap = config.compact_cap if config.compact_device else 0
@@ -452,18 +495,39 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
         fwd = _field_forward(
             spec, g, gat, vw, w0, ids, vals, labels, weights, caux=caux,
             device_cap=device_cap, gfull=config.gfull_fused,
+            psum_dtype=wire, score_shard=config.score_sharded,
         )
         s, xvs, rows, vals_c = fwd.s, fwd.xvs, fwd.rows, fwd.vals_c
         uidx, urows, aux, ovf = fwd.uidx, fwd.urows, fwd.aux, fwd.ovf
         labels, weights = fwd.labels, fwd.weights
 
-        # From here on every chip holds identical full-batch values.
+        # From here on every chip holds identical full-batch values
+        # (score_sharded: scores/dscores are computed on this chip's
+        # example block, then dscores is replicated by one tiny [B]
+        # all_gather — per-example values identical to the replicated
+        # computation; only the scalar loss reassociates).
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
 
-        def batch_loss(sc):
-            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+        if config.score_sharded:
+            idx, nsh = _score_block(g)
+            bs = labels.shape[0] // nsh
+            labels_l = lax.dynamic_slice_in_dim(labels, idx * bs, bs)
+            weights_l = lax.dynamic_slice_in_dim(weights, idx * bs, bs)
 
-        loss, dscores = jax.value_and_grad(batch_loss)(fwd.scores)
+            def batch_loss(sc):
+                return jnp.sum(
+                    per_example_loss(sc, labels_l) * weights_l) / wsum
+
+            loss_l, dscores_l = jax.value_and_grad(batch_loss)(fwd.scores)
+            loss = lax.psum(loss_l, g["score_axes"])
+            dscores = lax.all_gather(dscores_l, g["score_axes"],
+                                     tiled=True)
+        else:
+            def batch_loss(sc):
+                return jnp.sum(
+                    per_example_loss(sc, labels) * weights) / wsum
+
+            loss, dscores = jax.value_and_grad(batch_loss)(fwd.scores)
         lr = lr_at(step_idx)
         touched = weights > 0
 
@@ -687,6 +751,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
         _check_host_dedup,
+        _collective_dtype,
         _compact_apply_all,
         _fold_overflow,
         _gather_fn,
@@ -698,9 +763,10 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    from fm_spark_tpu.sparse import _reject_gfull
+    from fm_spark_tpu.sparse import _reject_gfull, _reject_score_sharded
 
     _reject_gfull(config, "the field-sharded DeepFM step")
+    _reject_score_sharded(config, "the field-sharded DeepFM step")
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded DeepFM runs on a ('feat',) or ('feat', 'row') "
@@ -717,6 +783,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         # every host-aux request.
         _reject_host_aux(config, "the field-sharded DeepFM step")
     g = _mesh_geometry(spec, mesh)
+    wire = _collective_dtype(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     k = spec.rank
@@ -740,7 +807,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         # add_bias=False — the bias rides the dense head's vjp below.
         fwd = _field_forward(
             spec, g, gat, vw, w0, ids, vals, labels, weights,
-            device_cap=device_cap, add_bias=False,
+            device_cap=device_cap, add_bias=False, psum_dtype=wire,
         )
         fm_scores, s, xvs, rows = fwd.scores, fwd.s, fwd.xvs, fwd.rows
         vals_c, uidx, urows = fwd.vals_c, fwd.uidx, fwd.urows
@@ -750,12 +817,16 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         # Deep head input: local xv columns — partial on a 2-D mesh
         # (ownership-masked), completed by one psum over `row` — then
         # gathered into global field order ([B, f_pad·k], padding
-        # columns zero) and trimmed to the MLP's F·k input.
+        # columns zero) and trimmed to the MLP's F·k input. The h
+        # collectives ride the wire dtype too (h is the DeepFM step's
+        # biggest activation transfer).
         h_local = jnp.concatenate(xvs, axis=1)
+        if wire is not None:
+            h_local = h_local.astype(wire)
         if two_d:
             h_local = lax.psum(h_local, "row")
         h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
-        h = h_full[:, : F * k]
+        h = h_full[:, : F * k].astype(cd)
 
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
 
@@ -869,7 +940,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
 
 
 def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
-                       caux=None, device_cap: int = 0):
+                       caux=None, device_cap: int = 0, wire=None):
     """The field-sharded FFM forward, shared by the train body and the
     eval step (config 4's multi-chip fast path, VERDICT r2 #3).
 
@@ -941,12 +1012,16 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
         axis=1,
     )                                           # [B, f_local, F_pad, k]
     # selT[b, p, j, :] = sel[b, j, i_p] — every other chip's view of
-    # this chip's fields as TARGETS, re-sharded in one collective.
+    # this chip's fields as TARGETS, re-sharded in one collective. The
+    # sel a2a is the FFM step's dominant ICI term (~F× the FM psum at
+    # headline shapes — parallel/projection.py); ``wire``
+    # (TrainConfig.collective_dtype) halves its bytes at bf16 precision.
+    sel_wire = sel_loc.astype(wire) if wire is not None else sel_loc
     selT = jnp.swapaxes(
-        lax.all_to_all(sel_loc, "feat", split_axis=2, concat_axis=1,
+        lax.all_to_all(sel_wire, "feat", split_axis=2, concat_axis=1,
                        tiled=True),
         1, 2,
-    )                                           # [B, f_local, F_pad, k]
+    ).astype(cd)                                # [B, f_local, F_pad, k]
 
     # Partial pairwise sum over owned i: Σ_j ⟨sel[i,j], sel[j,i]⟩ minus
     # the i==j diagonal; psum over feat completes Σ_{i≠j}.
@@ -961,10 +1036,12 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
         if spec.use_linear
         else jnp.zeros((b,), cd)
     )
-    pair = lax.psum(pair_p - diag_p, "feat")
+    from fm_spark_tpu.sparse import _psum_wire
+
+    pair = _psum_wire(pair_p - diag_p, "feat", wire, cd)
     scores = 0.5 * pair
     if spec.use_linear:
-        scores = scores + lax.psum(lin_p, "feat")
+        scores = scores + _psum_wire(lin_p, "feat", wire, cd)
     if spec.use_bias:
         scores = scores + w0.astype(cd)
     return (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
@@ -983,6 +1060,7 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
         _check_host_dedup,
+        _collective_dtype,
         _compact_apply_all,
         _fold_overflow,
         _lr_at,
@@ -997,6 +1075,10 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import _reject_gfull
 
     _reject_gfull(config, "the field-sharded FFM step")
+    from fm_spark_tpu.sparse import _reject_score_sharded
+
+    _reject_score_sharded(config, "the field-sharded FFM step")
+    wire = _collective_dtype(config)
     if set(mesh.axis_names) != {"feat"}:
         raise ValueError(
             "field-sharded FFM runs on a 1-D ('feat',) mesh (row "
@@ -1033,7 +1115,7 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
         (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
          labels, weights) = _ffm_field_forward(
             spec, g, vw, w0, ids, vals, labels, weights, caux=caux,
-            device_cap=device_cap,
+            device_cap=device_cap, wire=wire,
         )
 
         wsum = jnp.maximum(jnp.sum(weights), 1.0)

@@ -1,84 +1,131 @@
-"""Multi-chip projection model for the field-sharded fused step.
+"""Multi-chip projection model for the field-sharded fused steps.
 
 No multi-chip hardware is reachable from this environment (one tunneled
 v5e chip — PERF.md), so the 8-chip aggregate cannot be measured. What
 CAN be committed is (a) exact per-chip work and collective-traffic
-counts for the sharded program, derivable from its construction
+counts for each sharded program, derivable from its construction
 (parallel/field_step.py), and (b) a time model whose every input is a
 measured single-chip number or a named assumption — so a reviewer can
-audit the arithmetic and swap assumptions. VERDICT r2 #6 asked for
-exactly this; ``__graft_entry__.dryrun_multichip`` prints the result so
-the driver's MULTICHIP artifact carries it.
+audit the arithmetic and swap assumptions. VERDICT r2 #6 asked for the
+FM model; VERDICT r3 #4 for the FFM and DeepFM traffic models (the FFM
+sel all_to_all is ~F× the FM psum bytes at headline shapes — whether
+config 4 scales is a traffic question, answered here).
+``__graft_entry__.dryrun_multichip`` prints the result so the driver's
+MULTICHIP artifact carries it.
 
-Model (1-D ``feat`` mesh, the config-3 layout):
+Model (1-D ``feat`` mesh; the 2-D row axis adds only the h/ownership
+psums noted per model):
 
 - Each chip owns ``F_pad/n`` fields and performs only their big-table
   index ops: ``cap`` gather + ``cap`` scatter lanes per owned field on
   the compact path (B lanes each on the plain path).
 - The per-field [B]-lane work (expand, reorder, cumsum) also shards by
-  ``n`` — it is per owned field.
-- What does NOT shard: per-dispatch overhead, the replicated score /
-  dscores math ([B, k] reductions), and the collectives.
-- ICI traffic per chip per step: the batch all_to_all (ids+vals),
-  labels/weights all_gathers, and the ring-allreduce psum of
-  ``(s[B,k], sq[B], lin[B])`` — tables never move (single-owner
-  design).
+  ``n`` — it is per owned field. FFM's [B, F_pad, k] sel blocks and
+  DeepFM's MLP are per owned field / replicated-cheap respectively.
+- What does NOT shard: per-dispatch overhead and the replicated score /
+  dscores math ([B, k] reductions over the FULL global batch — every
+  chip repeats it, so in weak scaling this term GROWS with n; the model
+  scales it with B explicitly, which round-3's constant-input version
+  under-counted).
+- ICI traffic per chip per step (exact counts per model below): the
+  batch all_to_all (ids+vals), labels/weights all_gathers, and the
+  model's activation collectives. ``collective_dtype='bfloat16'``
+  (TrainConfig) halves the ACTIVATION collective bytes — the score
+  psum group (FM), + the sel all_to_all (FFM), + the h gather/psum
+  (DeepFM); the batch re-shard stays int32/fp32.
 
-Time decomposition: the measured single-chip step time ``T1 = B/rate``
-splits into ``t_fixed`` (dispatch + replicated score math, measured /
-estimated from bench_micro probes) and ``t_sharded = T1 - t_fixed``
+Time decomposition: the measured single-chip step time ``T1(B) =
+B/rate`` splits into ``t_fixed`` (dispatch), ``t_rep(B)`` (replicated
+score math, linear in B), and ``t_sharded = T1 − t_fixed − t_rep``
 (everything that divides by ``n``). Then
 
-    t(n) = t_fixed + t_sharded / n + ici_bytes(n) / ici_bw
+    t(n) = t_fixed + t_rep(B) + t_sharded(B)/n + ici_bytes(n)/ici_bw
     aggregate(n) = B / t(n)        # global samples per second
 """
 
 from __future__ import annotations
 
+_WIRE_BYTES = {"float32": 4, "bfloat16": 2}
 
-def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
-                        device_aux: bool = False) -> dict:
-    """Exact per-chip work + ICI traffic counts for one step of the
-    1-D field-sharded fused step (see module docstring). ``cap=0`` =
-    plain (non-compact) path. Byte counts assume int32 ids, fp32 vals/
-    labels/weights and fp32 compute buffers for the psum (the compact
-    path's cumsum stays fp32 by design)."""
+
+def _base_counts(B: int, F: int, k: int, n: int, cap: int,
+                 device_aux: bool) -> dict:
+    """Work + batch-reshard ICI counts shared by all three models."""
     f_pad = -(-F // n) * n
     f_local = f_pad // n
     lanes = cap if cap else B
-    per_chip = {
-        # Index ops against the BIG tables — the measured bottleneck
-        # (PERF.md facts 2-3). This is the n-fold reduction scale-out
-        # buys.
-        "big_table_gather_lanes": lanes * f_local,
-        "big_table_scatter_lanes": lanes * f_local,
-        # [B]-lane work per owned field against SMALL (cap- or B-sized)
-        # operands: compact expand + delta reorder + cumsum.
-        "small_operand_lanes": (3 * B * f_local) if cap else 0,
-        # Device-built aux only: one [B] stable sort per owned field.
-        "aux_sort_lanes": (B * f_local) if (cap and device_aux) else 0,
-    }
     ring = 2 * (n - 1) / n  # ring all-reduce traffic factor
     recv = (n - 1) / n      # fraction of an all_to_all/all_gather that
     #                         crosses ICI (the rest is already local)
     a2a_cols = f_local * (8 if device_aux or not cap else 4)
     # host-compact skips the ids all_to_all (field_step._field_forward);
     # its aux arrives host->device, not over ICI.
-    ici = {
-        "a2a_batch": int(B * a2a_cols * recv),
-        "allgather_labels_weights": int(8 * B * recv),
-        "psum_scores": int(ring * 4 * B * (k + 2)),
-    }
+    return dict(
+        f_pad=f_pad, f_local=f_local, lanes=lanes, ring=ring, recv=recv,
+        per_chip={
+            # Index ops against the BIG tables — the measured bottleneck
+            # (PERF.md facts 2-3). This is the n-fold reduction
+            # scale-out buys.
+            "big_table_gather_lanes": lanes * f_local,
+            "big_table_scatter_lanes": lanes * f_local,
+            # [B]-lane work per owned field against SMALL (cap- or
+            # B-sized) operands: compact expand + delta reorder + cumsum.
+            "small_operand_lanes": (3 * B * f_local) if cap else 0,
+            # Device-built aux only: one [B] stable sort per owned field.
+            "aux_sort_lanes": (B * f_local) if (cap and device_aux) else 0,
+        },
+        ici={
+            "a2a_batch": int(B * a2a_cols * recv),
+            "allgather_labels_weights": int(8 * B * recv),
+        },
+    )
+
+
+def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
+                        device_aux: bool = False,
+                        psum_dtype: str = "float32",
+                        model: str = "fm") -> dict:
+    """Exact per-chip work + ICI traffic counts for one step of the
+    1-D field-sharded fused step of ``model`` ('fm' | 'ffm' | 'deepfm').
+    ``cap=0`` = plain (non-compact) path. ``psum_dtype`` is the wire
+    dtype of the ACTIVATION collectives (TrainConfig.collective_dtype);
+    ids stay int32 and the batch re-shard fp32. Byte counts per
+    activation collective, by construction (field_step.py):
+
+    - fm:     psum of (s[B,k], sq[B], lin[B])             → ring·w·B·(k+2)
+    - ffm:    + sel all_to_all [B, f_local, F_pad, k]     → w·B·f_local·f_pad·k·recv
+              (score psums are 2·[B] — pair, lin)
+    - deepfm: fm's psum group + h all_gather [B, f_pad·k] → w·B·f_pad·k·recv
+    """
+    c = _base_counts(B, F, k, n, cap, device_aux)
+    w = _WIRE_BYTES[psum_dtype]
+    ici = c["ici"]
+    if model == "fm":
+        ici["psum_scores"] = int(c["ring"] * w * B * (k + 2))
+    elif model == "ffm":
+        ici["a2a_sel"] = int(
+            w * B * c["f_local"] * c["f_pad"] * k * c["recv"]
+        )
+        ici["psum_scores"] = int(c["ring"] * w * B * 2)
+    elif model == "deepfm":
+        ici["psum_scores"] = int(c["ring"] * w * B * (k + 2))
+        ici["allgather_h"] = int(w * B * c["f_pad"] * k * c["recv"])
+    else:
+        raise ValueError(f"unknown model {model!r}")
     ici["total"] = sum(v for kk, v in ici.items() if kk != "total")
+    per_chip = c["per_chip"]
     per_chip["ici_bytes_per_step"] = ici
-    per_chip["f_local"] = f_local
+    per_chip["f_local"] = c["f_local"]
     return per_chip
 
 
 def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
                       n: int, cap: int = 0, device_aux: bool = False,
+                      psum_dtype: str = "float32", model: str = "fm",
+                      score_sharded: bool = False,
                       dispatch_ms: float = 2.5,
-                      replicated_score_ms: float = 2.0,
+                      replicated_score_ms_per_128k: float = 2.0,
+                      measured_B: int = 131072,
                       ici_gbps: float = 100.0) -> dict:
     """Projected n-chip aggregate throughput from a MEASURED single-chip
     rate. Every assumption is a named argument echoed in the output:
@@ -86,27 +133,53 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
     - ``dispatch_ms``: per-step dispatch overhead (bench_micro
       ``dispatch``, measured 2.5ms this attachment; ~0.1ms expected on
       a direct-attached host).
-    - ``replicated_score_ms``: the [B, k] score/dscores math every chip
-      repeats on the full batch (≈ one read pass over s·s + loss grads;
-      estimated from the measured 35-90 GB/s effective stream rate).
+    - ``replicated_score_ms_per_128k``: the [B, k] score/dscores math
+      every chip repeats on the full global batch, measured at
+      ``measured_B`` (≈ one read pass over s·s + loss grads; estimated
+      from the measured 35-90 GB/s effective stream rate). Scaled
+      LINEARLY with B — in weak scaling this term grows with n, which
+      is exactly why it is separated from the shardable remainder
+      (round-3's constant-input model under-counted it).
     - ``ici_gbps``: assumed effective per-chip ICI bandwidth. Not
       measurable here; 100 GB/s is conservative for a v5e torus link
       set (nominal is several hundred GB/s).
+
+    The measured single-chip rate is the FM step's; for 'ffm'/'deepfm'
+    pass that model's own measured rate (bench.py variants) — the
+    traffic model is per-model either way.
+
+    ``score_sharded`` (TrainConfig.score_sharded, FM only): the score/
+    dscores math shards over examples, so ``t_rep`` moves into the
+    divided term and one [B] fp32 dscores all_gather joins the ICI
+    counts — the lever that removes the model's only non-shardable
+    B-proportional term.
     """
-    costs = field_sharded_costs(B, F, k, n, cap, device_aux)
+    costs = field_sharded_costs(B, F, k, n, cap, device_aux,
+                                psum_dtype=psum_dtype, model=model)
     t1 = B / single_chip_rate
-    t_fixed = (dispatch_ms + replicated_score_ms) / 1e3
-    t_sharded = max(t1 - t_fixed, 0.0)
+    t_fixed = dispatch_ms / 1e3
+    t_rep = replicated_score_ms_per_128k / 1e3 * (B / measured_B)
+    t_sharded = max(t1 - t_fixed - t_rep, 0.0)
+    if score_sharded:
+        if model != "fm":
+            raise ValueError("score_sharded is the FM step's lever")
+        ici = costs["ici_bytes_per_step"]
+        ici["allgather_dscores"] = int(4 * B * (n - 1) / n)
+        ici["total"] += ici["allgather_dscores"]
+        t_sharded = t_sharded + t_rep
+        t_rep = 0.0
     t_ici = costs["ici_bytes_per_step"]["total"] / (ici_gbps * 1e9)
-    t_n = t_fixed + t_sharded / n + t_ici
+    t_n = t_fixed + t_rep + t_sharded / n + t_ici
     return {
-        "model": "t(n) = t_fixed + (T1 - t_fixed)/n + ici/bw",
+        "model": "t(n) = t_fixed + t_rep(B) + (T1 - t_fixed - t_rep)/n"
+                 " + ici/bw",
         "inputs": {
             "single_chip_rate": round(single_chip_rate),
             "B": B, "F": F, "k": k, "n": n, "cap": cap,
-            "device_aux": device_aux,
+            "device_aux": device_aux, "psum_dtype": psum_dtype,
+            "step_model": model, "score_sharded": score_sharded,
             "dispatch_ms": dispatch_ms,
-            "replicated_score_ms": replicated_score_ms,
+            "replicated_score_ms_per_128k": replicated_score_ms_per_128k,
             "ici_gbps": ici_gbps,
         },
         "per_chip": costs,

@@ -206,9 +206,19 @@ def make_parallel_train_step(
     (params, opt_state, {loss, grad_norm})``. Inputs must be placed with
     :func:`shard_params` / :func:`shard_batch`.
     """
-    from fm_spark_tpu.sparse import _reject_host_aux
+    from fm_spark_tpu.sparse import (
+        _reject_collective_dtype,
+        _reject_host_aux,
+        _reject_score_sharded,
+    )
 
     _reject_host_aux(config, "the dense optax parallel step")
+    _reject_score_sharded(config, "the dense optax parallel step")
+    # Grad psums here feed the optimizer DIRECTLY (no later fp32
+    # re-derivation), a different precision contract from the fused
+    # steps' activation collectives — not wired up; reject rather than
+    # silently ignore.
+    _reject_collective_dtype(config, "the dense optax parallel step")
     _check_divisibility(spec, mesh, strategy)
     optimizer = optimizer or make_optimizer(config)
     add_reg = _group_reg(config)

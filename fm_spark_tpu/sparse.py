@@ -68,6 +68,13 @@ def _check_host_dedup(config: TrainConfig):
         raise ValueError(
             f"unknown compact_overflow {config.compact_overflow!r}"
         )
+    if config.compact_overflow != "error" and config.compact_cap <= 0:
+        # Without a cap there is nothing to overflow — accepting the
+        # policy would be a silent no-op (no-silent-fallback rule).
+        raise ValueError(
+            f"compact_overflow={config.compact_overflow!r} has no "
+            "effect without compact_cap > 0"
+        )
     if config.compact_overflow == "drop" and not config.compact_device:
         raise ValueError(
             "compact_overflow='drop' is the device-side policy; the "
@@ -181,12 +188,17 @@ def _device_compact_aux_all(ids, cap: int, f_count: int,
 
 def _fold_overflow(loss, ovf, config: TrainConfig):
     """Overflow policy for the device-compact path: 'error' poisons the
-    loss to +inf (the training loop's periodic loss fetch turns that
-    into an actionable failure — no extra device→host sync per step);
-    'drop' accepts the documented absent-feature semantics silently."""
+    loss to MINUS infinity (the training loop's periodic loss fetch
+    turns that into an actionable failure — no extra device→host sync
+    per step); 'drop' accepts the documented absent-feature semantics
+    silently. −inf, not +inf: every shipped loss (logistic, squared,
+    hinge) is a weighted mean of non-negative terms, so a genuinely
+    diverging run reaches +inf but never −inf — the sentinel is
+    unambiguous (ADVICE r3: a diverging run must not be reported as a
+    cap overflow)."""
     if ovf is None or config.compact_overflow == "drop":
         return loss
-    return jnp.where(ovf > 0, jnp.float32(jnp.inf), loss)
+    return jnp.where(ovf > 0, jnp.float32(-jnp.inf), loss)
 
 
 def _rows_for(compact, tables, aux, cd, gat, ids, col=False,
@@ -226,6 +238,43 @@ def _updates_for(compact, tables, ids, g_fulls, rows, urows,
     )
 
 
+def _collective_dtype(config: TrainConfig):
+    """Validate ``config.collective_dtype`` and return the wire dtype
+    for the sharded steps' activation collectives (None = no cast).
+    Single definition shared by every sharded factory."""
+    if config.collective_dtype == "float32":
+        return None
+    if config.collective_dtype == "bfloat16":
+        return jnp.bfloat16
+    raise ValueError(
+        f"unknown collective_dtype {config.collective_dtype!r} "
+        "(expected 'float32' or 'bfloat16')"
+    )
+
+
+def _psum_wire(x, axes, wire, cd):
+    """The sharded forwards' wire-dtype allreduce: cast to the wire
+    dtype for the collective, back to compute dtype on arrival (plain
+    psum when no wire override). One definition so the FM and FFM
+    forwards can never diverge on the wire contract."""
+    if wire is None:
+        return jax.lax.psum(x, axes)
+    return jax.lax.psum(x.astype(wire), axes).astype(cd)
+
+
+def _reject_collective_dtype(config: TrainConfig, what: str):
+    """Guard for factories that do not implement the wire-precision
+    knob (single-chip programs have no collectives; the dense optax
+    step's grad psum has a different precision contract): fail loudly
+    instead of silently training at a precision the caller did not get
+    (no-silent-fallback rule)."""
+    if config.collective_dtype != "float32":
+        raise ValueError(
+            f"collective_dtype={config.collective_dtype!r} is not "
+            f"supported by {what}; it is a field-sharded-step knob"
+        )
+
+
 def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
                  use_linear: bool, config: TrainConfig):
     """The fused g_full construction (``config.gfull_fused``), shared by
@@ -260,6 +309,18 @@ def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
             g = g + rv * rows[f] * touched[:, None]
         g_fulls.append(g)
     return g_fulls
+
+
+def _reject_score_sharded(config: TrainConfig, what: str):
+    """Guard for factories that do not implement the score-sharded
+    backward (it is the FM sharded step's lever; see
+    TrainConfig.score_sharded): fail loudly instead of silently
+    computing replicated scores (no-silent-fallback rule)."""
+    if config.score_sharded:
+        raise ValueError(
+            f"score_sharded is implemented for the field-sharded FM "
+            f"step only, not {what}"
+        )
 
 
 def _reject_gfull(config: TrainConfig, what: str):
@@ -364,6 +425,8 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
     if config.gfull_fused and not spec.fused_linear:
         raise ValueError("gfull_fused targets the fused-linear g_full "
                          "construction; it requires fused_linear=True")
+    _reject_collective_dtype(config, "the single-chip FieldFM body")
+    _reject_score_sharded(config, "the single-chip FieldFM body")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -536,11 +599,11 @@ def make_field_sparse_multistep(spec, config: TrainConfig, n: int):
             )
             p, loss = body(p, step0 + j, ids[j], vals[j], labels[j],
                            weights[j], a)
-            # Sticky +inf: the compact-overflow 'error' poison
+            # Sticky −inf: the compact-overflow 'error' poison
             # (_fold_overflow) must survive to the returned loss even
             # when a later inner step is clean — otherwise a fori roll
             # would silently swallow the failure signal.
-            return p, jnp.where(jnp.isposinf(prev), prev, loss)
+            return p, jnp.where(jnp.isneginf(prev), prev, loss)
 
         return jax.lax.fori_loop(0, m, fbody, (params, jnp.float32(0)))
 
@@ -568,6 +631,8 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
     _reject_gfull(config, "the FieldFFM body")
+    _reject_collective_dtype(config, "the single-chip FieldFFM body")
+    _reject_score_sharded(config, "the single-chip FieldFFM body")
     _check_host_dedup(config)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -647,9 +712,11 @@ def make_field_ffm_sparse_sgd_step(spec, config: TrainConfig):
     )
 
 
-def make_field_deepfm_sparse_step(spec, config: TrainConfig):
-    """Fused hybrid step for :class:`FieldDeepFMSpec` — the CTR fast path
-    for config 5 (BASELINE.json:11).
+def make_field_deepfm_sparse_body(spec, config: TrainConfig):
+    """UNJITTED fused hybrid body for :class:`FieldDeepFMSpec` — the CTR
+    fast path for config 5 (BASELINE.json:11); exposed separately (like
+    the FM/FFM bodies) so the multistep fori roll can carry the optax
+    state through its loop. Returns ``(body, init_opt_state)``.
 
     Embedding tables (the 10M-row side) update via the analytic sparse
     scatter rule — the FM part is the reference's ``x_i(s_f − v_{i,f}x_i)``
@@ -662,10 +729,6 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
     the only dense parameters — update with the configured optax
     optimizer (Adam for the registered config): no dense table gradient
     and no table-sized moment state ever exists.
-
-    Returns ``step(params, opt_state, step_idx, ids, vals, labels,
-    weights) → (params, opt_state, loss)``; ``opt_state`` covers only
-    ``{"w0", "mlp"}``.
     """
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
     from fm_spark_tpu.train import make_optimizer
@@ -673,6 +736,8 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
     _reject_gfull(config, "the FieldDeepFM body")
+    _reject_collective_dtype(config, "the single-chip FieldDeepFM body")
+    _reject_score_sharded(config, "the single-chip FieldDeepFM body")
     _check_host_dedup(config)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -691,7 +756,6 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
     def init_opt_state(params):
         return dense_opt.init(dense_subtree(params))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def _step(params, opt_state, step_idx, ids, vals, labels, weights,
               aux=None):
         if config.host_dedup and aux is None:
@@ -776,6 +840,18 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
             _fold_overflow(loss, ovf, config),
         )
 
+    return _step, init_opt_state
+
+
+def make_field_deepfm_sparse_step(spec, config: TrainConfig):
+    """Jitted fused hybrid step for :class:`FieldDeepFMSpec` (see
+    :func:`make_field_deepfm_sparse_body`). Returns ``step(params,
+    opt_state, step_idx, ids, vals, labels, weights) → (params,
+    opt_state, loss)`` with ``step.init_opt_state``; ``opt_state``
+    covers only ``{"w0", "mlp"}``."""
+    body, init_opt_state = make_field_deepfm_sparse_body(spec, config)
+    _step = functools.partial(jax.jit, donate_argnums=(0, 1))(body)
+
     def step(params, opt_state, step_idx, ids, vals, labels, weights,
              aux=None):
         return _step(params, opt_state, step_idx, ids, vals, labels,
@@ -783,6 +859,42 @@ def make_field_deepfm_sparse_step(spec, config: TrainConfig):
 
     step.init_opt_state = init_opt_state
     return step
+
+
+def make_field_deepfm_multistep(spec, config: TrainConfig, n: int):
+    """The DeepFM form of :func:`make_field_sparse_multistep` (VERDICT
+    r3 #6): ``n`` hybrid steps in ONE compiled ``fori_loop`` program,
+    with the dense head's optax state threaded through the carry —
+    adam's count/moments advance exactly as in ``n`` separate calls
+    (the state trees are shape-stable, so the carry is well-formed).
+    Returns ``mstep(params, opt_state, step0, m, ids, vals, labels,
+    weights, aux=None) → (params, opt_state, last_loss)`` over
+    ``[n, ...]``-stacked batches; ``mstep.init_opt_state`` as usual.
+    """
+    if n < 1:
+        raise ValueError(f"steps per call must be >= 1, got {n}")
+    body, init_opt_state = make_field_deepfm_sparse_body(spec, config)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def mstep(params, opt_state, step0, m, ids, vals, labels, weights,
+              aux=None):
+        def fbody(j, carry):
+            p, o, prev = carry
+            a = (
+                None if aux is None
+                else jax.tree_util.tree_map(lambda x: x[j], aux)
+            )
+            p, o, loss = body(p, o, step0 + j, ids[j], vals[j],
+                              labels[j], weights[j], a)
+            # Sticky −inf, as in the FM/FFM roll.
+            return p, o, jnp.where(jnp.isneginf(prev), prev, loss)
+
+        return jax.lax.fori_loop(
+            0, m, fbody, (params, opt_state, jnp.float32(0))
+        )
+
+    mstep.init_opt_state = init_opt_state
+    return mstep
 
 
 def make_sparse_sgd_step(spec, config: TrainConfig):
@@ -800,6 +912,8 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
         raise ValueError("sparse step implements plain SGD only")
     _reject_gfull(config, "the flat-table FM step (it has no fused "
                   "g_full concat to eliminate)")
+    _reject_collective_dtype(config, "the single-chip flat-table FM step")
+    _reject_score_sharded(config, "the single-chip flat-table FM step")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
 

@@ -89,7 +89,8 @@ class TrainConfig:
     # What happens when a field's per-batch unique-id count exceeds
     # compact_cap:
     #  'error' — host aux: raise before the step (the r2 behavior);
-    #            device aux: poison the loss to +inf, which the training
+    #            device aux: poison the loss to −inf (unreachable
+    #            naturally — losses are non-negative), which the training
     #            loop's periodic loss fetch turns into a hard error.
     #  'drop'  — device aux only: ids past the cap-th unique (the
     #            largest ids) behave as absent features for that batch —
@@ -103,11 +104,33 @@ class TrainConfig:
     # ``s1 = [s, 1]`` built once) instead of per-field
     # ``concat([g_v, g_l])`` — eliminates F × [B, k+1] concat copy
     # passes if XLA was not fusing them into the update's reorder
-    # gather (PERF.md round-4 lever). Bitwise-identical results
-    # (tests/test_sparse.py pins it); FieldFM fused-linear bodies only.
-    # Off by default until the on-chip A/B decides (bench.py
-    # --gfull-fused).
+    # gather (PERF.md round-4 lever). Same arithmetic; results pinned
+    # to a ULP-tight bound in tests/test_gfull.py (XLA contraction may
+    # differ). FieldFM fused-linear bodies only. Off by default until
+    # the on-chip A/B decides (bench.py --gfull-fused).
     gfull_fused: bool = False
+    # Wire format for the field-sharded steps' ACTIVATION collectives
+    # ('float32' | 'bfloat16'): the (s, sq, lin) score psum group (the
+    # dominant ~60MB/chip/step ICI term at headline shapes —
+    # parallel/projection.py), DeepFM's h psum/all_gather, and FFM's sel
+    # all_to_all. 'bfloat16' halves those ICI bytes; reductions
+    # accumulate in bf16 on the wire and results are cast back to the
+    # compute dtype on arrival. Batch re-shard collectives (ids/vals/
+    # labels/weights) and table writes are NOT affected — this is a
+    # wire-precision knob, not a storage one. Quality envelope measured
+    # by bench_quality.py (budget row); sharded-step factories only
+    # (single-chip programs have no collectives — rejected there).
+    collective_dtype: str = "float32"
+    # Shard the [B, k] score + dscores math over EXAMPLES on the
+    # field-sharded FM step: each chip reduces scores for its B/n
+    # example block and one tiny [B] all_gather replicates dscores for
+    # the backward. Per-example ops are elementwise, so dscores are
+    # EXACTLY the replicated computation's values (equivalence-tested);
+    # only the scalar loss reassociates. This removes the projection
+    # model's only non-shardable B-proportional term — the binding
+    # constraint on weak scaling (parallel/projection.py). Requires the
+    # global batch to divide by the mesh size; FM sharded step only.
+    score_sharded: bool = False
 
 
 def _group_reg(config: TrainConfig):
@@ -170,9 +193,15 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
     Returns ``step(params, opt_state, ids, vals, labels, weights) →
     (params, opt_state, metrics_dict)`` with donated params/opt_state.
     """
-    from fm_spark_tpu.sparse import _reject_host_aux
+    from fm_spark_tpu.sparse import (
+        _reject_collective_dtype,
+        _reject_host_aux,
+        _reject_score_sharded,
+    )
 
     _reject_host_aux(config, "the dense optax train step")
+    _reject_collective_dtype(config, "the dense single-device train step")
+    _reject_score_sharded(config, "the dense single-device train step")
     optimizer = optimizer or make_optimizer(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     add_reg = _group_reg(config)

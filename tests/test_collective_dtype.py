@@ -1,0 +1,143 @@
+"""collective_dtype='bfloat16': wire-precision collectives on the
+field-sharded steps (the projection model's dominant-ICI-term lever).
+
+The bf16 wire changes results (that is the point — halved ICI bytes for
+bounded precision), so the bar here is a loose agreement band against
+the fp32-wire sharded step plus hard finiteness; the QUALITY envelope at
+real shapes is bench_quality.py's budget row.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.parallel import (
+    make_field_mesh,
+    make_field_sharded_sgd_step,
+    pad_field_batch,
+    shard_field_batch,
+    shard_field_params,
+    stack_field_params,
+    unstack_field_params,
+)
+from fm_spark_tpu.train import TrainConfig
+
+F, BUCKET, K, B = 5, 32, 4, 64
+
+
+def _spec():
+    return models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+
+
+def _batch(rng, b=B):
+    return (
+        rng.integers(0, BUCKET, size=(b, F)).astype(np.int32),
+        rng.uniform(0.5, 1.5, size=(b, F)).astype(np.float32),
+        rng.integers(0, 2, b).astype(np.float32),
+        np.ones((b,), np.float32),
+    )
+
+
+def _run_sharded(spec, config, mesh, n_feat, batches):
+    params = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(5)), n_feat),
+        mesh,
+    )
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    for i, batch in enumerate(batches):
+        sb = shard_field_batch(pad_field_batch(batch, F, n_feat), mesh)
+        params, loss = step(params, jnp.int32(i), *sb)
+    return unstack_field_params(spec, jax.device_get(params)), float(loss)
+
+
+@pytest.mark.parametrize("n_row", [1, 2])
+def test_bf16_wire_close_to_fp32(eight_devices, n_row):
+    n_feat = 4
+    spec = _spec()
+    mesh = make_field_mesh(n_feat * n_row, devices=eight_devices,
+                           n_row=n_row)
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(2)]
+    base = dict(learning_rate=0.2, optimizer="sgd")
+    p32, l32 = _run_sharded(spec, TrainConfig(**base), mesh, n_feat,
+                            batches)
+    p16, l16 = _run_sharded(
+        spec, TrainConfig(**base, collective_dtype="bfloat16"), mesh,
+        n_feat, batches)
+    assert np.isfinite(l16)
+    # bf16 wire: ~3 decimal digits of mantissa — the loss and params
+    # must land inside a few bf16-epsilons of the fp32-wire run.
+    assert abs(l16 - l32) <= 3e-2 * max(1.0, abs(l32))
+    for f in range(F):
+        np.testing.assert_allclose(
+            p16["vw"][f], p32["vw"][f], rtol=0.1, atol=3e-2,
+            err_msg=f"vw[{f}]")
+
+
+def test_bf16_wire_ffm_and_deepfm_run(eight_devices):
+    from fm_spark_tpu.parallel import make_field_ffm_sharded_step
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_deepfm_sharded_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+    )
+
+    n_feat = 4
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    rng = np.random.default_rng(1)
+    batch = _batch(rng)
+    config = TrainConfig(learning_rate=0.1, optimizer="sgd",
+                         collective_dtype="bfloat16")
+
+    ffm = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET,
+        init_std=0.1)
+    fstep = make_field_ffm_sharded_step(ffm, config, mesh)
+    fparams = shard_field_params(
+        stack_field_params(ffm, ffm.init(jax.random.key(1)), n_feat),
+        mesh)
+    sb = shard_field_batch(pad_field_batch(batch, F, n_feat), mesh)
+    fparams, floss = fstep(fparams, jnp.int32(0), *sb)
+    assert np.isfinite(float(floss))
+
+    deep = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET,
+        mlp_dims=(8,), init_std=0.1)
+    dconfig = TrainConfig(learning_rate=0.1, optimizer="adam",
+                          collective_dtype="bfloat16")
+    dstep = make_field_deepfm_sharded_step(deep, dconfig, mesh)
+    dparams = shard_field_deepfm_params(
+        stack_field_deepfm_params(deep, deep.init(jax.random.key(2)),
+                                  n_feat), mesh)
+    dopt = dstep.init_opt_state(dparams)
+    dparams, dopt, dloss = dstep(dparams, dopt, jnp.int32(0), *sb)
+    assert np.isfinite(float(dloss))
+
+
+def test_collective_dtype_rejected_where_unimplemented(eight_devices):
+    from fm_spark_tpu.parallel import make_mesh, make_parallel_train_step
+    from fm_spark_tpu.sparse import (
+        make_field_sparse_sgd_step,
+        make_sparse_sgd_step,
+    )
+
+    spec = _spec()
+    config = TrainConfig(optimizer="sgd", collective_dtype="bfloat16")
+    with pytest.raises(ValueError, match="collective_dtype"):
+        make_field_sparse_sgd_step(spec, config)
+    with pytest.raises(ValueError, match="collective_dtype"):
+        make_sparse_sgd_step(models.FMSpec(num_features=64, rank=2),
+                             config)
+    mesh = make_mesh(4, 1, devices=eight_devices[:4])
+    with pytest.raises(ValueError, match="collective_dtype"):
+        make_parallel_train_step(
+            models.FMSpec(num_features=64, rank=2), config, mesh, "dp")
+    with pytest.raises(ValueError, match="unknown collective_dtype"):
+        make_field_sharded_sgd_step(
+            spec, TrainConfig(optimizer="sgd", collective_dtype="fp8"),
+            make_field_mesh(4, devices=eight_devices))

@@ -237,7 +237,7 @@ def test_overflow_error_poisons_loss(rng):
         spec.init(jax.random.key(1)), jnp.int32(0), jnp.asarray(ids),
         jnp.asarray(vals), jnp.asarray(labels), jnp.asarray(weights),
     )
-    assert np.isposinf(float(loss))
+    assert np.isneginf(float(loss))
 
 
 def test_sharded_2d_overflow_sentinel_not_counted(rng):
@@ -279,6 +279,13 @@ def test_config_validation():
             spec, _base_cfg(sparse_update="dedup", compact_device=True,
                             compact_cap=8, compact_overflow="split")
         )
+    # A non-default overflow policy without a cap is a silent no-op —
+    # rejected (ADVICE r3).
+    for policy in ("drop", "split"):
+        with pytest.raises(ValueError, match="no.*effect|no effect"):
+            make_field_sparse_sgd_step(
+                spec, _base_cfg(compact_overflow=policy)
+            )
 
 
 @pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
@@ -448,7 +455,7 @@ def test_split_state_replays_whole_batch(rng):
 
 
 def test_multistep_poison_is_sticky(rng):
-    """The fori-rolled multistep must not swallow an inner step's +inf
+    """The fori-rolled multistep must not swallow an inner step's −inf
     overflow poison when a later step is clean."""
     from fm_spark_tpu.sparse import make_field_sparse_multistep
 
@@ -468,7 +475,7 @@ def test_multistep_poison_is_sticky(rng):
         stack(weights, weights),
     )
     # Step 0 overflowed, step 1 was clean — the poison must survive.
-    assert np.isposinf(float(loss))
+    assert np.isneginf(float(loss))
 
 
 def test_sharded_builders_validate_unconditionally():
@@ -572,7 +579,7 @@ def test_sharded_deepfm_device_overflow_error(rng):
     sp, opt, loss = sharded(
         sp, opt, jnp.int32(0), *shard_field_batch(batch, mesh)
     )
-    assert np.isposinf(float(loss))
+    assert np.isneginf(float(loss))
 
 
 @pytest.mark.parametrize("dev_compact", [False, True])
@@ -628,3 +635,35 @@ def test_sharded_deepfm_2d_matches_single_chip(rng, dev_compact):
         ),
         canonical, got,
     )
+
+
+def test_overflow_guard_sticky():
+    """ADVICE r3 + round-4 review: an overflow at step i followed by
+    clean steps must still fail the NEXT boundary check — the guard is
+    a running min, not a point read of the latest loss."""
+    import jax.numpy as jnp
+
+    from fm_spark_tpu.cli import _make_overflow_guard
+
+    cfg = _base_cfg(sparse_update="dedup", compact_device=True,
+                    compact_cap=8)  # compact_overflow defaults to error
+    note, check, fetch = _make_overflow_guard(cfg)
+    note(jnp.float32(0.69))
+    check()  # clean so far
+    note(jnp.float32(-jnp.inf))   # the poisoned step
+    note(jnp.float32(0.55))       # clean again — must NOT clear it
+    with pytest.raises(SystemExit, match="compact_cap overflow"):
+        check()
+    # fetch_loss shares the sticky detector.
+    note2, _, fetch2 = _make_overflow_guard(cfg)
+    note2(jnp.float32(-jnp.inf))
+    note2(jnp.float32(0.5))
+    with pytest.raises(SystemExit, match="compact_cap overflow"):
+        fetch2(jnp.float32(0.5))
+    # Inactive policy (drop): everything is a no-op / plain float.
+    note3, check3, fetch3 = _make_overflow_guard(
+        _base_cfg(sparse_update="dedup", compact_device=True,
+                  compact_cap=8, compact_overflow="drop"))
+    note3(jnp.float32(-jnp.inf))
+    check3()
+    assert fetch3(jnp.float32(0.5)) == np.float32(0.5)

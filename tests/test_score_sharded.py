@@ -1,0 +1,119 @@
+"""score_sharded: example-sharded score/dscores math on the sharded FM
+step must be EXACT vs the replicated computation.
+
+Per-example score reduction and loss gradients are elementwise in the
+example axis, so slicing them per chip and all_gathering dscores is the
+same arithmetic on the same values — params must come out bit-identical;
+only the scalar loss reassociates (psum of block partials).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.parallel import (
+    make_field_mesh,
+    make_field_sharded_sgd_step,
+    pad_field_batch,
+    shard_field_batch,
+    shard_field_params,
+    stack_field_params,
+    unstack_field_params,
+)
+from fm_spark_tpu.train import TrainConfig
+
+F, BUCKET, K, B = 5, 32, 4, 64
+
+
+def _spec():
+    return models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+
+
+def _batches(rng, n=2):
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, BUCKET, size=(B, F)).astype(np.int32)
+        vals = rng.uniform(0.5, 1.5, size=(B, F)).astype(np.float32)
+        labels = rng.integers(0, 2, B).astype(np.float32)
+        weights = np.ones((B,), np.float32)
+        weights[-5:] = 0.0
+        out.append((ids, vals, labels, weights))
+    return out
+
+
+def _run(spec, config, mesh, n_feat, batches):
+    params = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(9)), n_feat),
+        mesh,
+    )
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    for i, batch in enumerate(batches):
+        sb = shard_field_batch(pad_field_batch(batch, F, n_feat), mesh)
+        params, loss = step(params, jnp.int32(i), *sb)
+    return unstack_field_params(spec, jax.device_get(params)), float(loss)
+
+
+@pytest.mark.parametrize("n_row", [1, 2])
+@pytest.mark.parametrize("extra", [
+    {}, {"reg_factors": 1e-3, "reg_linear": 1e-4, "reg_bias": 1e-4},
+    {"gfull_fused": True},
+])
+def test_score_sharded_bitwise_params(eight_devices, n_row, extra):
+    n_feat = 4
+    spec = _spec()
+    mesh = make_field_mesh(n_feat * n_row, devices=eight_devices,
+                           n_row=n_row)
+    rng = np.random.default_rng(0)
+    batches = _batches(rng)
+    base = dict(learning_rate=0.3, optimizer="sgd", **extra)
+    p_rep, l_rep = _run(spec, TrainConfig(**base), mesh, n_feat, batches)
+    p_sh, l_sh = _run(spec, TrainConfig(**base, score_sharded=True),
+                      mesh, n_feat, batches)
+    np.testing.assert_allclose(l_rep, l_sh, rtol=1e-6)
+    assert np.array_equal(p_rep["w0"], p_sh["w0"])
+    for f in range(F):
+        assert np.array_equal(p_rep["vw"][f], p_sh["vw"][f]), f
+
+
+def test_score_sharded_composes_with_compact_device(eight_devices):
+    # The full scale-out stack in one step: 2-D mesh + device-built
+    # compact aux + bf16 wire + gfull + score sharding.
+    n_feat, n_row = 4, 2
+    spec = _spec()
+    mesh = make_field_mesh(8, devices=eight_devices, n_row=n_row)
+    rng = np.random.default_rng(1)
+    config = TrainConfig(
+        learning_rate=0.2, optimizer="sgd", sparse_update="dedup_sr",
+        compact_device=True, compact_cap=B, score_sharded=True,
+        collective_dtype="bfloat16", gfull_fused=True,
+    )
+    p, loss = _run(spec, config, mesh, n_feat, _batches(rng, n=1))
+    assert np.isfinite(loss)
+
+
+def test_score_sharded_rejected_where_unimplemented(eight_devices):
+    from fm_spark_tpu.parallel import make_field_ffm_sharded_step
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_deepfm_sharded_step,
+    )
+    from fm_spark_tpu.sparse import make_field_sparse_sgd_step
+
+    config = TrainConfig(optimizer="sgd", score_sharded=True)
+    spec = _spec()
+    with pytest.raises(ValueError, match="score_sharded"):
+        make_field_sparse_sgd_step(spec, config)
+    mesh = make_field_mesh(4, devices=eight_devices)
+    ffm = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET)
+    with pytest.raises(ValueError, match="score_sharded"):
+        make_field_ffm_sharded_step(ffm, config, mesh)
+    deep = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET,
+        mlp_dims=(8,))
+    with pytest.raises(ValueError, match="score_sharded"):
+        make_field_deepfm_sharded_step(deep, config, mesh)
