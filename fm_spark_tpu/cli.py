@@ -317,7 +317,7 @@ _FIELD_CAPS = {
     ),
     "FieldFFMSpec": _FieldCap(
         single_step=_single_ffm_step, sharded_step=_sharded_ffm_step,
-        carries_opt=False, sharded_2d=False, sharded_host_compact=True,
+        carries_opt=False, sharded_2d=True, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
         multistep_single=True, sharded_score=False,
     ),

@@ -244,6 +244,18 @@ def _score_block(g):
     return idx, nsh
 
 
+def _ownership_mask(g, ids):
+    """Localize global ids to THIS row shard's bucket range: returns
+    ``(loc, own)`` — local ids and the ownership mask. The single
+    definition of the 2-D ownership contract (FM and FFM forwards,
+    plain and device-compact paths — the sentinel/clip handling at each
+    call site differs, the contract must not)."""
+    lo = lax.axis_index("row") * g["bucket_local"]
+    loc = ids - lo
+    own = (loc >= 0) & (loc < g["bucket_local"])
+    return loc, own
+
+
 def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
                    caux=None, device_cap: int = 0, add_bias: bool = True,
                    gfull: bool = False, psum_dtype=None,
@@ -320,9 +332,7 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
             # complete without any cross-shard reduction. The sentinel
             # segment is discounted from overflow accounting (dropping
             # it is the point, not data loss).
-            lo = lax.axis_index("row") * g["bucket_local"]
-            loc = ids - lo
-            own = (loc >= 0) & (loc < g["bucket_local"])
+            loc, own = _ownership_mask(g, ids)
             cids = jnp.where(own, loc, g["bucket_local"])
             extra = jnp.any(~own, axis=0).astype(jnp.int32)
         aux, ovf = _device_compact_aux_all(cids, device_cap, g["f_local"],
@@ -340,9 +350,7 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
         # axes reconstructs the exact sums. Non-owned update lanes go to
         # an out-of-bounds sentinel row (XLA scatter drop) — single-owner
         # writes.
-        lo = lax.axis_index("row") * g["bucket_local"]
-        loc = ids - lo
-        own = (loc >= 0) & (loc < g["bucket_local"])
+        loc, own = _ownership_mask(g, ids)
         gidx = jnp.clip(loc, 0, g["bucket_local"] - 1)
         rows = [
             r * own[:, f, None]
@@ -954,6 +962,20 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
     pattern as DeepFM's ``h`` all_gather but n× cheaper than gathering
     the full [B, F, F, k] tensor on every chip.
 
+    On a 2-D ``(feat, row)`` mesh (round 4 — VERDICT r3 #5) each row
+    shard additionally owns a bucket range of its fields, exactly the
+    FM step's ownership contract: non-owned lanes gather ZERO rows, so
+    each shard's ``sel_loc`` is a partial sum that ONE ``psum`` over
+    ``row`` completes before the transposing all_to_all — the same
+    linear-reduction identity the FM partials use, lifted to the sel
+    tensor (sel is linear in the gathered rows). Updates stay
+    single-owner via the OOB-sentinel ``uidx`` / the ownership-masked
+    device-compact aux. The extra collective is the price of bucket
+    capacity: ~ring·|sel| bytes over ``row`` per step, on top of the
+    1-D layout's a2a (projection.py models the 1-D layout; the row
+    psum adds ``2(r−1)/r·|sel|`` on a 2-D mesh — use it for capacity,
+    not speed).
+
     Returns ``(scores, rows, sel_loc, selT, vals_c, uidx, urows, aux,
     ovf, labels, weights)`` — scores replicated; sel_loc/selT are this
     chip's [B, f_local, F_pad, k] owner/transposed blocks for the
@@ -963,6 +985,7 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
         _compact_gather_all,
         _device_compact_aux_all,
         _gather_all,
+        _psum_wire,
     )
 
     cd = spec.cdtype
@@ -977,17 +1000,43 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
                           tiled=True)
     labels = lax.all_gather(labels, "feat", tiled=True)
     weights = lax.all_gather(weights, "feat", tiled=True)
+    if g["two_d"]:
+        ids = lax.all_gather(ids, "row", tiled=True)
+        vals = lax.all_gather(vals, "row", tiled=True)
+        labels = lax.all_gather(labels, "row", tiled=True)
+        weights = lax.all_gather(weights, "row", tiled=True)
     vals_c = vals.astype(cd)
 
     urows = None
     aux = caux
     ovf = None
+    own = None
     if device_cap > 0:
-        aux, ovf = _device_compact_aux_all(ids, device_cap, f_local)
+        cids = ids
+        extra = None
+        if g["two_d"]:
+            # Ownership masking before the sort — the FM step's 2-D
+            # device-compact pattern (see _field_forward).
+            loc, own = _ownership_mask(g, ids)
+            cids = jnp.where(own, loc, g["bucket_local"])
+            extra = jnp.any(~own, axis=0).astype(jnp.int32)
+        aux, ovf = _device_compact_aux_all(cids, device_cap, f_local,
+                                           extra_segs=extra)
         urows, rows = _compact_gather_all(
             [vw[f] for f in range(f_local)], aux, cd, mask_overflow=True
         )
+        if own is not None:
+            rows = [r * own[:, f, None] for f, r in enumerate(rows)]
         uidx = None
+    elif g["two_d"]:
+        loc, own = _ownership_mask(g, ids)
+        gidx = jnp.clip(loc, 0, g["bucket_local"] - 1)
+        rows = [
+            r * own[:, f, None]
+            for f, r in enumerate(
+                _gather_all(lambda t, i: t[i], vw, gidx, cd))
+        ]
+        uidx = jnp.where(own, loc, g["bucket_local"])
     elif caux is not None:
         urows, rows = _compact_gather_all(
             [vw[f] for f in range(f_local)], caux, cd
@@ -1011,6 +1060,13 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
         ],
         axis=1,
     )                                           # [B, f_local, F_pad, k]
+    if g["two_d"]:
+        # Complete each owned field's sel block across its row shards
+        # (non-owned lanes contributed zeros). After this, sel_loc is
+        # identical on every row shard, so everything downstream —
+        # the a2a, pair/diag, the backward's dsel — runs replicated
+        # over ``row`` by construction; only lin needs the 2-D psum.
+        sel_loc = _psum_wire(sel_loc, "row", wire, cd)
     # selT[b, p, j, :] = sel[b, j, i_p] — every other chip's view of
     # this chip's fields as TARGETS, re-sharded in one collective. The
     # sel a2a is the FFM step's dominant ICI term (~F× the FM psum at
@@ -1036,12 +1092,13 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
         if spec.use_linear
         else jnp.zeros((b,), cd)
     )
-    from fm_spark_tpu.sparse import _psum_wire
-
+    # pair/diag derive from the row-complete sel_loc (identical per row
+    # shard) — psum over ``feat`` only; lin derives from the MASKED rows
+    # (partial over row too) — psum over every score axis.
     pair = _psum_wire(pair_p - diag_p, "feat", wire, cd)
     scores = 0.5 * pair
     if spec.use_linear:
-        scores = scores + _psum_wire(lin_p, "feat", wire, cd)
+        scores = scores + _psum_wire(lin_p, g["score_axes"], wire, cd)
     if spec.use_bias:
         scores = scores + w0.astype(cd)
     return (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
@@ -1049,13 +1106,16 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
 
 
 def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
-    """Unjitted field-sharded fused FFM step (1-D ``feat`` mesh) —
-    config 4's multi-chip layout. Same math as the single-chip
+    """Unjitted field-sharded fused FFM step — config 4's multi-chip
+    layout, on a 1-D ``(feat,)`` or 2-D ``(feat, row)`` mesh (row
+    sharding of each field's bucket dimension — round 4, VERDICT r3
+    #5). Same math as the single-chip
     :func:`fm_spark_tpu.sparse.make_field_ffm_sparse_sgd_body`
-    (equivalence-tested); tables single-owner per field, one sel
-    ``all_to_all`` instead of table movement. Supports the compact
-    paths: host-built aux (single-process) and the device-built aux
-    (composes with multi-process)."""
+    (equivalence-tested); tables single-owner per field (and per bucket
+    range on 2-D), one sel ``all_to_all`` — plus, 2-D, one sel ``psum``
+    over ``row`` — instead of table movement. Supports the compact
+    paths: host-built aux (single-process, 1-D) and the device-built
+    aux (composes with 2-D meshes and multi-process)."""
     from fm_spark_tpu.models.field_ffm import FieldFFMSpec
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
@@ -1079,10 +1139,10 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
 
     _reject_score_sharded(config, "the field-sharded FFM step")
     wire = _collective_dtype(config)
-    if set(mesh.axis_names) != {"feat"}:
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
-            "field-sharded FFM runs on a 1-D ('feat',) mesh (row "
-            "sharding of cross-field tables is a follow-on)"
+            "field-sharded FFM runs on a ('feat',) or ('feat', 'row') "
+            "mesh (use make_field_mesh)"
         )
     if config.use_pallas:
         raise ValueError("use_pallas is a single-chip experiment")
@@ -1092,6 +1152,14 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
     host_compact = compact and not config.compact_device
     # Unconditional, like the single-chip factories (see the FM body).
     _check_host_dedup(config)
+    if host_compact and g["two_d"]:
+        # Same structural limit as the FM step: a host aux built from
+        # raw global ids cannot express row ownership.
+        raise ValueError(
+            "host-built compact_cap on the sharded FFM step requires a "
+            "1-D ('feat',) mesh; use compact_device=True for 2-D "
+            "(feat, row) meshes"
+        )
     if not compact and config.host_dedup:
         _reject_host_aux(config, "the field-sharded FFM step (non-compact)")
 
@@ -1129,6 +1197,10 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
 
         # ∂L/∂sel[b, i_p, j] = ds · sel[b, j, i_p] = ds · selT (zeroed
         # diagonal), then ∂L/∂v[id_p, j] = ∂sel · x_p — all local.
+        # (2-D: selT is row-complete, so dsel is identical per row
+        # shard; ownership lands at the WRITE via the sentinel/compact
+        # aux, exactly the FM contract. The reg term uses the masked
+        # rows — zero for non-owned lanes, whose writes drop anyway.)
         feat0 = lax.axis_index("feat") * f_local
         dsel = dscores[:, None, None, None] * selT
         own_col = jax.nn.one_hot(
@@ -1149,21 +1221,30 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
             else:
                 g_l = jnp.zeros_like(dscores)
             g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        # SR keys: one stream per (global field, row shard), like the
+        # FM body — noise never correlates across chips sharing a field.
+        field_offset = feat0
+        if g["two_d"]:
+            field_offset = field_offset + lax.axis_index("row") * g["f_pad"]
         if compact:
             new_slices = _compact_apply_all(
                 [vw[f] for f in range(f_local)], g_fulls, urows, config,
-                sr_base_key, step_idx, lr, aux, field_offset=feat0,
+                sr_base_key, step_idx, lr, aux,
+                field_offset=field_offset,
             )
         else:
             new_slices = _apply_field_updates(
                 [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
-                config, sr_base_key, step_idx, lr, field_offset=feat0,
+                config, sr_base_key, step_idx, lr,
+                field_offset=field_offset,
             )
         out = {"w0": w0, "vw": jnp.stack(new_slices, axis=0)}
         if spec.use_bias:
             out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
         if ovf is not None:
-            loss = _fold_overflow(loss, lax.pmax(ovf, "feat"), config)
+            loss = _fold_overflow(
+                loss, lax.pmax(ovf, g["score_axes"]), config
+            )
         return out, loss
 
     if host_compact:
@@ -1203,8 +1284,10 @@ def make_field_ffm_sharded_eval_step(spec, mesh):
 
     if type(spec) is not FieldFFMSpec:
         raise ValueError("expected a FieldFFMSpec")
-    if set(mesh.axis_names) != {"feat"}:
-        raise ValueError("sharded FFM eval runs on a 1-D ('feat',) mesh")
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
+        raise ValueError(
+            "sharded FFM eval runs on a ('feat',) or ('feat', 'row') mesh"
+        )
     per_example_loss = losses_lib.loss_fn(spec.loss)
     g = _mesh_geometry(spec, mesh)
     mstate_specs = jax.tree_util.tree_map(

@@ -382,9 +382,9 @@ def test_field_sparse_capability_guards():
     ffm_kw = dict(bucket=32, num_fields=4, rank=4)
     deepfm_kw = dict(bucket=32, num_fields=4, rank=4,
                      mlp_dims=(8, 8))
-    # FFM has no 2-D sharded step.
-    with pytest.raises(SystemExit, match="2-D"):
-        run("g1", "avazu_ffm_r16", ["--row-shards", "2"], ffm_kw)
+    # FFM 2-D row sharding is supported since round 4 (sel partials
+    # completed by one psum over `row` — field_step._ffm_field_forward).
+    assert run("g1", "avazu_ffm_r16", ["--row-shards", "2"], ffm_kw) == 0
     # steps-per-call only rolls the single-chip pure-SGD bodies; on the
     # 8-fake-device env field_sparse shards.
     with pytest.raises(SystemExit, match="steps-per-call"):

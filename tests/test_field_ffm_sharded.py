@@ -47,12 +47,13 @@ def _batch(rng, b=B):
     return ids, vals, labels, weights
 
 
-def _run_pair(rng, config, n_feat=8, steps=3, caux_builder=None):
+def _run_pair(rng, config, n_feat=8, steps=3, caux_builder=None,
+              n_row=1):
     ids, vals, labels, weights = _batch(rng)
     spec = _spec()
     canonical = spec.init(jax.random.key(1))
     single = make_field_ffm_sparse_sgd_step(spec, config)
-    mesh = make_field_mesh(n_feat)
+    mesh = make_field_mesh(n_feat * n_row, n_row=n_row)
     sharded = make_field_ffm_sharded_step(spec, config, mesh)
     sp = shard_field_params(
         stack_field_params(spec, jax.tree.map(jnp.copy, canonical),
@@ -162,12 +163,79 @@ def test_sharded_ffm_eval(rng):
     assert float(em["auc"]) == pytest.approx(float(got["auc"]), abs=1e-6)
 
 
-def test_sharded_ffm_rejects_2d_mesh():
+@pytest.mark.parametrize("mode", ["scatter_add", "dedup"])
+def test_sharded_ffm_2d_matches_single_chip(rng, mode):
+    # Round 4 (VERDICT r3 #5): the 2-D (feat, row) FFM step — bucket
+    # ranges row-sharded with ownership-masked sel partials completed
+    # by one psum over row; must match single-chip step-for-step.
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update=mode, reg_factors=1e-4,
+                    reg_linear=1e-4),
+        n_feat=4, n_row=2,
+    )
+
+
+def test_sharded_ffm_2d_device_compact_matches_single_chip(rng):
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update="dedup", compact_device=True,
+                    compact_cap=B),
+        n_feat=4, n_row=2,
+    )
+
+
+def test_sharded_ffm_2d_uneven_fields_sr(rng):
+    # f_pad padding + dedup_sr's per-(field, row-shard) key streams on
+    # the 2-D mesh (bf16 storage exercises the SR write-back).
+    _run_pair(
+        rng,
+        TrainConfig(learning_rate=0.1, optimizer="sgd",
+                    sparse_update="dedup", reg_factors=1e-4),
+        n_feat=2, n_row=2,
+    )
+
+
+def test_sharded_ffm_2d_eval(rng):
+    ids, vals, labels, weights = _batch(rng)
+    spec = _spec()
+    mesh = make_field_mesh(8, n_row=2)
+    sp = shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(1)), 4), mesh
+    )
+    em = evaluate_field_sharded(
+        spec, mesh, sp, [(ids, vals, labels, weights)]
+    )
+    assert float(em["count"]) == float(weights.sum())
+    canonical = unstack_field_params(spec, jax.device_get(sp))
+    want = np.asarray(
+        spec.scores(canonical, jnp.asarray(ids), jnp.asarray(vals))
+    )
+    from fm_spark_tpu.ops import losses as losses_lib
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    per = losses_lib.loss_fn(spec.loss)(jnp.asarray(want),
+                                        jnp.asarray(labels))
+    m = metrics_lib.init_metrics()
+    m = metrics_lib.update_metrics(
+        m, jnp.asarray(want), jnp.asarray(labels), per,
+        jnp.asarray(weights),
+        predictions=jax.nn.sigmoid(jnp.asarray(want)),
+    )
+    got = metrics_lib.finalize_metrics(m)
+    assert float(em["logloss"]) == pytest.approx(float(got["logloss"]),
+                                                 rel=1e-5)
+
+
+def test_sharded_ffm_2d_rejects_host_compact():
     from fm_spark_tpu.parallel import make_field_ffm_sharded_body
 
     spec = _spec()
     mesh = make_field_mesh(8, n_row=2)
     with pytest.raises(ValueError, match="1-D"):
         make_field_ffm_sharded_body(
-            spec, TrainConfig(optimizer="sgd"), mesh
+            spec, TrainConfig(optimizer="sgd", sparse_update="dedup",
+                              host_dedup=True, compact_cap=B), mesh
         )
