@@ -771,9 +771,8 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    from fm_spark_tpu.sparse import _reject_gfull, _reject_score_sharded
+    from fm_spark_tpu.sparse import _reject_score_sharded
 
-    _reject_gfull(config, "the field-sharded DeepFM step")
     _reject_score_sharded(config, "the field-sharded DeepFM step")
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
@@ -816,6 +815,7 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         fwd = _field_forward(
             spec, g, gat, vw, w0, ids, vals, labels, weights,
             device_cap=device_cap, add_bias=False, psum_dtype=wire,
+            gfull=config.gfull_fused,
         )
         fm_scores, s, xvs, rows = fwd.scores, fwd.s, fwd.xvs, fwd.rows
         vals_c, uidx, urows = fwd.vals_c, fwd.uidx, fwd.urows
@@ -862,24 +862,37 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
         g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0, f_local * k,
                                            axis=1)
 
-        g_fulls = []
-        for f in range(f_local):
-            # s − xvs[f] is exact for owned lanes; non-owned lanes (2-D)
-            # produce garbage that the sentinel index / dropped segment
-            # discards — same contract as the FM body.
-            g_v = (
-                dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
-                + g_h_loc[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+        if config.gfull_fused:
+            from fm_spark_tpu.sparse import _gfull_grads
+
+            gh_pad = jnp.pad(
+                g_h_loc.reshape(-1, f_local, k),
+                ((0, 0), (0, 0), (0, 1)))
+            g_fulls = _gfull_grads(
+                dscores, vals_c, s, fwd.xv_fulls, rows, touched, k, cd,
+                spec.use_linear, config, extra=gh_pad,
             )
-            if config.reg_factors:
-                g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
-            if spec.use_linear:
-                g_l = dscores * vals_c[:, f]
-                if config.reg_linear:
-                    g_l = g_l + config.reg_linear * rows[f][:, k] * touched
-            else:
-                g_l = jnp.zeros_like(dscores)
-            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        else:
+            g_fulls = []
+            for f in range(f_local):
+                # s − xvs[f] is exact for owned lanes; non-owned lanes
+                # (2-D) produce garbage that the sentinel index /
+                # dropped segment discards — same contract as the FM
+                # body.
+                g_v = (
+                    dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+                    + g_h_loc[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+                )
+                if config.reg_factors:
+                    g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+                if spec.use_linear:
+                    g_l = dscores * vals_c[:, f]
+                    if config.reg_linear:
+                        g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+                else:
+                    g_l = jnp.zeros_like(dscores)
+                g_fulls.append(
+                    jnp.concatenate([g_v, g_l[:, None]], axis=1))
         field_offset = lax.axis_index("feat") * f_local
         if two_d:
             field_offset = field_offset + lax.axis_index("row") * f_pad

@@ -276,22 +276,27 @@ def _reject_collective_dtype(config: TrainConfig, what: str):
 
 
 def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
-                 use_linear: bool, config: TrainConfig):
+                 use_linear: bool, config: TrainConfig, extra=None):
     """The fused g_full construction (``config.gfull_fused``), shared by
-    the single-chip and field-sharded FM bodies so the numerics can
-    never diverge: per field,
+    the single-chip and field-sharded FM/DeepFM bodies so the numerics
+    can never diverge: per field,
 
-        g_full = ds·x·(s1 − mask·xv_full) + rv·rows·touched
+        g_full = (ds·(s1 − mask·xv_full) + extra_f)·x + rv·rows·touched
 
     with ``s1 = [s, lin_on]`` built ONCE — col f<k gives
-    ``ds·x·(s_f − xv_f)`` (the reference's computeGradient rule), col k
-    gives ``ds·x·lin_on`` — the SAME arithmetic as the per-field
-    ``concat([g_v, g_l])`` construction (×1.0 and a select are exact;
-    XLA contraction may still differ by ~1 ULP, tests/test_gfull.py),
-    with no per-field concat copy pass. ``jnp.where`` (not ·mask) so a
+    ``ds·x·(s_f − xv_f)`` (the reference's computeGradient rule, plus
+    the deep head's pullback when ``extra`` is set), col k gives
+    ``ds·x·lin_on`` — the same arithmetic as the per-field
+    ``concat([g_v, g_l])`` construction up to association (the shared
+    ·x factors right-distribute here: one [B, k+1] multiply instead of
+    two; ≤ a few ULP under XLA contraction, tests/test_gfull.py), with
+    no per-field concat copy pass. ``jnp.where`` (not ·mask) so a
     non-finite factor row cannot poison the linear column. ``rv`` is
     the per-column reg vector (factor cols → reg_factors, col k →
-    reg_linear), so every reg split stays column-exact."""
+    reg_linear), so every reg split stays column-exact. ``extra``
+    (DeepFM) is the deep-head pullback as ONE zero-padded
+    [B, F_local, k+1] tensor (col k zero — the head never touches the
+    linear weight), built with a single pad instead of F concats."""
     lin_on = 1.0 if use_linear else 0.0
     s1 = jnp.concatenate(
         [s, jnp.full((dscores.shape[0], 1), lin_on, cd)], axis=1)
@@ -303,8 +308,11 @@ def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
             + [config.reg_linear if use_linear else 0.0], cd)
     g_fulls = []
     for f in range(len(rows)):
-        g = dscores[:, None] * vals_c[:, f : f + 1] * (
+        base = dscores[:, None] * (
             s1 - jnp.where(colmask, xv_fulls[f], jnp.zeros((), cd)))
+        if extra is not None:
+            base = base + extra[:, f]
+        g = base * vals_c[:, f : f + 1]
         if rv is not None:
             g = g + rv * rows[f] * touched[:, None]
         g_fulls.append(g)
@@ -329,8 +337,8 @@ def _reject_gfull(config: TrainConfig, what: str):
     construction (no-silent-fallback rule)."""
     if config.gfull_fused:
         raise ValueError(
-            f"gfull_fused is implemented for the FieldFM fused bodies "
-            f"only, not {what}"
+            f"gfull_fused is implemented for the FieldFM and "
+            f"FieldDeepFM fused bodies, not {what}"
         )
 
 
@@ -735,7 +743,6 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
 
     if type(spec) is not FieldDeepFMSpec:
         raise ValueError("expected a FieldDeepFMSpec")
-    _reject_gfull(config, "the FieldDeepFM body")
     _reject_collective_dtype(config, "the single-chip FieldDeepFM body")
     _reject_score_sharded(config, "the single-chip FieldDeepFM body")
     _check_host_dedup(config)
@@ -768,14 +775,24 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
             compact, params["vw"], aux, cd, gat, ids,
             device_cap=config.compact_cap if config.compact_device else 0,
         )                                           # F × [B, k+1]
-        xvs = [r[:, :k] * vals_c[:, f : f + 1] for f, r in enumerate(rows)]
+        if config.gfull_fused:
+            # Full-width products once, like the FM body's gfull path.
+            xv_fulls = [r * vals_c[:, f : f + 1]
+                        for f, r in enumerate(rows)]
+            xvs = [x[:, :k] for x in xv_fulls]
+        else:
+            xvs = [r[:, :k] * vals_c[:, f : f + 1]
+                   for f, r in enumerate(rows)]
         s = sum(xvs)
         sum_sq = sum(jnp.sum(x * x, axis=1) for x in xvs)
         fm_scores = 0.5 * (jnp.sum(s * s, axis=1) - sum_sq)
         if spec.use_linear:
-            fm_scores = fm_scores + sum(
-                r[:, k] * vals_c[:, f] for f, r in enumerate(rows)
-            )
+            if config.gfull_fused:
+                fm_scores = fm_scores + sum(x[:, k] for x in xv_fulls)
+            else:
+                fm_scores = fm_scores + sum(
+                    r[:, k] * vals_c[:, f] for f, r in enumerate(rows)
+                )
         h = jnp.concatenate(xvs, axis=1)                # [B, F·k]
 
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
@@ -802,21 +819,33 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
         lr = lr_at(step_idx)
         touched = weights > 0
 
-        g_fulls = []
-        for f in range(F):
-            g_v = (
-                dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
-                + g_h[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+        if config.gfull_fused:
+            # The deep-head pullback widened to [B, F, k+1] with ONE
+            # zero pad (col k: the head never touches the linear
+            # weight), then the shared fused construction.
+            gh_pad = jnp.pad(
+                g_h.reshape(-1, F, k), ((0, 0), (0, 0), (0, 1)))
+            g_fulls = _gfull_grads(
+                dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
+                spec.use_linear, config, extra=gh_pad,
             )
-            if config.reg_factors:
-                g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
-            if spec.use_linear:
-                g_l = dscores * vals_c[:, f]
-                if config.reg_linear:
-                    g_l = g_l + config.reg_linear * rows[f][:, k] * touched
-            else:
-                g_l = jnp.zeros_like(dscores)
-            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        else:
+            g_fulls = []
+            for f in range(F):
+                g_v = (
+                    dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+                    + g_h[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+                )
+                if config.reg_factors:
+                    g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+                if spec.use_linear:
+                    g_l = dscores * vals_c[:, f]
+                    if config.reg_linear:
+                        g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+                else:
+                    g_l = jnp.zeros_like(dscores)
+                g_fulls.append(
+                    jnp.concatenate([g_v, g_l[:, None]], axis=1))
         new_vw = _updates_for(
             compact, params["vw"], ids, g_fulls, rows, urows, config,
             sr_base_key, step_idx, lr, aux,

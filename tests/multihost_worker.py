@@ -280,9 +280,55 @@ def main() -> int:
     assert all(np.isfinite(fflosses)), fflosses
     assert np.mean(fflosses[-3:]) < np.mean(fflosses[:3]), fflosses
 
+    # ---- Phase 6: the round-4 scale-out levers across process
+    # boundaries. (a) score_sharded with an fp32 wire is EXACT — same
+    # model/init/data as phase 2, so the loss stream must reproduce
+    # phase 2's up to scalar reassociation (the per-example dscores are
+    # identical; the dscores all_gather and the loss psum are the only
+    # new collectives). (b) the full lever stack (score_sharded + bf16
+    # wire) trains finite and downhill.
+    for tag, lcfg, check_exact in (
+        ("ss_fp32", TrainConfig(learning_rate=0.3, optimizer="sgd",
+                                sparse_update="dedup",
+                                score_sharded=True), True),
+        ("ss_bf16w", TrainConfig(learning_rate=0.3, optimizer="sgd",
+                                 sparse_update="dedup",
+                                 score_sharded=True,
+                                 collective_dtype="bfloat16"), False),
+    ):
+        lstep = make_field_sharded_sgd_step(fspec, lcfg, fmesh)
+        lparams = {
+            k: make_global(v, fmesh, pspecs2[k])
+            for k, v in stack_field_params(
+                fspec, fspec.init(jax.random.key(1)),
+                fmesh.shape["feat"]
+            ).items()
+        }
+        llosses = []
+        for i in range(10):
+            sl = slice(i * b_global, (i + 1) * b_global)
+            fb = pad_field_batch(
+                (fids[sl], fvals[sl], flabels[sl],
+                 np.ones((b_global,), np.float32)),
+                F, fmesh.shape["feat"],
+            )
+            gb = [
+                make_global(a, fmesh, sp)
+                for a, sp in zip(fb, field_batch_specs(fmesh))
+            ]
+            lparams, ll = lstep(lparams, jnp.int32(i), *gb)
+            llosses.append(float(ll))
+        assert all(np.isfinite(llosses)), (tag, llosses)
+        if check_exact:
+            np.testing.assert_allclose(llosses, flosses, rtol=1e-5,
+                                       err_msg=tag)
+        else:
+            assert np.mean(llosses[-3:]) < np.mean(llosses[:3]), (
+                tag, llosses)
+
     print(f"MULTIHOST_OK process={process_id} "
           f"losses={losses}+{flosses}+{plosses}+{dlosses}+{fflosses}"
-          f"+digest={digest}")
+          f"+{llosses}+digest={digest}")
     return 0
 
 

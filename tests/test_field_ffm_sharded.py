@@ -48,9 +48,10 @@ def _batch(rng, b=B):
 
 
 def _run_pair(rng, config, n_feat=8, steps=3, caux_builder=None,
-              n_row=1):
+              n_row=1, spec_kw=None, loss_rel=2e-5, param_rtol=2e-5,
+              param_atol=1e-6):
     ids, vals, labels, weights = _batch(rng)
-    spec = _spec()
+    spec = _spec(**(spec_kw or {}))
     canonical = spec.init(jax.random.key(1))
     single = make_field_ffm_sparse_sgd_step(spec, config)
     mesh = make_field_mesh(n_feat * n_row, n_row=n_row)
@@ -79,12 +80,12 @@ def _run_pair(rng, config, n_feat=8, steps=3, caux_builder=None,
             sp, l2 = sharded(sp, *sargs, caux)
         else:
             sp, l2 = sharded(sp, *sargs)
-        assert float(l1) == pytest.approx(float(l2), rel=2e-5), i
+        assert float(l1) == pytest.approx(float(l2), rel=loss_rel), i
     got = unstack_field_params(spec, jax.device_get(sp))
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
-            rtol=2e-5, atol=1e-6,
+            rtol=param_rtol, atol=param_atol,
         ),
         canonical, got,
     )
@@ -188,13 +189,19 @@ def test_sharded_ffm_2d_device_compact_matches_single_chip(rng):
 
 
 def test_sharded_ffm_2d_uneven_fields_sr(rng):
-    # f_pad padding + dedup_sr's per-(field, row-shard) key streams on
-    # the 2-D mesh (bf16 storage exercises the SR write-back).
+    # f_pad padding + dedup_sr's per-(field, row-shard) SR key streams
+    # on the 2-D mesh, bf16 storage. The streams INTENTIONALLY differ
+    # from the single-chip (step, field) keys for row shards > 0 (noise
+    # must not correlate across chips sharing a field), so the bar here
+    # is bf16-SR-noise closeness — one rounding quantum per update —
+    # not exactness; the fp32 2-D tests above pin the deterministic
+    # math exactly.
     _run_pair(
         rng,
         TrainConfig(learning_rate=0.1, optimizer="sgd",
-                    sparse_update="dedup", reg_factors=1e-4),
-        n_feat=2, n_row=2,
+                    sparse_update="dedup_sr", reg_factors=1e-4),
+        n_feat=2, n_row=2, spec_kw=dict(param_dtype="bfloat16"),
+        loss_rel=3e-3, param_rtol=0.1, param_atol=3e-2,
     )
 
 

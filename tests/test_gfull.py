@@ -217,25 +217,102 @@ def test_gfull_rejected_where_unimplemented(eight_devices):
         num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET)
     with pytest.raises(ValueError, match="gfull_fused"):
         make_field_ffm_sparse_sgd_body(ffm, config)
-    deep = models.FieldDeepFMSpec(
-        num_features=F * BUCKET, rank=2, num_fields=F, bucket=BUCKET,
-        mlp_dims=(8,))
-    with pytest.raises(ValueError, match="gfull_fused"):
-        make_field_deepfm_sparse_step(deep, config)
     flat = models.FMSpec(num_features=100, rank=2)
     with pytest.raises(ValueError, match="gfull_fused"):
         make_sparse_sgd_step(flat, config)
     from fm_spark_tpu.parallel import make_field_mesh
     from fm_spark_tpu.parallel.field_step import (
-        make_field_deepfm_sharded_step,
         make_field_ffm_sharded_body,
     )
 
     mesh = make_field_mesh(4, devices=eight_devices)
     with pytest.raises(ValueError, match="gfull_fused"):
         make_field_ffm_sharded_body(ffm, config, mesh)
-    with pytest.raises(ValueError, match="gfull_fused"):
-        make_field_deepfm_sharded_step(deep, config, mesh)
+
+
+@pytest.mark.parametrize("reg", ["noreg", "both"])
+def test_gfull_deepfm_single_chip(reg):
+    # DeepFM (round 4): the deep-head pullback rides _gfull_grads'
+    # `extra` tensor (one pad, no per-field concat). The shared ·x
+    # right-distributes in the fused form, so the bar is a tight
+    # allclose, not ULP (one extra reassociation per element).
+    deep = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        mlp_dims=(8, 8), init_std=0.1)
+    batches = _batches(np.random.default_rng(5), n=2)
+    base = dict(learning_rate=0.05, optimizer="adam", **REGS[reg])
+
+    def run(gf):
+        step = make_field_deepfm_sparse_step(
+            deep, TrainConfig(**base, gfull_fused=gf))
+        params = deep.init(jax.random.key(11))
+        opt = step.init_opt_state(params)
+        for i, (ids, vals, labels, weights) in enumerate(batches):
+            params, opt, loss = step(
+                params, opt, jnp.int32(i), jnp.asarray(ids),
+                jnp.asarray(vals), jnp.asarray(labels),
+                jnp.asarray(weights))
+        return jax.device_get(params), float(loss)
+
+    p_ref, l_ref = run(False)
+    p_gf, l_gf = run(True)
+    np.testing.assert_allclose(l_ref, l_gf, rtol=1e-6)
+    for f in range(F):
+        np.testing.assert_allclose(
+            p_ref["vw"][f], p_gf["vw"][f], rtol=1e-5, atol=1e-7,
+            err_msg=f"vw[{f}]")
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-7),
+        p_ref["mlp"], p_gf["mlp"])
+
+
+@pytest.mark.parametrize("n_row", [1, 2])
+def test_gfull_deepfm_sharded(eight_devices, n_row):
+    from fm_spark_tpu.parallel import make_field_mesh
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_deepfm_sharded_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+        unstack_field_deepfm_params,
+    )
+
+    n_feat = 4
+    deep = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        mlp_dims=(8,), init_std=0.1)
+    mesh = make_field_mesh(n_feat * n_row, devices=eight_devices,
+                           n_row=n_row)
+    from fm_spark_tpu.parallel import (
+        pad_field_batch,
+        shard_field_batch,
+    )
+
+    batches = _batches(np.random.default_rng(6), n=2)
+    base = dict(learning_rate=0.05, optimizer="adam",
+                reg_factors=1e-3, reg_linear=1e-4)
+
+    def run(gf):
+        step = make_field_deepfm_sharded_step(
+            deep, TrainConfig(**base, gfull_fused=gf), mesh)
+        params = shard_field_deepfm_params(
+            stack_field_deepfm_params(
+                deep, deep.init(jax.random.key(12)), n_feat), mesh)
+        opt = step.init_opt_state(params)
+        for i, batch in enumerate(batches):
+            sb = shard_field_batch(pad_field_batch(batch, F, n_feat),
+                                   mesh)
+            params, opt, loss = step(params, opt, jnp.int32(i), *sb)
+        return (unstack_field_deepfm_params(deep, jax.device_get(params)),
+                float(loss))
+
+    p_ref, l_ref = run(False)
+    p_gf, l_gf = run(True)
+    np.testing.assert_allclose(l_ref, l_gf, rtol=1e-6)
+    for f in range(F):
+        np.testing.assert_allclose(
+            p_ref["vw"][f], p_gf["vw"][f], rtol=1e-5, atol=1e-7,
+            err_msg=f"vw[{f}]")
 
 
 def test_gfull_requires_fused_linear():
