@@ -212,7 +212,7 @@ def inner_main(args):
         # TRANSPOSED-table candidate (PERF.md "transpose" probe: the
         # col layout halves physical table bytes and the cap-gather
         # scan with it; donated scatter measured layout-neutral).
-        variants.insert(1, (
+        variants.insert(2, (
             f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT",
             ("bfloat16", "bfloat16", "col"),
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
@@ -223,7 +223,7 @@ def inner_main(args):
         # shipping/sort, F on-device sorts instead — the variant that
         # composes with 2-D meshes and multi-process scale-out. Measured
         # here so the single-chip cost of the in-step sort is on record.
-        variants.insert(2, (
+        variants.insert(3, (
             f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
             ("bfloat16", "bfloat16", None),
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
@@ -465,8 +465,13 @@ def main():
     ap.add_argument("--attempts", type=int, default=6,
                     help="max child attempts before emitting the error JSON "
                          "(the total deadline usually binds first)")
-    ap.add_argument("--attempt-timeout", type=float, default=600.0,
-                    help="hard wall-clock limit per attempt (seconds)")
+    ap.add_argument("--attempt-timeout", type=float, default=900.0,
+                    help="hard wall-clock limit per attempt (seconds); "
+                         "sized for the 7-variant default sweep (round 2 "
+                         "ran 5 variants inside 600s) — a hung INIT "
+                         "still exits at --init-timeout, and the "
+                         "cumulative-best lines salvage a sweep the "
+                         "limit cuts short")
     ap.add_argument("--total-deadline", type=float, default=1500.0,
                     dest="total_deadline",
                     help="hard wall-clock limit for the WHOLE run incl. "

@@ -84,32 +84,50 @@ def _base_counts(B: int, F: int, k: int, n: int, cap: int,
 def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
                         device_aux: bool = False,
                         psum_dtype: str = "float32",
-                        model: str = "fm") -> dict:
+                        model: str = "fm", n_row: int = 1) -> dict:
     """Exact per-chip work + ICI traffic counts for one step of the
-    1-D field-sharded fused step of ``model`` ('fm' | 'ffm' | 'deepfm').
+    field-sharded fused step of ``model`` ('fm' | 'ffm' | 'deepfm').
     ``cap=0`` = plain (non-compact) path. ``psum_dtype`` is the wire
     dtype of the ACTIVATION collectives (TrainConfig.collective_dtype);
-    ids stay int32 and the batch re-shard fp32. Byte counts per
-    activation collective, by construction (field_step.py):
+    ids stay int32 and the batch re-shard fp32. ``n_row`` > 1 models
+    the 2-D (feat, row) mesh's EXTRA activation collective for FFM (the
+    sel psum over ``row`` that completes the ownership-masked partials;
+    ``n`` is then the feat extent, total chips = n·n_row). Byte counts
+    per activation collective, by construction (field_step.py):
 
     - fm:     psum of (s[B,k], sq[B], lin[B])             → ring·w·B·(k+2)
     - ffm:    + sel all_to_all [B, f_local, F_pad, k]     → w·B·f_local·f_pad·k·recv
+              (+ 2-D: sel psum over row                   → 2(r−1)/r·w·B·f_local·f_pad·k)
               (score psums are 2·[B] — pair, lin)
     - deepfm: fm's psum group + h all_gather [B, f_pad·k] → w·B·f_pad·k·recv
     """
     c = _base_counts(B, F, k, n, cap, device_aux)
     w = _WIRE_BYTES[psum_dtype]
     ici = c["ici"]
+    if n_row > 1 and model == "fm":
+        raise ValueError(
+            "n_row adds no FM activation collective to model (the "
+            "score psums widen their axis set at the same [B, k+2] "
+            "bytes — a ring-factor nuance, not a new term); pass the "
+            "TOTAL chip count as n for a 2-D FM estimate"
+        )
+    row_ring = 2 * (n_row - 1) / n_row if n_row > 1 else 0.0
     if model == "fm":
         ici["psum_scores"] = int(c["ring"] * w * B * (k + 2))
     elif model == "ffm":
-        ici["a2a_sel"] = int(
-            w * B * c["f_local"] * c["f_pad"] * k * c["recv"]
-        )
+        sel_bytes = w * B * c["f_local"] * c["f_pad"] * k
+        ici["a2a_sel"] = int(sel_bytes * c["recv"])
+        if n_row > 1:
+            ici["psum_sel_row"] = int(row_ring * sel_bytes)
         ici["psum_scores"] = int(c["ring"] * w * B * 2)
     elif model == "deepfm":
         ici["psum_scores"] = int(c["ring"] * w * B * (k + 2))
         ici["allgather_h"] = int(w * B * c["f_pad"] * k * c["recv"])
+        if n_row > 1:
+            # The h completion psum runs BEFORE the feat all_gather, on
+            # each chip's [B, f_local·k] block (field_step.py DeepFM
+            # body) — first-order, comparable to allgather_h.
+            ici["psum_h_row"] = int(row_ring * w * B * c["f_local"] * k)
     else:
         raise ValueError(f"unknown model {model!r}")
     ici["total"] = sum(v for kk, v in ici.items() if kk != "total")
@@ -122,7 +140,7 @@ def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
 def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
                       n: int, cap: int = 0, device_aux: bool = False,
                       psum_dtype: str = "float32", model: str = "fm",
-                      score_sharded: bool = False,
+                      score_sharded: bool = False, n_row: int = 1,
                       dispatch_ms: float = 2.5,
                       replicated_score_ms_per_128k: float = 2.0,
                       measured_B: int = 131072,
@@ -155,7 +173,8 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
     B-proportional term.
     """
     costs = field_sharded_costs(B, F, k, n, cap, device_aux,
-                                psum_dtype=psum_dtype, model=model)
+                                psum_dtype=psum_dtype, model=model,
+                                n_row=n_row)
     t1 = B / single_chip_rate
     t_fixed = dispatch_ms / 1e3
     t_rep = replicated_score_ms_per_128k / 1e3 * (B / measured_B)
@@ -178,6 +197,7 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
             "B": B, "F": F, "k": k, "n": n, "cap": cap,
             "device_aux": device_aux, "psum_dtype": psum_dtype,
             "step_model": model, "score_sharded": score_sharded,
+            "n_row": n_row,
             "dispatch_ms": dispatch_ms,
             "replicated_score_ms_per_128k": replicated_score_ms_per_128k,
             "ici_gbps": ici_gbps,
@@ -186,5 +206,6 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
         "t_single_chip_ms": round(t1 * 1e3, 2),
         "t_projected_ms": round(t_n * 1e3, 2),
         "projected_aggregate_samples_per_sec": round(B / t_n),
-        "projected_per_chip_samples_per_sec": round(B / t_n / n),
+        "projected_per_chip_samples_per_sec": round(
+            B / t_n / (n * n_row)),
     }
