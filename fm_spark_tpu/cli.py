@@ -385,46 +385,13 @@ def _make_overflow_guard(tconfig):
     return note_loss, check_poison, fetch_loss
 
 
-def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
-                      eval_source=None, prefetch: int = 0,
-                      row_shards: int = 1, steps_per_call: int = 1,
-                      ckpt_sharded: bool = False):
-    """Training loop on the fused sparse steps (the CTR fast path).
-
-    On one device this is the single-chip fused step; with multiple
-    devices the field-sharded layout (parallel/field_step.py) is used —
-    tables partitioned over chips, all_to_all batch re-shard inside the
-    step. FieldDeepFM additionally carries optax state for its dense
-    head (MLP + bias); pure-SGD models carry an empty dict so the loop
-    and checkpoints have one shape.
-
-    ``steps_per_call > 1`` (single-chip FM/FFM) rolls that many steps
-    into one compiled ``fori_loop`` program over host-stacked batches —
-    bench.py's dispatch amortization for the production loop (PERF.md
-    fact 1). Logging/eval/checkpoint cadence rounds to call boundaries.
-
-    ``ckpt_sharded`` (multi-device field-sharded runs) checkpoints the
-    STACKED SHARDED arrays directly — orbax writes each shard from its
-    owning process, no full-table host gather per save. Sharded
-    checkpoints resume only onto the same mesh layout; the default
-    canonical (per-field-list) layout remains the topology-portable
-    format.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    n = jax.device_count()
-    pc = jax.process_count()
-    cap = _FIELD_CAPS.get(type(spec).__name__)
-    if cap is None:
-        raise SystemExit(
-            f"field_sparse strategy has no capability row for "
-            f"{type(spec).__name__}"
-        )
-    sharded = n > 1
-    is_deepfm = cap.carries_opt
-
-    # ---- validation: every guard reads the capability row -------------
+def _validate_field_caps(spec, tconfig, cap, n, pc, sharded,
+                         row_shards, steps_per_call, ckpt_sharded):
+    """The field_sparse guard block: every request a family's steps
+    cannot serve hard-fails against the capability row (_FIELD_CAPS) —
+    never a silent fallback. Returns ``(compact_sharded, multi)``.
+    Split out of _fit_field_sparse (VERDICT r3: the loop function was
+    accreting validation, placement, resume, and the loop)."""
     if row_shards < 1:
         raise SystemExit(f"--row-shards must be >= 1, got {row_shards}")
     if row_shards > 1 and not (sharded and cap.sharded_2d):
@@ -515,24 +482,23 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 f"count ({n})"
             )
 
-    # ---- state init ---------------------------------------------------
-    canonical = spec.init(jax.random.key(tconfig.seed))
-    opt0 = {}
-    if is_deepfm:
-        from fm_spark_tpu.train import make_optimizer
+    return compact_sharded, multi
 
-        # Dense-head optimizer state only (structure is device-count
-        # independent, so checkpoints resume on any mesh).
-        opt0 = make_optimizer(tconfig).init(
-            {"w0": canonical["w0"], "mlp": canonical["mlp"]}
-        )
-    start = 0
-    if not ckpt_sharded:
-        # Default: checkpoints use the canonical per-field-list layout so
-        # a run can resume on a different device count. (Sharded resume
-        # happens AFTER params are placed on the mesh, below.)
-        canonical, opt0, start = _resume(checkpointer, canonical, opt0,
-                                         batches)
+
+def _place_field_state(spec, tconfig, cap, canonical, opt0, n, pc,
+                       sharded, row_shards, compact_sharded):
+    """Step construction + parameter/batch placement for the
+    field_sparse loop, from the capability row: single-chip or
+    field-sharded (1-D/2-D mesh, single- or multi-process), with the
+    uniform ``(params, opt, i, *b) → (params, opt, loss)`` step shape.
+    Returns ``(step, params, opt, prep, to_canonical, mesh)`` —
+    ``mesh`` is None single-chip. Split out of _fit_field_sparse
+    (VERDICT r3)."""
+    import jax
+    import jax.numpy as jnp
+
+    is_deepfm = cap.carries_opt
+    mesh = None
 
     def adapt(step_pl):
         """Lift a ``(params, i, *b) → (params, loss)`` step into the
@@ -544,7 +510,6 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
 
     host = lambda b: jax.tree_util.tree_map(jnp.asarray, tuple(b))
 
-    # ---- step + placement, from the capability row --------------------
     if sharded:
         from fm_spark_tpu.parallel import (
             make_field_mesh, pad_field_batch, shard_field_batch,
@@ -612,6 +577,78 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         params, opt = canonical, opt0
         prep = host
         to_canonical = lambda p: p
+
+    return step, params, opt, prep, to_canonical, mesh
+
+
+def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
+                      eval_source=None, prefetch: int = 0,
+                      row_shards: int = 1, steps_per_call: int = 1,
+                      ckpt_sharded: bool = False):
+    """Training loop on the fused sparse steps (the CTR fast path).
+
+    On one device this is the single-chip fused step; with multiple
+    devices the field-sharded layout (parallel/field_step.py) is used —
+    tables partitioned over chips, all_to_all batch re-shard inside the
+    step. FieldDeepFM additionally carries optax state for its dense
+    head (MLP + bias); pure-SGD models carry an empty dict so the loop
+    and checkpoints have one shape.
+
+    ``steps_per_call > 1`` (single-chip FM/FFM) rolls that many steps
+    into one compiled ``fori_loop`` program over host-stacked batches —
+    bench.py's dispatch amortization for the production loop (PERF.md
+    fact 1). Logging/eval/checkpoint cadence rounds to call boundaries.
+
+    ``ckpt_sharded`` (multi-device field-sharded runs) checkpoints the
+    STACKED SHARDED arrays directly — orbax writes each shard from its
+    owning process, no full-table host gather per save. Sharded
+    checkpoints resume only onto the same mesh layout; the default
+    canonical (per-field-list) layout remains the topology-portable
+    format.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.device_count()
+    pc = jax.process_count()
+    cap = _FIELD_CAPS.get(type(spec).__name__)
+    if cap is None:
+        raise SystemExit(
+            f"field_sparse strategy has no capability row for "
+            f"{type(spec).__name__}"
+        )
+    sharded = n > 1
+    is_deepfm = cap.carries_opt
+
+    # ---- validation + placement (helpers above) -----------------------
+    compact_sharded, multi = _validate_field_caps(
+        spec, tconfig, cap, n, pc, sharded, row_shards, steps_per_call,
+        ckpt_sharded,
+    )
+
+    # ---- state init ---------------------------------------------------
+    canonical = spec.init(jax.random.key(tconfig.seed))
+    opt0 = {}
+    if is_deepfm:
+        from fm_spark_tpu.train import make_optimizer
+
+        # Dense-head optimizer state only (structure is device-count
+        # independent, so checkpoints resume on any mesh).
+        opt0 = make_optimizer(tconfig).init(
+            {"w0": canonical["w0"], "mlp": canonical["mlp"]}
+        )
+    start = 0
+    if not ckpt_sharded:
+        # Default: checkpoints use the canonical per-field-list layout so
+        # a run can resume on a different device count. (Sharded resume
+        # happens AFTER params are placed on the mesh, below.)
+        canonical, opt0, start = _resume(checkpointer, canonical, opt0,
+                                         batches)
+
+    step, params, opt, prep, to_canonical, mesh = _place_field_state(
+        spec, tconfig, cap, canonical, opt0, n, pc, sharded, row_shards,
+        compact_sharded,
+    )
 
     if ckpt_sharded:
         params, opt, start = _resume(checkpointer, params, opt, batches,
@@ -686,12 +723,14 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         )
         if compact_sharded:
             # F_pad-padding of the aux also belongs in the producer.
+            # compact_sharded guarantees row_shards == 1 (validated
+            # above), so the feat extent is the full device count.
             from fm_spark_tpu.data import MappedBatches
             from fm_spark_tpu.parallel import stack_compact_aux
 
             batches = MappedBatches(
                 batches,
-                lambda b: (*b[:4], stack_compact_aux(b[4], n_feat)),
+                lambda b: (*b[:4], stack_compact_aux(b[4], n)),
             )
     if multi:
         from fm_spark_tpu.data import StackedBatches

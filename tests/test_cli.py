@@ -415,3 +415,9 @@ def test_field_sparse_capability_guards():
                 "--compact-cap", "128", "--sparse-update", "dedup",
                 "--eval-every", "2", "--test-fraction", "0.2"],
                deepfm_kw) == 0
+    # HOST-built compact aux on the sharded (1-D, single-process) FM
+    # step — the DedupAuxBatches→stack_compact_aux producer chain the
+    # round-4 refactor touched; must run clean end-to-end.
+    assert run("g7", "criteo1tb_fm_r64",
+               ["--host-dedup", "--compact-cap", "128",
+                "--sparse-update", "dedup"], fm_kw) == 0
