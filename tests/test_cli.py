@@ -365,16 +365,17 @@ def test_field_sparse_capability_guards():
     — one test per capability column."""
     import pytest
 
-    def run(name, base, extra, small_kw):
+    def run(name, base, extra, small_kw, batch=128):
         small = dataclasses.replace(
             configs_lib.CONFIGS[base], name=name,
             strategy="field_sparse", **small_kw
         )
         configs_lib.CONFIGS[name] = small
+        bs = [] if batch is None else ["--batch-size", str(batch)]
         try:
             return cli.main([
                 "train", "--config", name, "--synthetic", "512",
-                "--steps", "4", "--batch-size", "128", *extra,
+                "--steps", "4", *bs, *extra,
             ])
         finally:
             del configs_lib.CONFIGS[name]
@@ -421,3 +422,12 @@ def test_field_sparse_capability_guards():
     assert run("g7", "criteo1tb_fm_r64",
                ["--host-dedup", "--compact-cap", "128",
                 "--sparse-update", "dedup"], fm_kw) == 0
+    # Round-4 levers end-to-end: bf16 wire + score-sharded on the
+    # sharded FM step, with weak-scaling batch sizing (global batch =
+    # per-chip x 8 fake devices).
+    assert run("g8", "criteo1tb_fm_r64",
+               ["--collective-dtype", "bfloat16", "--score-sharded",
+                "--batch-per-chip", "16"], fm_kw, batch=None) == 0
+    with pytest.raises(SystemExit, match="exclusive"):
+        run("g9", "criteo1tb_fm_r64",
+            ["--batch-per-chip", "16"], fm_kw)

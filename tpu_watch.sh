@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-4 builder utility: poll the flaky TPU attachment; the moment it
+# comes up, run the pending on-chip measurements (bench_micro gfull
+# probe, then the full bench.py sweep with the gfull A/B in slot 2) and
+# write them to tpu_watch_out/. Exits after one successful capture or
+# when the deadline passes. Killed by the builder before round end so
+# it can never collide with the driver's own bench run.
+set -u
+cd "$(dirname "$0")"
+OUT=tpu_watch_out
+mkdir -p "$OUT"
+DEADLINE=$(( $(date +%s) + ${1:-18000} ))   # default 5h
+echo "tpu_watch: start $(date -u +%H:%M:%S), deadline in ${1:-18000}s" >> "$OUT/log"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 240 python -c "import jax; assert jax.devices()" 2>/dev/null; then
+    echo "tpu_watch: attachment UP at $(date -u +%H:%M:%S)" >> "$OUT/log"
+    timeout 900 python bench_micro.py gfull \
+      > "$OUT/gfull_probe.jsonl" 2> "$OUT/gfull_probe.err"
+    echo "tpu_watch: gfull probe rc=$?" >> "$OUT/log"
+    timeout 1700 python bench.py --total-deadline 1500 \
+      > "$OUT/bench_sweep.out" 2> "$OUT/bench_sweep.err"
+    echo "tpu_watch: sweep rc=$? done $(date -u +%H:%M:%S)" >> "$OUT/log"
+    exit 0
+  fi
+  echo "tpu_watch: still down $(date -u +%H:%M:%S)" >> "$OUT/log"
+  sleep 300
+done
+echo "tpu_watch: deadline reached, no attachment" >> "$OUT/log"
+exit 1
