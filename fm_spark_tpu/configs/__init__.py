@@ -179,7 +179,11 @@ CONFIGS = {
             " Multi-chip / multi-host / --row-shards: swap --host-dedup"
             " for --compact-device (the in-step aux build; ~11% slower"
             " on ONE chip, the only form that composes with scale-out —"
-            " PERF.md round 3).",
+            " PERF.md round 3), and add the round-4 levers"
+            " --collective-dtype bfloat16 (halves the dominant ICI"
+            " term; quality cost 1e-5 AUC, QUALITY.md) and"
+            " --score-sharded (exact; removes the replicated score"
+            " math). Weak scaling: size with --batch-per-chip 131072.",
             model="field_fm", dataset="criteo", rank=64, num_fields=39,
             bucket=1 << 18, strategy="field_sparse", num_steps=1_000_000,
             batch_size=1 << 17, learning_rate=0.05, lr_schedule="constant",

@@ -127,9 +127,13 @@ def test_multistep_ffm(rng):
         )
 
 
-def test_multistep_deepfm(rng):
+@pytest.mark.parametrize("compact", [False, True],
+                         ids=["plain", "compact_aux"])
+def test_multistep_deepfm(rng, compact):
     """The DeepFM roll (VERDICT r3 #6): optax state threads through the
-    fori carry — params AND adam moments must match N separate calls."""
+    fori carry — params AND adam moments must match N separate calls
+    (with and without the stacked compact host aux riding the call)."""
+    from fm_spark_tpu.ops.scatter import compact_aux
     from fm_spark_tpu.sparse import (
         make_field_deepfm_multistep,
         make_field_deepfm_sparse_step,
@@ -139,10 +143,16 @@ def test_multistep_deepfm(rng):
         num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
         mlp_dims=(8, 8), init_std=0.1,
     )
-    config = TrainConfig(learning_rate=0.05, lr_schedule="inv_sqrt",
-                         optimizer="adam", reg_factors=1e-3,
-                         reg_linear=1e-4, reg_bias=1e-4)
+    cfg = dict(learning_rate=0.05, lr_schedule="inv_sqrt",
+               optimizer="adam", reg_factors=1e-3,
+               reg_linear=1e-4, reg_bias=1e-4)
+    if compact:
+        cfg.update(sparse_update="dedup", host_dedup=True,
+                   compact_cap=B)
+    config = TrainConfig(**cfg)
     batches = _batches(rng, 2 * N)
+    if compact:
+        batches = [(*b, compact_aux(b[0], B)) for b in batches]
 
     params_s = spec.init(jax.random.key(3))
     params_m = jax.tree_util.tree_map(jnp.copy, params_s)
