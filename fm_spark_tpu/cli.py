@@ -305,6 +305,7 @@ class _FieldCap:
     sharded_device_compact: bool     # in-step compact aux when sharded
     sharded_multiproc: bool          # multi-process pseudo-cluster / pods
     multistep_single: bool           # --steps-per-call fori roll (1 chip)
+    multistep_sharded: bool          # --steps-per-call on the sharded step
     sharded_score: bool              # --score-sharded example-sharded dscores
 
 
@@ -313,20 +314,23 @@ _FIELD_CAPS = {
         single_step=_single_fm_step, sharded_step=_sharded_fm_step,
         carries_opt=False, sharded_2d=True, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=True, sharded_score=True,
+        multistep_single=True, multistep_sharded=True,
+        sharded_score=True,
     ),
     "FieldFFMSpec": _FieldCap(
         single_step=_single_ffm_step, sharded_step=_sharded_ffm_step,
         carries_opt=False, sharded_2d=True, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=True, sharded_score=False,
+        multistep_single=True, multistep_sharded=True,
+        sharded_score=False,
     ),
     "FieldDeepFMSpec": _FieldCap(
         single_step=_single_deepfm_step,
         sharded_step=_sharded_deepfm_step,
         carries_opt=True, sharded_2d=True, sharded_host_compact=False,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=True, sharded_score=False,
+        multistep_single=True, multistep_sharded=False,
+        sharded_score=False,
     ),
 }
 
@@ -461,15 +465,32 @@ def _validate_field_caps(spec, tconfig, cap, n, pc, sharded,
             f"--steps-per-call must be >= 1, got {steps_per_call}"
         )
     multi = steps_per_call > 1
-    if multi and (sharded or not cap.multistep_single):
-        # The sharded steps take mesh-prepped operands, which do not
-        # roll into the fori body. Hard-fail, never silently run
-        # one-by-one. (DeepFM's optax state threads through the carry
-        # since round 4 — make_field_deepfm_multistep.)
-        raise SystemExit(
-            "--steps-per-call > 1 supports the single-chip fused "
-            f"steps only (found {type(spec).__name__}, {n} device(s))"
-        )
+    if multi:
+        if sharded:
+            # The SHARDED roll (round 4): fori inside the shard_map,
+            # FM/FFM only, no host-built aux (its per-batch producer
+            # chain does not stack — compact_device composes instead),
+            # single process (stacked local placement is a follow-on).
+            if not cap.multistep_sharded:
+                raise SystemExit(
+                    "--steps-per-call > 1 on multiple devices is not "
+                    f"supported for {type(spec).__name__}"
+                )
+            if compact_sharded:
+                raise SystemExit(
+                    "--steps-per-call > 1 does not take the host-built "
+                    "compact aux; use --compact-device"
+                )
+            if pc > 1:
+                raise SystemExit(
+                    "--steps-per-call > 1 is single-process for now "
+                    "(stacked multi-host batch placement not wired)"
+                )
+        elif not cap.multistep_single:
+            raise SystemExit(
+                "--steps-per-call > 1 is not supported for "
+                f"{type(spec).__name__} on a single device"
+            )
     if sharded:
         if tconfig.batch_size % n:
             raise SystemExit(
@@ -734,21 +755,43 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             )
     if multi:
         from fm_spark_tpu.data import StackedBatches
-        from fm_spark_tpu.sparse import make_field_sparse_multistep
 
-        # Stacking also runs in the prefetch producer thread. `total`
-        # bounds source consumption so the tail stack pads instead of
-        # reading batches that would never train (exact-resume cursor).
-        batches = StackedBatches(batches, steps_per_call,
-                                 total=tconfig.num_steps - start)
-        if is_deepfm:
+        if sharded:
+            # Pad each batch to F_pad in the producer; ONE compiled
+            # program rolls the m sharded steps (fori inside the
+            # shard_map — parallel.make_field_sharded_multistep),
+            # amortizing per-call dispatch exactly like the single-chip
+            # roll.
+            from fm_spark_tpu.data import MappedBatches
+            from fm_spark_tpu.parallel import (
+                make_field_sharded_multistep,
+                pad_field_batch,
+                shard_field_batch_stacked,
+            )
+
+            n_feat = n // row_shards
+            batches = MappedBatches(
+                batches,
+                lambda b: pad_field_batch(b, spec.num_fields, n_feat),
+            )
+            mstep = make_field_sharded_multistep(spec, tconfig, mesh,
+                                                 steps_per_call)
+            prep = lambda sb: shard_field_batch_stacked(sb, mesh)
+        elif is_deepfm:
             from fm_spark_tpu.sparse import make_field_deepfm_multistep
 
             mstep = make_field_deepfm_multistep(spec, tconfig,
                                                 steps_per_call)
         else:
+            from fm_spark_tpu.sparse import make_field_sparse_multistep
+
             mstep = make_field_sparse_multistep(spec, tconfig,
                                                 steps_per_call)
+        # Stacking runs in the prefetch producer thread. `total` bounds
+        # source consumption so the tail stack pads instead of reading
+        # batches that would never train (exact-resume cursor).
+        batches = StackedBatches(batches, steps_per_call,
+                                 total=tconfig.num_steps - start)
     batches, close_prefetch = wrap_prefetch(batches, prefetch)
     try:
         if multi:
@@ -777,8 +820,11 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 maybe_eval(i, lambda: to_canonical(params), window=m)
                 if checkpointer is not None and checkpointer.due_window(i, m):
                     check_poison()
-                    checkpointer.save(i, to_canonical(params),
-                                      opt_canonical(opt), pipe_state())
+                    # Same layout contract as the per-step loop:
+                    # --ckpt-sharded saves the live sharded arrays (no
+                    # host gather) and records the layout for resume.
+                    checkpointer.save(i, ckpt_params(), ckpt_opt(),
+                                      pipe_state(), extra=ckpt_extra)
         else:
             for i in range(start, tconfig.num_steps):
                 batch = batches.next_batch()

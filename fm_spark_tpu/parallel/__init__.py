@@ -39,10 +39,13 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     make_field_sharded_sgd_body,
     make_field_deepfm_sharded_eval_step,
     make_field_sharded_eval_step,
+    make_field_sharded_multistep,
     make_field_sharded_sgd_step,
     evaluate_field_sharded,
     pad_field_batch,
     shard_field_batch,
+    shard_field_batch_stacked,
+    stacked_field_batch_specs,
     shard_field_batch_local,
     place_compact_aux,
     shard_compact_aux,

@@ -421,11 +421,12 @@ def _field_forward(spec, g, gat, vw, w0, ids, vals, labels, weights,
                 weights=weights, aux=aux, ovf=ovf)
 
 
-def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
-    """Unjitted ``(params, step_idx, ids, vals, labels, weights) →
-    (params, loss)`` over stacked/sharded inputs; same semantics as the
-    single-chip fused body. Exposed unjitted so callers can roll steps
-    into one ``fori_loop`` program (bench.py pattern)."""
+def _make_field_local_step(spec, config: TrainConfig, mesh):
+    """Build the FM sharded LOCAL step (the per-shard function inside
+    the shard_map) plus its layout facts. Shared by the per-step wrapper
+    (:func:`make_field_sharded_sgd_body`) and the multi-step roll
+    (:func:`make_field_sharded_multistep`) so the step math has one
+    definition. Returns ``(local_step, host_compact)``."""
     from fm_spark_tpu.models.field_fm import FieldFMSpec
 
     if type(spec) is not FieldFMSpec:
@@ -598,6 +599,14 @@ def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
             )
         return out, loss
 
+    return local_step, host_compact
+
+
+def make_field_sharded_sgd_body(spec, config: TrainConfig, mesh):
+    """Unjitted ``(params, step_idx, ids, vals, labels, weights) →
+    (params, loss)`` over stacked/sharded inputs; same semantics as the
+    single-chip fused body."""
+    local_step, host_compact = _make_field_local_step(spec, config, mesh)
     if host_compact:
         return jax.shard_map(
             local_step,
@@ -621,6 +630,83 @@ def make_field_sharded_sgd_step(spec, config: TrainConfig, mesh):
     """Jitted field-sharded fused sparse-SGD step; params donated."""
     return jax.jit(
         make_field_sharded_sgd_body(spec, config, mesh), donate_argnums=(0,)
+    )
+
+
+def stacked_field_batch_specs(mesh) -> tuple:
+    """Batch PartitionSpecs for ``[m, ...]``-stacked batches (the
+    sharded multi-step roll): the leading stack axis is replicated, the
+    example axis shards over the mesh exactly as in
+    :func:`field_batch_specs`."""
+    return tuple(P(None, *tuple(sp)) for sp in field_batch_specs(mesh))
+
+
+def shard_field_batch_stacked(stacked, mesh):
+    """Device-place an ``[m, ...]``-stacked batch tuple
+    (data/pipeline.StackedBatches over F_pad-padded batches) for
+    :func:`make_field_sharded_multistep`."""
+    return tuple(
+        jax.device_put(jnp.asarray(x), NamedSharding(mesh, sp))
+        for x, sp in zip(stacked, stacked_field_batch_specs(mesh))
+    )
+
+
+def make_field_sharded_multistep(spec, config: TrainConfig, mesh, n: int):
+    """Roll ``n`` FIELD-SHARDED fused steps into ONE compiled program —
+    the multi-chip form of :func:`fm_spark_tpu.sparse.
+    make_field_sparse_multistep` (round 4). The ``fori_loop`` runs
+    INSIDE the shard_map, so per-call dispatch overhead — the
+    projection model's ``t_fixed``, ~14%% of a strong-scaled 8-chip
+    step at the measured 2.5ms dispatch — is paid once per ``n`` steps;
+    the collectives (all_to_all/psum/all_gather) repeat per iteration
+    inside the single program.
+
+    FM and FFM sharded bodies (pure SGD; no optax carry). The HOST-
+    compact aux does not ride this roll (its producer chain is
+    per-batch; use compact_device, which composes with everything) —
+    rejected at construction. Returns ``mstep(params, step0, m, ids,
+    vals, labels, weights) → (params, last_loss)`` over batches stacked
+    on a leading ``[n, ...]`` axis (place with
+    :func:`shard_field_batch_stacked`); ``m ≤ n`` dynamic, sticky −inf
+    overflow semantics as in the single-chip roll.
+    """
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+
+    if n < 1:
+        raise ValueError(f"steps per call must be >= 1, got {n}")
+    if config.host_dedup or (
+        config.compact_cap > 0 and not config.compact_device
+    ):
+        raise ValueError(
+            "the sharded multistep does not take the host-built "
+            "dedup/compact aux (per-batch producer chain); use "
+            "compact_device=True"
+        )
+    if isinstance(spec, FieldFFMSpec):
+        local_step, _ = _make_ffm_local_step(spec, config, mesh)
+    else:
+        local_step, _ = _make_field_local_step(spec, config, mesh)
+
+    def local_mstep(params, step0, m, ids, vals, labels, weights):
+        def fbody(j, carry):
+            p, prev = carry
+            p, loss = local_step(p, step0 + j, ids[j], vals[j],
+                                 labels[j], weights[j])
+            # Sticky −inf, as in the single-chip roll.
+            return p, jnp.where(jnp.isneginf(prev), prev, loss)
+
+        return lax.fori_loop(0, m, fbody, (params, jnp.float32(0)))
+
+    return jax.jit(
+        jax.shard_map(
+            local_mstep,
+            mesh=mesh,
+            in_specs=(field_param_specs(mesh), P(), P(),
+                      *stacked_field_batch_specs(mesh)),
+            out_specs=(field_param_specs(mesh), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
     )
 
 
@@ -1118,17 +1204,11 @@ def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
             labels, weights)
 
 
-def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
-    """Unjitted field-sharded fused FFM step — config 4's multi-chip
-    layout, on a 1-D ``(feat,)`` or 2-D ``(feat, row)`` mesh (row
-    sharding of each field's bucket dimension — round 4, VERDICT r3
-    #5). Same math as the single-chip
-    :func:`fm_spark_tpu.sparse.make_field_ffm_sparse_sgd_body`
-    (equivalence-tested); tables single-owner per field (and per bucket
-    range on 2-D), one sel ``all_to_all`` — plus, 2-D, one sel ``psum``
-    over ``row`` — instead of table movement. Supports the compact
-    paths: host-built aux (single-process, 1-D) and the device-built
-    aux (composes with 2-D meshes and multi-process)."""
+def _make_ffm_local_step(spec, config: TrainConfig, mesh):
+    """Build the FFM sharded LOCAL step + layout facts (the FFM
+    counterpart of :func:`_make_field_local_step`; shared by the
+    per-step wrapper and the multi-step roll). Returns ``(local_step,
+    host_compact)``."""
     from fm_spark_tpu.models.field_ffm import FieldFFMSpec
     from fm_spark_tpu.sparse import (
         _apply_field_updates,
@@ -1260,6 +1340,21 @@ def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
             )
         return out, loss
 
+    return local_step, host_compact
+
+
+def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
+    """Unjitted field-sharded fused FFM step — config 4's multi-chip
+    layout, on a 1-D ``(feat,)`` or 2-D ``(feat, row)`` mesh (row
+    sharding of each field's bucket dimension — round 4, VERDICT r3
+    #5). Same math as the single-chip
+    :func:`fm_spark_tpu.sparse.make_field_ffm_sparse_sgd_body`
+    (equivalence-tested); tables single-owner per field (and per bucket
+    range on 2-D), one sel ``all_to_all`` — plus, 2-D, one sel ``psum``
+    over ``row`` — instead of table movement. Supports the compact
+    paths: host-built aux (single-process, 1-D) and the device-built
+    aux (composes with 2-D meshes and multi-process)."""
+    local_step, host_compact = _make_ffm_local_step(spec, config, mesh)
     if host_compact:
         return jax.shard_map(
             local_step,

@@ -313,6 +313,50 @@ def test_cli_steps_per_call_rejects_wrong_strategy():
         ])
 
 
+def test_cli_sharded_steps_per_call(tmp_path):
+    """Round 4: --steps-per-call on the SHARDED field_sparse step (the
+    8-fake-device env) — the fori rides inside the shard_map; windowed
+    log cadence; compact_device composes; host aux rejected."""
+    import dataclasses
+
+    from fm_spark_tpu import cli
+    from fm_spark_tpu import configs as configs_lib
+
+    small = dataclasses.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"], name="msh",
+        strategy="field_sparse", bucket=64, num_fields=5, rank=4,
+    )
+    configs_lib.CONFIGS["msh"] = small
+    try:
+        assert cli.main([
+            "train", "--config", "msh", "--synthetic", "2048",
+            "--steps", "10", "--batch-size", "256",
+            "--steps-per-call", "4", "--log-every", "3",
+            "--compact-device", "--compact-cap", "256",
+            "--sparse-update", "dedup_sr",
+            "--collective-dtype", "bfloat16", "--score-sharded",
+        ]) == 0
+        with pytest.raises(SystemExit, match="compact-device"):
+            cli.main([
+                "train", "--config", "msh", "--synthetic", "1024",
+                "--steps", "4", "--batch-size", "256",
+                "--steps-per-call", "2", "--host-dedup",
+                "--compact-cap", "256", "--sparse-update", "dedup",
+            ])
+        # --ckpt-sharded with the sharded roll: the windowed periodic
+        # save must write the SHARDED layout (round-4 review repro: it
+        # used to write canonical, breaking the sharded resume).
+        ckpt = str(tmp_path / "ck")
+        base = ["train", "--config", "msh", "--synthetic", "2048",
+                "--batch-size", "256", "--steps-per-call", "2",
+                "--ckpt-sharded", "--checkpoint-dir", ckpt,
+                "--checkpoint-every", "2", "--log-every", "2"]
+        assert cli.main([*base, "--steps", "4"]) == 0
+        assert cli.main([*base, "--steps", "8"]) == 0  # resumes from 4
+    finally:
+        del configs_lib.CONFIGS["msh"]
+
+
 @pytest.mark.slow
 def test_cli_steps_per_call_deepfm_smoke():
     """DeepFM --steps-per-call runs end-to-end with windowed cadences

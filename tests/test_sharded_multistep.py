@@ -1,0 +1,176 @@
+"""make_field_sharded_multistep: N field-sharded steps in ONE compiled
+program (fori INSIDE the shard_map) ≡ N separate sharded step calls.
+
+The multi-chip form of --steps-per-call (round 4): amortizes the
+projection model's t_fixed dispatch term across the roll. FM and FFM;
+host-built aux rejected (compact_device composes instead).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models
+from fm_spark_tpu.parallel import (
+    make_field_ffm_sharded_step,
+    make_field_mesh,
+    make_field_sharded_multistep,
+    make_field_sharded_sgd_step,
+    pad_field_batch,
+    shard_field_batch,
+    shard_field_batch_stacked,
+    shard_field_params,
+    stack_field_params,
+    unstack_field_params,
+)
+from fm_spark_tpu.train import TrainConfig
+
+F, BUCKET, K, B, N = 5, 32, 4, 64, 4
+
+
+def _batches(rng, n_batches):
+    out = []
+    for _ in range(n_batches):
+        out.append((
+            rng.integers(0, BUCKET, size=(B, F)).astype(np.int32),
+            rng.uniform(0.5, 1.5, size=(B, F)).astype(np.float32),
+            rng.integers(0, 2, B).astype(np.float32),
+            np.ones((B,), np.float32),
+        ))
+    return out
+
+
+def _stack(padded):
+    return tuple(
+        np.stack([b[i] for b in padded], axis=0) for i in range(4)
+    )
+
+
+def _params(spec, n_feat, mesh, key=0):
+    return shard_field_params(
+        stack_field_params(spec, spec.init(jax.random.key(key)), n_feat),
+        mesh,
+    )
+
+
+CONFIGS = {
+    "plain": dict(),
+    "devcompact_levers": dict(sparse_update="dedup_sr",
+                              compact_device=True, compact_cap=B,
+                              collective_dtype="bfloat16",
+                              score_sharded=True, gfull_fused=True),
+}
+
+
+@pytest.mark.parametrize("n_row", [1, 2])
+@pytest.mark.parametrize("which", list(CONFIGS))
+def test_sharded_multistep_matches_per_step(eight_devices, n_row, which):
+    n_feat = 4
+    extra = dict(CONFIGS[which])
+    spec = models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.2, lr_schedule="inv_sqrt",
+                         optimizer="sgd", reg_factors=1e-3,
+                         reg_linear=1e-4, **extra)
+    mesh = make_field_mesh(n_feat * n_row, devices=eight_devices,
+                           n_row=n_row)
+    batches = _batches(np.random.default_rng(0), 2 * N)
+    padded = [pad_field_batch(b, F, n_feat) for b in batches]
+
+    params_s = _params(spec, n_feat, mesh)
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    for i, b in enumerate(padded):
+        params_s, loss_s = step(params_s, jnp.int32(i),
+                                *shard_field_batch(b, mesh))
+
+    params_m = _params(spec, n_feat, mesh)
+    mstep = make_field_sharded_multistep(spec, config, mesh, N)
+    for call in range(2):
+        stacked = shard_field_batch_stacked(
+            _stack(padded[call * N: (call + 1) * N]), mesh)
+        params_m, loss_m = mstep(params_m, jnp.int32(call * N),
+                                 jnp.int32(N), *stacked)
+    np.testing.assert_allclose(float(loss_m), float(loss_s), rtol=1e-6)
+    got_s = unstack_field_params(spec, jax.device_get(params_s))
+    got_m = unstack_field_params(spec, jax.device_get(params_m))
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(got_m["vw"][f], np.float32),
+            np.asarray(got_s["vw"][f], np.float32),
+            rtol=1e-5, atol=1e-6, err_msg=f"field {f}",
+        )
+
+
+def test_sharded_multistep_partial_tail(eight_devices):
+    n_feat = 4
+    spec = models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.2, optimizer="sgd")
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    batches = _batches(np.random.default_rng(1), N)
+    padded = [pad_field_batch(b, F, n_feat) for b in batches]
+    m = 2
+
+    params_s = _params(spec, n_feat, mesh, key=1)
+    step = make_field_sharded_sgd_step(spec, config, mesh)
+    for i, b in enumerate(padded[:m]):
+        params_s, _ = step(params_s, jnp.int32(i),
+                           *shard_field_batch(b, mesh))
+
+    params_m = _params(spec, n_feat, mesh, key=1)
+    mstep = make_field_sharded_multistep(spec, config, mesh, N)
+    params_m, _ = mstep(params_m, jnp.int32(0), jnp.int32(m),
+                        *shard_field_batch_stacked(_stack(padded), mesh))
+    got_s = unstack_field_params(spec, jax.device_get(params_s))
+    got_m = unstack_field_params(spec, jax.device_get(params_m))
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(got_m["vw"][f]), np.asarray(got_s["vw"][f]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_sharded_multistep_ffm(eight_devices):
+    n_feat = 4
+    spec = models.FieldFFMSpec(
+        num_features=F * BUCKET, rank=3, num_fields=F, bucket=BUCKET,
+        init_std=0.1,
+    )
+    config = TrainConfig(learning_rate=0.1, optimizer="sgd",
+                         sparse_update="dedup")
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    batches = _batches(np.random.default_rng(2), N)
+    padded = [pad_field_batch(b, F, n_feat) for b in batches]
+
+    params_s = _params(spec, n_feat, mesh, key=2)
+    step = make_field_ffm_sharded_step(spec, config, mesh)
+    for i, b in enumerate(padded):
+        params_s, _ = step(params_s, jnp.int32(i),
+                           *shard_field_batch(b, mesh))
+
+    params_m = _params(spec, n_feat, mesh, key=2)
+    mstep = make_field_sharded_multistep(spec, config, mesh, N)
+    params_m, _ = mstep(params_m, jnp.int32(0), jnp.int32(N),
+                        *shard_field_batch_stacked(_stack(padded), mesh))
+    got_s = unstack_field_params(spec, jax.device_get(params_s))
+    got_m = unstack_field_params(spec, jax.device_get(params_m))
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(got_m["vw"][f]), np.asarray(got_s["vw"][f]),
+            rtol=2e-5, atol=1e-6,
+        )
+
+
+def test_sharded_multistep_rejects_host_aux(eight_devices):
+    spec = models.FieldFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET)
+    mesh = make_field_mesh(4, devices=eight_devices)
+    with pytest.raises(ValueError, match="host-built"):
+        make_field_sharded_multistep(
+            spec, TrainConfig(optimizer="sgd", sparse_update="dedup",
+                              host_dedup=True, compact_cap=B), mesh, 2)
