@@ -656,7 +656,7 @@ def make_field_sharded_multistep(spec, config: TrainConfig, mesh, n: int):
     the multi-chip form of :func:`fm_spark_tpu.sparse.
     make_field_sparse_multistep` (round 4). The ``fori_loop`` runs
     INSIDE the shard_map, so per-call dispatch overhead — the
-    projection model's ``t_fixed``, ~14%% of a strong-scaled 8-chip
+    projection model's ``t_fixed``, ~14% of a strong-scaled 8-chip
     step at the measured 2.5ms dispatch — is paid once per ``n`` steps;
     the collectives (all_to_all/psum/all_gather) repeat per iteration
     inside the single program.
