@@ -469,8 +469,9 @@ def _validate_field_caps(spec, tconfig, cap, n, pc, sharded,
         if sharded:
             # The SHARDED roll (round 4): fori inside the shard_map,
             # FM/FFM only, no host-built aux (its per-batch producer
-            # chain does not stack — compact_device composes instead),
-            # single process (stacked local placement is a follow-on).
+            # chain does not stack — compact_device composes instead);
+            # multi-process rides shard_field_batch_stacked_local
+            # (phase 7 of the pseudo-cluster test).
             if not cap.multistep_sharded:
                 raise SystemExit(
                     "--steps-per-call > 1 on multiple devices is not "
@@ -481,11 +482,7 @@ def _validate_field_caps(spec, tconfig, cap, n, pc, sharded,
                     "--steps-per-call > 1 does not take the host-built "
                     "compact aux; use --compact-device"
                 )
-            if pc > 1:
-                raise SystemExit(
-                    "--steps-per-call > 1 is single-process for now "
-                    "(stacked multi-host batch placement not wired)"
-                )
+
         elif not cap.multistep_single:
             raise SystemExit(
                 "--steps-per-call > 1 is not supported for "
@@ -776,7 +773,17 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
             )
             mstep = make_field_sharded_multistep(spec, tconfig, mesh,
                                                  steps_per_call)
-            prep = lambda sb: shard_field_batch_stacked(sb, mesh)
+            if pc > 1:
+                # Each process stacks its LOCAL row slices; the global
+                # stacked arrays assemble across hosts.
+                from fm_spark_tpu.parallel import (
+                    shard_field_batch_stacked_local,
+                )
+
+                prep = lambda sb: shard_field_batch_stacked_local(
+                    sb, mesh)
+            else:
+                prep = lambda sb: shard_field_batch_stacked(sb, mesh)
         elif is_deepfm:
             from fm_spark_tpu.sparse import make_field_deepfm_multistep
 

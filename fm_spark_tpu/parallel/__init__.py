@@ -45,6 +45,7 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     pad_field_batch,
     shard_field_batch,
     shard_field_batch_stacked,
+    shard_field_batch_stacked_local,
     stacked_field_batch_specs,
     shard_field_batch_local,
     place_compact_aux,

@@ -651,6 +651,22 @@ def shard_field_batch_stacked(stacked, mesh):
     )
 
 
+def shard_field_batch_stacked_local(stacked, mesh):
+    """Multi-host placement of an ``[m, ...]``-stacked batch: each
+    PROCESS supplies only its row slice of every stacked step (the
+    stacked form of :func:`shard_field_batch_local` — same leading-axis
+    replication, example axis assembled across hosts without
+    replication)."""
+    import numpy as np
+
+    return tuple(
+        jax.make_array_from_process_local_data(
+            NamedSharding(mesh, sp), np.asarray(x)
+        )
+        for x, sp in zip(stacked, stacked_field_batch_specs(mesh))
+    )
+
+
 def make_field_sharded_multistep(spec, config: TrainConfig, mesh, n: int):
     """Roll ``n`` FIELD-SHARDED fused steps into ONE compiled program —
     the multi-chip form of :func:`fm_spark_tpu.sparse.

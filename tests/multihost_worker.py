@@ -326,9 +326,55 @@ def main() -> int:
             assert np.mean(llosses[-3:]) < np.mean(llosses[:3]), (
                 tag, llosses)
 
+    # ---- Phase 7: the SHARDED steps-per-call roll across process
+    # boundaries — fori inside the shard_map with cross-process
+    # collectives repeating per iteration, batches assembled from
+    # per-process stacked row slices (shard_field_batch_stacked_local).
+    # Same model/init/data as phase 2, plain config → the roll's final
+    # loss must reproduce the per-step stream's.
+    from fm_spark_tpu.parallel import (
+        make_field_sharded_multistep,
+        shard_field_batch_stacked_local,
+    )
+
+    rcfg = TrainConfig(learning_rate=0.3, optimizer="sgd",
+                       sparse_update="dedup")
+    rstep = make_field_sharded_multistep(fspec, rcfg, fmesh, 5)
+    rparams = {
+        k: make_global(v, fmesh, pspecs2[k])
+        for k, v in stack_field_params(
+            fspec, fspec.init(jax.random.key(1)), fmesh.shape["feat"]
+        ).items()
+    }
+    rlosses = []
+    for call in range(2):
+        stacked = []
+        for i in range(call * 5, call * 5 + 5):
+            sl = slice(i * b_global, (i + 1) * b_global)
+            stacked.append(pad_field_batch(
+                (fids[sl], fvals[sl], flabels[sl],
+                 np.ones((b_global,), np.float32)),
+                F, fmesh.shape["feat"],
+            ))
+        # Per-process local row slices of each stacked step.
+        per = b_global // num_processes
+        lo, hi = process_id * per, (process_id + 1) * per
+        local = tuple(
+            np.stack([b[i][lo:hi] for b in stacked], axis=0)
+            for i in range(4)
+        )
+        gb = shard_field_batch_stacked_local(local, fmesh)
+        rparams, rl = rstep(rparams, jnp.int32(call * 5), jnp.int32(5),
+                            *gb)
+        rlosses.append(float(rl))
+    assert all(np.isfinite(rlosses)), rlosses
+    # The roll's last loss = the per-step stream's loss at step 10
+    # (phase 2 ran the same 10 batches on the same init).
+    np.testing.assert_allclose(rlosses[-1], flosses[-1], rtol=1e-5)
+
     print(f"MULTIHOST_OK process={process_id} "
           f"losses={losses}+{flosses}+{plosses}+{dlosses}+{fflosses}"
-          f"+{llosses}+digest={digest}")
+          f"+{llosses}+{rlosses}+digest={digest}")
     return 0
 
 
