@@ -329,7 +329,7 @@ _FIELD_CAPS = {
         sharded_step=_sharded_deepfm_step,
         carries_opt=True, sharded_2d=True, sharded_host_compact=False,
         sharded_device_compact=True, sharded_multiproc=True,
-        multistep_single=True, multistep_sharded=False,
+        multistep_single=True, multistep_sharded=True,
         sharded_score=False,
     ),
 }
@@ -467,11 +467,12 @@ def _validate_field_caps(spec, tconfig, cap, n, pc, sharded,
     multi = steps_per_call > 1
     if multi:
         if sharded:
-            # The SHARDED roll (round 4): fori inside the shard_map,
-            # FM/FFM only, no host-built aux (its per-batch producer
-            # chain does not stack — compact_device composes instead);
-            # multi-process rides shard_field_batch_stacked_local
-            # (phase 7 of the pseudo-cluster test).
+            # The SHARDED roll (round 4): the fori rides inside the
+            # shard_map for FM/FFM, and in the outer jit around it for
+            # DeepFM (the optax carry). No host-built aux (its
+            # per-batch producer chain does not stack — compact_device
+            # composes instead); multi-process rides
+            # shard_field_batch_stacked_local (pseudo-cluster phase 7).
             if not cap.multistep_sharded:
                 raise SystemExit(
                     "--steps-per-call > 1 on multiple devices is not "
@@ -755,12 +756,11 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
 
         if sharded:
             # Pad each batch to F_pad in the producer; ONE compiled
-            # program rolls the m sharded steps (fori inside the
-            # shard_map — parallel.make_field_sharded_multistep),
-            # amortizing per-call dispatch exactly like the single-chip
-            # roll.
+            # program rolls the m sharded steps, amortizing per-call
+            # dispatch exactly like the single-chip roll.
             from fm_spark_tpu.data import MappedBatches
             from fm_spark_tpu.parallel import (
+                make_field_deepfm_sharded_multistep,
                 make_field_sharded_multistep,
                 pad_field_batch,
                 shard_field_batch_stacked,
@@ -771,8 +771,13 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
                 batches,
                 lambda b: pad_field_batch(b, spec.num_fields, n_feat),
             )
-            mstep = make_field_sharded_multistep(spec, tconfig, mesh,
-                                                 steps_per_call)
+            if is_deepfm:
+                mstep = make_field_deepfm_sharded_multistep(
+                    spec, tconfig, mesh, steps_per_call)
+            else:
+                mstep = make_field_sharded_multistep(spec, tconfig,
+                                                     mesh,
+                                                     steps_per_call)
             if pc > 1:
                 # Each process stacks its LOCAL row slices; the global
                 # stacked arrays assemble across hosts.

@@ -40,6 +40,7 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     make_field_deepfm_sharded_eval_step,
     make_field_sharded_eval_step,
     make_field_sharded_multistep,
+    make_field_deepfm_sharded_multistep,
     make_field_sharded_sgd_step,
     evaluate_field_sharded,
     pad_field_batch,

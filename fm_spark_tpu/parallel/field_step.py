@@ -633,6 +633,23 @@ def make_field_sharded_sgd_step(spec, config: TrainConfig, mesh):
     )
 
 
+def _check_sharded_multistep(config: TrainConfig, n: int):
+    """Shared guards for the sharded rolls (single definition across
+    the FM/FFM and DeepFM multistep factories): positive step count,
+    and no host-built aux (its per-batch producer chain does not stack
+    — compact_device composes with the roll instead)."""
+    if n < 1:
+        raise ValueError(f"steps per call must be >= 1, got {n}")
+    if config.host_dedup or (
+        config.compact_cap > 0 and not config.compact_device
+    ):
+        raise ValueError(
+            "the sharded multistep does not take the host-built "
+            "dedup/compact aux (per-batch producer chain); use "
+            "compact_device=True"
+        )
+
+
 def stacked_field_batch_specs(mesh) -> tuple:
     """Batch PartitionSpecs for ``[m, ...]``-stacked batches (the
     sharded multi-step roll): the leading stack axis is replicated, the
@@ -688,16 +705,7 @@ def make_field_sharded_multistep(spec, config: TrainConfig, mesh, n: int):
     """
     from fm_spark_tpu.models.field_ffm import FieldFFMSpec
 
-    if n < 1:
-        raise ValueError(f"steps per call must be >= 1, got {n}")
-    if config.host_dedup or (
-        config.compact_cap > 0 and not config.compact_device
-    ):
-        raise ValueError(
-            "the sharded multistep does not take the host-built "
-            "dedup/compact aux (per-batch producer chain); use "
-            "compact_device=True"
-        )
+    _check_sharded_multistep(config, n)
     if isinstance(spec, FieldFFMSpec):
         local_step, _ = _make_ffm_local_step(spec, config, mesh)
     else:
@@ -832,9 +840,10 @@ def shard_field_deepfm_params(stacked: dict, mesh) -> dict:
     return out
 
 
-def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
-    """Field-sharded fused DeepFM step (1-D ``feat`` or 2-D
-    ``(feat, row)`` mesh).
+def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
+    """Field-sharded fused DeepFM step builder (1-D ``feat`` or 2-D
+    ``(feat, row)`` mesh) — returns ``(apply_one, init_opt_state)``,
+    both unjitted.
 
     Embedding tables are single-owner per field exactly as in the FM
     step (same shared forward — :func:`_field_forward` — so the 2-D
@@ -853,8 +862,6 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     weights) → (params, opt_state, loss)`` with ``step.init_opt_state``;
     params enter via :func:`shard_field_deepfm_params`.
     """
-    import functools
-
     import optax
 
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
@@ -1030,8 +1037,11 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
     def init_opt_state(params):
         return dense_opt.init(dense_subtree(params))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def _step(params, opt_state, step_idx, ids, vals, labels, weights):
+    def apply_one(params, opt_state, step_idx, ids, vals, labels,
+                  weights):
+        """One UNJITTED sharded step incl. the replicated dense optax
+        update — jitted directly by the per-step wrapper, fori-rolled by
+        :func:`make_field_deepfm_sharded_multistep`."""
         new_vw, g_dense, loss = sharded(params, step_idx, ids, vals,
                                         labels, weights)
         if config.reg_bias:
@@ -1051,12 +1061,61 @@ def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
             loss,
         )
 
+    return apply_one, init_opt_state
+
+
+def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
+    """Jitted field-sharded DeepFM step (see
+    :func:`_make_deepfm_sharded_one_step`); params + opt donated;
+    ``step.init_opt_state`` as usual."""
+    import functools
+
+    apply_one, init_opt_state = _make_deepfm_sharded_one_step(
+        spec, config, mesh
+    )
+    _step = functools.partial(jax.jit, donate_argnums=(0, 1))(apply_one)
+
     def step(params, opt_state, step_idx, ids, vals, labels, weights):
         return _step(params, opt_state, step_idx, ids, vals, labels,
                      weights)
 
     step.init_opt_state = init_opt_state
     return step
+
+
+def make_field_deepfm_sharded_multistep(spec, config: TrainConfig, mesh,
+                                        n: int):
+    """Roll ``n`` field-sharded DeepFM steps into ONE compiled program
+    — the fori runs in the OUTER jit around the shard_map'd hybrid step,
+    threading the dense head's optax state through the carry (the
+    sharded analog of :func:`fm_spark_tpu.sparse.
+    make_field_deepfm_multistep`). Same dispatch-amortization rationale
+    as :func:`make_field_sharded_multistep`; same host-aux rejection.
+    Returns ``mstep(params, opt_state, step0, m, ids, vals, labels,
+    weights) → (params, opt_state, last_loss)`` over stacked batches
+    placed by :func:`shard_field_batch_stacked`(_local);
+    ``mstep.init_opt_state`` as usual."""
+    import functools
+
+    _check_sharded_multistep(config, n)
+    apply_one, init_opt_state = _make_deepfm_sharded_one_step(
+        spec, config, mesh
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def mstep(params, opt_state, step0, m, ids, vals, labels, weights):
+        def fbody(j, carry):
+            p, o, prev = carry
+            p, o, loss = apply_one(p, o, step0 + j, ids[j], vals[j],
+                                   labels[j], weights[j])
+            return p, o, jnp.where(jnp.isneginf(prev), prev, loss)
+
+        return lax.fori_loop(
+            0, m, fbody, (params, opt_state, jnp.float32(0))
+        )
+
+    mstep.init_opt_state = init_opt_state
+    return mstep
 
 
 # ---------------------------------------------------------------- FFM

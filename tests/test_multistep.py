@@ -355,6 +355,21 @@ def test_cli_sharded_steps_per_call(tmp_path):
         assert cli.main([*base, "--steps", "8"]) == 0  # resumes from 4
     finally:
         del configs_lib.CONFIGS["msh"]
+    # DeepFM sharded roll (optax carry through the outer-jit fori).
+    dsmall = dataclasses.replace(
+        configs_lib.CONFIGS["criteo1tb_deepfm"], name="mshd",
+        strategy="field_sparse", bucket=64, num_fields=5, rank=4,
+        mlp_dims=(8, 8),
+    )
+    configs_lib.CONFIGS["mshd"] = dsmall
+    try:
+        assert cli.main([
+            "train", "--config", "mshd", "--synthetic", "2048",
+            "--steps", "8", "--batch-size", "256",
+            "--steps-per-call", "4", "--log-every", "3",
+        ]) == 0
+    finally:
+        del configs_lib.CONFIGS["mshd"]
 
 
 @pytest.mark.slow

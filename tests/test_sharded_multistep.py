@@ -174,3 +174,60 @@ def test_sharded_multistep_rejects_host_aux(eight_devices):
         make_field_sharded_multistep(
             spec, TrainConfig(optimizer="sgd", sparse_update="dedup",
                               host_dedup=True, compact_cap=B), mesh, 2)
+
+
+def test_sharded_multistep_deepfm(eight_devices):
+    """The DeepFM sharded roll: optax state through the outer-jit fori
+    around the shard_map — params, mlp, AND moments match per-step."""
+    from fm_spark_tpu.parallel import make_field_deepfm_sharded_multistep
+    from fm_spark_tpu.parallel.field_step import (
+        make_field_deepfm_sharded_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+        unstack_field_deepfm_params,
+    )
+
+    n_feat = 4
+    deep = models.FieldDeepFMSpec(
+        num_features=F * BUCKET, rank=K, num_fields=F, bucket=BUCKET,
+        mlp_dims=(8,), init_std=0.1)
+    mesh = make_field_mesh(n_feat, devices=eight_devices)
+    config = TrainConfig(learning_rate=0.05, optimizer="adam",
+                         reg_factors=1e-3, reg_linear=1e-4,
+                         reg_bias=1e-4)
+    batches = _batches(np.random.default_rng(3), N)
+    padded = [pad_field_batch(b, F, n_feat) for b in batches]
+
+    def dparams():
+        return shard_field_deepfm_params(
+            stack_field_deepfm_params(
+                deep, deep.init(jax.random.key(4)), n_feat), mesh)
+
+    params_s = dparams()
+    step = make_field_deepfm_sharded_step(deep, config, mesh)
+    opt_s = step.init_opt_state(params_s)
+    for i, b in enumerate(padded):
+        params_s, opt_s, loss_s = step(params_s, opt_s, jnp.int32(i),
+                                       *shard_field_batch(b, mesh))
+
+    params_m = dparams()
+    mstep = make_field_deepfm_sharded_multistep(deep, config, mesh, N)
+    opt_m = mstep.init_opt_state(params_m)
+    params_m, opt_m, loss_m = mstep(
+        params_m, opt_m, jnp.int32(0), jnp.int32(N),
+        *shard_field_batch_stacked(_stack(padded), mesh))
+    np.testing.assert_allclose(float(loss_m), float(loss_s), rtol=1e-6)
+    got_s = unstack_field_deepfm_params(deep, jax.device_get(params_s))
+    got_m = unstack_field_deepfm_params(deep, jax.device_get(params_m))
+    for f in range(F):
+        np.testing.assert_allclose(
+            np.asarray(got_m["vw"][f]), np.asarray(got_s["vw"][f]),
+            rtol=1e-5, atol=1e-6, err_msg=f"vw[{f}]")
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        got_m["mlp"], got_s["mlp"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        jax.device_get(opt_m), jax.device_get(opt_s))
