@@ -1,0 +1,402 @@
+"""Field-sharded DeepFM: params layout, the hybrid step, its roll, eval.
+
+Split out of ``parallel/field_step.py`` (round 4 — the module carried
+three model families); pure move, no behavior change. The shared layout
+and FM machinery stay in :mod:`fm_spark_tpu.parallel.field_step`, which
+re-exports this module's public names so every existing import path
+keeps working. Cross-module helpers are referenced through the module
+object (``_fs``) so the field_step↔deepfm_step import cycle resolves at call
+time, not import time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fm_spark_tpu.ops import losses as losses_lib
+from fm_spark_tpu.parallel import field_step as _fs
+from fm_spark_tpu.train import TrainConfig
+
+# ---------------------------------------------------------------- DeepFM
+
+
+def stack_field_deepfm_params(spec, params, n_feat: int) -> dict:
+    """Per-field list → stacked layout, keeping the dense head."""
+    stacked = _fs.stack_field_params(
+        spec._field_fm_spec(), {"w0": params["w0"], "vw": params["vw"]},
+        n_feat,
+    )
+    stacked["mlp"] = params["mlp"]
+    return stacked
+
+
+def unstack_field_deepfm_params(spec, stacked: dict) -> dict:
+    out = _fs.unstack_field_params(spec._field_fm_spec(),
+                               {"w0": stacked["w0"], "vw": stacked["vw"]})
+    out["mlp"] = stacked["mlp"]
+    return out
+
+
+def shard_field_deepfm_params(stacked: dict, mesh) -> dict:
+    """vw field-sharded over ``feat`` (and, 2-D, bucket rows over
+    ``row``); the dense head replicated."""
+    vw_spec = _fs.field_param_specs(mesh)["vw"]
+    out = {
+        "w0": jax.device_put(stacked["w0"], NamedSharding(mesh, P())),
+        "vw": jax.device_put(stacked["vw"], NamedSharding(mesh, vw_spec)),
+        "mlp": jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+            stacked["mlp"],
+        ),
+    }
+    return out
+
+
+def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
+    """Field-sharded fused DeepFM step builder (1-D ``feat`` or 2-D
+    ``(feat, row)`` mesh) — returns ``(apply_one, init_opt_state)``,
+    both unjitted.
+
+    Embedding tables are single-owner per field exactly as in the FM
+    step (same shared forward — :func:`_field_forward` — so the 2-D
+    row-ownership masking and the device-built compact aux compose
+    unchanged); the deep head additionally needs the FULL ``h =
+    concat(xv)`` on every chip: one ``psum`` over ``row`` (2-D only —
+    each row shard holds ownership-masked partial columns) and one
+    ``all_gather`` of the local xv columns over ``feat`` ([B, F·k]
+    activations — the tables still never move). Every chip then runs
+    the identical MLP forward/backward on replicated weights (MLP FLOPs
+    are negligible next to the index ops, PERF.md fact 4), so the dense
+    gradient is replicated by construction and one optax update outside
+    the shard_map keeps the head in sync.
+
+    Returns ``step(params, opt_state, step_idx, ids, vals, labels,
+    weights) → (params, opt_state, loss)`` with ``step.init_opt_state``;
+    params enter via :func:`shard_field_deepfm_params`.
+    """
+    import optax
+
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.sparse import (
+        _apply_field_updates,
+        _check_host_dedup,
+        _collective_dtype,
+        _compact_apply_all,
+        _fold_overflow,
+        _gather_fn,
+        _lr_at,
+        _reject_host_aux,
+        _sr_base_key,
+    )
+    from fm_spark_tpu.train import make_optimizer
+
+    if type(spec) is not FieldDeepFMSpec:
+        raise ValueError("expected a FieldDeepFMSpec")
+    from fm_spark_tpu.sparse import _reject_score_sharded
+
+    _reject_score_sharded(config, "the field-sharded DeepFM step")
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
+        raise ValueError(
+            "field-sharded DeepFM runs on a ('feat',) or ('feat', 'row') "
+            "mesh (use make_field_mesh)"
+        )
+    # Device-built compact aux composes here exactly as in the FM step
+    # (the deep head touches activations, not tables); the HOST aux does
+    # not ride this step — reject it rather than silently ignore.
+    _check_host_dedup(config)
+    device_cap = config.compact_cap if config.compact_device else 0
+    if config.host_dedup:
+        # _check_host_dedup guarantees any compact_cap without
+        # compact_device implies host_dedup, so this one test covers
+        # every host-aux request.
+        _reject_host_aux(config, "the field-sharded DeepFM step")
+    g = _fs._mesh_geometry(spec, mesh)
+    wire = _collective_dtype(config)
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    cd = spec.cdtype
+    k = spec.rank
+    F = spec.num_fields
+    f_pad, f_local = g["f_pad"], g["f_local"]
+    two_d = g["two_d"]
+    sr_base_key = _sr_base_key(config)
+    lr_at = _lr_at(config)
+    gat = _gather_fn(config)
+    dense_opt = make_optimizer(config)
+
+    pspecs = field_deepfm_param_specs(spec, mesh)
+    mlp_specs = pspecs["mlp"]
+
+    def local_step(params, step_idx, ids, vals, labels, weights):
+        vw = params["vw"]
+        w0 = params["w0"]
+        mlp = params["mlp"]
+        # Shared forward: batch re-shard, (2-D) ownership masking,
+        # optional in-step compact aux, one psum of the partial sums.
+        # add_bias=False — the bias rides the dense head's vjp below.
+        fwd = _fs._field_forward(
+            spec, g, gat, vw, w0, ids, vals, labels, weights,
+            device_cap=device_cap, add_bias=False, psum_dtype=wire,
+            gfull=config.gfull_fused,
+        )
+        fm_scores, s, xvs, rows = fwd.scores, fwd.s, fwd.xvs, fwd.rows
+        vals_c, uidx, urows = fwd.vals_c, fwd.uidx, fwd.urows
+        labels, weights, aux, ovf = (fwd.labels, fwd.weights, fwd.aux,
+                                     fwd.ovf)
+
+        # Deep head input: local xv columns — partial on a 2-D mesh
+        # (ownership-masked), completed by one psum over `row` — then
+        # gathered into global field order ([B, f_pad·k], padding
+        # columns zero) and trimmed to the MLP's F·k input. The h
+        # collectives ride the wire dtype too (h is the DeepFM step's
+        # biggest activation transfer).
+        h_local = jnp.concatenate(xvs, axis=1)
+        if wire is not None:
+            h_local = h_local.astype(wire)
+        if two_d:
+            h_local = lax.psum(h_local, "row")
+        h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
+        h = h_full[:, : F * k].astype(cd)
+
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+
+        def head_loss(dense, h_in):
+            sc = fm_scores + spec.deep_scores(dense["mlp"], h_in)
+            if spec.use_bias:
+                sc = sc + dense["w0"].astype(cd)
+            per = per_example_loss(sc, labels) * weights
+            return jnp.sum(per) / wsum, sc
+
+        (loss, scores), vjp = jax.vjp(head_loss, {"w0": w0, "mlp": mlp}, h)
+        g_dense, g_h = vjp((jnp.ones_like(loss), jnp.zeros_like(scores)))
+
+        def batch_loss(sc):
+            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
+
+        dscores = jax.grad(batch_loss)(scores)
+        lr = lr_at(step_idx)
+        touched = weights > 0
+
+        # This chip's slice of the deep pullback, padded back to f_pad·k
+        # so padding fields see zero deep grad.
+        g_h_pad = jnp.pad(g_h, ((0, 0), (0, f_pad * k - F * k)))
+        col0 = lax.axis_index("feat") * (f_local * k)
+        g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0, f_local * k,
+                                           axis=1)
+
+        if config.gfull_fused:
+            from fm_spark_tpu.sparse import _gfull_grads
+
+            gh_pad = jnp.pad(
+                g_h_loc.reshape(-1, f_local, k),
+                ((0, 0), (0, 0), (0, 1)))
+            g_fulls = _gfull_grads(
+                dscores, vals_c, s, fwd.xv_fulls, rows, touched, k, cd,
+                spec.use_linear, config, extra=gh_pad,
+            )
+        else:
+            g_fulls = []
+            for f in range(f_local):
+                # s − xvs[f] is exact for owned lanes; non-owned lanes
+                # (2-D) produce garbage that the sentinel index /
+                # dropped segment discards — same contract as the FM
+                # body.
+                g_v = (
+                    dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
+                    + g_h_loc[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
+                )
+                if config.reg_factors:
+                    g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
+                if spec.use_linear:
+                    g_l = dscores * vals_c[:, f]
+                    if config.reg_linear:
+                        g_l = g_l + config.reg_linear * rows[f][:, k] * touched
+                else:
+                    g_l = jnp.zeros_like(dscores)
+                g_fulls.append(
+                    jnp.concatenate([g_v, g_l[:, None]], axis=1))
+        field_offset = lax.axis_index("feat") * f_local
+        if two_d:
+            field_offset = field_offset + lax.axis_index("row") * f_pad
+        if device_cap > 0:
+            new_slices = _compact_apply_all(
+                [vw[f] for f in range(f_local)], g_fulls, urows, config,
+                sr_base_key, step_idx, lr, aux,
+                field_offset=field_offset,
+            )
+            loss = _fold_overflow(
+                loss, lax.pmax(ovf, g["score_axes"]), config
+            )
+        else:
+            new_slices = _apply_field_updates(
+                [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
+                config, sr_base_key, step_idx, lr,
+                field_offset=field_offset,
+            )
+        return jnp.stack(new_slices, axis=0), g_dense, loss
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(pspecs, P(), *_fs.field_batch_specs(mesh)),
+        out_specs=(pspecs["vw"],
+                   {"w0": P(), "mlp": mlp_specs}, P()),
+        check_vma=False,
+    )
+
+    def dense_subtree(params):
+        return {"w0": params["w0"], "mlp": params["mlp"]}
+
+    def init_opt_state(params):
+        return dense_opt.init(dense_subtree(params))
+
+    def apply_one(params, opt_state, step_idx, ids, vals, labels,
+                  weights):
+        """One UNJITTED sharded step incl. the replicated dense optax
+        update — jitted directly by the per-step wrapper, fori-rolled by
+        :func:`make_field_deepfm_sharded_multistep`."""
+        new_vw, g_dense, loss = sharded(params, step_idx, ids, vals,
+                                        labels, weights)
+        if config.reg_bias:
+            g_dense["w0"] = g_dense["w0"] + config.reg_bias * params["w0"]
+        if config.reg_factors:
+            g_dense["mlp"] = jax.tree_util.tree_map(
+                lambda g, p: g + config.reg_factors * p,
+                g_dense["mlp"], params["mlp"],
+            )
+        updates, new_opt = dense_opt.update(
+            g_dense, opt_state, dense_subtree(params)
+        )
+        new_dense = optax.apply_updates(dense_subtree(params), updates)
+        return (
+            {"w0": new_dense["w0"], "vw": new_vw, "mlp": new_dense["mlp"]},
+            new_opt,
+            loss,
+        )
+
+    return apply_one, init_opt_state
+
+
+def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
+    """Jitted field-sharded DeepFM step (see
+    :func:`_make_deepfm_sharded_one_step`); params + opt donated;
+    ``step.init_opt_state`` as usual."""
+    import functools
+
+    apply_one, init_opt_state = _make_deepfm_sharded_one_step(
+        spec, config, mesh
+    )
+    _step = functools.partial(jax.jit, donate_argnums=(0, 1))(apply_one)
+
+    def step(params, opt_state, step_idx, ids, vals, labels, weights):
+        return _step(params, opt_state, step_idx, ids, vals, labels,
+                     weights)
+
+    step.init_opt_state = init_opt_state
+    return step
+
+
+def make_field_deepfm_sharded_multistep(spec, config: TrainConfig, mesh,
+                                        n: int):
+    """Roll ``n`` field-sharded DeepFM steps into ONE compiled program
+    — the fori runs in the OUTER jit around the shard_map'd hybrid step,
+    threading the dense head's optax state through the carry (the
+    sharded analog of :func:`fm_spark_tpu.sparse.
+    make_field_deepfm_multistep`). Same dispatch-amortization rationale
+    as :func:`make_field_sharded_multistep`; same host-aux rejection.
+    Returns ``mstep(params, opt_state, step0, m, ids, vals, labels,
+    weights) → (params, opt_state, last_loss)`` over stacked batches
+    placed by :func:`shard_field_batch_stacked`(_local);
+    ``mstep.init_opt_state`` as usual."""
+    import functools
+
+    _fs._check_sharded_multistep(config, n)
+    apply_one, init_opt_state = _make_deepfm_sharded_one_step(
+        spec, config, mesh
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def mstep(params, opt_state, step0, m, ids, vals, labels, weights):
+        def fbody(j, carry):
+            p, o, prev = carry
+            p, o, loss = apply_one(p, o, step0 + j, ids[j], vals[j],
+                                   labels[j], weights[j])
+            return p, o, jnp.where(jnp.isneginf(prev), prev, loss)
+
+        return lax.fori_loop(
+            0, m, fbody, (params, opt_state, jnp.float32(0))
+        )
+
+    mstep.init_opt_state = init_opt_state
+    return mstep
+
+
+
+
+def field_deepfm_param_specs(spec, mesh) -> dict:
+    """PartitionSpecs for the stacked sharded DeepFM params: tables
+    field-sharded (and bucket-row-sharded on a 2-D mesh), bias + MLP
+    replicated. Single definition for the train step and the eval
+    step."""
+    mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
+    mlp_specs = jax.tree_util.tree_map(lambda _: P(), mlp_struct)
+    return {"w0": P(), "vw": _fs.field_param_specs(mesh)["vw"],
+            "mlp": mlp_specs}
+
+
+def make_field_deepfm_sharded_eval_step(spec, mesh):
+    """Metrics-accumulation step on the sharded DeepFM layout — the FM
+    partial-sum forward plus the replicated-MLP deep head (same shape as
+    :func:`make_field_deepfm_sharded_step`'s forward: local xv columns,
+    (2-D) one ``psum`` over ``row``, one ``all_gather`` of ``h``, every
+    chip runs the identical MLP)."""
+    from fm_spark_tpu.models import base as model_base
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    if type(spec) is not FieldDeepFMSpec:
+        raise ValueError("expected a FieldDeepFMSpec")
+    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
+        raise ValueError(
+            "sharded DeepFM eval runs on a ('feat',) or ('feat', 'row') "
+            "mesh"
+        )
+    per_example_loss = losses_lib.loss_fn(spec.loss)
+    k = spec.rank
+    F = spec.num_fields
+    g = _fs._mesh_geometry(spec, mesh)
+    gat = lambda table, idx: table[idx]
+    pspecs = field_deepfm_param_specs(spec, mesh)
+    mstate_specs = jax.tree_util.tree_map(
+        lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
+    )
+
+    def local_eval(params, mstate, ids, vals, labels, weights):
+        # The shared FM forward (scores incl. linear + bias), then the
+        # deep head exactly as training: local xv columns, one all_gather
+        # of h, the replicated MLP.
+        fwd = _fs._field_forward(
+            spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
+            weights,
+        )
+        labels, weights = fwd.labels, fwd.weights
+        h_local = jnp.concatenate(fwd.xvs, axis=1)
+        if g["two_d"]:
+            h_local = lax.psum(h_local, "row")
+        h = lax.all_gather(h_local, "feat", axis=1, tiled=True)[:, : F * k]
+        scores = fwd.scores + spec.deep_scores(params["mlp"], h)
+        per = per_example_loss(scores, labels)
+        preds = model_base.predict_from_scores(spec, scores)
+        return metrics_lib.update_metrics(
+            mstate, scores, labels, per, weights, predictions=preds
+        )
+
+    return jax.jit(jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(pspecs, mstate_specs, *_fs.field_batch_specs(mesh)),
+        out_specs=mstate_specs,
+        check_vma=False,
+    ))

@@ -805,699 +805,6 @@ def _pad_aux_blocks(pad: int, cap: int, b: int):
 _PAD_AUX_CACHE: dict = {}
 
 
-# ---------------------------------------------------------------- DeepFM
-
-
-def stack_field_deepfm_params(spec, params, n_feat: int) -> dict:
-    """Per-field list → stacked layout, keeping the dense head."""
-    stacked = stack_field_params(
-        spec._field_fm_spec(), {"w0": params["w0"], "vw": params["vw"]},
-        n_feat,
-    )
-    stacked["mlp"] = params["mlp"]
-    return stacked
-
-
-def unstack_field_deepfm_params(spec, stacked: dict) -> dict:
-    out = unstack_field_params(spec._field_fm_spec(),
-                               {"w0": stacked["w0"], "vw": stacked["vw"]})
-    out["mlp"] = stacked["mlp"]
-    return out
-
-
-def shard_field_deepfm_params(stacked: dict, mesh) -> dict:
-    """vw field-sharded over ``feat`` (and, 2-D, bucket rows over
-    ``row``); the dense head replicated."""
-    vw_spec = field_param_specs(mesh)["vw"]
-    out = {
-        "w0": jax.device_put(stacked["w0"], NamedSharding(mesh, P())),
-        "vw": jax.device_put(stacked["vw"], NamedSharding(mesh, vw_spec)),
-        "mlp": jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, NamedSharding(mesh, P())),
-            stacked["mlp"],
-        ),
-    }
-    return out
-
-
-def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
-    """Field-sharded fused DeepFM step builder (1-D ``feat`` or 2-D
-    ``(feat, row)`` mesh) — returns ``(apply_one, init_opt_state)``,
-    both unjitted.
-
-    Embedding tables are single-owner per field exactly as in the FM
-    step (same shared forward — :func:`_field_forward` — so the 2-D
-    row-ownership masking and the device-built compact aux compose
-    unchanged); the deep head additionally needs the FULL ``h =
-    concat(xv)`` on every chip: one ``psum`` over ``row`` (2-D only —
-    each row shard holds ownership-masked partial columns) and one
-    ``all_gather`` of the local xv columns over ``feat`` ([B, F·k]
-    activations — the tables still never move). Every chip then runs
-    the identical MLP forward/backward on replicated weights (MLP FLOPs
-    are negligible next to the index ops, PERF.md fact 4), so the dense
-    gradient is replicated by construction and one optax update outside
-    the shard_map keeps the head in sync.
-
-    Returns ``step(params, opt_state, step_idx, ids, vals, labels,
-    weights) → (params, opt_state, loss)`` with ``step.init_opt_state``;
-    params enter via :func:`shard_field_deepfm_params`.
-    """
-    import optax
-
-    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
-    from fm_spark_tpu.sparse import (
-        _apply_field_updates,
-        _check_host_dedup,
-        _collective_dtype,
-        _compact_apply_all,
-        _fold_overflow,
-        _gather_fn,
-        _lr_at,
-        _reject_host_aux,
-        _sr_base_key,
-    )
-    from fm_spark_tpu.train import make_optimizer
-
-    if type(spec) is not FieldDeepFMSpec:
-        raise ValueError("expected a FieldDeepFMSpec")
-    from fm_spark_tpu.sparse import _reject_score_sharded
-
-    _reject_score_sharded(config, "the field-sharded DeepFM step")
-    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
-        raise ValueError(
-            "field-sharded DeepFM runs on a ('feat',) or ('feat', 'row') "
-            "mesh (use make_field_mesh)"
-        )
-    # Device-built compact aux composes here exactly as in the FM step
-    # (the deep head touches activations, not tables); the HOST aux does
-    # not ride this step — reject it rather than silently ignore.
-    _check_host_dedup(config)
-    device_cap = config.compact_cap if config.compact_device else 0
-    if config.host_dedup:
-        # _check_host_dedup guarantees any compact_cap without
-        # compact_device implies host_dedup, so this one test covers
-        # every host-aux request.
-        _reject_host_aux(config, "the field-sharded DeepFM step")
-    g = _mesh_geometry(spec, mesh)
-    wire = _collective_dtype(config)
-    per_example_loss = losses_lib.loss_fn(spec.loss)
-    cd = spec.cdtype
-    k = spec.rank
-    F = spec.num_fields
-    f_pad, f_local = g["f_pad"], g["f_local"]
-    two_d = g["two_d"]
-    sr_base_key = _sr_base_key(config)
-    lr_at = _lr_at(config)
-    gat = _gather_fn(config)
-    dense_opt = make_optimizer(config)
-
-    pspecs = field_deepfm_param_specs(spec, mesh)
-    mlp_specs = pspecs["mlp"]
-
-    def local_step(params, step_idx, ids, vals, labels, weights):
-        vw = params["vw"]
-        w0 = params["w0"]
-        mlp = params["mlp"]
-        # Shared forward: batch re-shard, (2-D) ownership masking,
-        # optional in-step compact aux, one psum of the partial sums.
-        # add_bias=False — the bias rides the dense head's vjp below.
-        fwd = _field_forward(
-            spec, g, gat, vw, w0, ids, vals, labels, weights,
-            device_cap=device_cap, add_bias=False, psum_dtype=wire,
-            gfull=config.gfull_fused,
-        )
-        fm_scores, s, xvs, rows = fwd.scores, fwd.s, fwd.xvs, fwd.rows
-        vals_c, uidx, urows = fwd.vals_c, fwd.uidx, fwd.urows
-        labels, weights, aux, ovf = (fwd.labels, fwd.weights, fwd.aux,
-                                     fwd.ovf)
-
-        # Deep head input: local xv columns — partial on a 2-D mesh
-        # (ownership-masked), completed by one psum over `row` — then
-        # gathered into global field order ([B, f_pad·k], padding
-        # columns zero) and trimmed to the MLP's F·k input. The h
-        # collectives ride the wire dtype too (h is the DeepFM step's
-        # biggest activation transfer).
-        h_local = jnp.concatenate(xvs, axis=1)
-        if wire is not None:
-            h_local = h_local.astype(wire)
-        if two_d:
-            h_local = lax.psum(h_local, "row")
-        h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
-        h = h_full[:, : F * k].astype(cd)
-
-        wsum = jnp.maximum(jnp.sum(weights), 1.0)
-
-        def head_loss(dense, h_in):
-            sc = fm_scores + spec.deep_scores(dense["mlp"], h_in)
-            if spec.use_bias:
-                sc = sc + dense["w0"].astype(cd)
-            per = per_example_loss(sc, labels) * weights
-            return jnp.sum(per) / wsum, sc
-
-        (loss, scores), vjp = jax.vjp(head_loss, {"w0": w0, "mlp": mlp}, h)
-        g_dense, g_h = vjp((jnp.ones_like(loss), jnp.zeros_like(scores)))
-
-        def batch_loss(sc):
-            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
-
-        dscores = jax.grad(batch_loss)(scores)
-        lr = lr_at(step_idx)
-        touched = weights > 0
-
-        # This chip's slice of the deep pullback, padded back to f_pad·k
-        # so padding fields see zero deep grad.
-        g_h_pad = jnp.pad(g_h, ((0, 0), (0, f_pad * k - F * k)))
-        col0 = lax.axis_index("feat") * (f_local * k)
-        g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0, f_local * k,
-                                           axis=1)
-
-        if config.gfull_fused:
-            from fm_spark_tpu.sparse import _gfull_grads
-
-            gh_pad = jnp.pad(
-                g_h_loc.reshape(-1, f_local, k),
-                ((0, 0), (0, 0), (0, 1)))
-            g_fulls = _gfull_grads(
-                dscores, vals_c, s, fwd.xv_fulls, rows, touched, k, cd,
-                spec.use_linear, config, extra=gh_pad,
-            )
-        else:
-            g_fulls = []
-            for f in range(f_local):
-                # s − xvs[f] is exact for owned lanes; non-owned lanes
-                # (2-D) produce garbage that the sentinel index /
-                # dropped segment discards — same contract as the FM
-                # body.
-                g_v = (
-                    dscores[:, None] * vals_c[:, f : f + 1] * (s - xvs[f])
-                    + g_h_loc[:, f * k : (f + 1) * k] * vals_c[:, f : f + 1]
-                )
-                if config.reg_factors:
-                    g_v = g_v + config.reg_factors * rows[f][:, :k] * touched[:, None]
-                if spec.use_linear:
-                    g_l = dscores * vals_c[:, f]
-                    if config.reg_linear:
-                        g_l = g_l + config.reg_linear * rows[f][:, k] * touched
-                else:
-                    g_l = jnp.zeros_like(dscores)
-                g_fulls.append(
-                    jnp.concatenate([g_v, g_l[:, None]], axis=1))
-        field_offset = lax.axis_index("feat") * f_local
-        if two_d:
-            field_offset = field_offset + lax.axis_index("row") * f_pad
-        if device_cap > 0:
-            new_slices = _compact_apply_all(
-                [vw[f] for f in range(f_local)], g_fulls, urows, config,
-                sr_base_key, step_idx, lr, aux,
-                field_offset=field_offset,
-            )
-            loss = _fold_overflow(
-                loss, lax.pmax(ovf, g["score_axes"]), config
-            )
-        else:
-            new_slices = _apply_field_updates(
-                [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
-                config, sr_base_key, step_idx, lr,
-                field_offset=field_offset,
-            )
-        return jnp.stack(new_slices, axis=0), g_dense, loss
-
-    sharded = jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(pspecs, P(), *field_batch_specs(mesh)),
-        out_specs=(pspecs["vw"],
-                   {"w0": P(), "mlp": mlp_specs}, P()),
-        check_vma=False,
-    )
-
-    def dense_subtree(params):
-        return {"w0": params["w0"], "mlp": params["mlp"]}
-
-    def init_opt_state(params):
-        return dense_opt.init(dense_subtree(params))
-
-    def apply_one(params, opt_state, step_idx, ids, vals, labels,
-                  weights):
-        """One UNJITTED sharded step incl. the replicated dense optax
-        update — jitted directly by the per-step wrapper, fori-rolled by
-        :func:`make_field_deepfm_sharded_multistep`."""
-        new_vw, g_dense, loss = sharded(params, step_idx, ids, vals,
-                                        labels, weights)
-        if config.reg_bias:
-            g_dense["w0"] = g_dense["w0"] + config.reg_bias * params["w0"]
-        if config.reg_factors:
-            g_dense["mlp"] = jax.tree_util.tree_map(
-                lambda g, p: g + config.reg_factors * p,
-                g_dense["mlp"], params["mlp"],
-            )
-        updates, new_opt = dense_opt.update(
-            g_dense, opt_state, dense_subtree(params)
-        )
-        new_dense = optax.apply_updates(dense_subtree(params), updates)
-        return (
-            {"w0": new_dense["w0"], "vw": new_vw, "mlp": new_dense["mlp"]},
-            new_opt,
-            loss,
-        )
-
-    return apply_one, init_opt_state
-
-
-def make_field_deepfm_sharded_step(spec, config: TrainConfig, mesh):
-    """Jitted field-sharded DeepFM step (see
-    :func:`_make_deepfm_sharded_one_step`); params + opt donated;
-    ``step.init_opt_state`` as usual."""
-    import functools
-
-    apply_one, init_opt_state = _make_deepfm_sharded_one_step(
-        spec, config, mesh
-    )
-    _step = functools.partial(jax.jit, donate_argnums=(0, 1))(apply_one)
-
-    def step(params, opt_state, step_idx, ids, vals, labels, weights):
-        return _step(params, opt_state, step_idx, ids, vals, labels,
-                     weights)
-
-    step.init_opt_state = init_opt_state
-    return step
-
-
-def make_field_deepfm_sharded_multistep(spec, config: TrainConfig, mesh,
-                                        n: int):
-    """Roll ``n`` field-sharded DeepFM steps into ONE compiled program
-    — the fori runs in the OUTER jit around the shard_map'd hybrid step,
-    threading the dense head's optax state through the carry (the
-    sharded analog of :func:`fm_spark_tpu.sparse.
-    make_field_deepfm_multistep`). Same dispatch-amortization rationale
-    as :func:`make_field_sharded_multistep`; same host-aux rejection.
-    Returns ``mstep(params, opt_state, step0, m, ids, vals, labels,
-    weights) → (params, opt_state, last_loss)`` over stacked batches
-    placed by :func:`shard_field_batch_stacked`(_local);
-    ``mstep.init_opt_state`` as usual."""
-    import functools
-
-    _check_sharded_multistep(config, n)
-    apply_one, init_opt_state = _make_deepfm_sharded_one_step(
-        spec, config, mesh
-    )
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def mstep(params, opt_state, step0, m, ids, vals, labels, weights):
-        def fbody(j, carry):
-            p, o, prev = carry
-            p, o, loss = apply_one(p, o, step0 + j, ids[j], vals[j],
-                                   labels[j], weights[j])
-            return p, o, jnp.where(jnp.isneginf(prev), prev, loss)
-
-        return lax.fori_loop(
-            0, m, fbody, (params, opt_state, jnp.float32(0))
-        )
-
-    mstep.init_opt_state = init_opt_state
-    return mstep
-
-
-# ---------------------------------------------------------------- FFM
-
-
-def _ffm_field_forward(spec, g, vw, w0, ids, vals, labels, weights,
-                       caux=None, device_cap: int = 0, wire=None):
-    """The field-sharded FFM forward, shared by the train body and the
-    eval step (config 4's multi-chip fast path, VERDICT r2 #3).
-
-    Cross-field factors make this structurally different from FM: the
-    chip owning field ``i`` holds ``sel[b, i, j] = v[id_i][j]·x_i`` for
-    every target ``j`` locally (the packed [B, F·k+1] row carries all
-    targets — field_ffm.py), but the pairwise term needs the TRANSPOSED
-    blocks ``sel[b, j, i]``. ONE ``all_to_all`` of the sel activations
-    over ``feat`` (split the target axis, concat the owner axis)
-    delivers exactly those — activation traffic, never tables, the same
-    pattern as DeepFM's ``h`` all_gather but n× cheaper than gathering
-    the full [B, F, F, k] tensor on every chip.
-
-    On a 2-D ``(feat, row)`` mesh (round 4 — VERDICT r3 #5) each row
-    shard additionally owns a bucket range of its fields, exactly the
-    FM step's ownership contract: non-owned lanes gather ZERO rows, so
-    each shard's ``sel_loc`` is a partial sum that ONE ``psum`` over
-    ``row`` completes before the transposing all_to_all — the same
-    linear-reduction identity the FM partials use, lifted to the sel
-    tensor (sel is linear in the gathered rows). Updates stay
-    single-owner via the OOB-sentinel ``uidx`` / the ownership-masked
-    device-compact aux. The extra collective is the price of bucket
-    capacity: ~ring·|sel| bytes over ``row`` per step, on top of the
-    1-D layout's a2a (projection.py models the 1-D layout; the row
-    psum adds ``2(r−1)/r·|sel|`` on a 2-D mesh — use it for capacity,
-    not speed).
-
-    Returns ``(scores, rows, sel_loc, selT, vals_c, uidx, urows, aux,
-    ovf, labels, weights)`` — scores replicated; sel_loc/selT are this
-    chip's [B, f_local, F_pad, k] owner/transposed blocks for the
-    analytic backward.
-    """
-    from fm_spark_tpu.sparse import (
-        _compact_gather_all,
-        _device_compact_aux_all,
-        _gather_all,
-        _psum_wire,
-    )
-
-    cd = spec.cdtype
-    k = spec.rank
-    F = spec.num_fields
-    f_local, f_pad = g["f_local"], g["f_pad"]
-
-    if caux is None:
-        ids = lax.all_to_all(ids, "feat", split_axis=1, concat_axis=0,
-                             tiled=True)
-    vals = lax.all_to_all(vals, "feat", split_axis=1, concat_axis=0,
-                          tiled=True)
-    labels = lax.all_gather(labels, "feat", tiled=True)
-    weights = lax.all_gather(weights, "feat", tiled=True)
-    if g["two_d"]:
-        ids = lax.all_gather(ids, "row", tiled=True)
-        vals = lax.all_gather(vals, "row", tiled=True)
-        labels = lax.all_gather(labels, "row", tiled=True)
-        weights = lax.all_gather(weights, "row", tiled=True)
-    vals_c = vals.astype(cd)
-
-    urows = None
-    aux = caux
-    ovf = None
-    own = None
-    if device_cap > 0:
-        cids = ids
-        extra = None
-        if g["two_d"]:
-            # Ownership masking before the sort — the FM step's 2-D
-            # device-compact pattern (see _field_forward).
-            loc, own = _ownership_mask(g, ids)
-            cids = jnp.where(own, loc, g["bucket_local"])
-            extra = jnp.any(~own, axis=0).astype(jnp.int32)
-        aux, ovf = _device_compact_aux_all(cids, device_cap, f_local,
-                                           extra_segs=extra)
-        urows, rows = _compact_gather_all(
-            [vw[f] for f in range(f_local)], aux, cd, mask_overflow=True
-        )
-        if own is not None:
-            rows = [r * own[:, f, None] for f, r in enumerate(rows)]
-        uidx = None
-    elif g["two_d"]:
-        loc, own = _ownership_mask(g, ids)
-        gidx = jnp.clip(loc, 0, g["bucket_local"] - 1)
-        rows = [
-            r * own[:, f, None]
-            for f, r in enumerate(
-                _gather_all(lambda t, i: t[i], vw, gidx, cd))
-        ]
-        uidx = jnp.where(own, loc, g["bucket_local"])
-    elif caux is not None:
-        urows, rows = _compact_gather_all(
-            [vw[f] for f in range(f_local)], caux, cd
-        )
-        uidx = None
-    else:
-        rows = _gather_all(lambda t, i: t[i], vw, ids, cd)
-        uidx = ids
-
-    b = vals.shape[0]
-    # sel_loc[b, p, j, :] = v[id_p][target j] · x_p for this chip's
-    # owned fields p; the target axis padded F → F_pad so the
-    # all_to_all splits evenly (padding targets are zero columns).
-    sel_loc = jnp.stack(
-        [
-            jnp.pad(
-                r[:, : F * k].reshape(b, F, k) * vals_c[:, p, None, None],
-                ((0, 0), (0, f_pad - F), (0, 0)),
-            )
-            for p, r in enumerate(rows)
-        ],
-        axis=1,
-    )                                           # [B, f_local, F_pad, k]
-    if g["two_d"]:
-        # Complete each owned field's sel block across its row shards
-        # (non-owned lanes contributed zeros). After this, sel_loc is
-        # identical on every row shard, so everything downstream —
-        # the a2a, pair/diag, the backward's dsel — runs replicated
-        # over ``row`` by construction; only lin needs the 2-D psum.
-        sel_loc = _psum_wire(sel_loc, "row", wire, cd)
-    # selT[b, p, j, :] = sel[b, j, i_p] — every other chip's view of
-    # this chip's fields as TARGETS, re-sharded in one collective. The
-    # sel a2a is the FFM step's dominant ICI term (~F× the FM psum at
-    # headline shapes — parallel/projection.py); ``wire``
-    # (TrainConfig.collective_dtype) halves its bytes at bf16 precision.
-    sel_wire = sel_loc.astype(wire) if wire is not None else sel_loc
-    selT = jnp.swapaxes(
-        lax.all_to_all(sel_wire, "feat", split_axis=2, concat_axis=1,
-                       tiled=True),
-        1, 2,
-    ).astype(cd)                                # [B, f_local, F_pad, k]
-
-    # Partial pairwise sum over owned i: Σ_j ⟨sel[i,j], sel[j,i]⟩ minus
-    # the i==j diagonal; psum over feat completes Σ_{i≠j}.
-    pair_p = jnp.sum(sel_loc * selT, axis=(1, 2, 3))
-    feat0 = lax.axis_index("feat") * f_local
-    diag_p = sum(
-        jnp.sum(sel_loc[:, p, feat0 + p, :] ** 2, axis=-1)
-        for p in range(f_local)
-    )
-    lin_p = (
-        sum(r[:, F * k] * vals_c[:, p] for p, r in enumerate(rows))
-        if spec.use_linear
-        else jnp.zeros((b,), cd)
-    )
-    # pair/diag derive from the row-complete sel_loc (identical per row
-    # shard) — psum over ``feat`` only; lin derives from the MASKED rows
-    # (partial over row too) — psum over every score axis.
-    pair = _psum_wire(pair_p - diag_p, "feat", wire, cd)
-    scores = 0.5 * pair
-    if spec.use_linear:
-        scores = scores + _psum_wire(lin_p, g["score_axes"], wire, cd)
-    if spec.use_bias:
-        scores = scores + w0.astype(cd)
-    return (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
-            labels, weights)
-
-
-def _make_ffm_local_step(spec, config: TrainConfig, mesh):
-    """Build the FFM sharded LOCAL step + layout facts (the FFM
-    counterpart of :func:`_make_field_local_step`; shared by the
-    per-step wrapper and the multi-step roll). Returns ``(local_step,
-    host_compact)``."""
-    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
-    from fm_spark_tpu.sparse import (
-        _apply_field_updates,
-        _check_host_dedup,
-        _collective_dtype,
-        _compact_apply_all,
-        _fold_overflow,
-        _lr_at,
-        _reject_host_aux,
-        _sr_base_key,
-    )
-
-    if type(spec) is not FieldFFMSpec:
-        raise ValueError("expected a FieldFFMSpec")
-    if config.optimizer != "sgd":
-        raise ValueError("sparse step implements plain SGD only")
-    from fm_spark_tpu.sparse import _reject_gfull
-
-    _reject_gfull(config, "the field-sharded FFM step")
-    from fm_spark_tpu.sparse import _reject_score_sharded
-
-    _reject_score_sharded(config, "the field-sharded FFM step")
-    wire = _collective_dtype(config)
-    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
-        raise ValueError(
-            "field-sharded FFM runs on a ('feat',) or ('feat', 'row') "
-            "mesh (use make_field_mesh)"
-        )
-    if config.use_pallas:
-        raise ValueError("use_pallas is a single-chip experiment")
-    g = _mesh_geometry(spec, mesh)
-    compact = config.compact_cap > 0
-    device_cap = config.compact_cap if config.compact_device else 0
-    host_compact = compact and not config.compact_device
-    # Unconditional, like the single-chip factories (see the FM body).
-    _check_host_dedup(config)
-    if host_compact and g["two_d"]:
-        # Same structural limit as the FM step: a host aux built from
-        # raw global ids cannot express row ownership.
-        raise ValueError(
-            "host-built compact_cap on the sharded FFM step requires a "
-            "1-D ('feat',) mesh; use compact_device=True for 2-D "
-            "(feat, row) meshes"
-        )
-    if not compact and config.host_dedup:
-        _reject_host_aux(config, "the field-sharded FFM step (non-compact)")
-
-    per_example_loss = losses_lib.loss_fn(spec.loss)
-    cd = spec.cdtype
-    k = spec.rank
-    F = spec.num_fields
-    f_local = g["f_local"]
-    sr_base_key = _sr_base_key(config)
-    lr_at = _lr_at(config)
-
-    def local_step(params, step_idx, ids, vals, labels, weights,
-                   caux=None):
-        if host_compact and caux is None:
-            raise ValueError(
-                "compact sharded FFM step needs the batch's compact_aux "
-                "operand (stacked [F_pad, ...], sharded over feat)"
-            )
-        vw = params["vw"]
-        w0 = params["w0"]
-        (scores, rows, sel_loc, selT, vals_c, uidx, urows, aux, ovf,
-         labels, weights) = _ffm_field_forward(
-            spec, g, vw, w0, ids, vals, labels, weights, caux=caux,
-            device_cap=device_cap, wire=wire,
-        )
-
-        wsum = jnp.maximum(jnp.sum(weights), 1.0)
-
-        def batch_loss(sc):
-            return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
-
-        loss, dscores = jax.value_and_grad(batch_loss)(scores)
-        lr = lr_at(step_idx)
-        touched = weights > 0
-
-        # ∂L/∂sel[b, i_p, j] = ds · sel[b, j, i_p] = ds · selT (zeroed
-        # diagonal), then ∂L/∂v[id_p, j] = ∂sel · x_p — all local.
-        # (2-D: selT is row-complete, so dsel is identical per row
-        # shard; ownership lands at the WRITE via the sentinel/compact
-        # aux, exactly the FM contract. The reg term uses the masked
-        # rows — zero for non-owned lanes, whose writes drop anyway.)
-        feat0 = lax.axis_index("feat") * f_local
-        dsel = dscores[:, None, None, None] * selT
-        own_col = jax.nn.one_hot(
-            feat0 + jnp.arange(f_local), g["f_pad"], dtype=cd
-        )                                        # [f_local, F_pad]
-        dsel = dsel * (1.0 - own_col)[None, :, :, None]
-        g_fulls = []
-        for p in range(f_local):
-            g_v = (
-                dsel[:, p, :F, :] * vals_c[:, p, None, None]
-            ).reshape(-1, F * k)
-            if config.reg_factors:
-                g_v = g_v + config.reg_factors * rows[p][:, : F * k] * touched[:, None]
-            if spec.use_linear:
-                g_l = dscores * vals_c[:, p]
-                if config.reg_linear:
-                    g_l = g_l + config.reg_linear * rows[p][:, F * k] * touched
-            else:
-                g_l = jnp.zeros_like(dscores)
-            g_fulls.append(jnp.concatenate([g_v, g_l[:, None]], axis=1))
-        # SR keys: one stream per (global field, row shard), like the
-        # FM body — noise never correlates across chips sharing a field.
-        field_offset = feat0
-        if g["two_d"]:
-            field_offset = field_offset + lax.axis_index("row") * g["f_pad"]
-        if compact:
-            new_slices = _compact_apply_all(
-                [vw[f] for f in range(f_local)], g_fulls, urows, config,
-                sr_base_key, step_idx, lr, aux,
-                field_offset=field_offset,
-            )
-        else:
-            new_slices = _apply_field_updates(
-                [vw[f] for f in range(f_local)], uidx, g_fulls, rows,
-                config, sr_base_key, step_idx, lr,
-                field_offset=field_offset,
-            )
-        out = {"w0": w0, "vw": jnp.stack(new_slices, axis=0)}
-        if spec.use_bias:
-            out["w0"] = w0 - lr * (jnp.sum(dscores) + config.reg_bias * w0)
-        if ovf is not None:
-            loss = _fold_overflow(
-                loss, lax.pmax(ovf, g["score_axes"]), config
-            )
-        return out, loss
-
-    return local_step, host_compact
-
-
-def make_field_ffm_sharded_body(spec, config: TrainConfig, mesh):
-    """Unjitted field-sharded fused FFM step — config 4's multi-chip
-    layout, on a 1-D ``(feat,)`` or 2-D ``(feat, row)`` mesh (row
-    sharding of each field's bucket dimension — round 4, VERDICT r3
-    #5). Same math as the single-chip
-    :func:`fm_spark_tpu.sparse.make_field_ffm_sparse_sgd_body`
-    (equivalence-tested); tables single-owner per field (and per bucket
-    range on 2-D), one sel ``all_to_all`` — plus, 2-D, one sel ``psum``
-    over ``row`` — instead of table movement. Supports the compact
-    paths: host-built aux (single-process, 1-D) and the device-built
-    aux (composes with 2-D meshes and multi-process)."""
-    local_step, host_compact = _make_ffm_local_step(spec, config, mesh)
-    if host_compact:
-        return jax.shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(field_param_specs(mesh), P(),
-                      *field_batch_specs(mesh),
-                      (P("feat", None),) * 5),
-            out_specs=(field_param_specs(mesh), P()),
-            check_vma=False,
-        )
-    return jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(field_param_specs(mesh), P(), *field_batch_specs(mesh)),
-        out_specs=(field_param_specs(mesh), P()),
-        check_vma=False,
-    )
-
-
-def make_field_ffm_sharded_step(spec, config: TrainConfig, mesh):
-    """Jitted field-sharded fused FFM step; params donated."""
-    return jax.jit(
-        make_field_ffm_sharded_body(spec, config, mesh),
-        donate_argnums=(0,),
-    )
-
-
-def make_field_ffm_sharded_eval_step(spec, mesh):
-    """Metrics-accumulation step on the field-sharded FFM layout —
-    the shared forward (:func:`_ffm_field_forward`), then a replicated
-    :func:`metrics.update_metrics` exactly like the FM eval step."""
-    from fm_spark_tpu.models import base as model_base
-    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
-    from fm_spark_tpu.utils import metrics as metrics_lib
-
-    if type(spec) is not FieldFFMSpec:
-        raise ValueError("expected a FieldFFMSpec")
-    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
-        raise ValueError(
-            "sharded FFM eval runs on a ('feat',) or ('feat', 'row') mesh"
-        )
-    per_example_loss = losses_lib.loss_fn(spec.loss)
-    g = _mesh_geometry(spec, mesh)
-    mstate_specs = jax.tree_util.tree_map(
-        lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
-    )
-
-    def local_eval(params, mstate, ids, vals, labels, weights):
-        scores, _, _, _, _, _, _, _, _, labels, weights = (
-            _ffm_field_forward(spec, g, params["vw"], params["w0"], ids,
-                               vals, labels, weights)
-        )
-        per = per_example_loss(scores, labels)
-        preds = model_base.predict_from_scores(spec, scores)
-        return metrics_lib.update_metrics(
-            mstate, scores, labels, per, weights, predictions=preds
-        )
-
-    return jax.jit(jax.shard_map(
-        local_eval,
-        mesh=mesh,
-        in_specs=(field_param_specs(mesh), mstate_specs,
-                  *field_batch_specs(mesh)),
-        out_specs=mstate_specs,
-        check_vma=False,
-    ))
-
-
 def make_field_sharded_eval_step(spec, mesh):
     """Metrics-accumulation step on the FIELD-SHARDED layout — periodic
     eval without gathering the multi-GB tables to the host (the default
@@ -1599,68 +906,29 @@ def evaluate_field_sharded(spec, mesh, params, batches, estep=None) -> dict:
     }
 
 
-def field_deepfm_param_specs(spec, mesh) -> dict:
-    """PartitionSpecs for the stacked sharded DeepFM params: tables
-    field-sharded (and bucket-row-sharded on a 2-D mesh), bias + MLP
-    replicated. Single definition for the train step and the eval
-    step."""
-    mlp_struct = jax.eval_shape(spec.init, jax.random.key(0))["mlp"]
-    mlp_specs = jax.tree_util.tree_map(lambda _: P(), mlp_struct)
-    return {"w0": P(), "vw": field_param_specs(mesh)["vw"],
-            "mlp": mlp_specs}
 
 
-def make_field_deepfm_sharded_eval_step(spec, mesh):
-    """Metrics-accumulation step on the sharded DeepFM layout — the FM
-    partial-sum forward plus the replicated-MLP deep head (same shape as
-    :func:`make_field_deepfm_sharded_step`'s forward: local xv columns,
-    (2-D) one ``psum`` over ``row``, one ``all_gather`` of ``h``, every
-    chip runs the identical MLP)."""
-    from fm_spark_tpu.models import base as model_base
-    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
-    from fm_spark_tpu.utils import metrics as metrics_lib
-
-    if type(spec) is not FieldDeepFMSpec:
-        raise ValueError("expected a FieldDeepFMSpec")
-    if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
-        raise ValueError(
-            "sharded DeepFM eval runs on a ('feat',) or ('feat', 'row') "
-            "mesh"
-        )
-    per_example_loss = losses_lib.loss_fn(spec.loss)
-    k = spec.rank
-    F = spec.num_fields
-    g = _mesh_geometry(spec, mesh)
-    gat = lambda table, idx: table[idx]
-    pspecs = field_deepfm_param_specs(spec, mesh)
-    mstate_specs = jax.tree_util.tree_map(
-        lambda _: P(), jax.eval_shape(metrics_lib.init_metrics)
-    )
-
-    def local_eval(params, mstate, ids, vals, labels, weights):
-        # The shared FM forward (scores incl. linear + bias), then the
-        # deep head exactly as training: local xv columns, one all_gather
-        # of h, the replicated MLP.
-        fwd = _field_forward(
-            spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
-            weights,
-        )
-        labels, weights = fwd.labels, fwd.weights
-        h_local = jnp.concatenate(fwd.xvs, axis=1)
-        if g["two_d"]:
-            h_local = lax.psum(h_local, "row")
-        h = lax.all_gather(h_local, "feat", axis=1, tiled=True)[:, : F * k]
-        scores = fwd.scores + spec.deep_scores(params["mlp"], h)
-        per = per_example_loss(scores, labels)
-        preds = model_base.predict_from_scores(spec, scores)
-        return metrics_lib.update_metrics(
-            mstate, scores, labels, per, weights, predictions=preds
-        )
-
-    return jax.jit(jax.shard_map(
-        local_eval,
-        mesh=mesh,
-        in_specs=(pspecs, mstate_specs, *field_batch_specs(mesh)),
-        out_specs=mstate_specs,
-        check_vma=False,
-    ))
+# ------------------------------------------------------------- family splits
+# The DeepFM and FFM machinery live in sibling modules since round 4
+# (this module had grown to carry three families); re-exported here so
+# every existing import path (cli, tests, bench, __graft_entry__) keeps
+# working unchanged. The sibling modules reference this module's layout
+# helpers through the module object at call time, so the import cycle
+# is benign.
+from fm_spark_tpu.parallel.deepfm_step import (  # noqa: E402,F401
+    _make_deepfm_sharded_one_step,
+    field_deepfm_param_specs,
+    make_field_deepfm_sharded_eval_step,
+    make_field_deepfm_sharded_multistep,
+    make_field_deepfm_sharded_step,
+    shard_field_deepfm_params,
+    stack_field_deepfm_params,
+    unstack_field_deepfm_params,
+)
+from fm_spark_tpu.parallel.ffm_step import (  # noqa: E402,F401
+    _ffm_field_forward,
+    _make_ffm_local_step,
+    make_field_ffm_sharded_body,
+    make_field_ffm_sharded_eval_step,
+    make_field_ffm_sharded_step,
+)
