@@ -1,0 +1,83 @@
+"""Single source of truth for MEASURED on-chip rates (VERDICT r4 Weak #1).
+
+Every projection that starts from a measured single-chip rate — the
+MULTICHIP dryrun artifact, PERF analyses, ad-hoc scripts — must load the
+rate from the repo-root ``MEASURED.json`` via :func:`load_measured`
+instead of hard-coding it. ``bench.py`` REWRITES the ``headline`` entry
+whenever a sweep lands a real number, so a stale projection constant can
+no longer survive a new measurement; the provenance fields (``source``,
+``date``, ``attachment``) travel with the number so downstream artifacts
+can name where their input came from.
+
+Schema (two entries, each with provenance)::
+
+    {"headline":  {"rate_samples_per_sec_per_chip": float, "vs_baseline":
+                   float|None, "variant": str, "source": str,
+                   "attachment": str, "date": "YYYY-MM-DD"},
+     "ffm_avazu": {"rate_samples_per_sec_per_chip": float, "source": str,
+                   "date": "YYYY-MM-DD"}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MEASURED_PATH = os.path.join(_REPO_ROOT, "MEASURED.json")
+
+_REQUIRED = {
+    "headline": ("rate_samples_per_sec_per_chip", "source", "date"),
+    "ffm_avazu": ("rate_samples_per_sec_per_chip", "source", "date"),
+}
+
+
+def load_measured(path: str | None = None) -> dict:
+    """Load and validate MEASURED.json. Fails loudly — no silent default:
+    a missing/invalid file means the provenance chain is broken and any
+    projection made from a guessed rate would be exactly the stale-constant
+    failure mode this module exists to kill."""
+    p = path or MEASURED_PATH
+    with open(p) as f:
+        data = json.load(f)
+    for key, fields in _REQUIRED.items():
+        if key not in data:
+            raise ValueError(f"MEASURED.json missing entry {key!r}")
+        for field in fields:
+            if field not in data[key]:
+                raise ValueError(
+                    f"MEASURED.json entry {key!r} missing field {field!r}")
+        rate = data[key]["rate_samples_per_sec_per_chip"]
+        if not (isinstance(rate, (int, float)) and rate > 0):
+            raise ValueError(
+                f"MEASURED.json {key}: bad rate {rate!r}")
+    return data
+
+
+def update_headline(rate: float, vs_baseline: float | None,
+                    variant: str, source: str, attachment: str,
+                    date: str, path: str | None = None) -> None:
+    """Rewrite the headline entry (called by bench.py on a successful
+    sweep), preserving the other entries and their provenance."""
+    p = path or MEASURED_PATH
+    try:
+        with open(p) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        data = {}  # first-ever measurement: start a fresh file
+    # Any other read/parse failure propagates: silently rewriting a
+    # corrupt file would discard the other entries (ffm_avazu) and their
+    # provenance — the destructive version of the stale-constant bug.
+    data["headline"] = {
+        "rate_samples_per_sec_per_chip": float(rate),
+        "vs_baseline": vs_baseline,
+        "variant": variant,
+        "source": source,
+        "attachment": attachment,
+        "date": date,
+    }
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, p)

@@ -41,6 +41,17 @@ _LOSSES = {
     "hinge": hinge_loss,
 }
 
+# Losses whose per-example value is provably >= 0 — the invariant the
+# fused bodies' -inf compact-overflow sentinel relies on
+# (sparse._fold_overflow: a weighted mean of non-negative terms can
+# diverge to +inf but never reach -inf, so -inf is unambiguously "cap
+# overflow"). A new loss must be listed here EXPLICITLY, and only after
+# checking non-negativity (and that example weights are non-negative);
+# membership is asserted at step-factory construction (ADVICE r4), so
+# adding a negative-capable loss fails loudly instead of silently
+# corrupting the sentinel.
+NON_NEGATIVE_LOSSES = frozenset(("logistic", "squared", "hinge"))
+
 
 def loss_fn(name: str):
     """Look up a per-example loss by name ('logistic'|'squared'|'hinge')."""

@@ -106,7 +106,7 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
     # Device-built compact aux composes here exactly as in the FM step
     # (the deep head touches activations, not tables); the HOST aux does
     # not ride this step — reject it rather than silently ignore.
-    _check_host_dedup(config)
+    _check_host_dedup(config, spec.loss)
     device_cap = config.compact_cap if config.compact_device else 0
     if config.host_dedup:
         # _check_host_dedup guarantees any compact_cap without

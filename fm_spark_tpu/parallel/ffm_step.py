@@ -221,7 +221,7 @@ def _make_ffm_local_step(spec, config: TrainConfig, mesh):
     device_cap = config.compact_cap if config.compact_device else 0
     host_compact = compact and not config.compact_device
     # Unconditional, like the single-chip factories (see the FM body).
-    _check_host_dedup(config)
+    _check_host_dedup(config, spec.loss)
     if host_compact and g["two_d"]:
         # Same structural limit as the FM step: a host aux built from
         # raw global ids cannot express row ownership.

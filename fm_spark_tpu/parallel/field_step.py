@@ -460,7 +460,7 @@ def _make_field_local_step(spec, config: TrainConfig, mesh):
     # Unconditional, like the single-chip factories: compact_device
     # without compact_cap (or a mismatched overflow policy) must fail
     # loudly here too, never silently train the plain path.
-    _check_host_dedup(config)
+    _check_host_dedup(config, spec.loss)
     if host_compact:
         # Compact HOST-dedup on the sharded step: supported on the 1-D
         # feat mesh — the aux is built from the GLOBAL batch and shards

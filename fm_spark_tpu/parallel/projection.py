@@ -49,14 +49,22 @@ _WIRE_BYTES = {"float32": 4, "bfloat16": 2}
 
 
 def _base_counts(B: int, F: int, k: int, n: int, cap: int,
-                 device_aux: bool) -> dict:
-    """Work + batch-reshard ICI counts shared by all three models."""
+                 device_aux: bool, n_total: int | None = None) -> dict:
+    """Work + batch-reshard ICI counts shared by all three models.
+
+    ``n_total`` (2-D meshes): the batch enters example-sharded over
+    EVERY mesh axis (field_step.field_batch_specs), so the batch
+    a2a / labels all_gather cross ``n_total`` chips while the
+    feat-axis activation collectives cross only ``n`` — the two recv
+    fractions differ (ADVICE r4)."""
     f_pad = -(-F // n) * n
     f_local = f_pad // n
     lanes = cap if cap else B
     ring = 2 * (n - 1) / n  # ring all-reduce traffic factor
     recv = (n - 1) / n      # fraction of an all_to_all/all_gather that
     #                         crosses ICI (the rest is already local)
+    nt = n_total if n_total is not None else n
+    recv_batch = (nt - 1) / nt  # batch-reshard fraction (total chips)
     a2a_cols = f_local * (8 if device_aux or not cap else 4)
     # host-compact skips the ids all_to_all (field_step._field_forward);
     # its aux arrives host->device, not over ICI.
@@ -75,8 +83,8 @@ def _base_counts(B: int, F: int, k: int, n: int, cap: int,
             "aux_sort_lanes": (B * f_local) if (cap and device_aux) else 0,
         },
         ici={
-            "a2a_batch": int(B * a2a_cols * recv),
-            "allgather_labels_weights": int(8 * B * recv),
+            "a2a_batch": int(B * a2a_cols * recv_batch),
+            "allgather_labels_weights": int(8 * B * recv_batch),
         },
     )
 
@@ -101,7 +109,8 @@ def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
               (score psums are 2·[B] — pair, lin)
     - deepfm: fm's psum group + h all_gather [B, f_pad·k] → w·B·f_pad·k·recv
     """
-    c = _base_counts(B, F, k, n, cap, device_aux)
+    c = _base_counts(B, F, k, n, cap, device_aux,
+                     n_total=n * n_row if n_row > 1 else None)
     w = _WIRE_BYTES[psum_dtype]
     ici = c["ici"]
     if n_row > 1 and model == "fm":

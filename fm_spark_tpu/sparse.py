@@ -47,12 +47,26 @@ def _sr_base_key(config: TrainConfig):
     return jax.random.key(config.seed + 0x5EED)
 
 
-def _check_host_dedup(config: TrainConfig):
+def _check_host_dedup(config: TrainConfig, loss: str):
     """Shared host_dedup/compact preconditions for the fused bodies
-    (single definition so the factories can never drift)."""
+    (single definition so the factories can never drift). ``loss`` is the
+    step's loss name: the 'error' overflow policy's -inf sentinel is only
+    unambiguous for non-negative losses (_fold_overflow), so membership
+    in the known-non-negative set is asserted here (ADVICE r4)."""
     if config.compact_device:
         if config.compact_cap <= 0:
             raise ValueError("compact_device requires compact_cap > 0")
+        if (config.compact_overflow == "error"
+                and loss not in losses_lib.NON_NEGATIVE_LOSSES):
+            raise ValueError(
+                "compact_overflow='error' signals overflow by poisoning "
+                "the loss to -inf, which is only unambiguous for "
+                "non-negative losses "
+                f"{sorted(losses_lib.NON_NEGATIVE_LOSSES)}; loss "
+                f"{loss!r} is not in that set — add it to "
+                "losses.NON_NEGATIVE_LOSSES only after verifying it "
+                "cannot go negative (or use compact_overflow='drop')"
+            )
         if config.host_dedup:
             raise ValueError(
                 "compact_device builds the aux in-step; host_dedup is "
@@ -417,7 +431,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     if config.use_pallas and not spec.fused_linear:
         raise ValueError("use_pallas requires fused_linear=True")
-    _check_host_dedup(config)
+    _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     if compact and not spec.fused_linear:
         raise ValueError("compact_cap requires fused_linear=True")
@@ -641,7 +655,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     _reject_gfull(config, "the FieldFFM body")
     _reject_collective_dtype(config, "the single-chip FieldFFM body")
     _reject_score_sharded(config, "the single-chip FieldFFM body")
-    _check_host_dedup(config)
+    _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
@@ -745,7 +759,7 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldDeepFMSpec")
     _reject_collective_dtype(config, "the single-chip FieldDeepFM body")
     _reject_score_sharded(config, "the single-chip FieldDeepFM body")
-    _check_host_dedup(config)
+    _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype

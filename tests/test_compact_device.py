@@ -286,6 +286,17 @@ def test_config_validation():
             make_field_sparse_sgd_step(
                 spec, _base_cfg(compact_overflow=policy)
             )
+    # The 'error' policy's -inf sentinel requires a provably
+    # non-negative loss; an unlisted loss must fail at construction,
+    # not silently corrupt the sentinel (ADVICE r4).
+    from fm_spark_tpu.sparse import _check_host_dedup
+
+    with pytest.raises(ValueError, match="non-negative losses"):
+        _check_host_dedup(
+            _base_cfg(sparse_update="dedup_sr", compact_device=True,
+                      compact_cap=8, compact_overflow="error"),
+            "exotic_negative_loss",
+        )
 
 
 @pytest.mark.parametrize("mode", ["dedup", "dedup_sr"])
