@@ -161,7 +161,8 @@ def inner_main(args):
                 or args.table_layout != "row"
                 or args.rank != 64 or args.batch != 1 << 17
                 or args.steps != 20 or args.compact_cap
-                or args.compact_device or args.gfull_fused)
+                or args.compact_device or args.gfull_fused
+                or args.segtotal_pallas)
     variants = [(
         f"{args.param_dtype}/{args.sparse_update}"
         + ("/pallas" if args.use_pallas else "")
@@ -170,14 +171,16 @@ def inner_main(args):
         + ("/devaux" if args.compact_device else "")
         + ("/cd-bf16" if args.compute_dtype == "bfloat16" else "")
         + ("/colT" if args.table_layout == "col" else "")
-        + ("/gfull" if args.gfull_fused else ""),
+        + ("/gfull" if args.gfull_fused else "")
+        + ("/segtotal" if args.segtotal_pallas else ""),
         (args.param_dtype, None, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
                     use_pallas=args.use_pallas, host_dedup=args.host_dedup,
                     compact_cap=args.compact_cap,
                     compact_device=args.compact_device,
-                    gfull_fused=args.gfull_fused),
+                    gfull_fused=args.gfull_fused,
+                    segtotal_pallas=args.segtotal_pallas),
     )]
     if not explicit:
         # The COMPACT host-dedup candidates (PERF.md: the round-2 probes
@@ -209,10 +212,23 @@ def inner_main(args):
                         host_dedup=True, compact_cap=cap,
                         gfull_fused=True),
         ))
+        # The round-5 segtotal A/B: the winning combo with the Pallas
+        # sorted-run segment-total kernel replacing the blocked prefix
+        # (ops/pallas_segsum.py — upside ≈ the remaining half of the
+        # blocked-prefix cost). THIRD so both staged kernel A/Bs land
+        # early if the attachment dies mid-sweep.
+        variants.insert(2, (
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap,
+                        segtotal_pallas=True),
+        ))
         # TRANSPOSED-table candidate (PERF.md "transpose" probe: the
         # col layout halves physical table bytes and the cap-gather
         # scan with it; donated scatter measured layout-neutral).
-        variants.insert(2, (
+        variants.insert(3, (
             f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT",
             ("bfloat16", "bfloat16", "col"),
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
@@ -223,7 +239,7 @@ def inner_main(args):
         # shipping/sort, F on-device sorts instead — the variant that
         # composes with 2-D meshes and multi-process scale-out. Measured
         # here so the single-chip cost of the in-step sort is on record.
-        variants.insert(3, (
+        variants.insert(4, (
             f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
             ("bfloat16", "bfloat16", None),
             TrainConfig(learning_rate=0.05, lr_schedule="constant",
@@ -502,6 +518,11 @@ def main():
                     dest="gfull_fused",
                     help="fused g_full construction (no per-field "
                          "concat([g_v, g_l]); PERF.md round-4 lever)")
+    ap.add_argument("--segtotal-pallas", action="store_true",
+                    dest="segtotal_pallas",
+                    help="Pallas sorted-run segment totals in the "
+                         "compact update (no blocked-prefix "
+                         "materialization; round-5 lever)")
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
@@ -566,6 +587,8 @@ def main():
         argv.append("--compact-device")
     if args.gfull_fused:
         argv.append("--gfull-fused")
+    if args.segtotal_pallas:
+        argv.append("--segtotal-pallas")
     # An outer kill (timeout(1) sends SIGTERM) must still leave a
     # parseable final line: best-so-far result if any child printed one,
     # otherwise the error JSON with the failure log.

@@ -32,6 +32,12 @@ import sys
 
 import numpy as np
 
+from fm_spark_tpu.cli_levers import (
+    _LEVERS,
+    _add_lever_args,
+    _lever_overrides,
+)
+
 
 # ----------------------------------------------------------------- data
 
@@ -307,6 +313,7 @@ class _FieldCap:
     multistep_single: bool           # --steps-per-call fori roll (1 chip)
     multistep_sharded: bool          # --steps-per-call on the sharded step
     sharded_score: bool              # --score-sharded example-sharded dscores
+    sharded_deep: bool               # --deep-sharded example-sharded head
 
 
 _FIELD_CAPS = {
@@ -315,14 +322,14 @@ _FIELD_CAPS = {
         carries_opt=False, sharded_2d=True, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
         multistep_single=True, multistep_sharded=True,
-        sharded_score=True,
+        sharded_score=True, sharded_deep=False,
     ),
     "FieldFFMSpec": _FieldCap(
         single_step=_single_ffm_step, sharded_step=_sharded_ffm_step,
         carries_opt=False, sharded_2d=True, sharded_host_compact=True,
         sharded_device_compact=True, sharded_multiproc=True,
         multistep_single=True, multistep_sharded=True,
-        sharded_score=False,
+        sharded_score=False, sharded_deep=False,
     ),
     "FieldDeepFMSpec": _FieldCap(
         single_step=_single_deepfm_step,
@@ -330,7 +337,7 @@ _FIELD_CAPS = {
         carries_opt=True, sharded_2d=True, sharded_host_compact=False,
         sharded_device_compact=True, sharded_multiproc=True,
         multistep_single=True, multistep_sharded=True,
-        sharded_score=False,
+        sharded_score=False, sharded_deep=True,
     ),
 }
 
@@ -444,17 +451,14 @@ def _validate_field_caps(spec, tconfig, cap, n, pc, sharded,
             f"--host-dedup on {n} devices requires --compact-cap "
             "(or drop --host-dedup / run on 1 chip)"
         )
-    if tconfig.collective_dtype != "float32" and not sharded:
-        raise SystemExit(
-            f"--collective-dtype {tconfig.collective_dtype} is a wire-"
-            f"precision knob for multi-device runs (found {n} device(s))"
-        )
-    if tconfig.score_sharded and not (sharded and cap.sharded_score):
-        raise SystemExit(
-            f"--score-sharded needs multiple devices and a model family "
-            f"with the example-sharded score path "
-            f"(found {n} device(s), {type(spec).__name__})"
-        )
+    # Registry-driven per-lever guards (one validate per _Lever row).
+    ctx = dict(spec=spec, cap=cap, n=n, pc=pc, sharded=sharded,
+               row_shards=row_shards)
+    for lv in _LEVERS:
+        if lv.validate is not None:
+            msg = lv.validate(tconfig, ctx)
+            if msg:
+                raise SystemExit(msg)
     if pc > 1 and not cap.sharded_multiproc:
         raise SystemExit(
             f"multi-process training is not supported for "
@@ -952,12 +956,7 @@ def cmd_train(args) -> int:
     tconfig = cfg.train_config(
         log_every=args.log_every, metrics_path=args.metrics,
         eval_every=args.eval_every,
-        host_dedup=True if args.host_dedup else None,
-        compact_cap=args.compact_cap,
-        compact_device=True if args.compact_device else None,
-        compact_overflow=args.compact_overflow,
-        collective_dtype=args.collective_dtype,
-        score_sharded=True if args.score_sharded else None,
+        **_lever_overrides(args),
     )
 
     import jax as _jax
@@ -1322,52 +1321,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route fused-step row gather/update through the "
                         "Pallas pipelined-DMA kernels (TPU; interpret mode "
                         "elsewhere)")
-    t.add_argument("--host-dedup", action="store_true", dest="host_dedup",
-                   help="precompute per-batch dedup sort/segment maps on "
-                        "the host prefetch thread; device writes each "
-                        "unique id once (needs --sparse-update dedup or "
-                        "dedup_sr; single-chip FieldFM)")
-    t.add_argument("--compact-cap", type=int, default=None,
-                   dest="compact_cap",
-                   help="COMPACT host-dedup: static per-field unique-id "
-                        "capacity — the device touches the big tables "
-                        "with this many lanes instead of the batch size "
-                        "(the measured headline winner, PERF.md). Must "
-                        "bound every field's per-batch unique-id count "
-                        "(the aux builder raises otherwise). Needs "
-                        "--host-dedup or --compact-device")
-    t.add_argument("--compact-device", action="store_true",
-                   dest="compact_device",
-                   help="build the compact aux ON DEVICE inside the step "
-                        "(no host aux shipping) — the scale-out form of "
-                        "--compact-cap: composes with --row-shards 2-D "
-                        "meshes and multi-process runs. Needs "
-                        "--compact-cap and a dedup --sparse-update; "
-                        "exclusive with --host-dedup")
-    t.add_argument("--compact-overflow", default=None,
-                   dest="compact_overflow",
-                   choices=["error", "drop", "split"],
-                   help="policy when a field's per-batch unique ids "
-                        "exceed --compact-cap: error (default; host aux "
-                        "raises before the step, device aux poisons the "
-                        "loss), drop (device: overflow ids behave as "
-                        "absent features), split (host: split the batch "
-                        "until every field fits — exact, more steps)")
-    t.add_argument("--collective-dtype", default=None,
-                   dest="collective_dtype",
-                   choices=["float32", "bfloat16"],
-                   help="wire dtype for the sharded steps' activation "
-                        "collectives (score psums, DeepFM h, FFM sel "
-                        "all_to_all) — bfloat16 halves the dominant ICI "
-                        "bytes (parallel/projection.py); multi-device "
-                        "field_sparse only")
-    t.add_argument("--score-sharded", action="store_true",
-                   dest="score_sharded",
-                   help="shard the [B,k] score/dscores math over "
-                        "examples on the sharded FM step (exact; one "
-                        "tiny [B] dscores all_gather) — removes the "
-                        "only non-shardable batch-proportional term "
-                        "(parallel/projection.py)")
+    _add_lever_args(t)
     t.add_argument("--batch-per-chip", type=int, default=None,
                    dest="batch_per_chip",
                    help="WEAK-SCALING batch sizing: global batch = N x "

@@ -1,0 +1,138 @@
+"""The CLI lever registry (VERDICT r4 #7): one row per TrainConfig lever.
+
+Parser setup (:func:`add_lever_args`), train-config threading
+(:func:`lever_overrides`), and the per-lever capability guards
+(:data:`LEVERS` rows' ``validate``, run by cli._validate_field_caps)
+all iterate ONE table — adding lever N+1 to the CLI is one ``_Lever``
+row here (+ its TrainConfig field and step support); cli.py itself does
+not change. Multi-flag interplay (the compact-aux family) stays in
+cli._validate_field_caps' dedicated block: those guards couple several
+flags at once and would not be clearer as rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+@dataclasses.dataclass(frozen=True)
+class _Lever:
+    flag: str            # CLI flag, e.g. "--score-sharded"
+    field: str           # TrainConfig field name (= argparse dest)
+    kind: str            # 'flag' | 'int' | 'choice'
+    help: str
+    choices: tuple = ()
+    # Optional guard: (tconfig, ctx) -> error message | None, where ctx
+    # has spec/cap/n/pc/sharded/row_shards. Raised as SystemExit by
+    # _validate_field_caps.
+    validate: object = None
+
+
+def _v_collective_dtype(tc, ctx):
+    if tc.collective_dtype != "float32" and not ctx["sharded"]:
+        return (
+            f"--collective-dtype {tc.collective_dtype} is a wire-"
+            f"precision knob for multi-device runs (found {ctx['n']} "
+            "device(s))"
+        )
+
+
+def _v_score_sharded(tc, ctx):
+    if tc.score_sharded and not (ctx["sharded"]
+                                 and ctx["cap"].sharded_score):
+        return (
+            f"--score-sharded needs multiple devices and a model family "
+            f"with the example-sharded score path "
+            f"(found {ctx['n']} device(s), {type(ctx['spec']).__name__})"
+        )
+
+
+def _v_deep_sharded(tc, ctx):
+    if tc.deep_sharded and not (ctx["sharded"]
+                                and ctx["cap"].sharded_deep):
+        return (
+            f"--deep-sharded needs multiple devices and a model family "
+            f"with an example-sharded deep head "
+            f"(found {ctx['n']} device(s), {type(ctx['spec']).__name__})"
+        )
+
+
+_LEVERS = (
+    _Lever("--host-dedup", "host_dedup", "flag",
+           "precompute per-batch dedup sort/segment maps on the host "
+           "prefetch thread; device writes each unique id once (needs "
+           "--sparse-update dedup or dedup_sr; single-chip FieldFM)"),
+    _Lever("--compact-cap", "compact_cap", "int",
+           "COMPACT host-dedup: static per-field unique-id capacity — "
+           "the device touches the big tables with this many lanes "
+           "instead of the batch size (the measured headline winner, "
+           "PERF.md). Must bound every field's per-batch unique-id "
+           "count (the aux builder raises otherwise). Needs "
+           "--host-dedup or --compact-device"),
+    _Lever("--compact-device", "compact_device", "flag",
+           "build the compact aux ON DEVICE inside the step (no host "
+           "aux shipping) — the scale-out form of --compact-cap: "
+           "composes with --row-shards 2-D meshes and multi-process "
+           "runs. Needs --compact-cap and a dedup --sparse-update; "
+           "exclusive with --host-dedup"),
+    _Lever("--compact-overflow", "compact_overflow", "choice",
+           "policy when a field's per-batch unique ids exceed "
+           "--compact-cap: error (default; host aux raises before the "
+           "step, device aux poisons the loss), drop (device: overflow "
+           "ids behave as absent features), split (host: split the "
+           "batch until every field fits — exact, more steps)",
+           choices=("error", "drop", "split")),
+    _Lever("--collective-dtype", "collective_dtype", "choice",
+           "wire dtype for the sharded steps' activation collectives "
+           "(score psums, DeepFM h, FFM sel all_to_all) — bfloat16 "
+           "halves the dominant ICI bytes (parallel/projection.py); "
+           "multi-device field_sparse only",
+           choices=("float32", "bfloat16"),
+           validate=_v_collective_dtype),
+    _Lever("--score-sharded", "score_sharded", "flag",
+           "shard the [B,k] score/dscores math over examples on the "
+           "sharded FM step (exact; one tiny [B] dscores all_gather) — "
+           "removes the only non-shardable batch-proportional term "
+           "(parallel/projection.py)",
+           validate=_v_score_sharded),
+    _Lever("--deep-sharded", "deep_sharded", "flag",
+           "example-shard the DeepFM deep head on the sharded step "
+           "(h all_gather -> one all_to_all, MLP on B/n examples per "
+           "chip, [B] deep-score gather) — ~n x fewer h wire bytes "
+           "and the deep FLOPs divide by n (parallel/projection.py)",
+           validate=_v_deep_sharded),
+    _Lever("--segtotal-pallas", "segtotal_pallas", "flag",
+           "compute the compact update's segment sums with the Pallas "
+           "sorted-run kernel (streaming read, VMEM-resident [cap, w] "
+           "accumulator — no [B, w] prefix materialization; "
+           "ops/pallas_segsum.py). Needs --compact-cap; off-TPU runs "
+           "interpret mode; the on-chip A/B prices it"),
+)
+
+
+def _add_lever_args(parser):
+    """Registry-driven argparse rows (one per _Lever)."""
+    for lv in _LEVERS:
+        if lv.kind == "flag":
+            parser.add_argument(lv.flag, action="store_true",
+                                dest=lv.field, help=lv.help)
+        elif lv.kind == "int":
+            parser.add_argument(lv.flag, type=int, default=None,
+                                dest=lv.field, help=lv.help)
+        elif lv.kind == "choice":
+            parser.add_argument(lv.flag, default=None,
+                                choices=list(lv.choices),
+                                dest=lv.field, help=lv.help)
+        else:
+            raise ValueError(f"unknown lever kind {lv.kind!r}")
+
+
+def _lever_overrides(args) -> dict:
+    """The registry's train_config(**overrides) slice: store_true flags
+    map False -> None (no override) so config defaults survive."""
+    out = {}
+    for lv in _LEVERS:
+        v = getattr(args, lv.field)
+        if lv.kind == "flag":
+            v = True if v else None
+        out[lv.field] = v
+    return out

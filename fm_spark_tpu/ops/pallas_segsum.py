@@ -1,0 +1,123 @@
+"""Pallas TPU kernel: sorted-run SEGMENT TOTALS for the compact update.
+
+The compact path's update half needs, per field, the per-segment sums of
+the sorted deltas (``compact_apply``). The shipped XLA formulation is a
+blocked two-level fp32 prefix + cap-lane boundary gathers (round 3,
++11%); its remaining cost is one full write+read pass of the [B, w]
+block-prefix buffer. This kernel computes the totals DIRECTLY — one
+streaming read of the sorted deltas, one [cap, w] output — with no
+prefix materialization at all (the round-4 "next levers" candidate,
+VERDICT r4 #2a).
+
+Why the round-4 sketch rejection ("per-tile variable segment counts
+force overlapping output windows or a disjoint [B, w] partials buffer")
+does not hold: a TPU Pallas grid is SEQUENTIAL and the whole [cap+T, w]
+output block stays VMEM-resident under a constant index map (cap=16384,
+w=65 fp32 = 4.3MB), so each tile can read-modify-write the dynamic
+window ``out[first_seg(tile) : +T]`` — boundary segments spanning tiles
+accumulate correctly through the resident block, no clobbering, no
+partials buffer. Within a tile the totals are ONE one-hot matmul on the
+MXU (``onehot[s, t] = (seg[t] − first == s)``, [T, T]·[T, w]), so the
+VPU never loops lanes.
+
+Traffic: read B·w (sorted deltas) + write cap·w — versus the XLA
+prefix's read B·w + write B·w + read-at-boundaries. Upside ≈ the
+remaining half of the blocked-prefix cost (PERF.md bounds it from the
+``cumsum`` probe rows at ~25-30ms/39 fields on the degraded
+attachment). Behind ``TrainConfig.segtotal_pallas``; interpret-mode
+semantics pinned in tests/test_pallas_segsum.py; the on-chip A/B prices
+it (bench.py sweep).
+
+Overflow semantics (device-built aux): lanes whose segment index
+reached past ``cap`` are clamped to the trash row ``cap`` outside the
+kernel; trash accumulates into ``out[cap:]`` and is trimmed, so
+overflow contributions can never corrupt a real segment — exactly the
+masked-drop contract of ``_compact_gather_all(mask_overflow=True)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Lanes per grid step. 512 makes the one-hot matmul a [512, 512]·[512, w]
+# MXU op and bounds the per-tile distinct-segment count by construction
+# (<= T), so the dynamic output window never needs more than T rows.
+_TILE = 512
+
+
+def _kernel(first_ref, seg_ref, x_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    first = first_ref[i]
+    seg = seg_ref[0, :]                                    # [T] int32
+    local = seg - first                                    # [0, T) valid
+    onehot = (
+        local[None, :]
+        == jax.lax.broadcasted_iota(jnp.int32, (_TILE, _TILE), 0)
+    ).astype(jnp.float32)                                  # [T(seg), T(lane)]
+    totals = jnp.dot(onehot, x_ref[...],
+                     preferred_element_type=jnp.float32)   # [T, w]
+    win = pl.ds(first, _TILE)
+    out_ref[win, :] = out_ref[win, :] + totals
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def segment_totals(sdelta: jax.Array, seg_sorted: jax.Array, cap: int,
+                   interpret: bool = False) -> jax.Array:
+    """Per-segment sums of sorted deltas: ``out[s] = Σ_{seg[t]=s} x[t]``.
+
+    ``sdelta`` [B, w] float32, sorted by segment; ``seg_sorted`` [B]
+    int32 non-decreasing (values ≥ cap = overflow, dropped to the trash
+    row). Returns [cap, w] float32.
+
+    PRECONDITION — dense ranks, not arbitrary ids: within any ``_TILE``
+    consecutive lanes the segment values must span < ``_TILE`` (the
+    one-hot window is [first_seg(tile), first_seg(tile)+_TILE); a lane
+    whose segment falls outside it contributes NOTHING, silently).
+    Non-decreasing DENSE ranks (0, 0, 1, 2, 2, ...; every rank in
+    [0, cap) occupied up to the unique count) satisfy this by
+    construction — a tile of T lanes covers ≤ T distinct ranks — and
+    that is exactly what both compact-aux builders emit (``inv`` is the
+    cumsum-derived rank of each lane's id). Do NOT feed raw gapped ids;
+    rank them first (one ``cumsum(seg[1:] != seg[:-1])``).
+    """
+    b, w = sdelta.shape
+    t = _TILE
+    pad = (-b) % t
+    if pad:
+        sdelta = jnp.pad(sdelta, ((0, pad), (0, 0)))
+        # Padding lanes carry zero values; park them on the trash row.
+        seg_sorted = jnp.pad(seg_sorted, (0, pad),
+                             constant_values=cap)
+    seg_sorted = jnp.minimum(seg_sorted, cap)              # clamp overflow
+    nb = sdelta.shape[0] // t
+    first = seg_sorted[::t].astype(jnp.int32)              # [nb] prefetch
+    seg2d = seg_sorted.reshape(nb, t).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, t), lambda i, first: (i, 0)),
+            pl.BlockSpec((t, w), lambda i, first: (i, 0)),
+        ],
+        # Constant index map: the [cap+T, w] accumulator stays
+        # VMEM-resident across the sequential grid.
+        out_specs=pl.BlockSpec((cap + t, w), lambda i, first: (0, 0)),
+    )
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((cap + t, w), jnp.float32),
+        interpret=interpret,
+    )(first, seg2d, sdelta)
+    return out[:cap]

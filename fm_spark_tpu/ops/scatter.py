@@ -365,7 +365,8 @@ def compact_gather(table, useg, col: bool = False):
 _CSUM_BLOCK = 512
 
 
-def compact_apply(table, delta, caux, mode, key, urows, col: bool = False):
+def compact_apply(table, delta, caux, mode, key, urows, col: bool = False,
+                  segtotal_pallas: bool = False):
     """Update half of the compact path (see :func:`compact_aux`): per-
     segment sums via a two-level blocked fp32 prefix over the sorted
     deltas + cap-lane boundary gathers (``sum[s] = csum(end_s) −
@@ -375,26 +376,42 @@ def compact_apply(table, delta, caux, mode, key, urows, col: bool = False):
     ``set`` of ``urows + sum`` for ``dedup_sr`` (``urows`` doubles as
     the old-row operand — no second gather). ``col`` = transposed table
     storage (see :func:`compact_gather`): the cap-sized update
-    transposes before the column write; values are identical."""
+    transposes before the column write; values are identical.
+
+    ``segtotal_pallas`` (TrainConfig.segtotal_pallas, round 5): compute
+    the segment sums with the Pallas sorted-run kernel
+    (:mod:`fm_spark_tpu.ops.pallas_segsum`) instead of the blocked
+    prefix — one streaming read, no prefix materialization; same values
+    up to fp32 reassociation (tests/test_pallas_segsum.py). Interpret
+    mode off-TPU; the on-chip A/B prices it."""
     useg, segstart, segend, order, inv = caux
-    _check_sentinel_range(table.shape[1] if col else table.shape[0],
-                          useg.shape[-1])
-    del inv
+    cap = useg.shape[-1]
+    _check_sentinel_range(table.shape[1] if col else table.shape[0], cap)
     sdelta = delta[order].astype(jnp.float32)
     b, w = sdelta.shape
-    blk = _CSUM_BLOCK
-    pad = (-b) % blk
-    padded = jnp.pad(sdelta, ((0, pad), (0, 0))) if pad else sdelta
-    nb = padded.shape[0] // blk
-    bl = jnp.cumsum(padded.reshape(nb, blk, w), axis=1)  # within-block
-    off = jnp.cumsum(bl[:, -1, :], axis=0)               # inclusive
-    off = jnp.concatenate([jnp.zeros_like(off[:1]), off[:-1]], axis=0)
+    if segtotal_pallas:
+        from fm_spark_tpu.ops import pallas_segsum
 
-    def csum_at(pos):
-        # Boundary positions are < b, so padding rows never enter.
-        return bl[pos // blk, pos % blk] + off[pos // blk]
+        segsum = pallas_segsum.segment_totals(
+            sdelta, inv[order], cap,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        del inv
+        blk = _CSUM_BLOCK
+        pad = (-b) % blk
+        padded = jnp.pad(sdelta, ((0, pad), (0, 0))) if pad else sdelta
+        nb = padded.shape[0] // blk
+        bl = jnp.cumsum(padded.reshape(nb, blk, w), axis=1)  # in-block
+        off = jnp.cumsum(bl[:, -1, :], axis=0)               # inclusive
+        off = jnp.concatenate([jnp.zeros_like(off[:1]), off[:-1]],
+                              axis=0)
 
-    segsum = csum_at(segend) - csum_at(segstart) + sdelta[segstart]
+        def csum_at(pos):
+            # Boundary positions are < b, so padding rows never enter.
+            return bl[pos // blk, pos % blk] + off[pos // blk]
+
+        segsum = csum_at(segend) - csum_at(segstart) + sdelta[segstart]
     if mode == "dedup":
         upd = segsum.astype(table.dtype)
         if col:

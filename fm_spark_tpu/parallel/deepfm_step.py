@@ -148,43 +148,108 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
 
         # Deep head input: local xv columns — partial on a 2-D mesh
         # (ownership-masked), completed by one psum over `row` — then
-        # gathered into global field order ([B, f_pad·k], padding
-        # columns zero) and trimmed to the MLP's F·k input. The h
-        # collectives ride the wire dtype too (h is the DeepFM step's
-        # biggest activation transfer).
+        # assembled into global field order. The h collectives ride the
+        # wire dtype too (h is the DeepFM step's biggest activation
+        # transfer).
         h_local = jnp.concatenate(xvs, axis=1)
         if wire is not None:
             h_local = h_local.astype(wire)
         if two_d:
             h_local = lax.psum(h_local, "row")
-        h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
-        h = h_full[:, : F * k].astype(cd)
 
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
-
-        def head_loss(dense, h_in):
-            sc = fm_scores + spec.deep_scores(dense["mlp"], h_in)
-            if spec.use_bias:
-                sc = sc + dense["w0"].astype(cd)
-            per = per_example_loss(sc, labels) * weights
-            return jnp.sum(per) / wsum, sc
-
-        (loss, scores), vjp = jax.vjp(head_loss, {"w0": w0, "mlp": mlp}, h)
-        g_dense, g_h = vjp((jnp.ones_like(loss), jnp.zeros_like(scores)))
 
         def batch_loss(sc):
             return jnp.sum(per_example_loss(sc, labels) * weights) / wsum
 
-        dscores = jax.grad(batch_loss)(scores)
-        lr = lr_at(step_idx)
-        touched = weights > 0
+        if config.deep_sharded:
+            # EXAMPLE-sharded deep head (TrainConfig.deep_sharded —
+            # VERDICT r4 #4): one all_to_all turns the field-sharded h
+            # columns into example-sharded full-width rows ([B/n,
+            # f_pad·k] per chip — ~n× fewer wire bytes than the
+            # replicated all_gather), the MLP runs on B/n examples
+            # (deep FLOPs divide by n instead of being replicated), a
+            # [B]-scalar all_gather replicates the deep scores for the
+            # fused FM backward, and the pullback returns through the
+            # reverse all_to_all straight into each owner's columns
+            # (no dynamic_slice). MLP grads complete with one psum
+            # over ``feat``; on 2-D meshes the head is row-replicated
+            # (h is row-complete after the psum above), so ``feat`` is
+            # the only reducing axis.
+            b = h_local.shape[0]
+            n_feat = g["n_feat"]
+            if b % n_feat:
+                raise ValueError(
+                    f"deep_sharded requires the global batch ({b}) to "
+                    f"divide by the feat mesh extent ({n_feat})"
+                )
+            h_ex = lax.all_to_all(h_local, "feat", split_axis=0,
+                                  concat_axis=1, tiled=True)
+            h_ex = h_ex[:, : F * k].astype(cd)
 
-        # This chip's slice of the deep pullback, padded back to f_pad·k
-        # so padding fields see zero deep grad.
-        g_h_pad = jnp.pad(g_h, ((0, 0), (0, f_pad * k - F * k)))
-        col0 = lax.axis_index("feat") * (f_local * k)
-        g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0, f_local * k,
-                                           axis=1)
+            deep_local, head_vjp = jax.vjp(
+                lambda m, hh: spec.deep_scores(m, hh), mlp, h_ex
+            )
+            deep_wire = (deep_local.astype(wire) if wire is not None
+                         else deep_local)
+            deep_full = lax.all_gather(
+                deep_wire, "feat", axis=0, tiled=True
+            ).astype(cd)
+            scores = fm_scores + deep_full
+            if spec.use_bias:
+                scores = scores + w0.astype(cd)
+            loss, dscores = jax.value_and_grad(batch_loss)(scores)
+
+            b_loc = b // n_feat
+            ds_loc = lax.dynamic_slice_in_dim(
+                dscores, lax.axis_index("feat") * b_loc, b_loc
+            )
+            g_mlp_part, g_h_ex = head_vjp(ds_loc.astype(deep_local.dtype))
+            g_mlp = jax.tree_util.tree_map(
+                lambda t: lax.psum(t, "feat"), g_mlp_part
+            )
+            g_w0 = (
+                jnp.sum(dscores).astype(w0.dtype).reshape(w0.shape)
+                if spec.use_bias else jnp.zeros_like(w0)
+            )
+            g_dense = {"w0": g_w0, "mlp": g_mlp}
+            g_h_ex_pad = jnp.pad(g_h_ex,
+                                 ((0, 0), (0, f_pad * k - F * k)))
+            if wire is not None:
+                g_h_ex_pad = g_h_ex_pad.astype(wire)
+            g_h_loc = lax.all_to_all(
+                g_h_ex_pad, "feat", split_axis=1, concat_axis=0,
+                tiled=True,
+            ).astype(cd)
+            lr = lr_at(step_idx)
+            touched = weights > 0
+        else:
+            h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
+            h = h_full[:, : F * k].astype(cd)
+
+            def head_loss(dense, h_in):
+                sc = fm_scores + spec.deep_scores(dense["mlp"], h_in)
+                if spec.use_bias:
+                    sc = sc + dense["w0"].astype(cd)
+                per = per_example_loss(sc, labels) * weights
+                return jnp.sum(per) / wsum, sc
+
+            (loss, scores), vjp = jax.vjp(
+                head_loss, {"w0": w0, "mlp": mlp}, h
+            )
+            g_dense, g_h = vjp((jnp.ones_like(loss),
+                                jnp.zeros_like(scores)))
+
+            dscores = jax.grad(batch_loss)(scores)
+            lr = lr_at(step_idx)
+            touched = weights > 0
+
+            # This chip's slice of the deep pullback, padded back to
+            # f_pad·k so padding fields see zero deep grad.
+            g_h_pad = jnp.pad(g_h, ((0, 0), (0, f_pad * k - F * k)))
+            col0 = lax.axis_index("feat") * (f_local * k)
+            g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0,
+                                               f_local * k, axis=1)
 
         if config.gfull_fused:
             from fm_spark_tpu.sparse import _gfull_grads

@@ -205,9 +205,13 @@ def _make_ffm_local_step(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import _reject_gfull
 
     _reject_gfull(config, "the field-sharded FFM step")
-    from fm_spark_tpu.sparse import _reject_score_sharded
+    from fm_spark_tpu.sparse import (
+        _reject_deep_sharded,
+        _reject_score_sharded,
+    )
 
     _reject_score_sharded(config, "the field-sharded FFM step")
+    _reject_deep_sharded(config, "the field-sharded FFM step")
     wire = _collective_dtype(config)
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(

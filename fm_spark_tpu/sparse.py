@@ -101,6 +101,12 @@ def _check_host_dedup(config: TrainConfig, loss: str):
             "device path cannot reshape a batch in-step (use 'error' "
             "or 'drop')"
         )
+    if config.segtotal_pallas and config.compact_cap <= 0:
+        # The kernel replaces the compact update's segment-sum stage;
+        # without a cap there is no such stage (no-silent-fallback).
+        raise ValueError(
+            "segtotal_pallas requires the compact path (compact_cap > 0)"
+        )
     if not (config.host_dedup or config.compact_device):
         return
     if config.sparse_update not in ("dedup", "dedup_sr"):
@@ -169,6 +175,7 @@ def _compact_apply_all(tables, g_fulls, urows, config: TrainConfig,
             scatter_lib.compact_apply(
                 tables[f], -lr * g_full, tuple(a[f] for a in aux),
                 config.sparse_update, key, urows[f], col=col,
+                segtotal_pallas=config.segtotal_pallas,
             )
         )
     return new
@@ -345,6 +352,18 @@ def _reject_score_sharded(config: TrainConfig, what: str):
         )
 
 
+def _reject_deep_sharded(config: TrainConfig, what: str):
+    """Guard for factories that do not implement the example-sharded
+    deep head (the field-sharded DeepFM step's lever; see
+    TrainConfig.deep_sharded): fail loudly instead of silently running
+    the replicated head (no-silent-fallback rule)."""
+    if config.deep_sharded:
+        raise ValueError(
+            f"deep_sharded is implemented for the field-sharded DeepFM "
+            f"step only, not {what}"
+        )
+
+
 def _reject_gfull(config: TrainConfig, what: str):
     """Guard for step factories that do not implement the gfull_fused
     backward: hard-fail instead of silently training with the concat
@@ -366,6 +385,13 @@ def _reject_host_aux(config: TrainConfig, what: str):
             f"the HOST-built dedup/compact aux is not supported by "
             f"{what}; drop host_dedup (compact_device=True is the "
             "form that composes with sharded layouts where supported)"
+        )
+    if config.segtotal_pallas:
+        # Requires the compact fused path (cap > 0) — which this
+        # factory just rejected above; a bare flag is equally a no-op.
+        raise ValueError(
+            f"segtotal_pallas rides the compact fused update, which is "
+            f"not part of {what}"
         )
 
 
@@ -449,6 +475,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                          "construction; it requires fused_linear=True")
     _reject_collective_dtype(config, "the single-chip FieldFM body")
     _reject_score_sharded(config, "the single-chip FieldFM body")
+    _reject_deep_sharded(config, "the single-chip FieldFM body")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -655,6 +682,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     _reject_gfull(config, "the FieldFFM body")
     _reject_collective_dtype(config, "the single-chip FieldFFM body")
     _reject_score_sharded(config, "the single-chip FieldFFM body")
+    _reject_deep_sharded(config, "the single-chip FieldFFM body")
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -759,6 +787,7 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldDeepFMSpec")
     _reject_collective_dtype(config, "the single-chip FieldDeepFM body")
     _reject_score_sharded(config, "the single-chip FieldDeepFM body")
+    _reject_deep_sharded(config, "the single-chip FieldDeepFM body")
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -957,6 +986,7 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
                   "g_full concat to eliminate)")
     _reject_collective_dtype(config, "the single-chip flat-table FM step")
     _reject_score_sharded(config, "the single-chip flat-table FM step")
+    _reject_deep_sharded(config, "the single-chip flat-table FM step")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
 

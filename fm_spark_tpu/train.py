@@ -131,6 +131,32 @@ class TrainConfig:
     # constraint on weak scaling (parallel/projection.py). Requires the
     # global batch to divide by the mesh size; FM sharded step only.
     score_sharded: bool = False
+    # Example-shard the DEEP HEAD on the field-sharded DeepFM step (the
+    # h-analog of score_sharded — VERDICT r4 #4): instead of
+    # all_gather-ing ``h`` ([B, F_pad·k] — the step's dominant ICI term,
+    # ~623MB/chip/step bf16 at headline shapes) and running the MLP
+    # replicated on every chip, ONE all_to_all re-shards h by EXAMPLES
+    # ([B/n, F_pad·k] per chip, ~n× fewer wire bytes), each chip runs
+    # the MLP forward/backward on its B/n slice (deep FLOPs divide by n
+    # instead of being replicated), a [B]-scalar all_gather replicates
+    # the deep scores, the deep pullback returns through the reverse
+    # all_to_all, and the MLP grads complete with one small psum over
+    # ``feat``. Numerics: per-example deep scores are the replicated
+    # computation's values up to matmul row-blocking; the MLP grad
+    # reassociates across chips (psum) — equivalence-tested to tight
+    # tolerance. Requires the global batch to divide by the feat mesh
+    # extent; field-sharded DeepFM step only (rejected elsewhere).
+    deep_sharded: bool = False
+    # Compute the compact update's per-segment sums with the Pallas
+    # sorted-run kernel (ops/pallas_segsum.py) instead of the blocked
+    # two-level prefix: one streaming read of the sorted deltas + a
+    # VMEM-resident [cap, w] accumulator — no [B, w] prefix
+    # materialization (the round-4 "next levers" candidate, VERDICT r4
+    # #2a; upside ≈ the remaining half of the blocked-prefix cost).
+    # Same values up to fp32 reassociation; interpret mode off-TPU;
+    # off by default until the on-chip A/B (bench.py sweep) prices it.
+    # Requires compact_cap > 0 (it has nothing to compute otherwise).
+    segtotal_pallas: bool = False
 
 
 def _group_reg(config: TrainConfig):
@@ -195,6 +221,7 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
     """
     from fm_spark_tpu.sparse import (
         _reject_collective_dtype,
+        _reject_deep_sharded,
         _reject_host_aux,
         _reject_score_sharded,
     )
@@ -202,6 +229,7 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
     _reject_host_aux(config, "the dense optax train step")
     _reject_collective_dtype(config, "the dense single-device train step")
     _reject_score_sharded(config, "the dense single-device train step")
+    _reject_deep_sharded(config, "the dense single-device train step")
     optimizer = optimizer or make_optimizer(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     add_reg = _group_reg(config)

@@ -432,3 +432,11 @@ def test_field_sparse_capability_guards():
     with pytest.raises(SystemExit, match="exclusive"):
         run("g9", "criteo1tb_fm_r64",
             ["--batch-per-chip", "16"], fm_kw)
+    # Round-5 lever: the example-sharded deep head on the sharded
+    # DeepFM step (with bf16 wire) — must run clean end-to-end; FM has
+    # no deep head, so the registry guard must hard-fail it.
+    assert run("g10", "criteo1tb_deepfm",
+               ["--deep-sharded", "--collective-dtype", "bfloat16"],
+               deepfm_kw) == 0
+    with pytest.raises(SystemExit, match="deep-sharded"):
+        run("g11", "criteo1tb_fm_r64", ["--deep-sharded"], fm_kw)
