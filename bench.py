@@ -60,9 +60,22 @@ import sys
 import threading
 import time
 
-METRIC = "criteo_fm_rank64_10Mfeat_samples_per_sec_per_chip"
+# Per-model metric + per-chip target (--model). The tracked headline is
+# the FM row (BASELINE.json:2); the FFM row exists so a chip window can
+# REFRESH MEASURED.json's config-4 rate (carried from round 3 otherwise)
+# with one command: `python bench.py --model ffm`.
+METRICS = {
+    "fm": ("criteo_fm_rank64_10Mfeat_samples_per_sec_per_chip",
+           10_000_000 / 8),
+    "ffm": ("avazu_ffm_rank16_samples_per_sec_per_chip", None),
+}
+METRIC, TARGET_PER_CHIP = METRICS["fm"]
 UNIT = "samples/sec/chip"
-TARGET_PER_CHIP = 10_000_000 / 8
+
+
+def _set_model(model: str) -> None:
+    global METRIC, TARGET_PER_CHIP
+    METRIC, TARGET_PER_CHIP = METRICS[model]
 
 
 def _log(msg):
@@ -121,19 +134,37 @@ def inner_main(args):
          f"{len(devs)} x {devs[0].device_kind}")
 
     from fm_spark_tpu import models
-    from fm_spark_tpu.sparse import make_field_sparse_sgd_body
+    from fm_spark_tpu.sparse import (
+        make_field_ffm_sparse_sgd_body,
+        make_field_sparse_sgd_body,
+    )
     from fm_spark_tpu.train import TrainConfig
 
     import numpy as np
 
-    num_fields = 39
-    bucket = 262_144
-    rank = args.rank
+    _set_model(args.model)
+    if args.model == "ffm":
+        # Config 4's shape (configs.avazu_ffm_r16): 23 fields, 16384
+        # per-field buckets, rank 16.
+        num_fields, bucket = 23, 1 << 14
+        rank = args.rank or 16
+        if args.table_layout != "row":
+            raise SystemExit("--table-layout col is a FieldFM lever")
+    else:
+        num_fields, bucket = 39, 262_144
+        rank = args.rank or 64
     batch = args.batch
     steps_warmup = 3
     steps_timed = args.steps
 
     def make_spec(param_dtype, compute_dtype=None, table_layout=None):
+        if args.model == "ffm":
+            return models.FieldFFMSpec(
+                num_features=num_fields * bucket, rank=rank,
+                num_fields=num_fields, bucket=bucket, init_std=0.01,
+                param_dtype=param_dtype,
+                compute_dtype=compute_dtype or args.compute_dtype,
+            )
         return models.FieldFMSpec(
             num_features=num_fields * bucket, rank=rank,
             num_fields=num_fields, bucket=bucket, init_std=0.01,
@@ -159,7 +190,7 @@ def inner_main(args):
                 or args.host_dedup or args.param_dtype != "float32"
                 or args.compute_dtype != "float32"
                 or args.table_layout != "row"
-                or args.rank != 64 or args.batch != 1 << 17
+                or args.rank is not None or args.batch != 1 << 17
                 or args.steps != 20 or args.compact_cap
                 or args.compact_device or args.gfull_fused
                 or args.segtotal_pallas)
@@ -182,7 +213,17 @@ def inner_main(args):
                     gfull_fused=args.gfull_fused,
                     segtotal_pallas=args.segtotal_pallas),
     )]
-    if not explicit:
+    if not explicit and args.model == "ffm":
+        # FFM default sweep: the bf16 storage candidate. NO compact
+        # variants: the compact lever measured a LOSER on avazu's 24MB
+        # tables (PERF.md: 537k vs 700k — the tables sit under every
+        # gather cliff, so cap-lane compaction only adds passes).
+        variants.append((
+            "bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr"),
+        ))
+    if not explicit and args.model == "fm":
         # The COMPACT host-dedup candidates (PERF.md: the round-2 probes
         # showed scatter cost is per-lane even for dropped lanes, so cap-
         # lane compaction is the lever; full-B hostdedup measured slower
@@ -258,9 +299,11 @@ def inner_main(args):
 
     aux_cache = {}
     results = []
+    make_body = (make_field_ffm_sparse_sgd_body if args.model == "ffm"
+                 else make_field_sparse_sgd_body)
     for label, dtypes, config in variants:
         spec = make_spec(*dtypes)
-        body = make_field_sparse_sgd_body(spec, config)
+        body = make_body(spec, config)
         aux = None
         if config.host_dedup:
             # Aux for the (fixed) bench batch is computed once here; in
@@ -316,7 +359,8 @@ def inner_main(args):
             "metric": METRIC,
             "value": round(best_rate, 1),
             "unit": UNIT,
-            "vs_baseline": round(best_rate / TARGET_PER_CHIP, 4),
+            "vs_baseline": (round(best_rate / TARGET_PER_CHIP, 4)
+                            if TARGET_PER_CHIP else None),
             "variant": best_label,
             "device": devs[0].device_kind,
             "all_variants": {l: round(r, 1) for r, l, _, _ in results},
@@ -372,10 +416,13 @@ def _emit_final():
                 # as tpu_watch.sh's best-sweep selection.
                 from fm_spark_tpu.measured import (
                     load_measured,
-                    update_headline,
+                    update_entry,
                 )
+                entry = ("ffm_avazu"
+                         if parsed["metric"] == METRICS["ffm"][0]
+                         else "headline")
                 try:
-                    prev = load_measured()["headline"][
+                    prev = load_measured()[entry][
                         "rate_samples_per_sec_per_chip"]
                 except (OSError, ValueError, KeyError):
                     prev = 0.0
@@ -383,16 +430,18 @@ def _emit_final():
                     raise RuntimeError(
                         f"measured {parsed['value']:.0f} <= recorded "
                         f"best {prev:.0f}; keeping the recorded rate")
-                update_headline(
+                update_entry(
+                    entry,
                     rate=parsed["value"],
                     vs_baseline=parsed.get("vs_baseline"),
                     variant=parsed.get("variant", "?"),
-                    source="bench.py sweep (round 5+)",
+                    source=f"bench.py --model sweep (round 5+), metric "
+                           f"{parsed['metric']}",
                     attachment=parsed.get("device", "unknown device"),
                     date=time.strftime("%Y-%m-%d", time.gmtime()),
                 )
-                _log("[parent] MEASURED.json headline updated from this "
-                     "sweep")
+                _log(f"[parent] MEASURED.json {entry} updated from "
+                     "this sweep")
             except Exception as e:  # never break the final-line contract
                 _log(f"[parent] MEASURED.json update failed: {e!r}")
         else:
@@ -523,7 +572,12 @@ def main():
                     help="Pallas sorted-run segment totals in the "
                          "compact update (no blocked-prefix "
                          "materialization; round-5 lever)")
-    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--model", default="fm", choices=sorted(METRICS),
+                    help="which fused step to measure: fm = the tracked "
+                         "Criteo headline; ffm = config 4's avazu shape "
+                         "(refreshes MEASURED.json's ffm_avazu entry)")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="factor rank (default: 64 for fm, 16 for ffm)")
     ap.add_argument("--batch", type=int, default=1 << 17)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--attempts", type=int, default=6,
@@ -567,16 +621,24 @@ def main():
         sys.exit(inner_main(args))
 
     # Re-build the child argv from the variant knobs only.
+    _set_model(args.model)
+    # Config errors must fail HERE, not in the child: the parent treats
+    # a child death as a retryable attachment flake and would burn the
+    # whole --total-deadline re-spawning a guaranteed failure.
+    if args.model == "ffm" and args.table_layout != "row":
+        raise SystemExit("--table-layout col is a FieldFM lever")
     argv = [
+        "--model", args.model,
         "--param-dtype", args.param_dtype,
         "--compute-dtype", args.compute_dtype,
         "--table-layout", args.table_layout,
         "--sparse-update", args.sparse_update,
-        "--rank", str(args.rank),
         "--batch", str(args.batch),
         "--steps", str(args.steps),
         "--init-timeout", str(args.init_timeout),
     ]
+    if args.rank is not None:
+        argv += ["--rank", str(args.rank)]
     if args.use_pallas:
         argv.append("--use-pallas")
     if args.host_dedup:

@@ -36,6 +36,7 @@ from fm_spark_tpu.cli_levers import (
     _LEVERS,
     _add_lever_args,
     _lever_overrides,
+    check_levers_any,
 )
 
 
@@ -693,7 +694,9 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         )
 
         if isinstance(spec, FieldDeepFMSpec):
-            _sh_estep = make_field_deepfm_sharded_eval_step(spec, mesh)
+            _sh_estep = make_field_deepfm_sharded_eval_step(
+                spec, mesh, deep_sharded=tconfig.deep_sharded
+            )
         elif isinstance(spec, FieldFFMSpec):
             _sh_estep = make_field_ffm_sharded_eval_step(spec, mesh)
         else:
@@ -958,6 +961,9 @@ def cmd_train(args) -> int:
         eval_every=args.eval_every,
         **_lever_overrides(args),
     )
+    msg = check_levers_any(tconfig)
+    if msg:
+        raise SystemExit(msg)
 
     import jax as _jax
 

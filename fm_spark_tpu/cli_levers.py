@@ -23,8 +23,38 @@ class _Lever:
     choices: tuple = ()
     # Optional guard: (tconfig, ctx) -> error message | None, where ctx
     # has spec/cap/n/pc/sharded/row_shards. Raised as SystemExit by
-    # _validate_field_caps.
+    # _validate_field_caps (field_sparse strategy only — the other
+    # strategies' step FACTORIES carry the per-flag rejects).
     validate: object = None
+    # Optional strategy-INDEPENDENT guard: (tconfig) -> error message |
+    # None, run by cli.cmd_train for EVERY strategy right after the
+    # TrainConfig is built — for flags whose misuse the non-field
+    # factories cannot see (e.g. a policy flag that is a silent no-op
+    # without its companion cap).
+    validate_any: object = None
+
+
+def check_levers_any(tconfig):
+    """Run every registry row's strategy-independent guard; returns the
+    first error message or None."""
+    for lv in _LEVERS:
+        if lv.validate_any is not None:
+            msg = lv.validate_any(tconfig)
+            if msg:
+                return msg
+    return None
+
+
+def _v_overflow_needs_cap(tc):
+    if tc.compact_overflow != "error" and tc.compact_cap <= 0:
+        # The fused factories hard-fail this (sparse._check_host_dedup);
+        # the dense strategies never consult compact flags, so without
+        # this guard the CLI would accept a policy that does nothing
+        # (no-silent-fallback rule, ADVICE r3/r4).
+        return (
+            f"--compact-overflow {tc.compact_overflow} has no effect "
+            "without --compact-cap"
+        )
 
 
 def _v_collective_dtype(tc, ctx):
@@ -80,7 +110,8 @@ _LEVERS = (
            "step, device aux poisons the loss), drop (device: overflow "
            "ids behave as absent features), split (host: split the "
            "batch until every field fits — exact, more steps)",
-           choices=("error", "drop", "split")),
+           choices=("error", "drop", "split"),
+           validate_any=_v_overflow_needs_cap),
     _Lever("--collective-dtype", "collective_dtype", "choice",
            "wire dtype for the sharded steps' activation collectives "
            "(score psums, DeepFM h, FFM sel all_to_all) — bfloat16 "

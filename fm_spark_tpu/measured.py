@@ -54,11 +54,14 @@ def load_measured(path: str | None = None) -> dict:
     return data
 
 
-def update_headline(rate: float, vs_baseline: float | None,
-                    variant: str, source: str, attachment: str,
-                    date: str, path: str | None = None) -> None:
-    """Rewrite the headline entry (called by bench.py on a successful
-    sweep), preserving the other entries and their provenance."""
+def update_entry(key: str, rate: float, variant: str, source: str,
+                 attachment: str, date: str,
+                 vs_baseline: float | None = None,
+                 path: str | None = None) -> None:
+    """Rewrite one entry (called by bench.py on a successful sweep),
+    preserving the other entries and their provenance."""
+    if key not in _REQUIRED:
+        raise ValueError(f"unknown MEASURED.json entry {key!r}")
     p = path or MEASURED_PATH
     try:
         with open(p) as f:
@@ -66,18 +69,27 @@ def update_headline(rate: float, vs_baseline: float | None,
     except FileNotFoundError:
         data = {}  # first-ever measurement: start a fresh file
     # Any other read/parse failure propagates: silently rewriting a
-    # corrupt file would discard the other entries (ffm_avazu) and their
+    # corrupt file would discard the other entries and their
     # provenance — the destructive version of the stale-constant bug.
-    data["headline"] = {
+    entry = {
         "rate_samples_per_sec_per_chip": float(rate),
-        "vs_baseline": vs_baseline,
         "variant": variant,
         "source": source,
         "attachment": attachment,
         "date": date,
     }
+    if key == "headline":
+        entry["vs_baseline"] = vs_baseline
+    data[key] = entry
     tmp = p + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=2)
         f.write("\n")
     os.replace(tmp, p)
+
+
+def update_headline(rate: float, vs_baseline: float | None,
+                    variant: str, source: str, attachment: str,
+                    date: str, path: str | None = None) -> None:
+    update_entry("headline", rate, variant, source, attachment, date,
+                 vs_baseline=vs_baseline, path=path)

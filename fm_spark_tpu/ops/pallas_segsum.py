@@ -92,6 +92,23 @@ def segment_totals(sdelta: jax.Array, seg_sorted: jax.Array, cap: int,
     """
     b, w = sdelta.shape
     t = _TILE
+    # The whole [cap+T, w] fp32 accumulator stays VMEM-resident (that
+    # residency IS the design — it's what makes the dynamic-window
+    # read-modify-write race-free and partials-buffer-free), so its
+    # size is a hard budget: the FM headline shape (cap 16384, w 65)
+    # is 4.4MB; an FFM-width row (w = F·k+1 = 369 at avazu shapes)
+    # would be ~25MB and fail at Mosaic compile time. Reject with an
+    # actionable message instead.
+    out_bytes = (cap + t) * w * 4
+    budget = 8 * 1024 * 1024  # leave room for the tile + one-hot blocks
+    if out_bytes > budget:
+        raise ValueError(
+            f"segtotal_pallas accumulator [(cap+{t}), {w}] fp32 = "
+            f"{out_bytes / 1e6:.1f}MB exceeds the {budget // 2**20}MB "
+            "VMEM budget (the kernel keeps the whole output resident); "
+            "lower compact_cap or use the blocked-prefix path (drop "
+            "--segtotal-pallas) for wide rows (FFM)"
+        )
     pad = (-b) % t
     if pad:
         sdelta = jnp.pad(sdelta, ((0, pad), (0, 0)))

@@ -190,10 +190,14 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
             deep_local, head_vjp = jax.vjp(
                 lambda m, hh: spec.deep_scores(m, hh), mlp, h_ex
             )
-            deep_wire = (deep_local.astype(wire) if wire is not None
-                         else deep_local)
+            # Deep scores gather in FULL precision even under a bf16
+            # wire: the replicated head never rounds the logit itself
+            # (only h rides the wire there), and this gather is B
+            # scalars — noise next to the a2a terms — so quantizing it
+            # would buy nothing and break score equality with the
+            # replicated path.
             deep_full = lax.all_gather(
-                deep_wire, "feat", axis=0, tiled=True
+                deep_local, "feat", axis=0, tiled=True
             ).astype(cd)
             scores = fm_scores + deep_full
             if spec.use_bias:
@@ -221,8 +225,6 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
                 g_h_ex_pad, "feat", split_axis=1, concat_axis=0,
                 tiled=True,
             ).astype(cd)
-            lr = lr_at(step_idx)
-            touched = weights > 0
         else:
             h_full = lax.all_gather(h_local, "feat", axis=1, tiled=True)
             h = h_full[:, : F * k].astype(cd)
@@ -241,8 +243,6 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
                                 jnp.zeros_like(scores)))
 
             dscores = jax.grad(batch_loss)(scores)
-            lr = lr_at(step_idx)
-            touched = weights > 0
 
             # This chip's slice of the deep pullback, padded back to
             # f_pad·k so padding fields see zero deep grad.
@@ -251,6 +251,8 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
             g_h_loc = lax.dynamic_slice_in_dim(g_h_pad, col0,
                                                f_local * k, axis=1)
 
+        lr = lr_at(step_idx)
+        touched = weights > 0
         if config.gfull_fused:
             from fm_spark_tpu.sparse import _gfull_grads
 
@@ -411,12 +413,15 @@ def field_deepfm_param_specs(spec, mesh) -> dict:
             "mlp": mlp_specs}
 
 
-def make_field_deepfm_sharded_eval_step(spec, mesh):
+def make_field_deepfm_sharded_eval_step(spec, mesh,
+                                        deep_sharded: bool = False):
     """Metrics-accumulation step on the sharded DeepFM layout — the FM
-    partial-sum forward plus the replicated-MLP deep head (same shape as
-    :func:`make_field_deepfm_sharded_step`'s forward: local xv columns,
-    (2-D) one ``psum`` over ``row``, one ``all_gather`` of ``h``, every
-    chip runs the identical MLP)."""
+    partial-sum forward plus the deep head (same shape as
+    :func:`make_field_deepfm_sharded_step`'s forward). ``deep_sharded``
+    mirrors the train lever's forward: the example-resharding
+    all_to_all + MLP on B/n examples + [B] deep-score all_gather,
+    instead of the replicated head's h all_gather — identical scores
+    (no backward in eval, so the re-route is pure wire savings)."""
     from fm_spark_tpu.models import base as model_base
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
     from fm_spark_tpu.utils import metrics as metrics_lib
@@ -441,7 +446,7 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
     def local_eval(params, mstate, ids, vals, labels, weights):
         # The shared FM forward (scores incl. linear + bias), then the
         # deep head exactly as training: local xv columns, one all_gather
-        # of h, the replicated MLP.
+        # (or, deep_sharded, one example a2a) of h, the MLP.
         fwd = _fs._field_forward(
             spec, g, gat, params["vw"], params["w0"], ids, vals, labels,
             weights,
@@ -450,8 +455,22 @@ def make_field_deepfm_sharded_eval_step(spec, mesh):
         h_local = jnp.concatenate(fwd.xvs, axis=1)
         if g["two_d"]:
             h_local = lax.psum(h_local, "row")
-        h = lax.all_gather(h_local, "feat", axis=1, tiled=True)[:, : F * k]
-        scores = fwd.scores + spec.deep_scores(params["mlp"], h)
+        if deep_sharded:
+            b = h_local.shape[0]
+            if b % g["n_feat"]:
+                raise ValueError(
+                    f"deep_sharded eval requires the batch ({b}) to "
+                    f"divide by the feat mesh extent ({g['n_feat']})"
+                )
+            h_ex = lax.all_to_all(h_local, "feat", split_axis=0,
+                                  concat_axis=1, tiled=True)[:, : F * k]
+            deep_local = spec.deep_scores(params["mlp"], h_ex)
+            deep = lax.all_gather(deep_local, "feat", axis=0, tiled=True)
+            scores = fwd.scores + deep
+        else:
+            h = lax.all_gather(h_local, "feat", axis=1,
+                               tiled=True)[:, : F * k]
+            scores = fwd.scores + spec.deep_scores(params["mlp"], h)
         per = per_example_loss(scores, labels)
         preds = model_base.predict_from_scores(spec, scores)
         return metrics_lib.update_metrics(

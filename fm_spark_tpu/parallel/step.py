@@ -208,12 +208,14 @@ def make_parallel_train_step(
     """
     from fm_spark_tpu.sparse import (
         _reject_collective_dtype,
+        _reject_deep_sharded,
         _reject_host_aux,
         _reject_score_sharded,
     )
 
     _reject_host_aux(config, "the dense optax parallel step")
     _reject_score_sharded(config, "the dense optax parallel step")
+    _reject_deep_sharded(config, "the dense optax parallel step")
     # Grad psums here feed the optimizer DIRECTLY (no later fp32
     # re-derivation), a different precision contract from the fused
     # steps' activation collectives — not wired up; reject rather than

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Re-baseline on a HEALTHY attachment (VERDICT r4 #2b / PERF.md "Next
+# levers": every ranking in PERF.md was measured on an attachment
+# streaming at 5-10% of nominal HBM, and standalone-op probes there
+# repeatedly over-predicted full-step effects — on a full-bandwidth
+# chip the scan terms shrink ~10x and the bottleneck ranking likely
+# reorders). Run this ONCE on real hardware before optimizing further:
+#
+#   bash rebaseline.sh [outdir]
+#
+# Captures, in order of value-per-minute (so a flaky window still
+# yields the important rows first):
+#   1. bench.py full default sweep  -> the headline + all staged A/Bs
+#      (gfull slot 2, segtotal slot 3, colT, devaux) + MEASURED.json
+#   2. bench_micro.py all           -> the op-level probe rows PERF.md's
+#      cost model is built from (re-rank the levers against these)
+#   3. bench_input.py               -> host pipeline rates (packed feed,
+#      hashing, aux build) to re-check the host is still not the
+#      bottleneck at the new device rate
+# Everything lands in a dated dir with logs; compare against PERF.md's
+# committed numbers and update the lever ranking there.
+set -u
+cd "$(dirname "$0")"
+OUT=${1:-rebaseline_$(date -u +%Y%m%d_%H%M%S)}
+mkdir -p "$OUT"
+echo "rebaseline: start $(date -u) -> $OUT" | tee "$OUT/log"
+
+run() {
+  name=$1; shift
+  echo "rebaseline: $name: $*" | tee -a "$OUT/log"
+  timeout "$TIMEOUT" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "rebaseline: $name rc=$? $(date -u +%H:%M:%S)" | tee -a "$OUT/log"
+}
+
+TIMEOUT=2000 run bench_sweep python bench.py --total-deadline 1800
+TIMEOUT=2400 run micro_all   python bench_micro.py all
+TIMEOUT=900  run input       python bench_input.py
+cp MEASURED.json "$OUT/MEASURED.json" 2>/dev/null
+echo "rebaseline: done $(date -u); headline line:" | tee -a "$OUT/log"
+tail -1 "$OUT/bench_sweep.out" | tee -a "$OUT/log"

@@ -83,6 +83,28 @@ def test_deep_sharded_matches_replicated(eight_devices, n_feat, n_row):
     )
 
 
+def test_deep_sharded_bf16_wire_matches_replicated(eight_devices):
+    """Under a bf16 wire BOTH paths round h identically (cast before
+    the collective), and the deep-score gather stays fp32 by design —
+    so the FIRST step's loss matches exactly (pins the
+    no-logit-quantization rule). Later steps drift at the 1e-6 level:
+    the deep PULLBACK's reverse a2a legitimately rides the bf16 wire
+    (those bytes are the lever), which the replicated head's local
+    dynamic_slice never rounds."""
+    from fm_spark_tpu.parallel import make_field_mesh
+
+    spec = _spec()
+    mesh = make_field_mesh(4, devices=eight_devices[:4])
+    cfg = dict(collective_dtype="bfloat16")
+    p_rep, l_rep = _run_steps(spec, _cfg(**cfg), mesh, 4, steps=2)
+    p_sh, l_sh = _run_steps(spec, _cfg(deep_sharded=True, **cfg),
+                            mesh, 4, steps=2)
+    np.testing.assert_allclose(l_sh[0], l_rep[0], rtol=1e-7)
+    np.testing.assert_allclose(l_sh, l_rep, rtol=1e-4)
+    np.testing.assert_allclose(p_sh["vw"], p_rep["vw"], rtol=1e-3,
+                               atol=1e-5)
+
+
 def test_deep_sharded_with_bf16_wire_and_multistep(eight_devices):
     """Composition smoke: deep_sharded + bf16 wire in the sharded
     multistep roll runs and stays finite (quality envelope for bf16 wire
@@ -125,6 +147,51 @@ def test_deep_sharded_with_bf16_wire_and_multistep(eight_devices):
         *shard_field_batch_stacked(stacked, mesh)
     )
     assert np.isfinite(float(loss))
+
+
+def test_deep_sharded_eval_matches_replicated(eight_devices):
+    """The deep_sharded EVAL forward produces the replicated head's
+    metrics (pure wire re-route; no backward in eval)."""
+    from fm_spark_tpu.parallel import make_field_mesh
+    from fm_spark_tpu.parallel.deepfm_step import (
+        make_field_deepfm_sharded_eval_step,
+        shard_field_deepfm_params,
+        stack_field_deepfm_params,
+    )
+    from fm_spark_tpu.parallel import pad_field_batch, shard_field_batch
+    from fm_spark_tpu.utils import metrics as metrics_lib
+
+    spec = _spec()
+    n_feat = 4
+    mesh = make_field_mesh(n_feat, devices=eight_devices[:n_feat])
+    params = shard_field_deepfm_params(
+        stack_field_deepfm_params(spec, spec.init(jax.random.key(5)),
+                                  n_feat),
+        mesh,
+    )
+    rng = np.random.default_rng(9)
+    sb = shard_field_batch(
+        pad_field_batch(
+            (
+                np.asarray(rng.integers(0, BUCKET, (B, F)), np.int32),
+                np.asarray(rng.uniform(0.5, 1.5, (B, F)), np.float32),
+                np.asarray(rng.integers(0, 2, B), np.float32),
+                np.ones((B,), np.float32),
+            ),
+            F, n_feat,
+        ),
+        mesh,
+    )
+    outs = {}
+    for flag in (False, True):
+        estep = make_field_deepfm_sharded_eval_step(spec, mesh,
+                                                    deep_sharded=flag)
+        m = estep(params, metrics_lib.init_metrics(), *sb)
+        outs[flag] = metrics_lib.finalize_metrics(m)
+    for key in ("auc", "logloss", "count"):
+        np.testing.assert_allclose(float(outs[True][key]),
+                                   float(outs[False][key]), rtol=1e-6,
+                                   err_msg=key)
 
 
 def test_deep_sharded_rejected_elsewhere(eight_devices):
