@@ -68,6 +68,13 @@ METRICS = {
     "fm": ("criteo_fm_rank64_10Mfeat_samples_per_sec_per_chip",
            10_000_000 / 8),
     "ffm": ("avazu_ffm_rank16_samples_per_sec_per_chip", None),
+    "deepfm": ("criteo_deepfm_rank16_samples_per_sec_per_chip", None),
+}
+# metric name -> MEASURED.json entry rewritten on a successful sweep
+METRIC_ENTRY = {
+    METRICS["fm"][0]: "headline",
+    METRICS["ffm"][0]: "ffm_avazu",
+    METRICS["deepfm"][0]: "deepfm_criteo",
 }
 METRIC, TARGET_PER_CHIP = METRICS["fm"]
 UNIT = "samples/sec/chip"
@@ -135,6 +142,7 @@ def inner_main(args):
 
     from fm_spark_tpu import models
     from fm_spark_tpu.sparse import (
+        make_field_deepfm_sparse_body,
         make_field_ffm_sparse_sgd_body,
         make_field_sparse_sgd_body,
     )
@@ -150,6 +158,13 @@ def inner_main(args):
         rank = args.rank or 16
         if args.table_layout != "row":
             raise SystemExit("--table-layout col is a FieldFM lever")
+    elif args.model == "deepfm":
+        # Config 5's shape (configs.criteo1tb_deepfm): 39 fields,
+        # 262144 buckets, rank 16, 3x400 MLP head on dense Adam.
+        num_fields, bucket = 39, 1 << 18
+        rank = args.rank or 16
+        if args.table_layout != "row":
+            raise SystemExit("--table-layout col is a FieldFM lever")
     else:
         num_fields, bucket = 39, 262_144
         rank = args.rank or 64
@@ -162,6 +177,14 @@ def inner_main(args):
             return models.FieldFFMSpec(
                 num_features=num_fields * bucket, rank=rank,
                 num_fields=num_fields, bucket=bucket, init_std=0.01,
+                param_dtype=param_dtype,
+                compute_dtype=compute_dtype or args.compute_dtype,
+            )
+        if args.model == "deepfm":
+            return models.FieldDeepFMSpec(
+                num_features=num_fields * bucket, rank=rank,
+                num_fields=num_fields, bucket=bucket, init_std=0.01,
+                mlp_dims=(400, 400, 400),
                 param_dtype=param_dtype,
                 compute_dtype=compute_dtype or args.compute_dtype,
             )
@@ -213,6 +236,19 @@ def inner_main(args):
                     gfull_fused=args.gfull_fused,
                     segtotal_pallas=args.segtotal_pallas),
     )]
+    if not explicit and args.model == "deepfm":
+        # DeepFM default sweep: config 5's optimizer (dense Adam head)
+        # with the measured-best FM table levers (bf16 storage +
+        # compute + compact host aux — criteo-sized tables sit ABOVE
+        # the gather cliffs, same as the FM headline).
+        cap = min(16384, batch)
+        variants.append((
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=1e-3, lr_schedule="constant",
+                        optimizer="adam", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap),
+        ))
     if not explicit and args.model == "ffm":
         # FFM default sweep: the bf16 storage candidate. NO compact
         # variants: the compact lever measured a LOSER on avazu's 24MB
@@ -299,11 +335,15 @@ def inner_main(args):
 
     aux_cache = {}
     results = []
-    make_body = (make_field_ffm_sparse_sgd_body if args.model == "ffm"
-                 else make_field_sparse_sgd_body)
     for label, dtypes, config in variants:
         spec = make_spec(*dtypes)
-        body = make_body(spec, config)
+        init_opt = None
+        if args.model == "ffm":
+            body = make_field_ffm_sparse_sgd_body(spec, config)
+        elif args.model == "deepfm":
+            body, init_opt = make_field_deepfm_sparse_body(spec, config)
+        else:
+            body = make_field_sparse_sgd_body(spec, config)
         aux = None
         if config.host_dedup:
             # Aux for the (fixed) bench batch is computed once here; in
@@ -321,35 +361,64 @@ def inner_main(args):
 
         # n_steps is a DYNAMIC argument so the warmup call compiles the
         # exact program the timed call runs (a static count would
-        # recompile inside the timed region).
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def run(params, ids, vals, labels, weights, aux, n_steps,
-                body=body):
-            def fbody(i, carry):
-                p, _ = carry
-                return body(p, i, ids, vals, labels, weights, aux)
+        # recompile inside the timed region). DeepFM threads its dense
+        # optax state through the carry (same shape as the multistep
+        # roll); the other models carry (params, loss) only.
+        if init_opt is not None:
+            # (params, opt, loss) carry; params + opt donated.
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def run_df(params, opt, ids, vals, labels, weights, aux,
+                       n_steps, body=body):
+                def fbody(i, carry):
+                    p, o, _ = carry
+                    return body(p, o, i, ids, vals, labels, weights, aux)
 
-            return lax.fori_loop(0, n_steps, fbody, (params, jnp.float32(0)))
+                return lax.fori_loop(0, n_steps, fbody,
+                                     (params, opt, jnp.float32(0)))
+
+            def run(carry, *a):
+                return run_df(carry[0], carry[1], *a)
+
+            carry = (params, init_opt(params), jnp.float32(0))
+        else:
+            # (params, loss) carry; params donated.
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run_pl(params, ids, vals, labels, weights, aux, n_steps,
+                       body=body):
+                def fbody(i, carry):
+                    p, _ = carry
+                    return body(p, i, ids, vals, labels, weights, aux)
+
+                return lax.fori_loop(0, n_steps, fbody,
+                                     (params, jnp.float32(0)))
+
+            def run(carry, *a):
+                return run_pl(carry[0], *a)
+
+            carry = (params, jnp.float32(0))
 
         _log(f"[inner] [{label}] compiling + warmup (first TPU compile "
              "is slow, ~20-60s)...")
         t0 = time.perf_counter()
-        params, loss = run(params, ids, vals, labels, weights, aux,
-                           jnp.int32(steps_warmup))
-        float(loss)  # d2h fence
+        carry = run(carry, ids, vals, labels, weights, aux,
+                    jnp.int32(steps_warmup))
+        float(carry[-1])  # d2h fence
         _log(f"[inner] [{label}] warmup done in "
              f"{time.perf_counter() - t0:.1f}s; timing {steps_timed} "
              f"steps x batch {batch}...")
         t0 = time.perf_counter()
-        params, loss = run(params, ids, vals, labels, weights, aux,
-                           jnp.int32(steps_timed))
-        final_loss = float(loss)  # d2h fence
+        carry = run(carry, ids, vals, labels, weights, aux,
+                    jnp.int32(steps_timed))
+        final_loss = float(carry[-1])  # d2h fence
         dt = time.perf_counter() - t0
         rate = steps_timed * batch / dt / jax.device_count()
         results.append((rate, label, dt, final_loss))
         _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "
              f"(dt={dt:.3f}s loss={final_loss:.4f})")
-        del params  # free the donated tables before the next variant
+        # Drop the LAST reference to the tables (and any optax state)
+        # before the next variant's init — two resident table sets
+        # would double peak HBM on the single chip.
+        del params, carry
         # Emit the best-so-far line after EVERY variant: if a later
         # variant hangs/crashes (flaky attachment), the parent's salvage
         # scan still finds a valid completed measurement (it takes the
@@ -418,9 +487,7 @@ def _emit_final():
                     load_measured,
                     update_entry,
                 )
-                entry = ("ffm_avazu"
-                         if parsed["metric"] == METRICS["ffm"][0]
-                         else "headline")
+                entry = METRIC_ENTRY[parsed["metric"]]
                 try:
                     prev = load_measured()[entry][
                         "rate_samples_per_sec_per_chip"]

@@ -270,7 +270,8 @@ def framework_variant(tr, te, model="fm", param_dtype="float32",
                       sparse_update="scatter_add", host_dedup=False,
                       compact_cap=0, compute_dtype="float32",
                       compact_device=False, sharded=False,
-                      collective_dtype="float32", score_sharded=False):
+                      collective_dtype="float32", score_sharded=False,
+                      deep_sharded=False):
     jax = _jax()
     import jax.numpy as jnp
 
@@ -294,17 +295,18 @@ def framework_variant(tr, te, model="fm", param_dtype="float32",
         sparse_update=sparse_update, host_dedup=host_dedup,
         compact_cap=compact_cap, compact_device=compact_device,
         seed=TASK["seed"], collective_dtype=collective_dtype,
-        score_sharded=score_sharded,
+        score_sharded=score_sharded, deep_sharded=deep_sharded,
     )
     opt = None
     if sharded:
-        # The wire-precision rows (collective_dtype / score_sharded)
-        # exist only on the sharded step — run it on every available
-        # device (the 8-fake-device CPU mesh in CI; a real slice on
-        # hardware). FM only: the budget isolates the wire numerics.
-        if model != "fm":
-            raise ValueError("sharded quality rows are FM-only")
+        # The wire-precision rows (collective_dtype / score_sharded /
+        # deep_sharded) exist only on the sharded steps — run them on
+        # every available device (the 8-fake-device CPU mesh in CI; a
+        # real slice on hardware). All three families (round 5: FFM
+        # budgets the sel-a2a wire dtype — the step's dominant ICI term
+        # — and DeepFM the example-sharded head).
         from fm_spark_tpu.parallel import (
+            make_field_ffm_sharded_step,
             make_field_mesh,
             make_field_sharded_sgd_step,
             pad_field_batch,
@@ -313,6 +315,12 @@ def framework_variant(tr, te, model="fm", param_dtype="float32",
             stack_field_params,
             unstack_field_params,
         )
+        from fm_spark_tpu.parallel.deepfm_step import (
+            make_field_deepfm_sharded_step,
+            shard_field_deepfm_params,
+            stack_field_deepfm_params,
+            unstack_field_deepfm_params,
+        )
 
         n = jax.device_count()
         if n < 2:
@@ -320,22 +328,44 @@ def framework_variant(tr, te, model="fm", param_dtype="float32",
                 "sharded quality rows need >1 device (set "
                 "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
             )
-        spec = models.FieldFMSpec(**common)
         mesh = make_field_mesh(n)
-        step_sh = make_field_sharded_sgd_step(spec, config, mesh)
-        params = shard_field_params(
-            stack_field_params(spec, spec.init(jax.random.key(TASK["seed"])),
-                               n),
-            mesh,
-        )
+        opt_sh = None
+        if model == "fm":
+            spec = models.FieldFMSpec(**common)
+            step_sh = make_field_sharded_sgd_step(spec, config, mesh)
+        elif model == "ffm":
+            spec = models.FieldFFMSpec(**common)
+            step_sh = make_field_ffm_sharded_step(spec, config, mesh)
+        elif model == "deepfm":
+            spec = models.FieldDeepFMSpec(**common, mlp_dims=MLP_DIMS)
+            step_sh = make_field_deepfm_sharded_step(spec, config, mesh)
+        else:
+            raise ValueError(f"unknown model {model!r}")
+        init = spec.init(jax.random.key(TASK["seed"]))
+        if model == "deepfm":
+            params = shard_field_deepfm_params(
+                stack_field_deepfm_params(spec, init, n), mesh
+            )
+            opt_sh = step_sh.init_opt_state(params)
+        else:
+            params = shard_field_params(
+                stack_field_params(spec, init, n), mesh
+            )
         batches = Batches(*tr, TRAIN["batch"], seed=TASK["seed"])
         nf = TASK["num_fields"]
         for i in range(TRAIN["steps"]):
             b = shard_field_batch(
                 pad_field_batch(tuple(batches.next_batch()), nf, n), mesh
             )
-            params, _ = step_sh(params, jnp.int32(i), *b)
-        params = unstack_field_params(spec, jax.device_get(params))
+            if model == "deepfm":
+                params, opt_sh, _ = step_sh(params, opt_sh,
+                                            jnp.int32(i), *b)
+            else:
+                params, _ = step_sh(params, jnp.int32(i), *b)
+        host = jax.device_get(params)
+        params = (unstack_field_deepfm_params(spec, host)
+                  if model == "deepfm"
+                  else unstack_field_params(spec, host))
         ids_te, vals_te, y_te = te
         scores = np.asarray(
             spec.scores(params, jnp.asarray(ids_te), jnp.asarray(vals_te)),
@@ -412,6 +442,12 @@ VARIANTS = {
     "sharded_bf16_wire_ss": dict(sharded=True,
                                  collective_dtype="bfloat16",
                                  score_sharded=True),
+    # Round 5: the example-sharded deep head under the bf16 wire
+    # (deepfm only — _variant_applies): budgets the lever's end-to-end
+    # AUC cost on top of the wire dtype's.
+    "sharded_bf16_wire_deep": dict(sharded=True,
+                                   collective_dtype="bfloat16",
+                                   deep_sharded=True),
 }
 
 # The committed protocol budgets (QUALITY.md): fp32-vs-oracle is expected
@@ -439,7 +475,19 @@ BUDGET_VS_FP32 = {
     "sharded_fp32_wire": 1e-3,
     "sharded_bf16_wire": 5e-3,
     "sharded_bf16_wire_ss": 5e-3,
+    "sharded_bf16_wire_deep": 1e-2,
 }
+
+
+def _variant_applies(name: str, kw: dict, model: str) -> bool:
+    """Per-model variant applicability (replaces the old FM-only gate on
+    every sharded row — round 5 runs the sharded wire rows for all
+    three families; only the family-specific levers stay scoped)."""
+    if kw.get("score_sharded") and model != "fm":
+        return False
+    if kw.get("deep_sharded") and model != "deepfm":
+        return False
+    return True
 
 
 ORACLES = {
@@ -468,7 +516,7 @@ def main():
         multi = jax.device_count() > 1
         names = [n for n in VARIANTS
                  if (args.model == "fm" or "host" not in n)
-                 and (args.model == "fm" or "sharded" not in n)
+                 and _variant_applies(n, VARIANTS[n], args.model)
                  and (multi or "sharded" not in n)]
     tr, te = _data()
     out = {}

@@ -26,10 +26,14 @@ import os
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MEASURED_PATH = os.path.join(_REPO_ROOT, "MEASURED.json")
 
-_REQUIRED = {
-    "headline": ("rate_samples_per_sec_per_chip", "source", "date"),
-    "ffm_avazu": ("rate_samples_per_sec_per_chip", "source", "date"),
-}
+_FIELDS = ("rate_samples_per_sec_per_chip", "source", "date")
+# Entries that must exist in a valid MEASURED.json (they have carried
+# measured values since round 3).
+_REQUIRED = {"headline": _FIELDS, "ffm_avazu": _FIELDS}
+# Entries bench.py MAY write once measured (no carried value exists yet,
+# so their absence is valid).
+_OPTIONAL = {"deepfm_criteo": _FIELDS}
+_KNOWN = {**_REQUIRED, **_OPTIONAL}
 
 
 def load_measured(path: str | None = None) -> dict:
@@ -40,14 +44,18 @@ def load_measured(path: str | None = None) -> dict:
     p = path or MEASURED_PATH
     with open(p) as f:
         data = json.load(f)
-    for key, fields in _REQUIRED.items():
+    for key in _REQUIRED:
         if key not in data:
             raise ValueError(f"MEASURED.json missing entry {key!r}")
+    for key, entry in data.items():
+        fields = _KNOWN.get(key)
+        if fields is None:
+            raise ValueError(f"MEASURED.json unknown entry {key!r}")
         for field in fields:
-            if field not in data[key]:
+            if field not in entry:
                 raise ValueError(
                     f"MEASURED.json entry {key!r} missing field {field!r}")
-        rate = data[key]["rate_samples_per_sec_per_chip"]
+        rate = entry["rate_samples_per_sec_per_chip"]
         if not (isinstance(rate, (int, float)) and rate > 0):
             raise ValueError(
                 f"MEASURED.json {key}: bad rate {rate!r}")
@@ -60,7 +68,7 @@ def update_entry(key: str, rate: float, variant: str, source: str,
                  path: str | None = None) -> None:
     """Rewrite one entry (called by bench.py on a successful sweep),
     preserving the other entries and their provenance."""
-    if key not in _REQUIRED:
+    if key not in _KNOWN:
         raise ValueError(f"unknown MEASURED.json entry {key!r}")
     p = path or MEASURED_PATH
     try:

@@ -372,9 +372,63 @@ def main() -> int:
     # (phase 2 ran the same 10 batches on the same init).
     np.testing.assert_allclose(rlosses[-1], flosses[-1], rtol=1e-5)
 
+    # ---- Phase 8 (round 5): the field-sharded DeepFM step across
+    # process boundaries, replicated head vs the example-sharded head
+    # (deep_sharded). With an fp32 wire the two heads compute the same
+    # scores (the a2a re-route only re-shards; the deep-score gather is
+    # full precision), so the loss streams must agree to reassociation
+    # tolerance — run on real cross-process collectives.
+    from fm_spark_tpu.parallel.deepfm_step import (
+        field_deepfm_param_specs,
+        make_field_deepfm_sharded_step,
+        stack_field_deepfm_params,
+    )
+
+    dfspec = models.FieldDeepFMSpec(
+        num_features=F * bucket, rank=3, num_fields=F, bucket=bucket,
+        mlp_dims=(8, 8), init_std=0.05,
+    )
+    dlosses_by_flag = {}
+    for flag in (False, True):
+        dcfg2 = TrainConfig(learning_rate=0.05, optimizer="adam",
+                            deep_sharded=flag)
+        dstep2 = make_field_deepfm_sharded_step(dfspec, dcfg2, fmesh)
+        dspecs = field_deepfm_param_specs(dfspec, fmesh)
+        stacked0 = stack_field_deepfm_params(
+            dfspec, dfspec.init(jax.random.key(11)), fmesh.shape["feat"]
+        )
+        dparams2 = {
+            "w0": make_global(stacked0["w0"], fmesh, dspecs["w0"]),
+            "vw": make_global(stacked0["vw"], fmesh, dspecs["vw"]),
+            "mlp": jax.tree_util.tree_map(
+                lambda x, s: make_global(x, fmesh, s),
+                stacked0["mlp"], dspecs["mlp"],
+            ),
+        }
+        dopt2 = dstep2.init_opt_state(dparams2)
+        ds_losses = []
+        for i in range(6):
+            sl = slice(i * b_global, (i + 1) * b_global)
+            fb = pad_field_batch(
+                (fids[sl], fvals[sl], flabels[sl],
+                 np.ones((b_global,), np.float32)),
+                F, fmesh.shape["feat"],
+            )
+            gb = [
+                make_global(a, fmesh, sp)
+                for a, sp in zip(fb, field_batch_specs(fmesh))
+            ]
+            dparams2, dopt2, dl2 = dstep2(dparams2, dopt2,
+                                          jnp.int32(i), *gb)
+            ds_losses.append(float(dl2))
+        assert all(np.isfinite(ds_losses)), (flag, ds_losses)
+        dlosses_by_flag[flag] = ds_losses
+    np.testing.assert_allclose(dlosses_by_flag[True],
+                               dlosses_by_flag[False], rtol=1e-5)
+
     print(f"MULTIHOST_OK process={process_id} "
           f"losses={losses}+{flosses}+{plosses}+{dlosses}+{fflosses}"
-          f"+{llosses}+{rlosses}+digest={digest}")
+          f"+{llosses}+{rlosses}+{dlosses_by_flag[True]}+digest={digest}")
     return 0
 
 

@@ -15,6 +15,28 @@ set -u
 cd "$(dirname "$0")"
 OUT=tpu_watch_out
 mkdir -p "$OUT"
+
+# Print the best parsed "value" from a bench output file (-1.0 if none).
+best_value() {
+  python - "$1" <<'PY'
+import json, sys
+best = -1.0
+try:
+    for line in open(sys.argv[1]):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            v = d.get("value")
+            if isinstance(v, (int, float)) and v > best:
+                best = v
+except OSError:
+    pass
+print(best)
+PY
+}
 DEADLINE=$(( $(date +%s) + ${1:-36000} ))   # default 10h
 echo "tpu_watch(r5): start $(date -u +%H:%M:%S), deadline in ${1:-36000}s" >> "$OUT/log"
 best_val=-1
@@ -32,25 +54,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     timeout 1700 python bench.py --total-deadline 1500 \
       > "$OUT/sweep_$TS.out" 2> "$OUT/sweep_$TS.err"
     rc=$?
-    val=$(python - "$OUT/sweep_$TS.out" <<'PY'
-import json, sys
-best = -1.0
-try:
-    for line in open(sys.argv[1]):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                d = json.loads(line)
-            except ValueError:
-                continue
-            v = d.get("value")
-            if isinstance(v, (int, float)) and v > best:
-                best = v
-except OSError:
-    pass
-print(best)
-PY
-)
+    val=$(best_value "$OUT/sweep_$TS.out")
     echo "tpu_watch: sweep rc=$rc value=$val at $TS" >> "$OUT/log"
     if python -c "import sys; sys.exit(0 if float('$val') > float('$best_val') else 1)"; then
       best_val=$val
@@ -68,28 +72,22 @@ PY
       timeout 1100 python bench.py --model ffm --total-deadline 900 \
         > "$OUT/ffm_sweep.out" 2> "$OUT/ffm_sweep.err"
       frc=$?
-      fval=$(python - "$OUT/ffm_sweep.out" <<'PY'
-import json, sys
-best = -1.0
-try:
-    for line in open(sys.argv[1]):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                d = json.loads(line)
-            except ValueError:
-                continue
-            v = d.get("value")
-            if isinstance(v, (int, float)) and v > best:
-                best = v
-except OSError:
-    pass
-print(best)
-PY
-)
+      fval=$(best_value "$OUT/ffm_sweep.out")
       echo "tpu_watch: ffm sweep rc=$frc value=$fval" >> "$OUT/log"
       if python -c "import sys; sys.exit(0 if float('$fval') > 0 else 1)"; then
         touch "$OUT/ffm_done"
+      fi
+    fi
+    # Window 3+: the config-5 DeepFM rate (never measured on-chip —
+    # projections used the FM rate as a proxy until now).
+    if [ "$rc" -eq 0 ] && [ -e "$OUT/ffm_done" ] && [ ! -e "$OUT/deepfm_done" ]; then
+      timeout 1100 python bench.py --model deepfm --total-deadline 900 \
+        > "$OUT/deepfm_sweep.out" 2> "$OUT/deepfm_sweep.err"
+      drc=$?
+      dval=$(best_value "$OUT/deepfm_sweep.out")
+      echo "tpu_watch: deepfm sweep rc=$drc value=$dval" >> "$OUT/log"
+      if python -c "import sys; sys.exit(0 if float('$dval') > 0 else 1)"; then
+        touch "$OUT/deepfm_done"
       fi
     fi
     # Attachment was up: re-probe sooner than the down cadence in case
