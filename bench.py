@@ -399,17 +399,28 @@ def inner_main(args):
 
         _log(f"[inner] [{label}] compiling + warmup (first TPU compile "
              "is slow, ~20-60s)...")
-        t0 = time.perf_counter()
-        carry = run(carry, ids, vals, labels, weights, aux,
-                    jnp.int32(steps_warmup))
-        float(carry[-1])  # d2h fence
-        _log(f"[inner] [{label}] warmup done in "
-             f"{time.perf_counter() - t0:.1f}s; timing {steps_timed} "
-             f"steps x batch {batch}...")
-        t0 = time.perf_counter()
-        carry = run(carry, ids, vals, labels, weights, aux,
-                    jnp.int32(steps_timed))
-        final_loss = float(carry[-1])  # d2h fence
+        try:
+            t0 = time.perf_counter()
+            carry = run(carry, ids, vals, labels, weights, aux,
+                        jnp.int32(steps_warmup))
+            float(carry[-1])  # d2h fence
+            _log(f"[inner] [{label}] warmup done in "
+                 f"{time.perf_counter() - t0:.1f}s; timing {steps_timed} "
+                 f"steps x batch {batch}...")
+            t0 = time.perf_counter()
+            carry = run(carry, ids, vals, labels, weights, aux,
+                        jnp.int32(steps_timed))
+            final_loss = float(carry[-1])  # d2h fence
+        except Exception as e:  # noqa: BLE001 — one broken variant (e.g.
+            # a Mosaic lowering reject, round 5's segtotal block-spec
+            # ValueError) must not kill the remaining A/Bs; the parent's
+            # retry would re-crash on the same variant and the sweep
+            # would never price the rest. Hangs are the watchdog's job.
+            _log(f"[inner] [{label}] FAILED ({type(e).__name__}): "
+                 f"{(str(e).splitlines() or [''])[0][:200]}"
+                 " -- skipping variant")
+            del params, carry
+            continue
         dt = time.perf_counter() - t0
         rate = steps_timed * batch / dt / jax.device_count()
         results.append((rate, label, dt, final_loss))
@@ -435,6 +446,9 @@ def inner_main(args):
             "all_variants": {l: round(r, 1) for r, l, _, _ in results},
         }), flush=True)
 
+    if not results:
+        _log("[inner] every variant failed; no measurement")
+        return 1
     rate, label, dt, final_loss = max(results)
     _log(f"[inner] device={devs[0].device_kind} "
          f"chips={jax.device_count()} best={label} batch={batch} "

@@ -57,16 +57,21 @@ def _kernel(first_ref, seg_ref, x_ref, out_ref):
     def _zero():
         out_ref[...] = jnp.zeros_like(out_ref)
 
+    # The store window starts at first ROUNDED DOWN to a multiple of 8:
+    # Mosaic requires (or strongly prefers) sublane-aligned dynamic
+    # slices, and the one-hot just grows 8 rows to absorb the offset —
+    # local indices land in [0, T+8) instead of [0, T).
     first = first_ref[i]
-    seg = seg_ref[0, :]                                    # [T] int32
-    local = seg - first                                    # [0, T) valid
+    first_a = (first // 8) * 8
+    seg = seg_ref[0, 0, :]                                 # [T] int32
+    local = seg - first_a                                  # [0, T+8) valid
     onehot = (
         local[None, :]
-        == jax.lax.broadcasted_iota(jnp.int32, (_TILE, _TILE), 0)
-    ).astype(jnp.float32)                                  # [T(seg), T(lane)]
+        == jax.lax.broadcasted_iota(jnp.int32, (_TILE + 8, _TILE), 0)
+    ).astype(jnp.float32)                                  # [T+8(seg), T(lane)]
     totals = jnp.dot(onehot, x_ref[...],
-                     preferred_element_type=jnp.float32)   # [T, w]
-    win = pl.ds(first, _TILE)
+                     preferred_element_type=jnp.float32)   # [T+8, w]
+    win = pl.ds(first_a, _TILE + 8)
     out_ref[win, :] = out_ref[win, :] + totals
 
 
@@ -81,8 +86,10 @@ def segment_totals(sdelta: jax.Array, seg_sorted: jax.Array, cap: int,
 
     PRECONDITION — dense ranks, not arbitrary ids: within any ``_TILE``
     consecutive lanes the segment values must span < ``_TILE`` (the
-    one-hot window is [first_seg(tile), first_seg(tile)+_TILE); a lane
-    whose segment falls outside it contributes NOTHING, silently).
+    one-hot window is [align8(first_seg(tile)), +_TILE+8) — first
+    rounded down to a sublane multiple, 8 extra rows absorb the offset;
+    a lane whose segment falls outside it contributes NOTHING,
+    silently).
     Non-decreasing DENSE ranks (0, 0, 1, 2, 2, ...; every rank in
     [0, cap) occupied up to the unique count) satisfy this by
     construction — a tile of T lanes covers ≤ T distinct ranks — and
@@ -99,11 +106,11 @@ def segment_totals(sdelta: jax.Array, seg_sorted: jax.Array, cap: int,
     # is 4.4MB; an FFM-width row (w = F·k+1 = 369 at avazu shapes)
     # would be ~25MB and fail at Mosaic compile time. Reject with an
     # actionable message instead.
-    out_bytes = (cap + t) * w * 4
+    out_bytes = (cap + t + 8) * w * 4
     budget = 8 * 1024 * 1024  # leave room for the tile + one-hot blocks
     if out_bytes > budget:
         raise ValueError(
-            f"segtotal_pallas accumulator [(cap+{t}), {w}] fp32 = "
+            f"segtotal_pallas accumulator [(cap+{t + 8}), {w}] fp32 = "
             f"{out_bytes / 1e6:.1f}MB exceeds the {budget // 2**20}MB "
             "VMEM budget (the kernel keeps the whole output resident); "
             "lower compact_cap or use the blocked-prefix path (drop "
@@ -118,23 +125,27 @@ def segment_totals(sdelta: jax.Array, seg_sorted: jax.Array, cap: int,
     seg_sorted = jnp.minimum(seg_sorted, cap)              # clamp overflow
     nb = sdelta.shape[0] // t
     first = seg_sorted[::t].astype(jnp.int32)              # [nb] prefetch
-    seg2d = seg_sorted.reshape(nb, t).astype(jnp.int32)
+    # [nb, 1, t]: the singleton sublane dim makes the block's trailing
+    # (1, t) EQUAL to the array's trailing dims — a (1, t) block on a
+    # flat [nb, t] array violates Mosaic's (8, 128)-divisibility rule
+    # (measured: lowering ValueError on chip, round 5).
+    seg3d = seg_sorted.reshape(nb, 1, t).astype(jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((1, t), lambda i, first: (i, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, first: (i, 0, 0)),
             pl.BlockSpec((t, w), lambda i, first: (i, 0)),
         ],
-        # Constant index map: the [cap+T, w] accumulator stays
+        # Constant index map: the [cap+T+8, w] accumulator stays
         # VMEM-resident across the sequential grid.
-        out_specs=pl.BlockSpec((cap + t, w), lambda i, first: (0, 0)),
+        out_specs=pl.BlockSpec((cap + t + 8, w), lambda i, first: (0, 0)),
     )
     out = pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((cap + t, w), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((cap + t + 8, w), jnp.float32),
         interpret=interpret,
-    )(first, seg2d, sdelta)
+    )(first, seg3d, sdelta)
     return out[:cap]
