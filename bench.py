@@ -312,6 +312,22 @@ def inner_main(args):
                         optimizer="sgd", sparse_update="dedup_sr",
                         host_dedup=True, compact_cap=cap),
         ))
+        # The round-5 COMPOSED candidate: gfull + segtotal touch
+        # disjoint halves of the step (backward g_full construction vs
+        # the update's segment totals) and each priced ~+8% alone on
+        # the healthy round-5 attachment — the composition is the
+        # north-star candidate (~1.33M needed for the 10M aggregate).
+        # Inserted AFTER the colT insert(3) in code so it lands at
+        # index 3 in the final order (FOURTH), ahead of the
+        # already-measured secondary probes.
+        variants.insert(3, (
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap,
+                        gfull_fused=True, segtotal_pallas=True),
+        ))
         # DEVICE-built aux form of the winner (round-3): no host aux
         # shipping/sort, F on-device sorts instead — the variant that
         # composes with 2-D meshes and multi-process scale-out. Measured
