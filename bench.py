@@ -249,6 +249,19 @@ def inner_main(args):
                         optimizer="adam", sparse_update="dedup_sr",
                         host_dedup=True, compact_cap=cap),
         ))
+        # The round-5 composed kernels: gfull covers the DeepFM body
+        # (deep-head pullback rides the fused expression) and segtotal
+        # rides the shared compact update — both priced winners on the
+        # FM headline (PERF.md round-5 table); this A/B prices them at
+        # config 5's own shape.
+        variants.append((
+            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
+            ("bfloat16", "bfloat16", None),
+            TrainConfig(learning_rate=1e-3, lr_schedule="constant",
+                        optimizer="adam", sparse_update="dedup_sr",
+                        host_dedup=True, compact_cap=cap,
+                        gfull_fused=True, segtotal_pallas=True),
+        ))
     if not explicit and args.model == "ffm":
         # FFM default sweep: the bf16 storage candidate. NO compact
         # variants: the compact lever measured a LOSER on avazu's 24MB
@@ -270,75 +283,33 @@ def inner_main(args):
         # the flaky attachment dies mid-sweep, the best-so-far salvage
         # line already carries the headline number.
         cap = min(16384, batch)
-        variants.insert(0, (
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap),
-        ))
-        # The round-4 gfull A/B: the winning combo with the fused g_full
-        # construction (PERF.md "g_full concatenate elimination"). Runs
-        # SECOND so the A/B pair lands even if the attachment dies
-        # mid-sweep.
-        variants.insert(1, (
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap,
-                        gfull_fused=True),
-        ))
-        # The round-5 segtotal A/B: the winning combo with the Pallas
-        # sorted-run segment-total kernel replacing the blocked prefix
-        # (ops/pallas_segsum.py — upside ≈ the remaining half of the
-        # blocked-prefix cost). THIRD so both staged kernel A/Bs land
-        # early if the attachment dies mid-sweep.
-        variants.insert(2, (
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap,
-                        segtotal_pallas=True),
-        ))
-        # TRANSPOSED-table candidate (PERF.md "transpose" probe: the
-        # col layout halves physical table bytes and the cap-gather
-        # scan with it; donated scatter measured layout-neutral).
-        variants.insert(3, (
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT",
-            ("bfloat16", "bfloat16", "col"),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap),
-        ))
-        # The round-5 COMPOSED candidate: gfull + segtotal touch
-        # disjoint halves of the step (backward g_full construction vs
-        # the update's segment totals) and each priced ~+8% alone on
-        # the healthy round-5 attachment — the composition is the
-        # north-star candidate (~1.33M needed for the 10M aggregate).
-        # Inserted AFTER the colT insert(3) in code so it lands at
-        # index 3 in the final order (FOURTH), ahead of the
-        # already-measured secondary probes.
-        variants.insert(3, (
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap,
-                        gfull_fused=True, segtotal_pallas=True),
-        ))
-        # DEVICE-built aux form of the winner (round-3): no host aux
-        # shipping/sort, F on-device sorts instead — the variant that
-        # composes with 2-D meshes and multi-process scale-out. Measured
-        # here so the single-chip cost of the in-step sort is on record.
-        variants.insert(4, (
-            f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr",
-                        compact_device=True, compact_cap=cap),
-        ))
+        base = dict(learning_rate=0.05, lr_schedule="constant",
+                    optimizer="sgd", sparse_update="dedup_sr",
+                    host_dedup=True, compact_cap=cap)
+        # Ordered by salvage value (a flaky attachment dying mid-sweep
+        # keeps the prefix): the MEASURED-BEST composed variant first
+        # (1,356,081 on 2026-07-31 — gfull + segtotal, PERF.md round-5
+        # table), then its two single-lever A/B legs, then the round-3
+        # winner closing the 2x2 grid, then the secondary probes
+        # (devaux = the multi-chip-composable denominator; colT =
+        # thrice-neutral, kept for drift detection; the dtype ladder).
+        ranked = [
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
+             dict(gfull_fused=True, segtotal_pallas=True), None),
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
+             dict(gfull_fused=True), None),
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
+             dict(segtotal_pallas=True), None),
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16", {}, None),
+            (f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
+             dict(host_dedup=False, compact_device=True), None),
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT", {}, "col"),
+        ]
+        variants[0:0] = [
+            (label, ("bfloat16", "bfloat16", layout),
+             TrainConfig(**{**base, **extra}))
+            for label, extra, layout in ranked
+        ]
         for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
             variants.append((
                 f"{dt}/{su}/compact{cap}", (dt, None, None),

@@ -131,6 +131,14 @@ _LEVERS = (
            "chip, [B] deep-score gather) — ~n x fewer h wire bytes "
            "and the deep FLOPs divide by n (parallel/projection.py)",
            validate=_v_deep_sharded),
+    _Lever("--gfull-fused", "gfull_fused", "flag",
+           "build each field's backward g_full buffer directly as "
+           "ds·x·(s1 − m·xv_full) instead of concat([g_v, g_l]) — "
+           "removes one materialized copy pass per field (measured "
+           "~+8% on-chip and composes with --segtotal-pallas to the "
+           "1.356M headline, PERF.md round-5 table; ULP-pinned in "
+           "tests/test_gfull.py). FieldFM/DeepFM fused bodies; other "
+           "step factories reject it"),
     _Lever("--segtotal-pallas", "segtotal_pallas", "flag",
            "compute the compact update's segment sums with the Pallas "
            "sorted-run kernel (streaming read, VMEM-resident [cap, w] "

@@ -173,9 +173,13 @@ CONFIGS = {
             " automatically, and --row-shards adds bucket row-sharding"
             " (2-D feat×row mesh). The generic 'row' strategy materializes"
             " dense gradients (optax path) — correctness fallback, not the"
-            " at-scale path. Measured-best single-chip flags (PERF.md,"
-            " +45%): --param-dtype bfloat16 --compute-dtype bfloat16"
-            " --sparse-update dedup_sr --host-dedup --compact-cap 16384."
+            " at-scale path. Measured-best single-chip flags (PERF.md"
+            " round-5 table, 1.356M samples/s/chip = 1.085x the Spark"
+            " baseline): --param-dtype bfloat16 --compute-dtype bfloat16"
+            " --sparse-update dedup_sr --host-dedup --compact-cap 16384"
+            " --gfull-fused --segtotal-pallas (the last two priced ~+8%"
+            " each on-chip and compose; equivalence ULP-pinned in"
+            " tests/test_gfull.py and tests/test_pallas_segsum.py)."
             " Multi-chip / multi-host / --row-shards: swap --host-dedup"
             " for --compact-device (the in-step aux build; ~11% slower"
             " on ONE chip, the only form that composes with scale-out —"

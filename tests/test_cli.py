@@ -440,3 +440,12 @@ def test_field_sparse_capability_guards():
                deepfm_kw) == 0
     with pytest.raises(SystemExit, match="deep-sharded"):
         run("g11", "criteo1tb_fm_r64", ["--deep-sharded"], fm_kw)
+    # Round-5 composed kernels through the CLI registry: --gfull-fused
+    # alone and composed with --segtotal-pallas over the device-built
+    # compact aux (the measured 1.356M headline combination's scale-out
+    # form, PERF.md round-5 table) — must run clean end-to-end.
+    assert run("g12", "criteo1tb_fm_r64", ["--gfull-fused"], fm_kw) == 0
+    assert run("g13", "criteo1tb_fm_r64",
+               ["--gfull-fused", "--segtotal-pallas", "--compact-device",
+                "--compact-cap", "128", "--sparse-update", "dedup"],
+               fm_kw) == 0
