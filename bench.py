@@ -80,6 +80,87 @@ METRIC, TARGET_PER_CHIP = METRICS["fm"]
 UNIT = "samples/sec/chip"
 
 
+def default_variants(model, batch):
+    """The default sweep's staged A/B grid: ``(head, tail)`` lists of
+    ``(label, (param_dtype, compute_dtype, table_layout), TrainConfig)``.
+
+    ``head`` goes BEFORE the fp32/scatter_add reference variant, ordered
+    by salvage value (a flaky attachment dying mid-sweep keeps the
+    prefix): the MEASURED-BEST composed variant first (1,387,615 on
+    2026-07-31 — gfull + segtotal, PERF.md round-5 table), then its two
+    single-lever A/B legs, the round-3 winner closing the 2x2 grid, and
+    the secondary probes (devaux = the multi-chip-composable
+    denominator; colT = thrice-neutral, kept for drift detection).
+    ``tail`` goes after it (the dtype ladder).
+
+    Module-level (not inlined in inner_main) so tests can pin the
+    label<->TrainConfig consistency that the measurement's provenance
+    depends on; imports TrainConfig lazily so the PARENT bench process
+    never pulls in jax.
+    """
+    from fm_spark_tpu.train import TrainConfig
+
+    cap = min(16384, batch)
+    if model == "deepfm":
+        # Config 5's optimizer (dense Adam head) with the measured-best
+        # FM table levers (criteo-sized tables sit ABOVE the gather
+        # cliffs, same as the FM headline), plus the composed-kernel
+        # A/B at config 5's own shape (measured a LOSER there — narrow
+        # rank-16 rows, PERF.md — kept as the drift sentinel).
+        base = dict(learning_rate=1e-3, lr_schedule="constant",
+                    optimizer="adam", sparse_update="dedup_sr",
+                    host_dedup=True, compact_cap=cap)
+        return [], [
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
+             ("bfloat16", "bfloat16", None), TrainConfig(**base)),
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
+             ("bfloat16", "bfloat16", None),
+             TrainConfig(**base, gfull_fused=True, segtotal_pallas=True)),
+        ]
+    if model == "ffm":
+        # The bf16 storage candidate only. NO compact variants: the
+        # compact lever measured a LOSER on avazu's 24MB tables
+        # (PERF.md: the tables sit under every gather cliff, so
+        # cap-lane compaction only adds passes).
+        return [], [
+            ("bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
+             TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                         optimizer="sgd", sparse_update="dedup_sr")),
+        ]
+    # FM headline (PERF.md "the compact lever": scatter cost is
+    # per-lane even for dropped lanes, so cap-lane compaction wins; cap
+    # 16384 bounds the measured max per-field unique count (~12k) on
+    # the bench's Zipf batch).
+    base = dict(learning_rate=0.05, lr_schedule="constant",
+                optimizer="sgd", sparse_update="dedup_sr",
+                host_dedup=True, compact_cap=cap)
+    ranked = [
+        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
+         dict(gfull_fused=True, segtotal_pallas=True), None),
+        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
+         dict(gfull_fused=True), None),
+        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
+         dict(segtotal_pallas=True), None),
+        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16", {}, None),
+        (f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
+         dict(host_dedup=False, compact_device=True), None),
+        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT", {}, "col"),
+    ]
+    head = [
+        (label, ("bfloat16", "bfloat16", layout),
+         TrainConfig(**{**base, **extra}))
+        for label, extra, layout in ranked
+    ]
+    tail = [
+        (f"{dt}/{su}/compact{cap}", (dt, None, None),
+         TrainConfig(learning_rate=0.05, lr_schedule="constant",
+                     optimizer="sgd", sparse_update=su,
+                     host_dedup=True, compact_cap=cap))
+        for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16"))
+    ]
+    return head, tail
+
+
 def _set_model(model: str) -> None:
     global METRIC, TARGET_PER_CHIP
     METRIC, TARGET_PER_CHIP = METRICS[model]
@@ -236,87 +317,10 @@ def inner_main(args):
                     gfull_fused=args.gfull_fused,
                     segtotal_pallas=args.segtotal_pallas),
     )]
-    if not explicit and args.model == "deepfm":
-        # DeepFM default sweep: config 5's optimizer (dense Adam head)
-        # with the measured-best FM table levers (bf16 storage +
-        # compute + compact host aux — criteo-sized tables sit ABOVE
-        # the gather cliffs, same as the FM headline).
-        cap = min(16384, batch)
-        variants.append((
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=1e-3, lr_schedule="constant",
-                        optimizer="adam", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap),
-        ))
-        # The round-5 composed kernels: gfull covers the DeepFM body
-        # (deep-head pullback rides the fused expression) and segtotal
-        # rides the shared compact update — both priced winners on the
-        # FM headline (PERF.md round-5 table); this A/B prices them at
-        # config 5's own shape.
-        variants.append((
-            f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
-            ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=1e-3, lr_schedule="constant",
-                        optimizer="adam", sparse_update="dedup_sr",
-                        host_dedup=True, compact_cap=cap,
-                        gfull_fused=True, segtotal_pallas=True),
-        ))
-    if not explicit and args.model == "ffm":
-        # FFM default sweep: the bf16 storage candidate. NO compact
-        # variants: the compact lever measured a LOSER on avazu's 24MB
-        # tables (PERF.md: 537k vs 700k — the tables sit under every
-        # gather cliff, so cap-lane compaction only adds passes).
-        variants.append((
-            "bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
-            TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                        optimizer="sgd", sparse_update="dedup_sr"),
-        ))
-    if not explicit and args.model == "fm":
-        # The COMPACT host-dedup candidates (PERF.md: the round-2 probes
-        # showed scatter cost is per-lane even for dropped lanes, so cap-
-        # lane compaction is the lever; full-B hostdedup measured slower
-        # than the default and left out). Cap 16384 bounds the measured
-        # max per-field unique count (~12k) on this Zipf batch. The
-        # MEASURED-BEST variant (bf16 tables + bf16 compute buffers +
-        # compact — quality pinned by bench_quality.py) runs FIRST: if
-        # the flaky attachment dies mid-sweep, the best-so-far salvage
-        # line already carries the headline number.
-        cap = min(16384, batch)
-        base = dict(learning_rate=0.05, lr_schedule="constant",
-                    optimizer="sgd", sparse_update="dedup_sr",
-                    host_dedup=True, compact_cap=cap)
-        # Ordered by salvage value (a flaky attachment dying mid-sweep
-        # keeps the prefix): the MEASURED-BEST composed variant first
-        # (1,356,081 on 2026-07-31 — gfull + segtotal, PERF.md round-5
-        # table), then its two single-lever A/B legs, then the round-3
-        # winner closing the 2x2 grid, then the secondary probes
-        # (devaux = the multi-chip-composable denominator; colT =
-        # thrice-neutral, kept for drift detection; the dtype ladder).
-        ranked = [
-            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
-             dict(gfull_fused=True, segtotal_pallas=True), None),
-            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
-             dict(gfull_fused=True), None),
-            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
-             dict(segtotal_pallas=True), None),
-            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16", {}, None),
-            (f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
-             dict(host_dedup=False, compact_device=True), None),
-            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT", {}, "col"),
-        ]
-        variants[0:0] = [
-            (label, ("bfloat16", "bfloat16", layout),
-             TrainConfig(**{**base, **extra}))
-            for label, extra, layout in ranked
-        ]
-        for su, dt in (("dedup", "float32"), ("dedup_sr", "bfloat16")):
-            variants.append((
-                f"{dt}/{su}/compact{cap}", (dt, None, None),
-                TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                            optimizer="sgd", sparse_update=su,
-                            host_dedup=True, compact_cap=cap),
-            ))
+    if not explicit:
+        head, tail = default_variants(args.model, batch)
+        variants[0:0] = head
+        variants.extend(tail)
 
     import functools
 

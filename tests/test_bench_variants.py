@@ -1,0 +1,65 @@
+"""Pins for bench.py's default sweep grid (bench.default_variants).
+
+The sweep's labels are the measurement's provenance — MEASURED.json and
+every PERF.md table row is keyed by them — so a label that disagrees
+with its TrainConfig silently corrupts the record (round 5 nearly
+shipped exactly this: an insert-order bug put the composed variant
+behind probes it was staged to precede). These tests pin label<->config
+consistency and the salvage ordering without touching a device.
+"""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import bench  # noqa: E402
+
+
+def _grid(model, batch=1 << 17):
+    head, tail = bench.default_variants(model, batch)
+    return head + tail
+
+
+def test_fm_label_config_consistency():
+    for label, (pd, cd, layout), cfg in _grid("fm"):
+        assert ("gfull" in label) == cfg.gfull_fused, label
+        assert ("segtotal" in label) == cfg.segtotal_pallas, label
+        assert ("devaux" in label) == cfg.compact_device, label
+        assert ("colT" in label) == (layout == "col"), label
+        assert (f"compact{cfg.compact_cap}" in label) == (
+            cfg.compact_cap > 0), label
+        assert label.startswith(pd), label
+        assert ("cd-bf16" in label) == (cd == "bfloat16"), label
+        # compact aux comes from exactly one builder
+        assert cfg.host_dedup != cfg.compact_device, label
+
+
+def test_fm_salvage_order_composed_first():
+    head, _ = bench.default_variants("fm", 1 << 17)
+    cfgs = [c for _, _, c in head]
+    assert cfgs[0].gfull_fused and cfgs[0].segtotal_pallas
+    assert cfgs[1].gfull_fused and not cfgs[1].segtotal_pallas
+    assert cfgs[2].segtotal_pallas and not cfgs[2].gfull_fused
+    assert not cfgs[3].gfull_fused and not cfgs[3].segtotal_pallas
+
+
+def test_fm_cap_respects_small_batch():
+    for label, _, cfg in _grid("fm", batch=1024):
+        if cfg.compact_cap:
+            assert cfg.compact_cap == 1024, label
+            assert "compact1024" in label, label
+
+
+def test_deepfm_grid():
+    grid = _grid("deepfm")
+    assert [c.optimizer for _, _, c in grid] == ["adam", "adam"]
+    assert [c.gfull_fused for _, _, c in grid] == [False, True]
+    for label, _, cfg in grid:
+        assert ("gfull" in label) == cfg.gfull_fused, label
+        assert ("segtotal" in label) == cfg.segtotal_pallas, label
+
+
+def test_ffm_grid_no_compact():
+    for label, _, cfg in _grid("ffm"):
+        assert cfg.compact_cap == 0, "compact measured a loser on avazu"
+        assert "compact" not in label
