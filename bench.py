@@ -118,14 +118,24 @@ def default_variants(model, batch):
              TrainConfig(**base, gfull_fused=True, segtotal_pallas=True)),
         ]
     if model == "ffm":
-        # The bf16 storage candidate only. NO compact variants: the
+        # Measured winner first (816,553 on 2026-07-31): fp32 storage +
+        # bf16 COMPUTE buffers + plain scatter_add — the cd-bf16 lever
+        # halves the [B, F, F, k] sel-buffer traffic (FFM's dominant
+        # term) while the fp32 tables keep scatter_add exact, so no
+        # SR/dedup machinery is needed. NO compact variants: the
         # compact lever measured a LOSER on avazu's 24MB tables
         # (PERF.md: the tables sit under every gather cliff, so
-        # cap-lane compaction only adds passes).
-        return [], [
+        # cap-lane compaction only adds passes); bf16 STORAGE +
+        # dedup_sr measured a 2x loser for the same reason (kept as
+        # the drift sentinel).
+        ffm_base = dict(learning_rate=0.05, lr_schedule="constant",
+                        optimizer="sgd")
+        return [
+            ("float32/scatter_add/cd-bf16", ("float32", "bfloat16", None),
+             TrainConfig(**ffm_base, sparse_update="scatter_add")),
+        ], [
             ("bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
-             TrainConfig(learning_rate=0.05, lr_schedule="constant",
-                         optimizer="sgd", sparse_update="dedup_sr")),
+             TrainConfig(**ffm_base, sparse_update="dedup_sr")),
         ]
     # FM headline (PERF.md "the compact lever": scatter cost is
     # per-lane even for dropped lanes, so cap-lane compaction wins; cap
@@ -205,14 +215,11 @@ def inner_main(args):
     threading.Thread(target=_init_watchdog, daemon=True).start()
     import jax
 
-    # The installed TPU plugin ignores the JAX_PLATFORMS env var; honor an
-    # explicit cpu request (CI / smoke tests) via jax.config, same guard as
-    # cli.main and __graft_entry__.dryrun_multichip.
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    # Honor an explicit cpu request (CI / smoke tests): config pin + axon
+    # factory drop, same guard as cli.main and __graft_entry__.
+    from fm_spark_tpu.utils.cpuguard import force_cpu_platform
+
+    force_cpu_platform()
     import jax.numpy as jnp
     from jax import lax
 

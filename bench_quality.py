@@ -252,17 +252,14 @@ def numpy_float64_oracle_deepfm(tr, te):
 
 def _jax():
     """Import jax honoring an explicit JAX_PLATFORMS=cpu request — the
-    installed TPU plugin ignores the env var (same guard as bench.py and
-    cli.main; without it a hung TPU attachment hangs this script too)."""
-    import os
-
+    installed TPU plugin ignores the env var, and a dead attachment hangs
+    its factory outright (same guard as bench.py and cli.main; without it
+    a hung TPU attachment hangs this script too)."""
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    from fm_spark_tpu.utils.cpuguard import force_cpu_platform
+
+    force_cpu_platform()
     return jax
 
 
@@ -433,6 +430,11 @@ VARIANTS = {
                                 sparse_update="dedup_sr",
                                 host_dedup=True, compact_cap=128,
                                 compute_dtype="bfloat16"),
+    # bf16 COMPUTE over EXACT fp32 storage + plain scatter_add — the
+    # measured config-4 (FFM avazu) winner: only the forward/backward
+    # buffers round to bf16; tables, gradients-at-rest, and the
+    # scatter_add accumulation stay fp32, so no SR is needed.
+    "fp32_cdbf16": dict(compute_dtype="bfloat16"),
     # The round-4 wire-precision rows (multi-device only — skipped on a
     # single device): fp32-wire sharded pins the sharded step's own
     # numerics; the bf16-wire rows budget the collective_dtype lever and
@@ -472,6 +474,7 @@ BUDGET_VS_FP32 = {
     "fp32_dedup_compact": 1e-3,
     "bf16_dedup_sr_compact": 5e-3,
     "bf16_compact_cdbf16": 5e-3,
+    "fp32_cdbf16": 5e-3,
     "sharded_fp32_wire": 1e-3,
     "sharded_bf16_wire": 5e-3,
     "sharded_bf16_wire_ss": 5e-3,

@@ -1396,19 +1396,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    import os
-
     # The installed TPU plugin ignores the JAX_PLATFORMS env var and grabs
-    # the TPU backend anyway; honor an explicit cpu request via jax.config,
-    # which wins as long as the backend is not yet initialized (same guard
-    # as __graft_entry__.dryrun_multichip).
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        try:
-            import jax
+    # the TPU backend anyway (and a DEAD attachment hangs its factory even
+    # with the config pinned to cpu); honor an explicit cpu request via the
+    # shared guard (same as bench.py and __graft_entry__.dryrun_multichip).
+    from fm_spark_tpu.utils.cpuguard import force_cpu_platform
 
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    force_cpu_platform()
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,63 @@
+"""Honor an explicit ``JAX_PLATFORMS=cpu`` request on a machine whose TPU
+plugin misbehaves.
+
+Two distinct failure modes, both observed on this session's tunneled
+attachment (PERF.md round-5 notes):
+
+1. The plugin ignores the ``JAX_PLATFORMS`` env var and grabs the device
+   anyway — fixed by ``jax.config.update("jax_platforms", "cpu")`` before
+   backend init.
+2. When the attachment is DEAD, the plugin's backend factory hangs forever
+   inside ``jax.devices()`` — even with the config pinned to cpu (observed
+   2026-07-31: the factory initializes regardless and never returns). The
+   only in-process fix is to deregister the factory before first backend
+   init; tests/benches that asked for cpu never want the real chip, so
+   that is always safe for them.
+
+Private-API use (``xla_bridge._backend_factories``) is deliberate and
+best-effort: on a jax version where the attribute moves, we degrade to
+mode-1 behavior rather than erroring.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_cpu_platform"]
+
+
+def force_cpu_platform(only_if_env: bool = True) -> bool:
+    """If ``JAX_PLATFORMS=cpu`` is requested (or unconditionally with
+    ``only_if_env=False``), pin jax to the cpu backend and drop the
+    session's axon TPU factory so a dead attachment cannot hang init.
+
+    Returns True when the cpu pin was applied. Call BEFORE the first
+    ``jax.devices()``/jit; a no-op (False) when the env var asks for a
+    real platform.
+    """
+    if only_if_env and os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return False
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        return False  # backend already initialized — use what exists
+    try:
+        from jax._src import xla_bridge
+
+        # Drop every plugin factory, not just this session's "axon": the
+        # caller pinned cpu, so no accelerator factory may run — and any of
+        # them (axon today, a differently-named plugin elsewhere) can hang
+        # init when its device is unreachable. "tpu" must SURVIVE even
+        # though it is never initialized here: jax derives
+        # ``known_platforms()`` from this dict, and Pallas registers tpu
+        # MLIR lowerings at import — removing the factory turns every
+        # Pallas import into NotImplementedError("unknown platform tpu").
+        for name in list(xla_bridge._backend_factories):
+            if name not in ("cpu", "tpu"):
+                xla_bridge._backend_factories.pop(name, None)
+    except Exception:
+        pass
+    return True

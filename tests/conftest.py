@@ -21,7 +21,16 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# The session's axon PJRT plugin (sitecustomize on PYTHONPATH) registers a
+# backend factory in EVERY interpreter, and when the TPU tunnel is dead its
+# init hangs forever — even under JAX_PLATFORMS=cpu, taking the whole CPU
+# suite down with it (observed 2026-07-31: `jax.devices()` never returns
+# while the attachment flaps). Tests never want the real chip: pin cpu and
+# drop the accelerator factories before the first backend init.
+from fm_spark_tpu.utils.cpuguard import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()  # config pin + accelerator-factory drop
+
 jax.config.update("jax_debug_nans", False)  # enabled per-test where useful
 assert len(jax.devices()) >= 8, (
     "conftest failed to get 8 fake CPU devices — was the XLA backend "

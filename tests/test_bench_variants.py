@@ -8,9 +8,10 @@ behind probes it was staged to precede). These tests pin label<->config
 consistency and the salvage ordering without touching a device.
 """
 
+import os
 import sys
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
 
@@ -63,3 +64,16 @@ def test_ffm_grid_no_compact():
     for label, _, cfg in _grid("ffm"):
         assert cfg.compact_cap == 0, "compact measured a loser on avazu"
         assert "compact" not in label
+
+
+def test_ffm_salvage_order_measured_winner_first():
+    head, tail = bench.default_variants("ffm", 1 << 17)
+    # 816,553 on 2026-07-31 (MEASURED.json ffm_avazu): fp32 storage +
+    # bf16 compute + scatter_add. Label<->config consistency matters
+    # here doubly — cd-bf16 with FP32 storage is exact-storage, so the
+    # label's "/cd-bf16" is the only record that compute ran in bf16.
+    label, (pd, cd, layout), cfg = head[0]
+    assert label == "float32/scatter_add/cd-bf16"
+    assert (pd, cd) == ("float32", "bfloat16")
+    assert cfg.sparse_update == "scatter_add"
+    assert not cfg.host_dedup and not cfg.compact_device

@@ -1,0 +1,60 @@
+"""Pins for fm_spark_tpu.utils.cpuguard (the dead-attachment hang guard).
+
+The guard is what keeps every cpu-targeted surface (tests, bench_quality,
+bench_convergence, bench_wire_spot, __graft_entry__.dryrun_multichip,
+bench.py / cli.main under JAX_PLATFORMS=cpu) from hanging forever in
+``jax.devices()`` while the session's TPU tunnel is down — see the
+2026-07-31 PERF.md note. These tests run with the backend already up
+(conftest), so they pin the API contract, not the hang itself.
+"""
+
+import os
+
+from fm_spark_tpu.utils.cpuguard import force_cpu_platform
+
+
+def test_noop_without_cpu_request(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert force_cpu_platform() is False
+
+
+def test_harmless_after_backend_init(monkeypatch):
+    # conftest initialized the cpu backend long ago; the guard must not
+    # raise and must leave the 8-fake-device mesh intact either way.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    force_cpu_platform()
+    import jax
+
+    assert len(jax.devices()) >= 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_accelerator_factories_absent():
+    # conftest drops the plugin factories before backend init; the guard
+    # does the same for non-pytest surfaces. No plugin factory (axon, or a
+    # future plugin name) may survive into a cpu-pinned process — but
+    # "tpu" must stay registered, or Pallas's import-time tpu lowering
+    # registration dies with "unknown platform tpu" (cpuguard docstring).
+    from jax._src import xla_bridge
+
+    assert set(xla_bridge._backend_factories) <= {"cpu", "tpu"}
+    assert "axon" not in xla_bridge._backend_factories
+
+
+def test_unconditional_mode_ignores_env(monkeypatch):
+    # The env gate must decide whether the cpu pin is even ATTEMPTED:
+    # with only_if_env=False the guard must try the config update despite
+    # a non-cpu env; with the default gate and a non-cpu env it must not.
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda *a, **k: calls.append(a),
+    )
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert force_cpu_platform() is False
+    assert calls == []  # gated out before touching the config
+    assert force_cpu_platform(only_if_env=False) is True
+    assert ("jax_platforms", "cpu") in [tuple(c) for c in calls]
+    assert os.environ["JAX_PLATFORMS"] == "axon"  # env never mutated
