@@ -55,6 +55,7 @@ fence, and is what we use.
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -100,7 +101,13 @@ def default_variants(model, batch):
     """
     from fm_spark_tpu.train import TrainConfig
 
-    cap = min(16384, batch)
+    # Compact capacity must bound the bench batch's max per-field unique
+    # count (Zipf 1.3, seed 0: 11,990 at B=131072; 20,109 at B=262144 —
+    # both under batch/10, rounded up to segtotal's 512 tile). The
+    # historical 16384 stays the default-batch cap; larger batches scale
+    # it, or the compact variants would die on compact_overflow='error'.
+    bound = max(512, ((batch // 10) + 511) // 512 * 512)
+    cap = min(max(16384, bound), batch)
     if model == "deepfm":
         # Config 5's optimizer (dense Adam head) with the measured-best
         # FM table levers (criteo-sized tables sit ABOVE the gather
@@ -144,9 +151,26 @@ def default_variants(model, batch):
     base = dict(learning_rate=0.05, lr_schedule="constant",
                 optimizer="sgd", sparse_update="dedup_sr",
                 host_dedup=True, compact_cap=cap)
+    # Tight-cap A/B (staged for the next chip window): at the default
+    # batch, cap 13312 (= the bound above) cuts ~19% of cap lanes vs the
+    # historical 16384 — every cap-lane gather/expand/scatter pass
+    # shrinks proportionally. The bound is MEASURED only at 131072 and
+    # 262144; at other batches a too-tight cap makes the aux build raise
+    # CompactCapOverflow, which the sweep's per-variant guard turns into
+    # a logged skip (not a sweep abort). Staged second so a dying sweep
+    # prices it right after the winner; dropped when the scaled cap
+    # already equals the bound (no A/B to run).
+    tight = min(bound, cap)
     ranked = [
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
          dict(gfull_fused=True, segtotal_pallas=True), None),
+    ]
+    if tight < cap:
+        ranked.append(
+            (f"bfloat16/dedup_sr/compact{tight}/cd-bf16/gfull/segtotal",
+             dict(compact_cap=tight, gfull_fused=True,
+                  segtotal_pallas=True), None))
+    ranked += [
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
          dict(gfull_fused=True), None),
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
@@ -329,11 +353,19 @@ def inner_main(args):
         variants[0:0] = head
         variants.extend(tail)
 
+    if args.batch != 1 << 17:
+        # Batch is part of a rate's provenance (a doubled batch amortizes
+        # fixed per-step work, so its samples/sec is not comparable to the
+        # default-batch rows); stamp it into every label so MEASURED.json
+        # and the PERF tables can never conflate the two.
+        variants = [(f"{label}/b{args.batch}", dtypes, config)
+                    for label, dtypes, config in variants]
+
     import functools
 
     aux_cache = {}
-    results = []
-    for label, dtypes, config in variants:
+
+    def build_variant(dtypes, config):
         spec = make_spec(*dtypes)
         init_opt = None
         if args.model == "ffm":
@@ -355,6 +387,23 @@ def inner_main(args):
                     compact_aux(ids_np, akey) if akey else dedup_aux(ids_np)
                 )
             aux = aux_cache[akey]
+        return spec, init_opt, body, aux
+
+    results = []
+    for label, dtypes, config in variants:
+        # Everything variant-specific — INCLUDING the host aux build,
+        # whose CompactCapOverflow is exactly the failure a staged
+        # tight-cap variant can hit at an unmeasured batch — sits inside
+        # one guard so a broken variant is skipped, not sweep-fatal.
+        try:
+            spec, init_opt, body, aux = build_variant(dtypes, config)
+        except Exception as e:  # noqa: BLE001 — same rationale as the
+            # warmup/timing guard below
+            _log(f"[inner] [{label}] construction FAILED "
+                 f"({type(e).__name__}): "
+                 f"{(str(e).splitlines() or [''])[0][:200]}"
+                 " -- skipping variant")
+            continue
         params = spec.init(jax.random.key(0))
 
         # n_steps is a DYNAMIC argument so the warmup call compiles the
@@ -489,6 +538,18 @@ def _emit_final():
                 if "tpu" not in str(parsed.get("device", "")).lower():
                     raise RuntimeError(
                         f"not a TPU measurement: {parsed.get('device')!r}")
+                # Only the DEFAULT batch is comparable: a doubled batch
+                # amortizes fixed per-step work, so its samples/sec would
+                # clobber the tracked rate with an incomparable number
+                # (every recorded rate since round 2 is at B=131072). A
+                # non-default-batch A/B (the /b262144 label) stays in its
+                # sweep artifact; promoting it is a deliberate
+                # re-baseline, not a keep-best side effect.
+                if re.search(r"/b\d", str(parsed.get("variant", ""))):
+                    raise RuntimeError(
+                        f"non-default batch variant "
+                        f"{parsed.get('variant')!r}; not comparable with "
+                        "the recorded default-batch rate")
                 # Keep-best: MEASURED.json records the best measured
                 # on-chip capability. A later throttled window (this
                 # attachment streams at 5-10% of nominal HBM on bad

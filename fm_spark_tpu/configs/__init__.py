@@ -174,7 +174,7 @@ CONFIGS = {
             " (2-D feat×row mesh). The generic 'row' strategy materializes"
             " dense gradients (optax path) — correctness fallback, not the"
             " at-scale path. Measured-best single-chip flags (PERF.md"
-            " round-5 table, 1.356M samples/s/chip = 1.085x the Spark"
+            " round-5 table, 1.388M samples/s/chip = 1.110x the Spark"
             " baseline): --param-dtype bfloat16 --compute-dtype bfloat16"
             " --sparse-update dedup_sr --host-dedup --compact-cap 16384"
             " --gfull-fused --segtotal-pallas (the last two priced ~+8%"
@@ -196,7 +196,11 @@ CONFIGS = {
             name="avazu_ffm_r16",
             description="Config 4 (BASELINE.json:10): FFM rank-16, Avazu CTR,"
             " 23 fields (avazu.py), per-field hashed; field-partitioned"
-            " packed tables + fused sparse-SGD fast path.",
+            " packed tables + fused sparse-SGD fast path. Measured winner"
+            " (816,553 samples/s/chip, 2026-07-31): add --compute-dtype"
+            " bfloat16 and keep fp32 params + scatter_add — the bf16"
+            " compute buffers halve the [B, F, F, k] sel traffic; dedup/"
+            "compact LOSE at this table size (PERF.md).",
             model="field_ffm", dataset="avazu", rank=16, num_fields=23,
             bucket=1 << 14, strategy="field_sparse", num_steps=100_000,
             batch_size=8192, learning_rate=0.05, lr_schedule="constant",
@@ -207,7 +211,12 @@ CONFIGS = {
             " rank-16 + 3-layer 400-wide MLP on Criteo shapes, on the CTR"
             " fast path: field-partitioned embedding with fused sparse"
             " scatter updates; dense Adam covers only the MLP + bias"
-            " (no table-sized gradients or moment state).",
+            " (no table-sized gradients or moment state). Measured"
+            " (1,654,599 samples/s/chip, 2026-07-31): --param-dtype"
+            " bfloat16 --compute-dtype bfloat16 --sparse-update dedup_sr"
+            " --host-dedup --compact-cap 16384; do NOT add --gfull-fused/"
+            "--segtotal-pallas here — both measured LOSERS at rank 16's"
+            " narrow update rows (PERF.md).",
             model="field_deepfm", dataset="criteo", rank=16, num_fields=39,
             bucket=1 << 18, strategy="field_sparse", num_steps=1_000_000,
             batch_size=16384, learning_rate=1e-3, lr_schedule="constant",

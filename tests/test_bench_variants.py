@@ -38,17 +38,39 @@ def test_fm_label_config_consistency():
 def test_fm_salvage_order_composed_first():
     head, _ = bench.default_variants("fm", 1 << 17)
     cfgs = [c for _, _, c in head]
+    # [0] measured winner; [1] the tight-cap A/B of the same composed
+    # lever stack (the live pricing question — right after the winner so
+    # a dying sweep still answers it); [2][3] single-lever legs; [4] the
+    # r3 winner closing the grid.
     assert cfgs[0].gfull_fused and cfgs[0].segtotal_pallas
-    assert cfgs[1].gfull_fused and not cfgs[1].segtotal_pallas
-    assert cfgs[2].segtotal_pallas and not cfgs[2].gfull_fused
-    assert not cfgs[3].gfull_fused and not cfgs[3].segtotal_pallas
+    assert cfgs[0].compact_cap == 16384
+    assert cfgs[1].gfull_fused and cfgs[1].segtotal_pallas
+    assert cfgs[1].compact_cap == 13312
+    assert cfgs[2].gfull_fused and not cfgs[2].segtotal_pallas
+    assert cfgs[3].segtotal_pallas and not cfgs[3].gfull_fused
+    assert not cfgs[4].gfull_fused and not cfgs[4].segtotal_pallas
+
+
+def test_fm_tight_cap_bounds_measured_unique():
+    # The tight cap must bound the bench batch's measured max per-field
+    # unique count (Zipf 1.3, seed 0) or the staged A/B would die on
+    # compact_overflow='error'; and it must be a multiple of segtotal's
+    # 512 tile. Values measured 2026-07-31.
+    for batch, max_unique in ((131072, 11990), (262144, 20109)):
+        head, _ = bench.default_variants("fm", batch)
+        tight = sorted({c.compact_cap for _, _, c in head})[0]
+        assert tight % 512 == 0
+        assert max_unique <= tight <= batch
 
 
 def test_fm_cap_respects_small_batch():
+    # No compact variant may cap above the batch (the aux builder would
+    # allocate dead lanes); the tight-cap A/B additionally floors at 512
+    # (segtotal's tile).
     for label, _, cfg in _grid("fm", batch=1024):
         if cfg.compact_cap:
-            assert cfg.compact_cap == 1024, label
-            assert "compact1024" in label, label
+            assert cfg.compact_cap in (512, 1024), label
+            assert f"compact{cfg.compact_cap}" in label, label
 
 
 def test_deepfm_grid():
