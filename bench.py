@@ -519,6 +519,16 @@ _SALVAGE = {"line": None, "failures": [], "emitted": False, "proc": None}
 _SALVAGE_LOCK = threading.RLock()
 
 
+def default_batch_variant(variant) -> bool:
+    """True iff a sweep result's variant label carries no non-default
+    batch stamp (``/b<digits>``, added by inner_main when ``--batch``
+    differs from 1<<17). Only such results are comparable with the
+    recorded MEASURED.json rates — every recorded rate since round 2 is
+    at B=131072, and a doubled batch amortizes fixed per-step work into
+    an incomparable samples/sec."""
+    return not re.search(r"/b\d", str(variant or ""))
+
+
 def _emit_final():
     """Print the authoritative last line exactly once (result or error),
     and on a real measurement rewrite MEASURED.json so every downstream
@@ -538,14 +548,10 @@ def _emit_final():
                 if "tpu" not in str(parsed.get("device", "")).lower():
                     raise RuntimeError(
                         f"not a TPU measurement: {parsed.get('device')!r}")
-                # Only the DEFAULT batch is comparable: a doubled batch
-                # amortizes fixed per-step work, so its samples/sec would
-                # clobber the tracked rate with an incomparable number
-                # (every recorded rate since round 2 is at B=131072). A
-                # non-default-batch A/B (the /b262144 label) stays in its
-                # sweep artifact; promoting it is a deliberate
+                # A non-default-batch A/B (the /b262144 label) stays in
+                # its sweep artifact; promoting it is a deliberate
                 # re-baseline, not a keep-best side effect.
-                if re.search(r"/b\d", str(parsed.get("variant", ""))):
+                if not default_batch_variant(parsed.get("variant")):
                     raise RuntimeError(
                         f"non-default batch variant "
                         f"{parsed.get('variant')!r}; not comparable with "

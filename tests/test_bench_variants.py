@@ -88,6 +88,23 @@ def test_ffm_grid_no_compact():
         assert "compact" not in label
 
 
+def test_default_batch_variant_gate():
+    # The MEASURED.json keep-best gate: non-default-batch labels (the
+    # /b262144 A/B) must never be comparable with the recorded
+    # default-batch headline; every real default-batch label must be.
+    assert not bench.default_batch_variant(
+        "bfloat16/dedup_sr/compact26624/cd-bf16/gfull/segtotal/b262144")
+    assert not bench.default_batch_variant("float32/scatter_add/b2048")
+    for ok in (
+        "bfloat16/dedup_sr/compact16384/cd-bf16/gfull/segtotal",
+        "float32/scatter_add/cd-bf16",
+        "bfloat16/dedup_sr/compact16384/devaux/cd-bf16",
+        "float32/dedup/compact16384",
+        None,
+    ):
+        assert bench.default_batch_variant(ok), ok
+
+
 def test_ffm_salvage_order_measured_winner_first():
     head, tail = bench.default_variants("ffm", 1 << 17)
     # 816,553 on 2026-07-31 (MEASURED.json ffm_avazu): fp32 storage +
