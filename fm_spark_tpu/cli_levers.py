@@ -135,8 +135,8 @@ _LEVERS = (
            "build each field's backward g_full buffer directly as "
            "ds·x·(s1 − m·xv_full) instead of concat([g_v, g_l]) — "
            "removes one materialized copy pass per field (measured "
-           "~+8% on-chip and composes with --segtotal-pallas to the "
-           "1.356M headline, PERF.md round-5 table; ULP-pinned in "
+           "~+8%% on-chip and composes with --segtotal-pallas to the "
+           "1.388M headline, PERF.md round-5 table; ULP-pinned in "
            "tests/test_gfull.py). FieldFM/DeepFM fused bodies; other "
            "step factories reject it"),
     _Lever("--segtotal-pallas", "segtotal_pallas", "flag",

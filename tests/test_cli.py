@@ -449,3 +449,16 @@ def test_field_sparse_capability_guards():
                ["--gfull-fused", "--segtotal-pallas", "--compact-device",
                 "--compact-cap", "128", "--sparse-update", "dedup"],
                fm_kw) == 0
+
+
+def test_help_renders_for_every_subcommand(capsys):
+    # argparse expands help strings with %-formatting at RENDER time, so
+    # an unescaped literal % in any flag's help crashes --help for the
+    # whole subcommand (round 5: the --gfull-fused lever help's "~+8%"
+    # broke `train --help` with "%o format: an integer is required").
+    # Render every subcommand's help to pin this class of regression.
+    for sub in ("train", "eval", "predict", "preprocess", "list-configs"):
+        with pytest.raises(SystemExit) as exc:
+            cli.build_parser().parse_args([sub, "--help"])
+        assert exc.value.code == 0
+        assert capsys.readouterr().out  # non-empty rendered help
