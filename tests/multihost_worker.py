@@ -26,11 +26,32 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}",
-        num_processes=num_processes,
-        process_id=process_id,
+
+    # ---- Phase 0: the CLI `--distributed` path IS this worker's
+    # distributed initializer — a tiny injected field_sparse config
+    # trains end-to-end through ``cli.main`` (round 5): argument
+    # plumbing, jax.distributed.initialize with the explicit triple,
+    # the multi-process placement machinery, the sharded training loop,
+    # and the cross-process eval, all through the real user entry
+    # point. The remaining phases then reuse the initialized runtime.
+    from fm_spark_tpu import cli, configs as configs_lib
+    from fm_spark_tpu.configs import RunConfig
+
+    configs_lib.CONFIGS["_mh_smoke"] = RunConfig(
+        name="_mh_smoke",
+        description="2-process CLI smoke config (injected by "
+                    "multihost_worker; not a registered benchmark)",
+        model="field_fm", dataset="synthetic", rank=4, num_fields=4,
+        bucket=64, strategy="field_sparse", num_steps=4, batch_size=32,
+        learning_rate=0.1, lr_schedule="constant",
     )
+    rc = cli.main([
+        "train", "--config", "_mh_smoke", "--synthetic", "256",
+        "--distributed", "--coordinator", f"127.0.0.1:{port}",
+        "--num-processes", str(num_processes),
+        "--process-id", str(process_id),
+    ])
+    assert rc == 0, f"phase-0 CLI train rc={rc}"
     assert jax.process_count() == num_processes
     import numpy as np
     import jax.numpy as jnp

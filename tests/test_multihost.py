@@ -43,7 +43,7 @@ def test_two_process_dp_psum_agrees():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=300)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
