@@ -11,6 +11,8 @@ consistency and the salvage ordering without touching a device.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
@@ -116,3 +118,67 @@ def test_ffm_salvage_order_measured_winner_first():
     assert (pd, cd) == ("float32", "bfloat16")
     assert cfg.sparse_update == "scatter_add"
     assert not cfg.host_dedup and not cfg.compact_device
+
+
+@pytest.mark.slow
+def test_default_grids_build_and_step():
+    """Every default-sweep variant of every model must CONSTRUCT and run
+    one step — label pins alone would let a variant that fails at build
+    time (the class the sweep's per-variant guard logs and skips) go
+    unnoticed until the driver's round-end bench. Tiny shapes; segtotal
+    runs its interpret path off-TPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.ops.scatter import compact_aux, dedup_aux
+    from fm_spark_tpu.sparse import (
+        make_field_deepfm_sparse_step,
+        make_field_ffm_sparse_sgd_step,
+        make_field_sparse_sgd_step,
+    )
+
+    B, F, BUCKET, RANK = 512, 4, 256, 8
+    rng = np.random.default_rng(0)
+    ids_np = (rng.zipf(1.3, size=(B, F)) % BUCKET).astype(np.int32)
+    ids = jnp.asarray(ids_np)
+    vals = jnp.ones((B, F), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 2, B), jnp.float32)
+    weights = jnp.ones((B,), jnp.float32)
+
+    for model in ("fm", "ffm", "deepfm"):
+        head, tail = bench.default_variants(model, B)
+        assert head or tail, model
+        for label, (pd, cd, layout), cfg in head + tail:
+            # Mirror bench.make_spec's dtype fallback: a None compute
+            # dtype means "the --compute-dtype default" (float32), NOT
+            # dtype(None) — numpy canonicalizes the latter to float64.
+            common = dict(
+                num_features=F * BUCKET, rank=RANK, num_fields=F,
+                bucket=BUCKET, init_std=0.01, param_dtype=pd,
+                compute_dtype=cd or "float32",
+            )
+            aux = None
+            if cfg.host_dedup:
+                aux = (compact_aux(ids_np, cfg.compact_cap)
+                       if cfg.compact_cap else dedup_aux(ids_np))
+            if model == "ffm":
+                spec = models.FieldFFMSpec(**common)
+                step = make_field_ffm_sparse_sgd_step(spec, cfg)
+            elif model == "deepfm":
+                spec = models.FieldDeepFMSpec(**common, mlp_dims=(8, 8))
+                step = make_field_deepfm_sparse_step(spec, cfg)
+            else:
+                spec = models.FieldFMSpec(
+                    **common, table_layout=layout or "row")
+                step = make_field_sparse_sgd_step(spec, cfg)
+            params = spec.init(jax.random.key(0))
+            if model == "deepfm":
+                opt = step.init_opt_state(params)
+                params, opt, loss = step(params, opt, jnp.int32(0), ids,
+                                         vals, labels, weights, aux)
+            else:
+                params, loss = step(params, jnp.int32(0), ids, vals,
+                                    labels, weights, aux)
+            assert np.isfinite(float(loss)), f"{model}:{label}"
