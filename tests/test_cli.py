@@ -517,3 +517,28 @@ def test_distributed_init_precedes_backend_touch():
     for touch in ("device_count", "process_count", "jax.devices"):
         if touch in src:
             assert hook < src.index(touch), touch
+
+
+def test_readme_multihost_exemplar_validates():
+    # The README "Multi-host" quick-start command (README.md Quick
+    # start) must parse and pass the lever validator — a lever rename
+    # or a new validation rule that breaks the documented command
+    # should fail here, not in a user's pod job. Mirrors the README
+    # flags minus host-environment ones (--data, --checkpoint-dir).
+    args = cli.build_parser().parse_args([
+        "train", "--config", "criteo1tb_fm_r64", "--synthetic", "64",
+        "--distributed",
+        "--compact-device", "--collective-dtype", "bfloat16",
+        "--score-sharded", "--batch-per-chip", "131072",
+        "--ckpt-sharded",
+    ])
+    assert args.distributed and args.compact_device
+    from fm_spark_tpu import configs as configs_lib
+    from fm_spark_tpu.cli import _lever_overrides
+    from fm_spark_tpu.cli_levers import check_levers_any
+
+    cfg = configs_lib.get_config("criteo1tb_fm_r64")
+    tconfig = cfg.train_config(**_lever_overrides(args))
+    assert check_levers_any(tconfig) is None
+    assert tconfig.compact_device and tconfig.score_sharded
+    assert tconfig.collective_dtype == "bfloat16"
