@@ -520,24 +520,42 @@ def test_distributed_init_precedes_backend_touch():
 
 
 def test_readme_multihost_exemplar_validates():
-    # The README "Multi-host" quick-start command (README.md Quick
-    # start) must parse and pass the lever validator — a lever rename
-    # or a new validation rule that breaks the documented command
-    # should fail here, not in a user's pod job. Mirrors the README
-    # flags minus host-environment ones (--data, --checkpoint-dir).
-    args = cli.build_parser().parse_args([
-        "train", "--config", "criteo1tb_fm_r64", "--synthetic", "64",
-        "--distributed",
-        "--compact-device", "--collective-dtype", "bfloat16",
-        "--score-sharded", "--batch-per-chip", "131072",
-        "--ckpt-sharded",
-    ])
-    assert args.distributed and args.compact_device
-    from fm_spark_tpu import configs as configs_lib
+    # The README "Multi-host" quick-start command must parse and pass
+    # the lever validator — a lever rename or a new validation rule
+    # that breaks the documented command should fail here, not in a
+    # user's pod job. The command is EXTRACTED from README.md (not
+    # hand-copied), so an edit to either side re-validates the pair;
+    # only host-environment flags (--data, --checkpoint-dir) are
+    # swapped for --synthetic.
+    import os
+    import re
+    import shlex
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "README.md")) as f:
+        text = f.read()
+    # Continuation lines first ([^\n]*\\\n repeated), then the final
+    # line — the naive [^\n]*(?:\\\n...)* form never extends past the
+    # first line (the zero-iteration group already succeeds, and greedy
+    # quantifiers don't backtrack to lengthen a match).
+    cmds = [m.group(0).replace("\\\n", " ") for m in re.finditer(
+        r"python -m fm_spark_tpu\.cli train(?:[^\n]*\\\n)*[^\n]*", text)]
+    dist = [c for c in cmds if "--distributed" in c]
+    assert len(dist) == 1, "expected exactly one --distributed exemplar"
+    argv = shlex.split(dist[0])[3:]  # drop 'python -m fm_spark_tpu.cli'
+    cleaned, i = [], 0
+    while i < len(argv):
+        if argv[i] in ("--data", "--checkpoint-dir"):
+            i += 2
+            continue
+        cleaned.append(argv[i])
+        i += 1
+    args = cli.build_parser().parse_args(cleaned + ["--synthetic", "64"])
+    assert args.distributed
     from fm_spark_tpu.cli import _lever_overrides
     from fm_spark_tpu.cli_levers import check_levers_any
 
-    cfg = configs_lib.get_config("criteo1tb_fm_r64")
+    cfg = configs_lib.get_config(args.config)
     tconfig = cfg.train_config(**_lever_overrides(args))
     assert check_levers_any(tconfig) is None
     assert tconfig.compact_device and tconfig.score_sharded
