@@ -70,12 +70,22 @@ METRICS = {
            10_000_000 / 8),
     "ffm": ("avazu_ffm_rank16_samples_per_sec_per_chip", None),
     "deepfm": ("criteo_deepfm_rank16_samples_per_sec_per_chip", None),
+    # Config 2 (BASELINE.json:8): FM rank-32, Criteo-Kaggle, 39x32768
+    # ~= 1.28M hashed features. Its own metric so its rate can never
+    # conflate with the rank-64/10M headline.
+    "fm_kaggle": ("kaggle_fm_rank32_1Mfeat_samples_per_sec_per_chip",
+                  None),
 }
+# Per-model DEFAULT rank: an explicit --rank override changes the
+# program being measured, so it is stamped into the variant label
+# (same provenance rule as a non-default --batch).
+DEFAULT_RANK = {"fm": 64, "ffm": 16, "deepfm": 16, "fm_kaggle": 32}
 # metric name -> MEASURED.json entry rewritten on a successful sweep
 METRIC_ENTRY = {
     METRICS["fm"][0]: "headline",
     METRICS["ffm"][0]: "ffm_avazu",
     METRICS["deepfm"][0]: "deepfm_criteo",
+    METRICS["fm_kaggle"][0]: "fm_kaggle",
 }
 METRIC, TARGET_PER_CHIP = METRICS["fm"]
 UNIT = "samples/sec/chip"
@@ -143,6 +153,27 @@ def default_variants(model, batch):
         ], [
             ("bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
              TrainConfig(**ffm_base, sparse_update="dedup_sr")),
+        ]
+    if model == "fm_kaggle":
+        # Config 2: small tables — candidates from BOTH measured
+        # regimes: the avazu winner form (bf16 compute over exact fp32
+        # storage, no dedup machinery) and the criteo winner form
+        # (bf16 storage + SR + compact; cap 16384 bounds the measured
+        # 10,711 max per-field unique at B=131072). The on-chip sweep
+        # decides; fp32/scatter_add is the reference variant between
+        # head and tail.
+        kbase = dict(learning_rate=0.05, lr_schedule="constant",
+                     optimizer="sgd")
+        return [
+            ("float32/scatter_add/cd-bf16", ("float32", "bfloat16", None),
+             TrainConfig(**kbase, sparse_update="scatter_add")),
+            (f"bfloat16/dedup_sr/compact{cap}/cd-bf16",
+             ("bfloat16", "bfloat16", None),
+             TrainConfig(**kbase, sparse_update="dedup_sr",
+                         host_dedup=True, compact_cap=cap)),
+        ], [
+            ("bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
+             TrainConfig(**kbase, sparse_update="dedup_sr")),
         ]
     # FM headline (PERF.md "the compact lever": scatter cost is
     # per-lane even for dropped lanes, so cap-lane compaction wins; cap
@@ -267,19 +298,26 @@ def inner_main(args):
         # Config 4's shape (configs.avazu_ffm_r16): 23 fields, 16384
         # per-field buckets, rank 16.
         num_fields, bucket = 23, 1 << 14
-        rank = args.rank or 16
+        rank = args.rank or DEFAULT_RANK["ffm"]
         if args.table_layout != "row":
             raise SystemExit("--table-layout col is a FieldFM lever")
     elif args.model == "deepfm":
         # Config 5's shape (configs.criteo1tb_deepfm): 39 fields,
         # 262144 buckets, rank 16, 3x400 MLP head on dense Adam.
         num_fields, bucket = 39, 1 << 18
-        rank = args.rank or 16
+        rank = args.rank or DEFAULT_RANK["deepfm"]
         if args.table_layout != "row":
             raise SystemExit("--table-layout col is a FieldFM lever")
+    elif args.model == "fm_kaggle":
+        # Config 2's shape (configs.criteo_kaggle_fm_r32): 39 fields,
+        # 32768 per-field buckets, rank 32 — per-field tables are SMALL
+        # (2.1MB bf16), so the avazu small-table lesson applies and the
+        # grid stages the cd-bf16-over-fp32 candidate first.
+        num_fields, bucket = 39, 1 << 15
+        rank = args.rank or DEFAULT_RANK["fm_kaggle"]
     else:
         num_fields, bucket = 39, 262_144
-        rank = args.rank or 64
+        rank = args.rank or DEFAULT_RANK["fm"]
     batch = args.batch
     steps_warmup = 3
     steps_timed = args.steps
@@ -353,12 +391,18 @@ def inner_main(args):
         variants[0:0] = head
         variants.extend(tail)
 
+    # Batch and rank are part of a rate's provenance (a doubled batch
+    # amortizes fixed per-step work; a different rank is a different
+    # program entirely), so non-default values are stamped into every
+    # label and such rates can never keep-best into MEASURED.json
+    # (comparable_variant below).
+    stamp = ""
     if args.batch != 1 << 17:
-        # Batch is part of a rate's provenance (a doubled batch amortizes
-        # fixed per-step work, so its samples/sec is not comparable to the
-        # default-batch rows); stamp it into every label so MEASURED.json
-        # and the PERF tables can never conflate the two.
-        variants = [(f"{label}/b{args.batch}", dtypes, config)
+        stamp += f"/b{args.batch}"
+    if args.rank is not None and args.rank != DEFAULT_RANK[args.model]:
+        stamp += f"/r{args.rank}"
+    if stamp:
+        variants = [(f"{label}{stamp}", dtypes, config)
                     for label, dtypes, config in variants]
 
     import functools
@@ -519,14 +563,15 @@ _SALVAGE = {"line": None, "failures": [], "emitted": False, "proc": None}
 _SALVAGE_LOCK = threading.RLock()
 
 
-def default_batch_variant(variant) -> bool:
+def comparable_variant(variant) -> bool:
     """True iff a sweep result's variant label carries no non-default
-    batch stamp (``/b<digits>``, added by inner_main when ``--batch``
-    differs from 1<<17). Only such results are comparable with the
-    recorded MEASURED.json rates — every recorded rate since round 2 is
-    at B=131072, and a doubled batch amortizes fixed per-step work into
-    an incomparable samples/sec."""
-    return not re.search(r"/b\d", str(variant or ""))
+    shape stamp — ``/b<digits>`` (non-default ``--batch``) or
+    ``/r<digits>`` (non-default ``--rank``), added by inner_main. Only
+    such results are comparable with the recorded MEASURED.json rates:
+    every recorded rate is at its model's default batch and rank, a
+    doubled batch amortizes fixed per-step work into an incomparable
+    samples/sec, and a different rank is a different program."""
+    return not re.search(r"/[br]\d", str(variant or ""))
 
 
 def _emit_final():
@@ -548,14 +593,14 @@ def _emit_final():
                 if "tpu" not in str(parsed.get("device", "")).lower():
                     raise RuntimeError(
                         f"not a TPU measurement: {parsed.get('device')!r}")
-                # A non-default-batch A/B (the /b262144 label) stays in
-                # its sweep artifact; promoting it is a deliberate
-                # re-baseline, not a keep-best side effect.
-                if not default_batch_variant(parsed.get("variant")):
+                # A non-default-shape A/B (the /b262144 or /r32 labels)
+                # stays in its sweep artifact; promoting it is a
+                # deliberate re-baseline, not a keep-best side effect.
+                if not comparable_variant(parsed.get("variant")):
                     raise RuntimeError(
-                        f"non-default batch variant "
+                        f"non-default-shape variant "
                         f"{parsed.get('variant')!r}; not comparable with "
-                        "the recorded default-batch rate")
+                        "the recorded default-shape rate")
                 # Keep-best: MEASURED.json records the best measured
                 # on-chip capability. A later throttled window (this
                 # attachment streams at 5-10% of nominal HBM on bad

@@ -32,7 +32,7 @@ _FIELDS = ("rate_samples_per_sec_per_chip", "source", "date")
 _REQUIRED = {"headline": _FIELDS, "ffm_avazu": _FIELDS}
 # Entries bench.py MAY write once measured (no carried value exists yet,
 # so their absence is valid).
-_OPTIONAL = {"deepfm_criteo": _FIELDS}
+_OPTIONAL = {"deepfm_criteo": _FIELDS, "fm_kaggle": _FIELDS}
 _KNOWN = {**_REQUIRED, **_OPTIONAL}
 
 

@@ -90,13 +90,18 @@ def test_ffm_grid_no_compact():
         assert "compact" not in label
 
 
-def test_default_batch_variant_gate():
-    # The MEASURED.json keep-best gate: non-default-batch labels (the
-    # /b262144 A/B) must never be comparable with the recorded
-    # default-batch headline; every real default-batch label must be.
-    assert not bench.default_batch_variant(
-        "bfloat16/dedup_sr/compact26624/cd-bf16/gfull/segtotal/b262144")
-    assert not bench.default_batch_variant("float32/scatter_add/b2048")
+def test_comparable_variant_gate():
+    # The MEASURED.json keep-best gate: non-default-shape labels (the
+    # /b262144 batch A/B, any explicit --rank run) must never be
+    # comparable with the recorded default-shape rates; every real
+    # default-shape label must be.
+    for bad in (
+        "bfloat16/dedup_sr/compact26624/cd-bf16/gfull/segtotal/b262144",
+        "float32/scatter_add/b2048",
+        "bfloat16/dedup_sr/compact16384/cd-bf16/r32",
+        "float32/scatter_add/b2048/r8",
+    ):
+        assert not bench.comparable_variant(bad), bad
     for ok in (
         "bfloat16/dedup_sr/compact16384/cd-bf16/gfull/segtotal",
         "float32/scatter_add/cd-bf16",
@@ -104,7 +109,22 @@ def test_default_batch_variant_gate():
         "float32/dedup/compact16384",
         None,
     ):
-        assert bench.default_batch_variant(ok), ok
+        assert bench.comparable_variant(ok), ok
+
+
+def test_fm_kaggle_grid():
+    # Config 2's grid: cd-bf16-over-fp32 staged first (small-table
+    # regime, the measured avazu-winner form), the criteo-winner form
+    # second, bf16/dedup_sr as the tail sentinel; compact cap bounds
+    # the measured 10,711 max per-field unique at B=131072.
+    head, tail = bench.default_variants("fm_kaggle", 1 << 17)
+    label0, (pd0, cd0, _), cfg0 = head[0]
+    assert label0 == "float32/scatter_add/cd-bf16"
+    assert (pd0, cd0) == ("float32", "bfloat16")
+    label1, _, cfg1 = head[1]
+    assert cfg1.compact_cap == 16384 and cfg1.host_dedup
+    assert f"compact{cfg1.compact_cap}" in label1
+    assert [c.sparse_update for _, _, c in tail] == ["dedup_sr"]
 
 
 def test_ffm_salvage_order_measured_winner_first():
@@ -147,7 +167,7 @@ def test_default_grids_build_and_step():
     labels = jnp.asarray(rng.integers(0, 2, B), jnp.float32)
     weights = jnp.ones((B,), jnp.float32)
 
-    for model in ("fm", "ffm", "deepfm"):
+    for model in ("fm", "ffm", "deepfm", "fm_kaggle"):
         head, tail = bench.default_variants(model, B)
         assert head or tail, model
         for label, (pd, cd, layout), cfg in head + tail:

@@ -90,11 +90,25 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         touch "$OUT/deepfm_done"
       fi
     fi
-    # Window 4+: the doubled-batch A/B of the composed winner (B=262144
+    # Window 4+: config 2's first-ever on-chip rate (fm_kaggle — its
+    # own metric + MEASURED entry, so no conflation with the headline).
+    # BEFORE the b262 A/B: a brand-new MEASURED entry outranks an A/B
+    # that by design can never update MEASURED.json.
+    if [ "$rc" -eq 0 ] && [ -e "$OUT/deepfm_done" ] && [ ! -e "$OUT/kaggle_done" ]; then
+      timeout 1100 python bench.py --model fm_kaggle --total-deadline 900 \
+        > "$OUT/kaggle_sweep.out" 2> "$OUT/kaggle_sweep.err"
+      krc=$?
+      kval=$(best_value "$OUT/kaggle_sweep.out")
+      echo "tpu_watch: fm_kaggle sweep rc=$krc value=$kval" >> "$OUT/log"
+      if python -c "import sys; sys.exit(0 if float('$kval') > 0 else 1)"; then
+        touch "$OUT/kaggle_done"
+      fi
+    fi
+    # Window 5+ (last): the doubled-batch A/B of the composed winner (B=262144
     # amortizes every batch-independent cost; cap 26624 bounds the
     # measured 20,109 max unique at that batch — bench.py grid notes).
     # The /b262144 label suffix keeps the rate's provenance distinct.
-    if [ "$rc" -eq 0 ] && [ -e "$OUT/deepfm_done" ] && [ ! -e "$OUT/b262_done" ]; then
+    if [ "$rc" -eq 0 ] && [ -e "$OUT/kaggle_done" ] && [ ! -e "$OUT/b262_done" ]; then
       timeout 1100 python bench.py --batch 262144 --compact-cap 26624 \
         --param-dtype bfloat16 --compute-dtype bfloat16 \
         --sparse-update dedup_sr --host-dedup \
