@@ -129,9 +129,17 @@ def main():
     def raw():
         return PackedBatches(ds, args.batch, seed=1)
 
-    def with_field_local():
+    def with_field_local_unfused():
+        # The pre-round-5 production path: conversion as a second
+        # full-batch pass in the StreamingBatches wrapper. Kept as a
+        # stage so the fused win stays attributable.
         return StreamingBatches(PackedBatches(ds, args.batch, seed=1),
                                 bucket=bucket)
+
+    def with_field_local():
+        # The production path: conversion fused into the (native when
+        # available) row gather inside PackedBatches.
+        return PackedBatches(ds, args.batch, seed=1, bucket=bucket)
 
     def put_block(b):
         jax.block_until_ready(jax.device_put(b))
@@ -143,8 +151,12 @@ def main():
                                  cap=args.compact_cap))
         if args.host_dedup else with_field_local
     )
+    from fm_spark_tpu import native
+
+    _log(f"native gather: {native.gather_available()}")
     stages = [
         ("packed_batches", raw, lambda b: None),
+        ("+field_local_unfused", with_field_local_unfused, lambda b: None),
         ("+field_local", with_field_local, lambda b: None),
     ]
     if args.host_dedup:

@@ -43,10 +43,7 @@ from fm_spark_tpu.cli_levers import (
 # ----------------------------------------------------------------- data
 
 
-def _field_local(ids: np.ndarray, bucket: int) -> np.ndarray:
-    """Global per-field-offset ids [N, F] → field-local ids in [0, bucket)."""
-    offs = np.arange(ids.shape[1], dtype=ids.dtype) * bucket
-    return ids - offs[None, :]
+from fm_spark_tpu.data.packed import field_local as _field_local
 
 
 def _is_packed_dir(path) -> bool:
@@ -131,9 +128,7 @@ def iter_packed_once(ds, batch_size: int, bucket: int = 0, row_range=None):
     lo, hi = row_range if row_range is not None else (0, len(ds))
     for start in range(lo, hi, batch_size):
         end = min(start + batch_size, hi)
-        ids, vals, labels = ds.slice(np.s_[start:end])
-        if bucket:
-            ids = _field_local(ids, bucket)
+        ids, vals, labels = ds.assemble(np.s_[start:end], bucket=bucket)
         b = end - start
         pad = batch_size - b
         weights = np.ones((b,), np.float32)
@@ -1060,11 +1055,11 @@ def cmd_train(args) -> int:
         else:
             row_range = (0, cut)
             local_bs = tconfig.batch_size
-        batches = StreamingBatches(
-            PackedBatches(ds, local_bs, seed=cfg.seed,
-                          row_range=row_range),
-            bucket=bucket,
-        )
+        # bucket pushed into PackedBatches: the field-local conversion
+        # fuses into the (native) row gather instead of a second pass,
+        # and PackedBatches speaks the batch-source protocol directly.
+        batches = PackedBatches(ds, local_bs, seed=cfg.seed,
+                                row_range=row_range, bucket=bucket)
         if cut < len(ds):
             te_packed = (ds, (cut, len(ds)), bucket)
     else:

@@ -32,6 +32,15 @@ import numpy as np
 _VERSION = 1
 
 
+def field_local(ids: np.ndarray, bucket: int) -> np.ndarray:
+    """Global per-field-offset ids [N, F] → field-local ids in
+    [0, bucket): the FieldFM id layout (``id - field*bucket``). The one
+    shared definition — the native ``fm_gather_rows`` kernel fuses the
+    same formula into its gather and is pinned bit-identical to it."""
+    offs = np.arange(ids.shape[1], dtype=ids.dtype) * bucket
+    return ids - offs[None, :]
+
+
 class PackedWriter:
     """Append-only writer for the packed format (one-time preprocessing)."""
 
@@ -124,6 +133,7 @@ class PackedDataset:
         )
         self.labels = np.memmap(os.path.join(path, "labels.bin"), np.int8,
                                 "r", shape=(self.num_examples,))
+        self._ones = None  # cached all-ones vals, see assemble()
 
     def __len__(self):
         return self.num_examples
@@ -135,6 +145,61 @@ class PackedDataset:
             np.asarray(self.vals[sel])
             if self.vals is not None
             else np.ones(ids.shape, np.float32)
+        )
+        return ids, vals, np.asarray(self.labels[sel], np.float32)
+
+    def _ones_vals(self, shape) -> np.ndarray:
+        """Shared all-ones vals for store_vals=False dirs (one-hot data).
+
+        Refilling 4*B*F bytes per batch is pure feed-path waste when
+        every batch's vals are identically 1.0; the returned array is
+        CACHED AND SHARED across batches — treat it as read-only (every
+        in-repo consumer only ships it to the device or concatenates)."""
+        ones = self._ones  # local read: assemble() may race between the
+        # prefetch producer thread and a concurrent eval pass; returning
+        # the local keeps each caller's shape right even if another
+        # thread swaps the cache underneath it.
+        if ones is None or ones.shape != shape:
+            ones = np.ones(shape, np.float32)
+            self._ones = ones
+        return ones
+
+    def assemble(self, sel, bucket: int = 0,
+                 n_threads: int = 0) -> tuple[np.ndarray, np.ndarray,
+                                              np.ndarray]:
+        """Fused batch assembly: :meth:`slice` + the FieldFM field-local
+        id conversion (``ids[b, f] - f*bucket`` when ``bucket > 0``) in
+        one pass.
+
+        This is the feed hot path (SURVEY.md §7 hard part #1): the
+        native ``fm_gather_rows`` kernel does the row gather, the id
+        conversion, and the int8->f32 label cast in a single sweep
+        (threaded over rows on multi-core hosts; the pure-numpy
+        fallback is bit-identical), and store_vals=False dirs reuse one
+        cached all-ones vals array instead of refilling it per batch
+        (read-only — see :meth:`_ones_vals`)."""
+        from fm_spark_tpu import native
+
+        if isinstance(sel, slice):
+            start, stop, step = sel.indices(self.num_examples)
+            sel = np.arange(start, stop, step, dtype=np.int64)
+        else:
+            sel = np.asarray(sel, np.int64)
+        got = native.gather_rows_native(
+            self.ids, self.vals, self.labels, sel, bucket, n_threads
+        )
+        if got is not None:
+            ids, vals, labels = got
+            if vals is None:
+                vals = self._ones_vals(ids.shape)
+            return ids, vals, labels
+        ids = np.asarray(self.ids[sel])
+        if bucket:
+            ids = field_local(ids, bucket)
+        vals = (
+            np.asarray(self.vals[sel])
+            if self.vals is not None
+            else self._ones_vals(ids.shape)
         )
         return ids, vals, np.asarray(self.labels[sel], np.float32)
 
@@ -276,10 +341,12 @@ class PackedBatches:
                  chunk_size: int = 1 << 18,
                  host_index: int = 0, num_hosts: int = 1,
                  drop_remainder: bool = False,
-                 row_range: tuple[int, int] | None = None):
+                 row_range: tuple[int, int] | None = None,
+                 bucket: int = 0):
         if not (0 <= host_index < num_hosts):
             raise ValueError(f"host_index {host_index} not in [0,{num_hosts})")
         self.ds = dataset
+        self.bucket = int(bucket)  # >0: yield field-local ids (fused)
         self.batch_size = int(batch_size)
         self.seed = int(seed)
         self.shuffle = bool(shuffle)
@@ -332,14 +399,15 @@ class PackedBatches:
     def state(self) -> dict:
         return {"epoch": self.epoch, "index": self.index, "seed": self.seed,
                 "lo": self.lo, "hi": self.hi, "shuffle": self.shuffle,
-                "chunk_size": self.chunk_size}
+                "chunk_size": self.chunk_size, "bucket": self.bucket}
 
     def restore(self, state: dict) -> None:
         # Everything the epoch order is a function of must match, or the
         # resumed sequence silently diverges from the saved one.
         for key, have in [("seed", self.seed), ("lo", self.lo),
                           ("hi", self.hi), ("shuffle", self.shuffle),
-                          ("chunk_size", self.chunk_size)]:
+                          ("chunk_size", self.chunk_size),
+                          ("bucket", self.bucket)]:
             if key in state and state[key] != have:
                 raise ValueError(
                     f"restoring pipeline state with a different {key} "
@@ -351,6 +419,10 @@ class PackedBatches:
 
     def __iter__(self):
         return self
+
+    def next_batch(self):
+        """Batch-source protocol (what Prefetcher/StackedBatches wrap)."""
+        return self.__next__()
 
     def __next__(self):
         n, b = self.num_examples, self.batch_size
@@ -377,5 +449,5 @@ class PackedBatches:
             self._order = None
         # memmap fancy-indexing wants sorted offsets for locality; sorting
         # would undo the shuffle, and chunk-local order is already close.
-        ids, vals, labels = self.ds.slice(sel)
+        ids, vals, labels = self.ds.assemble(sel, bucket=self.bucket)
         return ids, vals, labels, weights

@@ -86,6 +86,14 @@ def _load():
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p,
             ]
+        if hasattr(lib, "fm_gather_rows"):
+            lib.fm_gather_rows.restype = None
+            lib.fm_gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
         _lib = lib
         return _lib
 
@@ -93,6 +101,14 @@ def _load():
 def available() -> bool:
     """True if the native library compiled and loaded on this machine."""
     return _load() is not None
+
+
+def gather_available() -> bool:
+    """True iff the fused batch-gather path is actually live (library
+    loaded AND the fm_gather_rows symbol present — a stale cached .so
+    can load without it, silently degrading to the numpy fallback)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "fm_gather_rows")
 
 
 def build_error() -> str | None:
@@ -254,3 +270,50 @@ def compact_aux_native(ids: np.ndarray, cap: int):
             "unique-id count)"
         )
     return useg, segstart, segend, order, inv
+
+
+def gather_rows_native(ids: np.ndarray, vals: np.ndarray | None,
+                       labels: np.ndarray, sel: np.ndarray,
+                       bucket: int = 0, n_threads: int = 0):
+    """Fused packed-batch assembly (fm_gather_rows): gather ``sel`` rows
+    out of the [N, F] int32 id table (and f32 vals table when present),
+    converting to field-local ids in the same pass when ``bucket > 0``
+    and casting int8 labels to f32. Returns ``(ids, vals, labels)`` with
+    ``vals = None`` when the source stores none (caller supplies its
+    cached all-ones array), or None when the native library (or the
+    symbol, for stale builds) is unavailable.
+
+    Bit-identical to the numpy fallback in
+    :meth:`fm_spark_tpu.data.packed.PackedDataset.assemble` (int32
+    subtraction and int8->f32 cast are exact in both)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fm_gather_rows"):
+        return None
+    if ids.dtype != np.int32 or labels.dtype != np.int8:
+        return None  # non-standard packed arrays: let numpy handle it
+    if vals is not None and vals.dtype != np.float32:
+        return None
+    if not (ids.flags.c_contiguous and labels.flags.c_contiguous
+            and (vals is None or vals.flags.c_contiguous)):
+        return None  # packed memmaps are contiguous; anything else -> numpy
+    sel = np.ascontiguousarray(sel, np.int64)
+    b = sel.shape[0]
+    f = ids.shape[1]
+    if b and (int(sel.min()) < 0 or int(sel.max()) >= ids.shape[0]):
+        # The C kernel does no bounds checks; numpy's fancy indexing
+        # semantics (IndexError / negative wraparound) must win instead
+        # of a silent out-of-bounds read.
+        return None
+    out_ids = np.empty((b, f), np.int32)
+    out_vals = np.empty((b, f), np.float32) if vals is not None else None
+    out_labels = np.empty((b,), np.float32)
+    lib.fm_gather_rows(
+        ids.ctypes.data,
+        (vals.ctypes.data if vals is not None else None),
+        labels.ctypes.data, sel.ctypes.data, b, f, int(bucket),
+        int(n_threads),
+        out_ids.ctypes.data,
+        (out_vals.ctypes.data if out_vals is not None else None),
+        out_labels.ctypes.data,
+    )
+    return out_ids, out_vals, out_labels

@@ -14,6 +14,7 @@
 // buffers; fm_dedup_aux is the one routine with internal scratch
 // allocation and worker threads (it is a per-batch, not per-row, call).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -346,6 +347,64 @@ int32_t fm_compact_aux(const int32_t* ids, int64_t B, int32_t F,
   for (int t = 0; t < n_threads; ++t)
     if (overflow[t] >= 0) return overflow[t];
   return -1;
+}
+
+// Fused batch assembly for the packed-format loader (data/packed.py
+// PackedDataset.assemble): one pass does the row gather, the FieldFM
+// field-local id conversion (out_id = id - f*bucket when bucket > 0),
+// the int8 -> f32 label cast, and (when the dir stores vals) the vals
+// gather. The numpy path does these as 3-4 separate full-batch passes
+// with temporaries; on the feed's critical path that is the measured
+// difference between stage 1 and stage 2 of bench_input.py. Row-range
+// threaded: batch rows are independent, and memmap page faults inside
+// the call run GIL-free (ctypes releases the GIL).
+// vals == nullptr means store_vals=false: out_vals is untouched (the
+// caller reuses a cached all-ones array instead of refilling 4*B*F
+// bytes every batch).
+void fm_gather_rows(const int32_t* ids, const float* vals,
+                    const int8_t* labels, const int64_t* sel, int64_t B,
+                    int32_t F, int32_t bucket, int n_threads,
+                    int32_t* out_ids, float* out_vals, float* out_labels) {
+  auto work = [&](int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; ++b) {
+      const int64_t row = sel[b];
+      const int32_t* src = ids + row * F;
+      int32_t* dst = out_ids + b * F;
+      if (bucket > 0) {
+        for (int32_t f = 0; f < F; ++f) dst[f] = src[f] - f * bucket;
+      } else {
+        std::memcpy(dst, src, sizeof(int32_t) * static_cast<size_t>(F));
+      }
+      if (vals != nullptr) {
+        std::memcpy(out_vals + b * F, vals + row * F,
+                    sizeof(float) * static_cast<size_t>(F));
+      }
+      out_labels[b] = static_cast<float>(labels[row]);
+    }
+  };
+  if (n_threads <= 0) {
+    // Auto: one thread per core, but below ~64k rows per thread the
+    // spawn/join overhead dominates, so small batches stay serial.
+    // An EXPLICIT n_threads is honored as given (tests exercise the
+    // threaded path at small B through it).
+    int hw = (int)std::thread::hardware_concurrency();
+    n_threads = hw > 0 ? hw : 1;
+    int64_t max_useful = B / 65536 + 1;
+    if (n_threads > max_useful) n_threads = static_cast<int>(max_useful);
+  }
+  if (n_threads > B) n_threads = B > 0 ? static_cast<int>(B) : 1;
+  if (n_threads <= 1) {
+    work(0, B);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  const int64_t per = (B + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int64_t b0 = t * per;
+    threads.emplace_back(work, b0, std::min(B, b0 + per));
+  }
+  for (auto& th : threads) th.join();
 }
 
 }  // extern "C"

@@ -431,3 +431,136 @@ def test_packed_end_to_end_training(tmp_path):
     batches = PackedBatches(ds, 128, seed=1)
     trainer.fit(batches)
     assert np.isfinite(trainer.loss_history[-1])
+
+
+# ------------------------------------------------- fused batch assembly
+
+
+@pytest.mark.parametrize("store_vals", [True, False])
+@pytest.mark.parametrize("bucket", [0, 5000])
+def test_assemble_matches_slice_plus_conversion(tmp_path, store_vals,
+                                                bucket):
+    """assemble() == slice() + field-local conversion, whichever of the
+    native / numpy paths is active (they are pinned against each other
+    in test_assemble_native_bitidentical_to_fallback)."""
+    _write_packed(tmp_path, store_vals=store_vals)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    rng = np.random.default_rng(3)
+    sel = rng.permutation(len(ds))[:257]
+    from fm_spark_tpu.data.packed import field_local
+
+    got_i, got_v, got_l = ds.assemble(sel, bucket=bucket)
+    ref_i, ref_v, ref_l = ds.slice(sel)
+    if bucket:
+        ref_i = field_local(ref_i, bucket)
+    np.testing.assert_array_equal(got_i, ref_i)
+    np.testing.assert_array_equal(got_v, ref_v)
+    np.testing.assert_array_equal(got_l, ref_l)
+    assert got_l.dtype == np.float32 and got_v.dtype == np.float32
+    # slice-object sel takes the same fused path
+    got_i2, _, _ = ds.assemble(np.s_[10:60], bucket=bucket)
+    ref_i2 = np.asarray(ds.ids[10:60])
+    if bucket:
+        ref_i2 = field_local(ref_i2, bucket)
+    np.testing.assert_array_equal(got_i2, ref_i2)
+
+
+@needs_native
+@pytest.mark.parametrize("store_vals", [True, False])
+def test_assemble_native_bitidentical_to_fallback(tmp_path, store_vals,
+                                                  monkeypatch):
+    _write_packed(tmp_path, store_vals=store_vals)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    sel = np.random.default_rng(4).permutation(len(ds))[:300]
+    nat = ds.assemble(sel, bucket=5000)
+    monkeypatch.setattr(native, "gather_rows_native",
+                        lambda *a, **k: None)
+    ds2 = PackedDataset(str(tmp_path / "ds"))
+    fall = ds2.assemble(sel, bucket=5000)
+    for g, f in zip(nat, fall):
+        np.testing.assert_array_equal(g, f)
+
+
+@needs_native
+def test_native_gather_thread_count_invariant(tmp_path):
+    _write_packed(tmp_path, n=700)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    sel = np.random.default_rng(5).permutation(700)[:256]
+    outs = [
+        native.gather_rows_native(ds.ids, ds.vals, ds.labels, sel,
+                                  bucket=5000, n_threads=t)
+        for t in (1, 3)
+    ]
+    for g, f in zip(outs[0], outs[1]):
+        np.testing.assert_array_equal(g, f)
+
+
+def test_packed_batches_bucket_fuses_the_wrapper_conversion(tmp_path):
+    """PackedBatches(bucket=B) yields exactly what the pre-round-5
+    StreamingBatches(.., bucket=B) wrapper produced — including the
+    weight-0 padded final batch — so pushing the conversion into the
+    gather changes no observable sequence."""
+    from fm_spark_tpu.cli import StreamingBatches
+
+    _write_packed(tmp_path, n=1000)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    bucket = 5000
+    fused = PackedBatches(ds, 128, seed=11, bucket=bucket)
+    wrapped = StreamingBatches(PackedBatches(ds, 128, seed=11),
+                               bucket=bucket)
+    for _ in range(2 * (1000 // 128 + 1)):  # crosses an epoch boundary
+        for got, ref in zip(next(fused), wrapped.next_batch()):
+            np.testing.assert_array_equal(got, ref)
+
+
+def test_packed_batches_restore_bucket_mismatch_raises(tmp_path):
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    state = PackedBatches(ds, 32, seed=1, bucket=100).state()
+    with pytest.raises(ValueError, match="bucket"):
+        PackedBatches(ds, 32, seed=1).restore(state)
+
+
+def test_assemble_ones_vals_cached_and_shared(tmp_path):
+    """store_vals=False dirs reuse ONE all-ones vals array across
+    batches (read-only by contract) instead of refilling 4*B*F bytes
+    per batch — a feed-path invariant bench_input.py relies on."""
+    _write_packed(tmp_path, store_vals=False)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    _, v1, _ = ds.assemble(np.arange(64), bucket=0)
+    _, v2, _ = ds.assemble(np.arange(64, 128), bucket=0)
+    assert v1 is v2
+    assert v1.shape == (64, 7) and np.all(v1 == 1.0)
+
+
+def test_assemble_negative_and_oob_sel_numpy_semantics(tmp_path):
+    """The native kernel does no bounds checks, so the binding routes
+    negative / out-of-range sel to the numpy path: -1 means last row
+    (fancy-indexing wraparound), past-the-end raises IndexError —
+    never a silent out-of-bounds read."""
+    ids, _, labels = _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    got_i, _, got_l = ds.assemble(np.array([-1, 0]))
+    np.testing.assert_array_equal(got_i[0], ids[-1])
+    assert got_l[0] == np.float32(labels[-1])
+    with pytest.raises(IndexError):
+        ds.assemble(np.array([len(ds)]))
+
+
+def test_prefetcher_wraps_packed_batches_directly(tmp_path):
+    """Prefetcher's documented contract includes bare PackedBatches
+    (pipeline.py docstring) — bench_input.py's +prefetcher stage relies
+    on it since the fused-bucket change dropped the StreamingBatches
+    wrapper."""
+    from fm_spark_tpu.data import Prefetcher
+
+    _write_packed(tmp_path)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    direct = PackedBatches(ds, 64, seed=2, bucket=100)
+    pre = Prefetcher(PackedBatches(ds, 64, seed=2, bucket=100), depth=2)
+    try:
+        for _ in range(5):
+            for got, ref in zip(pre.next_batch(), next(direct)):
+                np.testing.assert_array_equal(got, ref)
+    finally:
+        pre.close()
