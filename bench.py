@@ -97,12 +97,13 @@ def default_variants(model, batch):
 
     ``head`` goes BEFORE the fp32/scatter_add reference variant, ordered
     by salvage value (a flaky attachment dying mid-sweep keeps the
-    prefix): the MEASURED-BEST composed variant first (1,387,615 on
-    2026-07-31 — gfull + segtotal, PERF.md round-5 table), then its two
-    single-lever A/B legs, the round-3 winner closing the 2x2 grid, and
-    the secondary probes (devaux = the multi-chip-composable
-    denominator; colT = thrice-neutral, kept for drift detection).
-    ``tail`` goes after it (the dtype ladder).
+    prefix): the MEASURED-BEST composed variant first (1,398,617 on
+    2026-07-31 — tight-cap + gfull + segtotal, PERF.md round-5 table),
+    the historical-cap leg as the ongoing A/B, the two single-lever
+    legs, the round-3 winner closing the 2x2 grid, and the secondary
+    probes (devaux = the multi-chip-composable denominator; colT =
+    thrice-neutral, kept for drift detection). ``tail`` goes after it
+    (the dtype ladder).
 
     Module-level (not inlined in inner_main) so tests can pin the
     label<->TrainConfig consistency that the measurement's provenance
@@ -182,25 +183,26 @@ def default_variants(model, batch):
     base = dict(learning_rate=0.05, lr_schedule="constant",
                 optimizer="sgd", sparse_update="dedup_sr",
                 host_dedup=True, compact_cap=cap)
-    # Tight-cap A/B (staged for the next chip window): at the default
-    # batch, cap 13312 (= the bound above) cuts ~19% of cap lanes vs the
-    # historical 16384 — every cap-lane gather/expand/scatter pass
-    # shrinks proportionally. The bound is MEASURED only at 131072 and
-    # 262144; at other batches a too-tight cap makes the aux build raise
-    # CompactCapOverflow, which the sweep's per-variant guard turns into
-    # a logged skip (not a sweep abort). Staged second so a dying sweep
-    # prices it right after the winner; dropped when the scaled cap
-    # already equals the bound (no A/B to run).
+    # Tight-cap measured a WINNER (2026-07-31 on-chip A/B: 1,398,617 at
+    # cap 13312 vs 1,383,925 at 16384, +1.1% — the ~19% cap-lane
+    # shrinkage priced across the gather/expand/scatter/segtotal
+    # passes), so the tight composed variant now runs FIRST (salvage
+    # order = measured best first) with the historical cap as the
+    # ongoing A/B leg. The bound is MEASURED only at 131072 and 262144;
+    # at other batches a too-tight cap makes the aux build raise
+    # CompactCapOverflow, which the sweep's per-variant guard turns
+    # into a logged skip (not a sweep abort).
     tight = min(bound, cap)
-    ranked = [
-        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
-         dict(gfull_fused=True, segtotal_pallas=True), None),
-    ]
+    ranked = []
     if tight < cap:
         ranked.append(
             (f"bfloat16/dedup_sr/compact{tight}/cd-bf16/gfull/segtotal",
              dict(compact_cap=tight, gfull_fused=True,
                   segtotal_pallas=True), None))
+    ranked += [
+        (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
+         dict(gfull_fused=True, segtotal_pallas=True), None),
+    ]
     ranked += [
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
          dict(gfull_fused=True), None),

@@ -174,9 +174,12 @@ CONFIGS = {
             " (2-D feat×row mesh). The generic 'row' strategy materializes"
             " dense gradients (optax path) — correctness fallback, not the"
             " at-scale path. Measured-best single-chip flags (PERF.md"
-            " round-5 table, 1.388M samples/s/chip = 1.110x the Spark"
+            " round-5 table, 1.399M samples/s/chip = 1.119x the Spark"
             " baseline): --param-dtype bfloat16 --compute-dtype bfloat16"
-            " --sparse-update dedup_sr --host-dedup --compact-cap 16384"
+            " --sparse-update dedup_sr --host-dedup --compact-cap 13312"
+            " (cap must bound YOUR batch's max per-field unique count;"
+            " 13312 bounds the bench's Zipf batch at B=131072 — use"
+            " 16384 when in doubt)"
             " --gfull-fused --segtotal-pallas (the last two priced ~+8%"
             " each on-chip and compose; equivalence ULP-pinned in"
             " tests/test_gfull.py and tests/test_pallas_segsum.py)."

@@ -180,19 +180,23 @@ class PackedDataset:
         (read-only — see :meth:`_ones_vals`)."""
         from fm_spark_tpu import native
 
-        if isinstance(sel, slice):
-            start, stop, step = sel.indices(self.num_examples)
-            sel = np.arange(start, stop, step, dtype=np.int64)
-        else:
-            sel = np.asarray(sel, np.int64)
-        got = native.gather_rows_native(
-            self.ids, self.vals, self.labels, sel, bucket, n_threads
-        )
-        if got is not None:
-            ids, vals, labels = got
-            if vals is None:
-                vals = self._ones_vals(ids.shape)
-            return ids, vals, labels
+        if native.gather_available():
+            if isinstance(sel, slice):
+                start, stop, step = sel.indices(self.num_examples)
+                idx = np.arange(start, stop, step, dtype=np.int64)
+            else:
+                idx = np.asarray(sel, np.int64)
+            got = native.gather_rows_native(
+                self.ids, self.vals, self.labels, idx, bucket, n_threads
+            )
+            if got is not None:
+                ids, vals, labels = got
+                if vals is None:
+                    vals = self._ones_vals(ids.shape)
+                return ids, vals, labels
+        # numpy fallback keeps the ORIGINAL sel: a slice stays a basic
+        # (contiguous, no-gather) memmap read instead of being widened
+        # to fancy indexing (eval/predict stream contiguous ranges).
         ids = np.asarray(self.ids[sel])
         if bucket:
             ids = field_local(ids, bucket)

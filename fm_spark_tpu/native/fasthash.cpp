@@ -365,21 +365,31 @@ void fm_gather_rows(const int32_t* ids, const float* vals,
                     const int8_t* labels, const int64_t* sel, int64_t B,
                     int32_t F, int32_t bucket, int n_threads,
                     int32_t* out_ids, float* out_vals, float* out_labels) {
+  // Conversion as a SECOND flat pass over the gathered output, not
+  // fused into the per-row gather: a per-row subtract loop (F=39, odd
+  // length, aliasing-uncertain pointers) measured ~2.5x SLOWER than
+  // memcpy — the vectorizer punts on it — while a single restrict-
+  // qualified in-place sweep over the contiguous [B, F] output
+  // vectorizes cleanly and touches cache-hot data.
+  std::vector<int32_t> offs(static_cast<size_t>(F));
+  for (int32_t f = 0; f < F; ++f) offs[f] = bucket > 0 ? f * bucket : 0;
   auto work = [&](int64_t b0, int64_t b1) {
     for (int64_t b = b0; b < b1; ++b) {
       const int64_t row = sel[b];
-      const int32_t* src = ids + row * F;
-      int32_t* dst = out_ids + b * F;
-      if (bucket > 0) {
-        for (int32_t f = 0; f < F; ++f) dst[f] = src[f] - f * bucket;
-      } else {
-        std::memcpy(dst, src, sizeof(int32_t) * static_cast<size_t>(F));
-      }
+      std::memcpy(out_ids + b * F, ids + row * F,
+                  sizeof(int32_t) * static_cast<size_t>(F));
       if (vals != nullptr) {
         std::memcpy(out_vals + b * F, vals + row * F,
                     sizeof(float) * static_cast<size_t>(F));
       }
       out_labels[b] = static_cast<float>(labels[row]);
+    }
+    if (bucket > 0) {
+      const int32_t* __restrict off = offs.data();
+      int32_t* __restrict dst = out_ids + b0 * F;
+      const int64_t nrow = b1 - b0;
+      for (int64_t b = 0; b < nrow; ++b, dst += F)
+        for (int32_t f = 0; f < F; ++f) dst[f] -= off[f];
     }
   };
   if (n_threads <= 0) {

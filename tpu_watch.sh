@@ -121,9 +121,16 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         touch "$OUT/b262_done"
       fi
     fi
-    # Attachment was up: re-probe sooner than the down cadence in case
-    # the window is long enough for another (possibly healthier) sweep.
-    sleep 120
+    # Attachment was up: once the one-time queue (ffm/deepfm/kaggle/
+    # b262 markers) has fully drained, further passes are keep-best
+    # re-sweeps only — back off so the watcher stops contending with
+    # the builder's CPU work on this single-core VM; while the queue
+    # is still draining, re-probe quickly.
+    if [ -e "$OUT/b262_done" ]; then
+      sleep 1500
+    else
+      sleep 120
+    fi
   else
     echo "tpu_watch: still down $(date -u +%H:%M:%S)" >> "$OUT/log"
     sleep 45
