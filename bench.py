@@ -97,7 +97,7 @@ def default_variants(model, batch):
 
     ``head`` goes BEFORE the fp32/scatter_add reference variant, ordered
     by salvage value (a flaky attachment dying mid-sweep keeps the
-    prefix): the MEASURED-BEST composed variant first (1,398,617 on
+    prefix): the MEASURED-BEST composed variant first (1,406,184 on
     2026-07-31 — tight-cap + gfull + segtotal, PERF.md round-5 table),
     the historical-cap leg as the ongoing A/B, the two single-lever
     legs, the round-3 winner closing the 2x2 grid, and the secondary
@@ -198,6 +198,17 @@ def default_variants(model, batch):
         ranked.append(
             (f"bfloat16/dedup_sr/compact{tight}/cd-bf16/gfull/segtotal",
              dict(compact_cap=tight, gfull_fused=True,
+                  segtotal_pallas=True), None))
+    if batch == 1 << 17:
+        # Tightest-cap probe: the bench batch's MEASURED max per-field
+        # unique is 11,990 (Zipf 1.3, seed 0), so 12288 (= next 512
+        # tile) is the floor of the cap lever at this exact batch —
+        # another ~8% fewer cap lanes than the batch/10 bound. Only
+        # staged at the measured batch; anywhere else the guard would
+        # just skip it on CompactCapOverflow without pricing anything.
+        ranked.append(
+            ("bfloat16/dedup_sr/compact12288/cd-bf16/gfull/segtotal",
+             dict(compact_cap=12288, gfull_fused=True,
                   segtotal_pallas=True), None))
     ranked += [
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",

@@ -174,7 +174,7 @@ CONFIGS = {
             " (2-D feat×row mesh). The generic 'row' strategy materializes"
             " dense gradients (optax path) — correctness fallback, not the"
             " at-scale path. Measured-best single-chip flags (PERF.md"
-            " round-5 table, 1.399M samples/s/chip = 1.119x the Spark"
+            " round-5 table, 1.406M samples/s/chip = 1.125x the Spark"
             " baseline): --param-dtype bfloat16 --compute-dtype bfloat16"
             " --sparse-update dedup_sr --host-dedup --compact-cap 13312"
             " (cap must bound YOUR batch's max per-field unique count;"

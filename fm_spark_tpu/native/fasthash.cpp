@@ -384,7 +384,8 @@ void fm_gather_rows(const int32_t* ids, const float* vals,
       }
       out_labels[b] = static_cast<float>(labels[row]);
     }
-    if (bucket > 0) {
+    if (bucket > 0 && b1 > b0) {  // b1 > b0: an empty trailing thread
+      // range must not even form the out-of-range dst pointer (UB).
       const int32_t* __restrict off = offs.data();
       int32_t* __restrict dst = out_ids + b0 * F;
       const int64_t nrow = b1 - b0;
