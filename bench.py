@@ -97,9 +97,9 @@ def default_variants(model, batch):
 
     ``head`` goes BEFORE the fp32/scatter_add reference variant, ordered
     by salvage value (a flaky attachment dying mid-sweep keeps the
-    prefix): the MEASURED-BEST composed variant first (1,406,184 on
-    2026-07-31 — tight-cap + gfull + segtotal, PERF.md round-5 table),
-    the historical-cap leg as the ongoing A/B, the two single-lever
+    prefix): the MEASURED-BEST composed variant first (1,422,411 on
+    2026-07-31 — floor-cap + gfull + segtotal, PERF.md round-5 table),
+    the cap-ladder legs as the ongoing A/B, the two single-lever
     legs, the round-3 winner closing the 2x2 grid, and the secondary
     probes (devaux = the multi-chip-composable denominator; colT =
     thrice-neutral, kept for drift detection). ``tail`` goes after it
@@ -194,21 +194,23 @@ def default_variants(model, batch):
     # into a logged skip (not a sweep abort).
     tight = min(bound, cap)
     ranked = []
+    if batch == 1 << 17:
+        # MEASURED WINNER (1,422,411 = 1.138x, 2026-07-31): cap 12288 =
+        # the bench batch's measured max per-field unique (11,990 at
+        # Zipf 1.3, seed 0) rounded to segtotal's 512 tile — the FLOOR
+        # of the cap lever. The one-window cap ladder priced ~+1.1% per
+        # step: 16384 -> 1.387M, 13312 -> 1.407M, 12288 -> 1.422M.
+        # Only staged at the measured batch; anywhere else the
+        # unique-count bound is unknown and the overflow guard would
+        # just skip it without pricing anything.
+        ranked.append(
+            ("bfloat16/dedup_sr/compact12288/cd-bf16/gfull/segtotal",
+             dict(compact_cap=12288, gfull_fused=True,
+                  segtotal_pallas=True), None))
     if tight < cap:
         ranked.append(
             (f"bfloat16/dedup_sr/compact{tight}/cd-bf16/gfull/segtotal",
              dict(compact_cap=tight, gfull_fused=True,
-                  segtotal_pallas=True), None))
-    if batch == 1 << 17:
-        # Tightest-cap probe: the bench batch's MEASURED max per-field
-        # unique is 11,990 (Zipf 1.3, seed 0), so 12288 (= next 512
-        # tile) is the floor of the cap lever at this exact batch —
-        # another ~8% fewer cap lanes than the batch/10 bound. Only
-        # staged at the measured batch; anywhere else the guard would
-        # just skip it on CompactCapOverflow without pricing anything.
-        ranked.append(
-            ("bfloat16/dedup_sr/compact12288/cd-bf16/gfull/segtotal",
-             dict(compact_cap=12288, gfull_fused=True,
                   segtotal_pallas=True), None))
     ranked += [
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",

@@ -136,7 +136,7 @@ _LEVERS = (
            "ds·x·(s1 − m·xv_full) instead of concat([g_v, g_l]) — "
            "removes one materialized copy pass per field (measured "
            "~+8%% on-chip and composes with --segtotal-pallas to the "
-           "1.406M headline, PERF.md round-5 table; ULP-pinned in "
+           "1.422M headline, PERF.md round-5 table; ULP-pinned in "
            "tests/test_gfull.py). FieldFM/DeepFM fused bodies; other "
            "step factories reject it"),
     _Lever("--segtotal-pallas", "segtotal_pallas", "flag",

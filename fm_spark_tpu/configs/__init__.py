@@ -174,11 +174,11 @@ CONFIGS = {
             " (2-D feat×row mesh). The generic 'row' strategy materializes"
             " dense gradients (optax path) — correctness fallback, not the"
             " at-scale path. Measured-best single-chip flags (PERF.md"
-            " round-5 table, 1.406M samples/s/chip = 1.125x the Spark"
+            " round-5 table, 1.422M samples/s/chip = 1.138x the Spark"
             " baseline): --param-dtype bfloat16 --compute-dtype bfloat16"
-            " --sparse-update dedup_sr --host-dedup --compact-cap 13312"
+            " --sparse-update dedup_sr --host-dedup --compact-cap 12288"
             " (cap must bound YOUR batch's max per-field unique count;"
-            " 13312 bounds the bench's Zipf batch at B=131072 — use"
+            " 12288 bounds the bench's Zipf batch at B=131072 — use"
             " 16384 when in doubt)"
             " --gfull-fused --segtotal-pallas (the last two priced ~+8%"
             " each on-chip and compose; equivalence ULP-pinned in"

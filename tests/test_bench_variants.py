@@ -40,15 +40,15 @@ def test_fm_label_config_consistency():
 def test_fm_salvage_order_composed_first():
     head, _ = bench.default_variants("fm", 1 << 17)
     cfgs = [c for _, _, c in head]
-    # [0] measured winner (tight cap, 1,406,184 on 2026-07-31); [1] the
-    # tightest-cap 12288 probe (the open pricing question — right after
-    # the winner so a dying sweep still answers it); [2] the
-    # historical-cap drift leg; [3][4] single-lever legs; [5] the r3
-    # winner closing the grid.
+    # [0] measured winner (floor cap 12288, 1,422,411 on 2026-07-31);
+    # [1] the batch/10-bound cap leg (the formula-derived fallback —
+    # right after the winner so a dying sweep still prices the ladder);
+    # [2] the historical-cap drift leg; [3][4] single-lever legs; [5]
+    # the r3 winner closing the grid.
     assert cfgs[0].gfull_fused and cfgs[0].segtotal_pallas
-    assert cfgs[0].compact_cap == 13312
+    assert cfgs[0].compact_cap == 12288
     assert cfgs[1].gfull_fused and cfgs[1].segtotal_pallas
-    assert cfgs[1].compact_cap == 12288
+    assert cfgs[1].compact_cap == 13312
     assert cfgs[2].gfull_fused and cfgs[2].segtotal_pallas
     assert cfgs[2].compact_cap == 16384
     assert cfgs[3].gfull_fused and not cfgs[3].segtotal_pallas

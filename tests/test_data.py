@@ -564,3 +564,19 @@ def test_prefetcher_wraps_packed_batches_directly(tmp_path):
                 np.testing.assert_array_equal(got, ref)
     finally:
         pre.close()
+
+
+@needs_native
+def test_native_gather_more_threads_than_rows(tmp_path):
+    """Explicit n_threads > B leaves trailing workers with empty row
+    ranges — they must not touch (or even form pointers into) the
+    output. Pinned after an out-of-bounds pointer-arithmetic fix."""
+    _write_packed(tmp_path, n=40)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    sel = np.arange(5, dtype=np.int64)
+    got = native.gather_rows_native(ds.ids, ds.vals, ds.labels, sel,
+                                    bucket=5000, n_threads=4)
+    ref = native.gather_rows_native(ds.ids, ds.vals, ds.labels, sel,
+                                    bucket=5000, n_threads=1)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(g, r)
