@@ -193,19 +193,22 @@ def default_variants(model, batch):
     # CompactCapOverflow, which the sweep's per-variant guard turns
     # into a logged skip (not a sweep abort).
     tight = min(bound, cap)
+    # MEASURED WINNER (1,422,411 = 1.138x, 2026-07-31): cap 12288 = the
+    # bench batch's measured max per-field unique (11,990 at Zipf 1.3,
+    # seed 0) rounded to segtotal's 512 tile — the FLOOR of the cap
+    # lever. The one-window cap ladder: 16384 -> 1.387M (+1.5%) ->
+    # 13312 -> 1.407M (+1.1%) -> 12288 -> 1.422M. The floor is only
+    # KNOWN at the measured batch; anywhere else floor_cap falls back
+    # to the formula cap (the overflow guard would otherwise just skip
+    # the variant without pricing anything). One definition so the
+    # probe and devaux legs can never measure different caps.
+    floor_cap = 12288 if batch == 1 << 17 else cap
     ranked = []
-    if batch == 1 << 17:
-        # MEASURED WINNER (1,422,411 = 1.138x, 2026-07-31): cap 12288 =
-        # the bench batch's measured max per-field unique (11,990 at
-        # Zipf 1.3, seed 0) rounded to segtotal's 512 tile — the FLOOR
-        # of the cap lever. The one-window cap ladder priced ~+1.1% per
-        # step: 16384 -> 1.387M, 13312 -> 1.407M, 12288 -> 1.422M.
-        # Only staged at the measured batch; anywhere else the
-        # unique-count bound is unknown and the overflow guard would
-        # just skip it without pricing anything.
+    if floor_cap < tight:
         ranked.append(
-            ("bfloat16/dedup_sr/compact12288/cd-bf16/gfull/segtotal",
-             dict(compact_cap=12288, gfull_fused=True,
+            (f"bfloat16/dedup_sr/compact{floor_cap}/cd-bf16/gfull"
+             "/segtotal",
+             dict(compact_cap=floor_cap, gfull_fused=True,
                   segtotal_pallas=True), None))
     if tight < cap:
         ranked.append(
@@ -222,8 +225,17 @@ def default_variants(model, batch):
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/segtotal",
          dict(segtotal_pallas=True), None),
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16", {}, None),
-        (f"bfloat16/dedup_sr/compact{cap}/devaux/cd-bf16",
-         dict(host_dedup=False, compact_device=True), None),
+        # devaux = the multi-chip-composable denominator (in-step aux
+        # build; the only compact form that composes with scale-out —
+        # PERF.md round 3). Measured at the floor cap WITH the composed
+        # kernels so the multi-chip projection's discount is priced
+        # against the same lever stack as the headline, not the bare
+        # cd-bf16 base.
+        (f"bfloat16/dedup_sr/compact{floor_cap}/devaux/cd-bf16"
+         "/gfull/segtotal",
+         dict(host_dedup=False, compact_device=True,
+              compact_cap=floor_cap,
+              gfull_fused=True, segtotal_pallas=True), None),
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/colT", {}, "col"),
     ]
     head = [

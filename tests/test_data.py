@@ -580,3 +580,25 @@ def test_native_gather_more_threads_than_rows(tmp_path):
                                     bucket=5000, n_threads=1)
     for g, r in zip(got, ref):
         np.testing.assert_array_equal(g, r)
+
+
+@needs_native
+@pytest.mark.parametrize("f", [1, 3, 8, 64])
+def test_native_gather_field_width_sweep(tmp_path, f):
+    """Bit-identity across field widths: the C second-pass conversion
+    has vectorized/remainder paths whose boundaries move with F (1 =
+    pure remainder, 8 = exact vector, 64 = many vectors)."""
+    rng = np.random.default_rng(f)
+    n, bucket = 300, 1000
+    ids = (rng.integers(0, bucket, (n, f))
+           + np.arange(f) * bucket).astype(np.int32)
+    labels = rng.integers(0, 2, n).astype(np.int8)
+    with PackedWriter(str(tmp_path / "ds"), f, store_vals=False) as w:
+        w.append(ids, labels)
+    ds = PackedDataset(str(tmp_path / "ds"))
+    sel = rng.permutation(n)[:128]
+    got_i, got_v, got_l = ds.assemble(sel, bucket=bucket)
+    ref_i = ids[sel] - (np.arange(f, dtype=np.int32) * bucket)[None, :]
+    np.testing.assert_array_equal(got_i, ref_i)
+    assert np.all(got_v == 1.0) and got_v.shape == (128, f)
+    np.testing.assert_array_equal(got_l, labels[sel].astype(np.float32))
