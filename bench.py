@@ -199,9 +199,11 @@ def default_variants(model, batch):
     # lever. The one-window cap ladder: 16384 -> 1.387M (+1.5%) ->
     # 13312 -> 1.407M (+1.1%) -> 12288 -> 1.422M. The floor is only
     # KNOWN at the measured batch; anywhere else floor_cap falls back
-    # to the formula cap (the overflow guard would otherwise just skip
-    # the variant without pricing anything). One definition so the
-    # probe and devaux legs can never measure different caps.
+    # to the formula cap (otherwise an overflowing cap would just
+    # waste the slot: the host-aux probe raises CompactCapOverflow at
+    # build, and a compact-device leg poisons its loss to -inf — both
+    # now skipped, never priced). One definition so the probe and
+    # devaux legs can never measure different caps.
     floor_cap = 12288 if batch == 1 << 17 else cap
     ranked = []
     if floor_cap < tight:
@@ -540,6 +542,17 @@ def inner_main(args):
             del params, carry
             continue
         dt = time.perf_counter() - t0
+        if not np.isfinite(final_loss):
+            # compact_device signals cap overflow by POISONING the loss
+            # (-inf; sparse.py _fold_overflow) instead of raising like
+            # the host aux build — a poisoned run's rate is a
+            # measurement of a corrupted program and must not enter
+            # results (it could win max() and reach MEASURED.json).
+            _log(f"[inner] [{label}] non-finite final loss "
+                 f"({final_loss}) — overflow/divergence poison; "
+                 "skipping variant")
+            del params, carry
+            continue
         rate = steps_timed * batch / dt / jax.device_count()
         results.append((rate, label, dt, final_loss))
         _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "

@@ -111,13 +111,14 @@ def main():
         synthesize_packed(args.data_dir, args.rows, num_fields, bucket)
         _log(f"synthesized in {time.perf_counter() - t0:.1f}s")
 
+    # Full cpu guard (not just the config pin): with the attachment
+    # dead, the plugin factory hangs jax.devices() even under
+    # JAX_PLATFORMS=cpu — utils/cpuguard drops the factory first.
+    from fm_spark_tpu.utils.cpuguard import force_cpu_platform
+
+    force_cpu_platform()
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
     dev = jax.devices()[0]
     _log(f"device: {dev.device_kind}")
 

@@ -25,6 +25,19 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
+    from fm_spark_tpu.utils.cpuguard import force_cpu_platform
+
+    if force_cpu_platform():
+        # The guard honored an explicit JAX_PLATFORMS=cpu — but this
+        # bench is TPU-only (module docstring): the Pallas kernels
+        # need Mosaic lane alignment and CPU numbers are meaningless.
+        # Exit actionably instead of hanging on a dead attachment
+        # (pre-guard behavior) or dying in a raw Pallas ValueError.
+        raise SystemExit(
+            "bench_kernels needs the real TPU (CPU numbers are "
+            "meaningless for the XLA-vs-Pallas decision); unset "
+            "JAX_PLATFORMS=cpu"
+        )
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -932,6 +932,11 @@ BENCHES = {
 
 
 def main():
+    # Honor an explicit JAX_PLATFORMS=cpu smoke request even when the
+    # attachment is dead (the plugin factory would hang init otherwise).
+    from fm_spark_tpu.utils.cpuguard import force_cpu_platform
+
+    force_cpu_platform()
     ap = argparse.ArgumentParser()
     ap.add_argument("bench", choices=[*BENCHES, "all"])
     ap.add_argument("--calls", type=int, default=30)
