@@ -151,6 +151,16 @@ def default_variants(model, batch):
         return [
             ("float32/scatter_add/cd-bf16", ("float32", "bfloat16", None),
              TrainConfig(**ffm_base, sparse_update="scatter_add")),
+            # Round-5 staged A/B (unpriced — needs a chip window): the
+            # sel-blocked body never materializes the [B, F, F, k]
+            # sel/dsel/dv tensors, the step's dominant HBM traffic
+            # (the cd-bf16 lever, which halves exactly those bytes,
+            # measured +23% — so the expected effect is of that order
+            # if the step is still sel-bandwidth-bound).
+            ("float32/scatter_add/cd-bf16/selblk",
+             ("float32", "bfloat16", None),
+             TrainConfig(**ffm_base, sparse_update="scatter_add",
+                         sel_blocked=True)),
         ], [
             ("bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
              TrainConfig(**ffm_base, sparse_update="dedup_sr")),

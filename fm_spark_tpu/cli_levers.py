@@ -86,6 +86,18 @@ def _v_deep_sharded(tc, ctx):
         )
 
 
+def _v_sel_blocked(tc, ctx):
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+
+    if tc.sel_blocked and (ctx["sharded"]
+                           or type(ctx["spec"]) is not FieldFFMSpec):
+        return (
+            f"--sel-blocked is the single-chip FieldFFM body's lever "
+            f"(it blocks the [B, F, F, k] sel tensor; found "
+            f"{ctx['n']} device(s), {type(ctx['spec']).__name__})"
+        )
+
+
 _LEVERS = (
     _Lever("--host-dedup", "host_dedup", "flag",
            "precompute per-batch dedup sort/segment maps on the host "
@@ -139,6 +151,14 @@ _LEVERS = (
            "1.422M headline, PERF.md round-5 table; ULP-pinned in "
            "tests/test_gfull.py). FieldFM/DeepFM fused bodies; other "
            "step factories reject it"),
+    _Lever("--sel-blocked", "sel_blocked", "flag",
+           "FFM: compute the field-aware interaction and its backward "
+           "in per-owner-field blocks — the [B, F, F, k] sel/dsel/dv "
+           "tensors (config 4's dominant HBM traffic, PERF.md) are "
+           "never materialized; largest live buffer drops to [B, F, "
+           "k]. Single-chip FieldFFM body; staged for on-chip pricing "
+           "in the bench --model ffm sweep",
+           validate=_v_sel_blocked),
     _Lever("--segtotal-pallas", "segtotal_pallas", "flag",
            "compute the compact update's segment sums with the Pallas "
            "sorted-run kernel (streaming read, VMEM-resident [cap, w] "

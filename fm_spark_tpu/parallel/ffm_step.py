@@ -205,6 +205,11 @@ def _make_ffm_local_step(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import _reject_gfull
 
     _reject_gfull(config, "the field-sharded FFM step")
+    from fm_spark_tpu.sparse import _reject_sel_blocked
+
+    _reject_sel_blocked(config, "the field-sharded FFM step (single-chip "
+                        "body lever; the sharded sel exchange has its own "
+                        "blocking)")
     from fm_spark_tpu.sparse import (
         _reject_deep_sharded,
         _reject_score_sharded,

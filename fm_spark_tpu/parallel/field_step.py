@@ -445,10 +445,12 @@ def _make_field_local_step(spec, config: TrainConfig, mesh):
         _lr_at,
         _reject_deep_sharded,
         _reject_host_aux,
+        _reject_sel_blocked,
         _sr_base_key,
     )
 
     _reject_deep_sharded(config, "the field-sharded FM step")
+    _reject_sel_blocked(config, "the field-sharded FM step")
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded step runs on a ('feat',) or ('feat', 'row') "

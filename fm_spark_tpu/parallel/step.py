@@ -215,6 +215,9 @@ def make_parallel_train_step(
 
     _reject_host_aux(config, "the dense optax parallel step")
     _reject_score_sharded(config, "the dense optax parallel step")
+    from fm_spark_tpu.sparse import _reject_sel_blocked
+
+    _reject_sel_blocked(config, "the dense optax parallel step")
     _reject_deep_sharded(config, "the dense optax parallel step")
     # Grad psums here feed the optimizer DIRECTLY (no later fp32
     # re-derivation), a different precision contract from the fused

@@ -379,6 +379,17 @@ def _reject_gfull(config: TrainConfig, what: str):
         )
 
 
+def _reject_sel_blocked(config: TrainConfig, what: str):
+    """Guard for step factories that have no ``sel`` tensor to block
+    (everything but the FFM bodies): hard-fail instead of silently
+    ignoring the flag (no-silent-fallback rule)."""
+    if config.sel_blocked:
+        raise ValueError(
+            f"sel_blocked is the FieldFFM fused body's lever (it blocks "
+            f"the [B, F, F, k] interaction tensor), not {what}"
+        )
+
+
 def _reject_host_aux(config: TrainConfig, what: str):
     """Guard for step factories that take no aux operand (the sharded
     steps): hard-fail an explicit fast-path request rather than
@@ -479,6 +490,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
                          "construction; it requires fused_linear=True")
     _reject_collective_dtype(config, "the single-chip FieldFM body")
     _reject_score_sharded(config, "the single-chip FieldFM body")
+    _reject_sel_blocked(config, "the single-chip FieldFM body")
     _reject_deep_sharded(config, "the single-chip FieldFM body")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
@@ -707,10 +719,33 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             compact, params["vw"], aux, cd, gat, ids,
             device_cap=config.compact_cap if config.compact_device else 0,
         )                                               # F × [B, F·k+1]
-        sel = spec._sel(rows, vals_c)                   # [B, F, F, k]
-        a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)
-        diag = jnp.trace(a, axis1=1, axis2=2)
-        scores = 0.5 * (jnp.sum(a, axis=(1, 2)) - diag)
+        if config.sel_blocked:
+            # Per-owner-field blocks: sel[b, i, j] = Rv[i][b, j] * x_i
+            # and its transpose-slice selT_i[b, j] = Rv[j][b, i] * x_j
+            # are built on the fly from the (already needed) gathered
+            # rows — the [B, F, F, k] sel tensor never exists, and the
+            # largest live array is one [B, F, k] pair. Unrolled over
+            # the static F (≤ ~40): each iteration is a handful of
+            # fused slice/multiply/reduce ops.
+            Rv = [r[:, : F * k].reshape(-1, F, k) for r in rows]
+
+            def _selT(i):
+                return jnp.stack(
+                    [Rv[j][:, i, :] for j in range(F)], axis=1
+                ) * vals_c[:, :, None]                  # [B, F, k]
+
+            acc = jnp.zeros_like(vals_c[:, 0])
+            for i in range(F):
+                sel_i = Rv[i] * vals_c[:, i, None, None]  # [B, F, k]
+                selT_i = _selT(i)
+                prod = jnp.sum(sel_i * selT_i, axis=-1)   # [B, F]
+                acc = acc + jnp.sum(prod, axis=1) - prod[:, i]
+            scores = 0.5 * acc
+        else:
+            sel = spec._sel(rows, vals_c)               # [B, F, F, k]
+            a = jnp.sum(sel * jnp.swapaxes(sel, 1, 2), axis=-1)
+            diag = jnp.trace(a, axis1=1, axis2=2)
+            scores = 0.5 * (jnp.sum(a, axis=(1, 2)) - diag)
         if spec.use_linear:
             lins = [r[:, F * k] for r in rows]
             scores = scores + sum(
@@ -728,16 +763,30 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         lr = lr_at(step_idx)
         touched = weights > 0
 
-        # d/dsel = ds · selᵀ with a zeroed diagonal.
-        dsel = dscores[:, None, None, None] * jnp.swapaxes(sel, 1, 2)
-        eye = jnp.eye(F, dtype=cd)[None, :, :, None]
-        dsel = dsel * (1.0 - eye)
-        # dv[id_i, :, :] = dsel[b, i, :, :] · x_i  → flat [B, F·k] per field.
-        dv = (dsel * vals_c[:, :, None, None]).reshape(-1, F, F * k)
+        if config.sel_blocked:
+            # d/dsel[b, i, j] = ds_b · sel[b, j, i] (zero diagonal), so
+            # per owner i the whole [B, F·k] factor gradient is one
+            # recomputed selT_i slice — dsel/dv are never materialized.
+            ds_cd = dscores.astype(cd)
+            dvs = []
+            for i in range(F):
+                dsel_i = ds_cd[:, None, None] * _selT(i)
+                dsel_i = dsel_i.at[:, i, :].set(0)
+                dvs.append(
+                    (dsel_i * vals_c[:, i, None, None]).reshape(-1, F * k)
+                )
+        else:
+            # d/dsel = ds · selᵀ with a zeroed diagonal.
+            dsel = dscores[:, None, None, None] * jnp.swapaxes(sel, 1, 2)
+            eye = jnp.eye(F, dtype=cd)[None, :, :, None]
+            dsel = dsel * (1.0 - eye)
+            # dv[id_i, :, :] = dsel[b, i, :, :] · x_i → flat [B, F·k]
+            # per field.
+            dv = (dsel * vals_c[:, :, None, None]).reshape(-1, F, F * k)
 
         g_fulls = []
         for f in range(F):
-            g_v = dv[:, f, :]
+            g_v = dvs[f] if config.sel_blocked else dv[:, f, :]
             if config.reg_factors:
                 g_v = g_v + config.reg_factors * rows[f][:, : F * k] * touched[:, None]
             if spec.use_linear:
@@ -791,6 +840,7 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
         raise ValueError("expected a FieldDeepFMSpec")
     _reject_collective_dtype(config, "the single-chip FieldDeepFM body")
     _reject_score_sharded(config, "the single-chip FieldDeepFM body")
+    _reject_sel_blocked(config, "the single-chip FieldDeepFM body")
     _reject_deep_sharded(config, "the single-chip FieldDeepFM body")
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
@@ -990,6 +1040,7 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
                   "g_full concat to eliminate)")
     _reject_collective_dtype(config, "the single-chip flat-table FM step")
     _reject_score_sharded(config, "the single-chip flat-table FM step")
+    _reject_sel_blocked(config, "the single-chip flat-table FM step")
     _reject_deep_sharded(config, "the single-chip flat-table FM step")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype

@@ -157,6 +157,16 @@ class TrainConfig:
     # off by default until the on-chip A/B (bench.py sweep) prices it.
     # Requires compact_cap > 0 (it has nothing to compute otherwise).
     segtotal_pallas: bool = False
+    # FFM only: compute the field-aware interaction and its backward in
+    # per-owner-field blocks instead of materializing the [B, F, F, k]
+    # ``sel``/``dsel``/``dv`` tensors (the config-4 step's dominant HBM
+    # traffic — PERF.md: bf16 compute buffers alone, which halve
+    # exactly these, measured +23%). Same math, so values agree with
+    # the default body up to fp reassociation of the pair sums; the
+    # largest live tensor drops from [B, F, F, k] to [B, F, k]. Off by
+    # default until the on-chip A/B (bench.py --model ffm sweep)
+    # prices it.
+    sel_blocked: bool = False
 
 
 def _group_reg(config: TrainConfig):
@@ -226,10 +236,13 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
         _reject_score_sharded,
     )
 
+    from fm_spark_tpu.sparse import _reject_sel_blocked
+
     _reject_host_aux(config, "the dense optax train step")
     _reject_collective_dtype(config, "the dense single-device train step")
     _reject_score_sharded(config, "the dense single-device train step")
     _reject_deep_sharded(config, "the dense single-device train step")
+    _reject_sel_blocked(config, "the dense single-device train step")
     optimizer = optimizer or make_optimizer(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     add_reg = _group_reg(config)
