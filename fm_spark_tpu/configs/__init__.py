@@ -203,7 +203,10 @@ CONFIGS = {
             " (816,553 samples/s/chip, 2026-07-31): add --compute-dtype"
             " bfloat16 and keep fp32 params + scatter_add — the bf16"
             " compute buffers halve the [B, F, F, k] sel traffic; dedup/"
-            "compact LOSE at this table size (PERF.md).",
+            "compact LOSE at this table size (PERF.md). Staged, unpriced:"
+            " --sel-blocked never materializes the sel tensors at all"
+            " (the bench --model ffm sweep prices it on the next healthy"
+            " chip window; equivalence-pinned either way).",
             model="field_ffm", dataset="avazu", rank=16, num_fields=23,
             bucket=1 << 14, strategy="field_sparse", num_steps=100_000,
             batch_size=8192, learning_rate=0.05, lr_schedule="constant",
