@@ -1306,6 +1306,55 @@ def cmd_preprocess(args) -> int:
     return 0
 
 
+def cmd_cap_advise(args) -> int:
+    """Recommend a ``--compact-cap`` for a packed dir at a batch size.
+
+    The compact lever's capacity must bound EVERY field's per-batch
+    unique-id count (overflow is a crash/poison/degradation per
+    ``--compact-overflow``), and a tight cap is measurably faster —
+    the round-5 on-chip cap ladder priced ~+1-1.5% per step down
+    16384 → 13312 → 12288 at the bench batch (PERF.md). This scans
+    real batches the way training would draw them (same chunk-shuffled
+    order) and reports the observed per-field max, so operators pick
+    caps from measurement instead of folklore."""
+    import numpy as np
+
+    from fm_spark_tpu.data import PackedBatches, PackedDataset
+
+    ds = PackedDataset(args.data)
+    batches = PackedBatches(ds, args.batch_size, seed=args.seed)
+    overall = 0
+    per_field_max = np.zeros((ds.num_fields,), np.int64)
+    maxima = []
+    for _ in range(args.batches):
+        ids, _, _, _ = next(batches)
+        counts = np.array([
+            np.unique(ids[:, f]).size for f in range(ids.shape[1])
+        ])
+        per_field_max = np.maximum(per_field_max, counts)
+        maxima.append(int(counts.max()))
+        overall = max(overall, maxima[-1])
+    # segtotal's tile (ops/pallas_segsum._TILE) and the aux layouts
+    # want a 512 multiple; headroom covers batches not scanned.
+    pad = max(64, int(overall * args.headroom))
+    recommended = ((overall + pad) + 511) // 512 * 512
+    print(json.dumps({
+        "data": args.data,
+        "batch_size": args.batch_size,
+        "batches_scanned": args.batches,
+        "max_unique_per_field_overall": overall,
+        "per_batch_max": maxima,
+        "per_field_max": per_field_max.tolist(),
+        "recommended_compact_cap": int(min(recommended, args.batch_size)),
+        "note": "cap must bound EVERY future batch; rounded to the "
+                "segtotal 512 tile with "
+                f"{int(args.headroom * 100)}% headroom over the "
+                "scanned max — rescan after changing batch size, "
+                "hashing, or data distribution",
+    }))
+    return 0
+
+
 def cmd_list_configs(args) -> int:
     from fm_spark_tpu import configs as configs_lib
 
@@ -1440,6 +1489,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="keep raw source order (tail holdouts become "
                          "temporal splits — see train --test-fraction)")
     pp.set_defaults(fn=cmd_preprocess, shuffle=True)
+
+    ca = sub.add_parser(
+        "cap-advise",
+        help="scan a packed dir and recommend a --compact-cap "
+             "(bounds the per-field per-batch unique-id count)",
+    )
+    ca.add_argument("--data", required=True, help="packed dir")
+    ca.add_argument("--batch-size", type=int, required=True,
+                    help="the training batch size the cap must serve")
+    ca.add_argument("--batches", type=int, default=20,
+                    help="batches to scan (chunk-shuffled, like training)")
+    ca.add_argument("--seed", type=int, default=0)
+    ca.add_argument("--headroom", type=float, default=0.10,
+                    help="fractional headroom over the scanned max "
+                         "before rounding up to the 512 tile")
+    ca.set_defaults(fn=cmd_cap_advise)
 
     lc = sub.add_parser("list-configs", help="show registered configs")
     lc.add_argument("--verbose", action="store_true")

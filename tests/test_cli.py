@@ -560,3 +560,33 @@ def test_readme_multihost_exemplar_validates():
     assert check_levers_any(tconfig) is None
     assert tconfig.compact_device and tconfig.score_sharded
     assert tconfig.collective_dtype == "bfloat16"
+
+
+def test_cap_advise_bounds_and_format(tmp_path, capsys):
+    """cap-advise's recommendation must bound the observed per-field
+    unique count with headroom, stay a 512 multiple (segtotal tile),
+    and never exceed the batch size."""
+    import json as json_lib
+
+    from fm_spark_tpu.cli import build_parser
+    from fm_spark_tpu.data import PackedWriter
+
+    rng = np.random.default_rng(0)
+    n, f, bucket = 3000, 5, 200
+    ids = (rng.integers(0, bucket, size=(n, f))
+           + np.arange(f) * bucket).astype(np.int32)
+    labels = rng.integers(0, 2, n).astype(np.int8)
+    with PackedWriter(str(tmp_path / "pk"), f, store_vals=False) as w:
+        w.append(ids, labels)
+    args = build_parser().parse_args([
+        "cap-advise", "--data", str(tmp_path / "pk"),
+        "--batch-size", "256", "--batches", "4",
+    ])
+    assert args.fn(args) == 0
+    out = json_lib.loads(capsys.readouterr().out.strip())
+    rec = out["recommended_compact_cap"]
+    assert rec % 512 == 0 or rec == 256  # tile-rounded unless batch-capped
+    assert rec <= 256
+    assert out["max_unique_per_field_overall"] <= 256
+    assert len(out["per_field_max"]) == f
+    assert max(out["per_field_max"]) == out["max_unique_per_field_overall"]
