@@ -47,6 +47,23 @@ parseable line because the 4 x 600s retry budget exceeded the driver's
     parent streams the child's stdout live so a mid-sweep cumulative-best
     line is salvageable at any instant.
 
+Round-6 warm-start (ISSUE 1 — BENCH_r03..r05 all returned null because
+backend init + first XLA compile outlasted the attachment's healthy
+windows):
+  * ``--compile-cache [DIR]`` enables jax's persistent compilation
+    cache (utils/compile_cache) so a SECOND bench process deserializes
+    every compiled step instead of recompiling — time-to-first-result
+    drops from minutes to seconds on a warm cache.
+  * ``--fast-first`` runs a TIERED sweep: leg 1 is the recorded winner
+    variant (MEASURED.json), AOT-precompiled against abstract shapes
+    before the tables are even initialized, and its non-provisional
+    result JSON is emitted before any remaining leg starts.
+  * Every completed leg streams to ``--artifacts-dir`` as it lands
+    (``sweep_<model>.jsonl`` + atomically-replaced
+    ``keepbest_<model>.json``), so a run killed mid-window leaves the
+    best-so-far metric instead of null; a SIGTERM'd parent that
+    salvaged any result line now exits 0.
+
 Timing note: on this TPU attachment, ``block_until_ready`` returns before
 execution completes; a device->host transfer of the loss is the reliable
 fence, and is what we use.
@@ -270,6 +287,48 @@ def _set_model(model: str) -> None:
     METRIC, TARGET_PER_CHIP = METRICS[model]
 
 
+def _artifacts_dir(args) -> str:
+    """Where the incremental sweep artifacts land (``--artifacts-dir``,
+    default ``artifacts/`` next to this script)."""
+    d = args.artifacts_dir or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _persist_incremental(dirpath, model, best_payload, leg_record):
+    """Persist the sweep's state AS IT LANDS (warm-start tiering, ISSUE
+    1): append this leg's measurement to ``sweep_<model>.jsonl`` and
+    atomically replace ``keepbest_<model>.json`` with the cumulative
+    best — so a bench killed mid-window (flaky attachment, outer
+    timeout) leaves the best-so-far metric on disk instead of nothing.
+    Best-effort by contract: persistence must never kill the sweep."""
+    try:
+        with open(os.path.join(dirpath, f"sweep_{model}.jsonl"), "a") as f:
+            f.write(json.dumps(leg_record) + "\n")
+        tmp = os.path.join(dirpath, f".keepbest_{model}.tmp")
+        with open(tmp, "w") as f:
+            f.write(json.dumps(best_payload) + "\n")
+        os.replace(tmp, os.path.join(dirpath, f"keepbest_{model}.json"))
+    except OSError as e:
+        _log(f"[inner] incremental artifact write failed: {e!r}")
+
+
+def _recorded_winner(metric: str):
+    """The measured-best variant label recorded for this metric in
+    MEASURED.json, or None — the fast-first tier measures it FIRST so
+    the highest-value leg is in the can before the sweep's A/B legs
+    start."""
+    try:
+        from fm_spark_tpu.measured import load_measured
+
+        entry = METRIC_ENTRY.get(metric)
+        return load_measured()[entry]["variant"] if entry else None
+    except Exception:
+        return None
+
+
 def _log(msg):
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
@@ -314,6 +373,21 @@ def inner_main(args):
     from fm_spark_tpu.utils.cpuguard import force_cpu_platform
 
     force_cpu_platform()
+
+    # Warm-start (ISSUE 1): the persistent compile cache turns the
+    # second process's minutes of XLA compilation into a disk read —
+    # enable BEFORE the first compile. --compile-cache DIR / bare flag
+    # for the repo-local default; FM_SPARK_COMPILE_CACHE without the
+    # flag.
+    from fm_spark_tpu.utils import compile_cache
+
+    if args.compile_cache is not None:
+        cache_dir = compile_cache.enable(args.compile_cache or None)
+        _log(f"[inner] persistent compile cache at {cache_dir}")
+    elif compile_cache.enable_from_env():
+        _log("[inner] persistent compile cache from env: "
+             f"{compile_cache.cache_stats()['dir']}")
+
     import jax.numpy as jnp
     from jax import lax
 
@@ -398,14 +472,21 @@ def inner_main(args):
     # round-3 lever) and report the fastest — the headline is "the
     # framework's best configuration", decided by measurement, not by a
     # default frozen before the chip could confirm it.
-    explicit = (args.sparse_update != "scatter_add" or args.use_pallas
-                or args.host_dedup or args.param_dtype != "float32"
-                or args.compute_dtype != "float32"
-                or args.table_layout != "row"
-                or args.rank is not None or args.batch != 1 << 17
-                or args.steps != 20 or args.compact_cap
-                or args.compact_device or args.gfull_fused
-                or args.segtotal_pallas)
+    lever_explicit = (args.sparse_update != "scatter_add"
+                      or args.use_pallas
+                      or args.host_dedup or args.param_dtype != "float32"
+                      or args.compute_dtype != "float32"
+                      or args.table_layout != "row"
+                      or args.compact_cap
+                      or args.compact_device or args.gfull_fused
+                      or args.segtotal_pallas)
+    shape_explicit = (args.rank is not None or args.batch != 1 << 17
+                      or args.steps != 20)
+    # --fast-first keeps the tiered variant sweep even at a non-default
+    # SHAPE (batch/steps/rank only change what one leg measures — the
+    # stamp below keeps the provenance honest); explicit LEVER knobs
+    # still mean "measure exactly this one program".
+    explicit = lever_explicit or (shape_explicit and not args.fast_first)
     variants = [(
         f"{args.param_dtype}/{args.sparse_update}"
         + ("/pallas" if args.use_pallas else "")
@@ -429,6 +510,22 @@ def inner_main(args):
         head, tail = default_variants(args.model, batch)
         variants[0:0] = head
         variants.extend(tail)
+        if args.fast_first:
+            # Tier 1 = the RECORDED winner (MEASURED.json), measured
+            # before any A/B leg: with a warm compile cache its result
+            # JSON lands in seconds, so even a window that dies right
+            # after still beats a null artifact. The head is already
+            # ranked best-first, so this only reorders when the record
+            # disagrees with the static ranking.
+            rec = _recorded_winner(METRIC)
+            idx = next((i for i, (l, _, _) in enumerate(variants)
+                        if l == rec), None)
+            if idx:
+                variants.insert(0, variants.pop(idx))
+            _log(f"[inner] fast-first: leg 1 = "
+                 f"{variants[0][0]!r}"
+                 + (f" (recorded winner)" if idx is not None else
+                    " (ranked head; no recorded winner in sweep)"))
 
     # Batch and rank are part of a rate's provenance (a doubled batch
     # amortizes fixed per-step work; a different rank is a different
@@ -472,6 +569,8 @@ def inner_main(args):
             aux = aux_cache[akey]
         return spec, init_opt, body, aux
 
+    art_dir = _artifacts_dir(args)
+    t_first_result = None  # wall-clock to the FIRST emitted result
     results = []
     for label, dtypes, config in variants:
         # Everything variant-specific — INCLUDING the host aux build,
@@ -487,8 +586,6 @@ def inner_main(args):
                  f"{(str(e).splitlines() or [''])[0][:200]}"
                  " -- skipping variant")
             continue
-        params = spec.init(jax.random.key(0))
-
         # n_steps is a DYNAMIC argument so the warmup call compiles the
         # exact program the timed call runs (a static count would
         # recompile inside the timed region). DeepFM threads its dense
@@ -506,10 +603,10 @@ def inner_main(args):
                 return lax.fori_loop(0, n_steps, fbody,
                                      (params, opt, jnp.float32(0)))
 
+            jit_fn = run_df
+
             def run(carry, *a):
                 return run_df(carry[0], carry[1], *a)
-
-            carry = (params, init_opt(params), jnp.float32(0))
         else:
             # (params, loss) carry; params donated.
             @functools.partial(jax.jit, donate_argnums=(0,))
@@ -522,10 +619,53 @@ def inner_main(args):
                 return lax.fori_loop(0, n_steps, fbody,
                                      (params, jnp.float32(0)))
 
+            jit_fn = run_pl
+
             def run(carry, *a):
                 return run_pl(carry[0], *a)
 
-            carry = (params, jnp.float32(0))
+        if args.fast_first and not results and compile_cache.is_enabled():
+            # AOT warm-start: lower + compile leg 1's program against
+            # ABSTRACT shapes before the multi-GB tables are even
+            # initialized — on a warm cache this is a deserialize (the
+            # whole point: the healthy window starts MEASURING in
+            # seconds); on a cold one it populates the cache for every
+            # later process. The later run() call re-traces but its XLA
+            # compile hits the same cache entry. Skipped when the cache
+            # is off (the work would be thrown away) and best-effort:
+            # an AOT failure must not cost the leg.
+            try:
+                from fm_spark_tpu.sparse import abstract_field_batch
+
+                t_aot = time.perf_counter()
+                sds = jax.ShapeDtypeStruct
+                params_abs = jax.eval_shape(spec.init, jax.random.key(0))
+                batch_abs = abstract_field_batch(spec, batch)
+                aux_abs = (None if aux is None else jax.tree_util.tree_map(
+                    lambda a: sds(a.shape, a.dtype), aux))
+                n_abs = sds((), jnp.int32)
+                if init_opt is not None:
+                    opt_abs = jax.eval_shape(init_opt, params_abs)
+                    jit_fn.lower(params_abs, opt_abs, *batch_abs,
+                                 aux_abs, n_abs).compile()
+                else:
+                    jit_fn.lower(params_abs, *batch_abs,
+                                 aux_abs, n_abs).compile()
+                cs = compile_cache.cache_stats()
+                _log(f"[inner] [{label}] AOT precompile in "
+                     f"{time.perf_counter() - t_aot:.1f}s (cache: "
+                     f"{cs['hits']} hits / {cs['misses']} misses, "
+                     f"{cs['entries']} entries)")
+            except Exception as e:  # noqa: BLE001 — best-effort
+                _log(f"[inner] [{label}] AOT precompile failed "
+                     f"({type(e).__name__}): "
+                     f"{(str(e).splitlines() or [''])[0][:200]}")
+
+        params = spec.init(jax.random.key(0))
+        carry = (
+            (params, init_opt(params), jnp.float32(0))
+            if init_opt is not None else (params, jnp.float32(0))
+        )
 
         _log(f"[inner] [{label}] compiling + warmup (first TPU compile "
              "is slow, ~20-60s)...")
@@ -574,9 +714,14 @@ def inner_main(args):
         # Emit the best-so-far line after EVERY variant: if a later
         # variant hangs/crashes (flaky attachment), the parent's salvage
         # scan still finds a valid completed measurement (it takes the
-        # LAST matching line).
+        # LAST matching line). In --fast-first terms this IS the tier
+        # boundary: the first line (leg 1 = the recorded winner) is a
+        # full non-provisional result, emitted before any remaining
+        # sweep leg starts.
+        if t_first_result is None:
+            t_first_result = round(time.perf_counter() - t_start, 1)
         best_rate, best_label, _, _ = max(results)
-        print(json.dumps({
+        payload = {
             "metric": METRIC,
             "value": round(best_rate, 1),
             "unit": UNIT,
@@ -585,7 +730,18 @@ def inner_main(args):
             "variant": best_label,
             "device": devs[0].device_kind,
             "all_variants": {l: round(r, 1) for r, l, _, _ in results},
-        }), flush=True)
+            "legs_completed": len(results),
+            "t_first_result_s": t_first_result,
+        }
+        print(json.dumps(payload), flush=True)
+        # Keep-best incrementally persisted: an interrupted run never
+        # reports null when any leg completed.
+        _persist_incremental(art_dir, args.model, payload, {
+            "variant": label, "value": round(rate, 1), "unit": UNIT,
+            "dt_s": round(dt, 3), "loss": round(final_loss, 6),
+            "device": devs[0].device_kind,
+            "t_since_start_s": round(time.perf_counter() - t_start, 1),
+        })
 
     if not results:
         _log("[inner] every variant failed; no measurement")
@@ -813,6 +969,27 @@ def main():
                     help="Pallas sorted-run segment totals in the "
                          "compact update (no blocked-prefix "
                          "materialization; round-5 lever)")
+    ap.add_argument("--fast-first", action="store_true",
+                    dest="fast_first",
+                    help="tiered sweep (warm-start): measure the "
+                         "recorded winner variant FIRST (AOT-"
+                         "precompiled when the compile cache is on) "
+                         "and emit its non-provisional result JSON "
+                         "before the remaining legs start; every leg "
+                         "streams to --artifacts-dir as it lands")
+    ap.add_argument("--compile-cache", nargs="?", const="", default=None,
+                    metavar="DIR", dest="compile_cache",
+                    help="enable jax's persistent XLA compilation "
+                         "cache at DIR (bare flag = the repo-local "
+                         "default): a second bench process reuses "
+                         "every compiled step — time-to-first-result "
+                         "drops from minutes to seconds. "
+                         "FM_SPARK_COMPILE_CACHE=<dir|1> without the "
+                         "flag")
+    ap.add_argument("--artifacts-dir", default=None, dest="artifacts_dir",
+                    help="where sweep_<model>.jsonl / "
+                         "keepbest_<model>.json land (default: "
+                         "artifacts/ next to this script)")
     ap.add_argument("--model", default="fm", choices=sorted(METRICS),
                     help="which fused step to measure: fm = the tracked "
                          "Criteo headline; ffm = config 4's avazu shape "
@@ -892,6 +1069,14 @@ def main():
         argv.append("--gfull-fused")
     if args.segtotal_pallas:
         argv.append("--segtotal-pallas")
+    if args.fast_first:
+        argv.append("--fast-first")
+    if args.compile_cache is not None:
+        argv.append("--compile-cache")
+        if args.compile_cache:
+            argv.append(args.compile_cache)
+    if args.artifacts_dir:
+        argv += ["--artifacts-dir", args.artifacts_dir]
     # An outer kill (timeout(1) sends SIGTERM) must still leave a
     # parseable final line: best-so-far result if any child printed one,
     # otherwise the error JSON with the failure log.
@@ -902,13 +1087,18 @@ def main():
             _SALVAGE["failures"].append(
                 f"parent received signal {signum} before completion")
             proc = _SALVAGE["proc"]
+            salvaged = _SALVAGE["line"] is not None
         if proc is not None:
             try:
                 proc.kill()
             except OSError:
                 pass
         _emit_final()
-        os._exit(1)
+        # A salvaged sweep IS a successful measurement (fast-first
+        # contract: any completed leg beats a null artifact) — exit 0
+        # so callers chained on success (tpu_watch's one-time queue)
+        # still advance.
+        os._exit(0 if salvaged else 1)
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _on_signal)

@@ -1,0 +1,32 @@
+"""jax version compatibility: ``jax.shard_map`` on older jax.
+
+The sharded steps target the modern API — ``jax.shard_map(f, mesh=...,
+in_specs=..., out_specs=..., check_vma=False)`` — which jax promoted out
+of ``jax.experimental`` (renaming ``check_rep`` → ``check_vma``). On a
+jaxlib that predates the promotion (this container ships 0.4.37) the
+attribute does not exist and every sharded step builder — and the AOT
+warm-start entries that lower them — dies on AttributeError.
+
+Installed from ``fm_spark_tpu/__init__`` so any entry point (cli, bench,
+tests, direct library use) sees a working ``jax.shard_map`` regardless
+of jax version. On a jax that already has it, this module is a no-op —
+the shim never shadows a real implementation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kwargs):
+        """``jax.experimental.shard_map`` under the promoted API's
+        signature (``check_vma`` maps onto the old ``check_rep``)."""
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs
+        )
+
+    jax.shard_map = shard_map

@@ -970,7 +970,17 @@ def cmd_train(args) -> int:
     from fm_spark_tpu import models
     from fm_spark_tpu.data import Batches, train_test_split
     from fm_spark_tpu.train import FMTrainer, evaluate_params
+    from fm_spark_tpu.utils import compile_cache
     from fm_spark_tpu.utils.logging import MetricsLogger
+
+    # Warm-start: point jax's persistent compilation cache at the
+    # repo-local dir (or the given one) BEFORE any jit compile, so a
+    # second run of the same config skips every XLA compilation.
+    # Without the flag, FM_SPARK_COMPILE_CACHE=<dir|1> does the same.
+    if args.compile_cache is not None:
+        compile_cache.enable(args.compile_cache or None)
+    else:
+        compile_cache.enable_from_env()
 
     _maybe_init_distributed(args)
 
@@ -1338,6 +1348,34 @@ def cmd_cap_advise(args) -> int:
     # want a 512 multiple; headroom covers batches not scanned.
     pad = max(64, int(overall * args.headroom))
     recommended = ((overall + pad) + 511) // 512 * 512
+    note = ("cap must bound EVERY future batch; rounded to the "
+            "segtotal 512 tile with "
+            f"{int(args.headroom * 100)}% headroom over the "
+            "scanned max — rescan after changing batch size, "
+            "hashing, or data distribution")
+    if recommended > args.batch_size:
+        # A batch of B rows can never contain more than B unique ids,
+        # so clamping to batch_size preserves the "bounds EVERY future
+        # batch" guarantee unconditionally. Rounding the clamp DOWN to
+        # the 512 tile would sacrifice that (a future batch may hold
+        # more uniques than the scan observed), so the clamp wins and
+        # the note stops claiming tile alignment when the clamp broke
+        # it — benign for the Pallas segtotal kernel, which pads B,
+        # not cap (ADVICE r5).
+        recommended = args.batch_size
+        if recommended % 512:
+            note = ("cap must bound EVERY future batch; clamped to "
+                    "batch_size (a batch's unique count is necessarily "
+                    "bounded by it), which is NOT tile-aligned — "
+                    "benign for the Pallas segtotal kernel, which "
+                    "pads B, not cap — rescan after changing batch "
+                    "size, hashing, or data distribution")
+        else:
+            note = ("cap must bound EVERY future batch; clamped to "
+                    "batch_size (a batch's unique count is necessarily "
+                    "bounded by it; itself a segtotal 512 tile "
+                    "multiple) — rescan after changing batch size, "
+                    "hashing, or data distribution")
     print(json.dumps({
         "data": args.data,
         "batch_size": args.batch_size,
@@ -1345,12 +1383,8 @@ def cmd_cap_advise(args) -> int:
         "max_unique_per_field_overall": overall,
         "per_batch_max": maxima,
         "per_field_max": per_field_max.tolist(),
-        "recommended_compact_cap": int(min(recommended, args.batch_size)),
-        "note": "cap must bound EVERY future batch; rounded to the "
-                "segtotal 512 tile with "
-                f"{int(args.headroom * 100)}% headroom over the "
-                "scanned max — rescan after changing batch size, "
-                "hashing, or data distribution",
+        "recommended_compact_cap": int(recommended),
+        "note": note,
     }))
     return 0
 
@@ -1451,6 +1485,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(single-chip FM/FFM field_sparse; amortizes "
                         "per-dispatch overhead, PERF.md fact 1); "
                         "logging/eval/checkpoint round to call boundaries")
+    t.add_argument("--compile-cache", nargs="?", const="", default=None,
+                   metavar="DIR", dest="compile_cache",
+                   help="enable jax's persistent XLA compilation cache "
+                        "at DIR (bare flag = the repo-local default "
+                        "dir): a warm process reuses every compiled "
+                        "step instead of recompiling — seconds instead "
+                        "of minutes to the first step (PERF.md "
+                        "warm-start). FM_SPARK_COMPILE_CACHE=<dir|1> "
+                        "does the same without the flag")
     t.add_argument("--prefetch", type=int, default=2,
                    help="background batch read-ahead depth (0 = off); "
                         "overlaps host batch assembly with device compute")

@@ -161,6 +161,12 @@ class PackedDataset:
         # thread swaps the cache underneath it.
         if ones is None or ones.shape != shape:
             ones = np.ones(shape, np.float32)
+            # The array is shared across every batch (and escapes to
+            # arbitrary consumers as the batch vals): enforce the
+            # read-only contract so an accidental in-place scale/pad
+            # raises ValueError instead of silently corrupting all
+            # past and future batches.
+            ones.setflags(write=False)
             self._ones = ones
         return ones
 

@@ -25,8 +25,10 @@ from fm_spark_tpu.parallel.step import (  # noqa: F401
     param_specs,
     shard_params,
     shard_batch,
+    lower_parallel_train_step,
     make_parallel_train_step,
     make_parallel_eval_step,
+    precompile_parallel_train_step,
 )
 from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     field_batch_specs,
@@ -35,8 +37,10 @@ from fm_spark_tpu.parallel.field_step import (  # noqa: F401
     make_field_ffm_sharded_body,
     make_field_ffm_sharded_eval_step,
     make_field_ffm_sharded_step,
+    lower_field_sharded_step,
     make_field_mesh,
     make_field_sharded_sgd_body,
+    precompile_field_sharded_step,
     make_field_deepfm_sharded_eval_step,
     make_field_sharded_eval_step,
     make_field_sharded_multistep,

@@ -936,3 +936,129 @@ from fm_spark_tpu.parallel.ffm_step import (  # noqa: E402,F401
     make_field_ffm_sharded_eval_step,
     make_field_ffm_sharded_step,
 )
+
+
+# --------------------------------------------------------------------------
+# AOT warm-start entries (see fm_spark_tpu/sparse.py's counterpart): the
+# field-sharded fused steps lowered against abstract SHARDED shapes —
+# compile (and persist, with utils/compile_cache enabled) before any
+# table or batch is placed on the mesh.
+# --------------------------------------------------------------------------
+
+
+def lower_field_sharded_step(spec, config: TrainConfig, mesh,
+                             batch_size: int, steps_per_call: int = 1):
+    """Lower the field-sharded fused step (FM / FFM / DeepFM — the
+    multi-chip CTR fast path) — or its ``steps_per_call`` roll —
+    against abstract sharded shapes. Returns a ``jax.stages.Lowered``.
+
+    Host-built compact aux configs are rejected (their aux rides each
+    batch from the producer thread; precompiling would need a live
+    batch) — ``compact_device`` is the composable form, and it lowers
+    here like any other lever.
+    """
+    import functools
+
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+    from fm_spark_tpu.parallel.deepfm_step import (
+        field_deepfm_param_specs,
+        make_field_deepfm_sharded_multistep,
+        make_field_deepfm_sharded_step,
+        stack_field_deepfm_params,
+    )
+    from fm_spark_tpu.parallel.ffm_step import make_field_ffm_sharded_step
+    from fm_spark_tpu.parallel.step import (
+        _sharded_abstract as _abstract_sharded_tree,
+    )
+
+    if steps_per_call < 1:
+        raise ValueError(
+            f"steps per call must be >= 1, got {steps_per_call}"
+        )
+    if config.host_dedup:
+        raise ValueError(
+            "the AOT entry cannot precompile a host-built aux step "
+            "(the aux ships with each batch); use compact_device=True"
+        )
+    n = mesh.size
+    if batch_size % n:
+        raise ValueError(
+            f"batch_size={batch_size} must divide by the mesh size ({n})"
+        )
+    n_feat = mesh.shape["feat"]
+    is_deepfm = isinstance(spec, FieldDeepFMSpec)
+    stack = (stack_field_deepfm_params if is_deepfm
+             else stack_field_params)
+    stacked_struct = jax.eval_shape(
+        lambda key: stack(spec, spec.init(key), n_feat),
+        jax.random.key(0),
+    )
+    pspecs = (field_deepfm_param_specs(spec, mesh) if is_deepfm
+              else field_param_specs(mesh))
+    params_abs = _abstract_sharded_tree(stacked_struct, mesh, pspecs)
+    B = batch_size
+    f_pad = padded_num_fields(spec.num_fields, n_feat)
+    sds = jax.ShapeDtypeStruct
+    batch_struct = (
+        sds((B, f_pad), jnp.int32), sds((B, f_pad), jnp.float32),
+        sds((B,), jnp.float32), sds((B,), jnp.float32),
+    )
+    batch_abs = _abstract_sharded_tree(
+        batch_struct, mesh, field_batch_specs(mesh)
+    )
+    i32 = sds((), jnp.int32)
+    multi = steps_per_call > 1
+
+    def stack_batch(abs_batch):
+        return tuple(
+            jax.ShapeDtypeStruct(
+                (steps_per_call, *a.shape), a.dtype,
+                sharding=NamedSharding(mesh, sp),
+            )
+            for a, sp in zip(abs_batch, stacked_field_batch_specs(mesh))
+        )
+
+    if is_deepfm:
+        if multi:
+            mstep = make_field_deepfm_sharded_multistep(
+                spec, config, mesh, steps_per_call
+            )
+            opt_abs = jax.eval_shape(mstep.init_opt_state, params_abs)
+            return mstep.lower(params_abs, opt_abs, i32, i32,
+                               *stack_batch(batch_abs))
+        step = make_field_deepfm_sharded_step(spec, config, mesh)
+        opt_abs = jax.eval_shape(step.init_opt_state, params_abs)
+        # The public wrapper is a plain function (it carries
+        # init_opt_state); re-jit the underlying body for .lower().
+        from fm_spark_tpu.parallel.deepfm_step import (
+            _make_deepfm_sharded_one_step,
+        )
+
+        apply_one, _ = _make_deepfm_sharded_one_step(spec, config, mesh)
+        jitted = functools.partial(jax.jit, donate_argnums=(0, 1))(
+            apply_one
+        )
+        return jitted.lower(params_abs, opt_abs, i32, *batch_abs)
+
+    if multi:
+        mstep = make_field_sharded_multistep(spec, config, mesh,
+                                             steps_per_call)
+        return mstep.lower(params_abs, i32, i32,
+                           *stack_batch(batch_abs))
+    step = (
+        make_field_ffm_sharded_step(spec, config, mesh)
+        if isinstance(spec, FieldFFMSpec)
+        else make_field_sharded_sgd_step(spec, config, mesh)
+    )
+    return step.lower(params_abs, i32, *batch_abs)
+
+
+def precompile_field_sharded_step(spec, config: TrainConfig, mesh,
+                                  batch_size: int,
+                                  steps_per_call: int = 1):
+    """Eagerly compile the field-sharded fused step — the multi-chip
+    warm-start producer; returns the ``jax.stages.Compiled``."""
+    return lower_field_sharded_step(
+        spec, config, mesh, batch_size, steps_per_call
+    ).compile()

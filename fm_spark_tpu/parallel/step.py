@@ -277,3 +277,100 @@ def make_parallel_eval_step(spec, mesh, strategy: str = "dp"):
         return jax.tree_util.tree_map(jnp.add, mstate, d)
 
     return step
+
+
+# --------------------------------------------------------------------------
+# AOT warm-start entries (see fm_spark_tpu/sparse.py's counterpart for
+# the rationale): lower + compile the dense parallel step against
+# abstract SHARDED shapes, so the executable exists — and, with
+# utils/compile_cache enabled, persists — before any array is placed on
+# the mesh.
+# --------------------------------------------------------------------------
+
+
+def _sharded_abstract(struct, mesh, specs):
+    """ShapeDtypeStructs carrying the NamedShardings the real call will
+    use — lowering without them would compile a differently-partitioned
+    program and the warm cache would never be hit."""
+    return jax.tree_util.tree_map(
+        lambda s, sp: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, sp)
+        ),
+        struct, specs,
+    )
+
+
+def _abstract_opt_state(optimizer, params_abs, mesh, pspecs):
+    """Abstract optimizer state with shardings matched to the params.
+
+    optax slot buffers (adam/adagrad moments) mirror a param leaf's
+    shape exactly, and the update runs under jit where SPMD keeps each
+    slot co-located with its rows — so shape-matching against the param
+    specs reproduces the placement ``optimizer.init(sharded_params)``
+    produces. Scalars (counts) and unmatched leaves are replicated.
+    """
+    shape_to_spec = {}
+    for leaf, sp in zip(
+        jax.tree_util.tree_leaves(params_abs),
+        jax.tree_util.tree_leaves(pspecs, is_leaf=lambda x: isinstance(x, P)),
+    ):
+        shape_to_spec.setdefault(leaf.shape, sp)
+    struct = jax.eval_shape(optimizer.init, params_abs)
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype,
+            sharding=NamedSharding(
+                mesh, shape_to_spec.get(s.shape, P())
+            ),
+        ),
+        struct,
+    )
+
+
+def lower_parallel_train_step(spec, config: TrainConfig, mesh,
+                              strategy: str = "dp", *,
+                              batch_size: int, nnz: int | None = None,
+                              optimizer=None):
+    """Lower the dp/row mesh step against abstract sharded shapes.
+
+    ``nnz`` is the batch's per-example id count (defaults to
+    ``spec.num_fields`` when the model has one). Returns a
+    ``jax.stages.Lowered``; ``.compile()`` yields the executable."""
+    nnz = nnz if nnz is not None else getattr(spec, "num_fields", None)
+    if not nnz:
+        raise ValueError(
+            "nnz (ids per example) is required for a model without "
+            "num_fields"
+        )
+    if batch_size % mesh.shape["data"]:
+        raise ValueError(
+            f"batch_size={batch_size} must divide by the data mesh "
+            f"axis ({mesh.shape['data']})"
+        )
+    optimizer = optimizer or make_optimizer(config)
+    step = make_parallel_train_step(spec, config, mesh, strategy,
+                                    optimizer)
+    pspecs = param_specs(spec, strategy)
+    params_abs = _sharded_abstract(_params_struct(spec), mesh, pspecs)
+    opt_abs = _abstract_opt_state(optimizer, params_abs, mesh, pspecs)
+    B = batch_size
+    sds = jax.ShapeDtypeStruct
+    batch_struct = (
+        sds((B, nnz), jnp.int32), sds((B, nnz), jnp.float32),
+        sds((B,), jnp.float32), sds((B,), jnp.float32),
+    )
+    batch_abs = _sharded_abstract(batch_struct, mesh, BATCH_SPECS)
+    return step.lower(params_abs, opt_abs, *batch_abs)
+
+
+def precompile_parallel_train_step(spec, config: TrainConfig, mesh,
+                                   strategy: str = "dp", *,
+                                   batch_size: int,
+                                   nnz: int | None = None,
+                                   optimizer=None):
+    """Eagerly compile the dp/row mesh step (the warm-start producer for
+    the dense strategies); returns the ``jax.stages.Compiled``."""
+    return lower_parallel_train_step(
+        spec, config, mesh, strategy,
+        batch_size=batch_size, nnz=nnz, optimizer=optimizer,
+    ).compile()

@@ -723,10 +723,14 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             # Per-owner-field blocks: sel[b, i, j] = Rv[i][b, j] * x_i
             # and its transpose-slice selT_i[b, j] = Rv[j][b, i] * x_j
             # are built on the fly from the (already needed) gathered
-            # rows — the [B, F, F, k] sel tensor never exists, and the
-            # largest live array is one [B, F, k] pair. Unrolled over
-            # the static F (≤ ~40): each iteration is a handful of
-            # fused slice/multiply/reduce ops.
+            # rows — the [B, F, F, k] sel tensor never exists; the
+            # FORWARD's largest live array is one [B, F, k] pair.
+            # (The backward below still accumulates the per-field
+            # gradient set dvs — F × [B, F·k], the same total bytes as
+            # the default body's dv — so the lever removes the sel/dsel
+            # materialization traffic, not the gradient set.) Unrolled
+            # over the static F (≤ ~40): each iteration is a handful
+            # of fused slice/multiply/reduce ops.
             Rv = [r[:, : F * k].reshape(-1, F, k) for r in rows]
 
             def _selT(i):
@@ -766,7 +770,10 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         if config.sel_blocked:
             # d/dsel[b, i, j] = ds_b · sel[b, j, i] (zero diagonal), so
             # per owner i the whole [B, F·k] factor gradient is one
-            # recomputed selT_i slice — dsel/dv are never materialized.
+            # recomputed selT_i slice — the [B, F, F, k] dsel tensor is
+            # never materialized. The per-field gradients dvs (F ×
+            # [B, F·k], all live until _updates_for) ARE — the same
+            # set the default body builds.
             ds_cd = dscores.astype(cd)
             dvs = []
             for i in range(F):
@@ -1094,3 +1101,134 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
         return {"w0": w0, "w": w, "v": v}, loss
 
     return step
+
+
+# --------------------------------------------------------------------------
+# AOT warm-start entries (the compile-before-data path).
+#
+# The fused step programs are deterministic functions of (spec, config,
+# batch shape) — nothing about them needs real data or initialized
+# tables. Lowering against ABSTRACT shapes and calling ``.compile()``
+# runs the whole XLA pipeline eagerly, so:
+#   * with the persistent compile cache enabled
+#     (utils/compile_cache.enable), the executable lands on disk and
+#     every later process — bench, training, a retried attachment
+#     window — deserializes it instead of recompiling;
+#   * the compile happens BEFORE any batch or table touches the device,
+#     so a flaky attachment's healthy window is spent measuring, not
+#     compiling.
+# Sharded variants live next to their builders
+# (parallel/step.py, parallel/field_step.py).
+# --------------------------------------------------------------------------
+
+
+def abstract_field_batch(spec, batch_size: int):
+    """ShapeDtypeStructs of one ``(ids, vals, labels, weights)`` batch
+    as every fused field step consumes it: ``[B, F]`` int32 ids, ``[B,
+    F]`` f32 vals, ``[B]`` f32 labels/weights."""
+    B, F = batch_size, spec.num_fields
+    sds = jax.ShapeDtypeStruct
+    return (
+        sds((B, F), jnp.int32),
+        sds((B, F), jnp.float32),
+        sds((B,), jnp.float32),
+        sds((B,), jnp.float32),
+    )
+
+
+def abstract_host_aux(config: TrainConfig, batch_size: int,
+                      num_fields: int):
+    """Abstract pytree of the host-built dedup/compact aux for a
+    ``[B, F]`` batch, or None when the config ships no aux.
+
+    Aux shapes depend only on ``(B, F, cap)``, never on id values, so a
+    zeros-ids probe build (every field has one unique id — always under
+    any positive cap) yields the exact structure the real producer
+    ships."""
+    if not config.host_dedup:
+        return None
+    import numpy as np
+
+    from fm_spark_tpu.ops.scatter import compact_aux, dedup_aux
+
+    ids = np.zeros((batch_size, num_fields), np.int32)
+    aux = (compact_aux(ids, config.compact_cap) if config.compact_cap
+           else dedup_aux(ids))
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        aux,
+    )
+
+
+def _stack_abstract(tree, n: int):
+    """Prepend a ``[n, ...]`` stack axis to every leaf (the multistep
+    roll's batch layout, data/pipeline.StackedBatches)."""
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct((n, *s.shape), s.dtype), tree
+    )
+
+
+def lower_field_sparse_step(spec, config: TrainConfig, batch_size: int,
+                            steps_per_call: int = 1):
+    """Lower the single-chip fused step for ``spec``'s family — or the
+    ``steps_per_call`` fori roll — against abstract shapes.
+
+    Returns a ``jax.stages.Lowered``; ``.compile()`` produces the
+    executable (and, with the persistent cache enabled, persists it).
+    Dispatches FieldFM / FieldFFM / FieldDeepFM exactly like the
+    training loop's builders, so the compiled program is the one the
+    loop's first dispatch would otherwise build on the critical path.
+    """
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+
+    if steps_per_call < 1:
+        raise ValueError(
+            f"steps per call must be >= 1, got {steps_per_call}"
+        )
+    params_abs = jax.eval_shape(spec.init, jax.random.key(0))
+    batch_abs = abstract_field_batch(spec, batch_size)
+    aux_abs = abstract_host_aux(config, batch_size, spec.num_fields)
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    multi = steps_per_call > 1
+
+    if isinstance(spec, FieldDeepFMSpec):
+        if multi:
+            mstep = make_field_deepfm_multistep(spec, config,
+                                                steps_per_call)
+            opt_abs = jax.eval_shape(mstep.init_opt_state, params_abs)
+            return mstep.lower(
+                params_abs, opt_abs, i32, i32,
+                *_stack_abstract(batch_abs, steps_per_call),
+                _stack_abstract(aux_abs, steps_per_call),
+            )
+        body, init_opt = make_field_deepfm_sparse_body(spec, config)
+        opt_abs = jax.eval_shape(init_opt, params_abs)
+        step = functools.partial(jax.jit, donate_argnums=(0, 1))(body)
+        return step.lower(params_abs, opt_abs, i32, *batch_abs, aux_abs)
+
+    if multi:
+        mstep = make_field_sparse_multistep(spec, config, steps_per_call)
+        return mstep.lower(
+            params_abs, i32, i32,
+            *_stack_abstract(batch_abs, steps_per_call),
+            _stack_abstract(aux_abs, steps_per_call),
+        )
+    step = (
+        make_field_ffm_sparse_sgd_step(spec, config)
+        if isinstance(spec, FieldFFMSpec)
+        else make_field_sparse_sgd_step(spec, config)
+    )
+    return step.lower(params_abs, i32, *batch_abs, aux_abs)
+
+
+def precompile_field_sparse_step(spec, config: TrainConfig,
+                                 batch_size: int,
+                                 steps_per_call: int = 1):
+    """Eagerly compile the fused step (``lower().compile()``) — the
+    warm-start producer: run once per (config, shape) to populate the
+    persistent cache before data ever touches the device. Returns the
+    ``jax.stages.Compiled`` (callable with concrete arrays)."""
+    return lower_field_sparse_step(
+        spec, config, batch_size, steps_per_call
+    ).compile()

@@ -159,13 +159,16 @@ class TrainConfig:
     segtotal_pallas: bool = False
     # FFM only: compute the field-aware interaction and its backward in
     # per-owner-field blocks instead of materializing the [B, F, F, k]
-    # ``sel``/``dsel``/``dv`` tensors (the config-4 step's dominant HBM
+    # ``sel``/``dsel`` tensors (the config-4 step's dominant HBM
     # traffic — PERF.md: bf16 compute buffers alone, which halve
     # exactly these, measured +23%). Same math, so values agree with
     # the default body up to fp reassociation of the pair sums; the
-    # largest live tensor drops from [B, F, F, k] to [B, F, k]. Off by
-    # default until the on-chip A/B (bench.py --model ffm sweep)
-    # prices it.
+    # FORWARD's largest live tensor drops from [B, F, F, k] to
+    # [B, F, k]. The backward's per-field gradient set (F × [B, F·k],
+    # the same total bytes as the default body's dv) remains live until
+    # the table updates — only the sel/dsel materialization is
+    # eliminated. Off by default until the on-chip A/B (bench.py
+    # --model ffm sweep) prices it.
     sel_blocked: bool = False
 
 
@@ -319,6 +322,13 @@ class FMTrainer:
     """
 
     def __init__(self, spec, config: TrainConfig, n_chips: int = 1):
+        # Warm-start hook: FM_SPARK_COMPILE_CACHE=<dir|1> enables the
+        # persistent XLA compilation cache for any library user of the
+        # trainer (the CLI's --compile-cache flag reaches the same
+        # switch); a no-op when the env var is unset.
+        from fm_spark_tpu.utils import compile_cache
+
+        compile_cache.enable_from_env()
         self.spec = spec
         self.config = config
         self.optimizer = make_optimizer(config)

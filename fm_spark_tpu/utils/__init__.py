@@ -1,5 +1,7 @@
-"""Cross-cutting utilities: metrics, structured logging, profiling."""
+"""Cross-cutting utilities: metrics, structured logging, profiling,
+and the persistent-compile-cache warm-start switch (compile_cache)."""
 
+from fm_spark_tpu.utils import compile_cache  # noqa: F401
 from fm_spark_tpu.utils.metrics import (  # noqa: F401
     MetricsState,
     init_metrics,

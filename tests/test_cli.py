@@ -590,3 +590,43 @@ def test_cap_advise_bounds_and_format(tmp_path, capsys):
     assert out["max_unique_per_field_overall"] <= 256
     assert len(out["per_field_max"]) == f
     assert max(out["per_field_max"]) == out["max_unique_per_field_overall"]
+    if rec % 512:
+        # Sub-tile batch: the note must not claim tile rounding.
+        assert "NOT tile-aligned" in out["note"]
+
+
+def test_cap_advise_clamp_note_matches_value(tmp_path, capsys):
+    """When the recommendation is clamped to a non-512-multiple batch
+    size, the note must stop claiming tile rounding (ADVICE r5) — and
+    the clamp itself must stay batch_size, the only value that bounds
+    ANY future batch's unique count unconditionally (rounding down to
+    the tile could dip under a future batch the scan never saw)."""
+    import json as json_lib
+
+    from fm_spark_tpu.cli import build_parser
+    from fm_spark_tpu.data import PackedWriter
+
+    rng = np.random.default_rng(1)
+    n, f, bucket = 4000, 5, 1000
+    # Per-field unique count near 500 at batch 1000 (each residue
+    # class has 8 copies in the file): with headroom 0.5 the unclamped
+    # recommendation exceeds the batch for any plausible chunk-shuffled
+    # coverage (≥ ~342 unique), so the clamp path is deterministic.
+    ids = ((np.arange(n)[:, None] % 500)
+           + np.arange(f) * bucket).astype(np.int32)
+    labels = rng.integers(0, 2, n).astype(np.int8)
+    with PackedWriter(str(tmp_path / "pk"), f, store_vals=False) as w:
+        w.append(ids, labels)
+    args = build_parser().parse_args([
+        "cap-advise", "--data", str(tmp_path / "pk"),
+        "--batch-size", "1000", "--batches", "3", "--headroom", "0.5",
+    ])
+    assert args.fn(args) == 0
+    out = json_lib.loads(capsys.readouterr().out.strip())
+    overall = out["max_unique_per_field_overall"]
+    assert 342 <= overall <= 500
+    # Clamped to the batch (no batch of 1000 rows can exceed 1000
+    # uniques), and the note says so instead of claiming the tile.
+    assert out["recommended_compact_cap"] == 1000
+    assert "NOT tile-aligned" in out["note"]
+    assert "rounded to the segtotal 512 tile" not in out["note"]

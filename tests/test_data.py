@@ -531,6 +531,14 @@ def test_assemble_ones_vals_cached_and_shared(tmp_path):
     _, v2, _ = ds.assemble(np.arange(64, 128), bucket=0)
     assert v1 is v2
     assert v1.shape == (64, 7) and np.all(v1 == 1.0)
+    # The shared array is WRITE-PROTECTED: an accidental in-place
+    # mutation by any consumer raises instead of silently corrupting
+    # every other batch (the read-only contract, enforced not just
+    # documented).
+    assert not v1.flags.writeable
+    with pytest.raises(ValueError):
+        v1 *= 2.0
+    assert np.all(v1 == 1.0)
 
 
 def test_assemble_negative_and_oob_sel_numpy_semantics(tmp_path):

@@ -9,12 +9,25 @@
 #     the BEST sweep (highest parsed samples/sec) in bench_sweep.out,
 #     so a later, healthier window replaces an early throttled one;
 #   - each raw capture is also kept timestamped for the audit trail.
+# Round-6 warm-start (ISSUE 1): every bench runs with the persistent
+# compile cache (--compile-cache, repo-local .jax_compile_cache) and
+# --fast-first. The FIRST healthy window pays XLA once and populates
+# the cache (this is the pre-warm — executables are keyed per platform,
+# so only an on-chip compile can warm the on-chip cache); every later
+# window deserializes instead of recompiling and measures the recorded
+# winner variant first, so even a window that flaps after one leg
+# leaves a non-null result (keep-best streamed to artifacts/ as legs
+# land). A SIGTERM'd-but-salvaged sweep exits 0; the one-time queue
+# below gates on a PARSED headline value rather than the exit code,
+# because the outer `timeout` wrapper reports 124 on its own kill no
+# matter what bench exited with.
 # Killed by the builder before round end so it can never collide with
 # the driver's own bench run.
 set -u
 cd "$(dirname "$0")"
 OUT=tpu_watch_out
 mkdir -p "$OUT"
+BENCH_WARM="--fast-first --compile-cache"
 
 # Print the best parsed "value" from a bench output file (-1.0 if none).
 best_value() {
@@ -51,11 +64,17 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         > "$OUT/gfull_probe.jsonl" 2> "$OUT/gfull_probe.err"
       echo "tpu_watch: gfull probe rc=$?" >> "$OUT/log"
     fi
-    timeout 1700 python bench.py --total-deadline 1500 \
+    timeout 1700 python bench.py $BENCH_WARM --total-deadline 1500 \
       > "$OUT/sweep_$TS.out" 2> "$OUT/sweep_$TS.err"
     rc=$?
     val=$(best_value "$OUT/sweep_$TS.out")
     echo "tpu_watch: sweep rc=$rc value=$val at $TS" >> "$OUT/log"
+    # Queue gate = a PARSED headline result, not the exit code: the
+    # outer `timeout` reports 124 on its own SIGTERM regardless of
+    # bench's salvage exit, so rc alone would stall the queue exactly
+    # when fast-first salvaged a real measurement.
+    headline_ok=1
+    python -c "import sys; sys.exit(0 if float('$val') > 0 else 1)" || headline_ok=0
     if python -c "import sys; sys.exit(0 if float('$val') > float('$best_val') else 1)"; then
       best_val=$val
       cp "$OUT/sweep_$TS.out" "$OUT/bench_sweep.out"
@@ -68,8 +87,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # Gate on a PARSED success (ffm_done marker), not file bytes — a
     # failed attempt writes an error JSON, which must not block the
     # refresh in later, healthier windows.
-    if [ "$rc" -eq 0 ] && [ ! -e "$OUT/ffm_done" ]; then
-      timeout 1100 python bench.py --model ffm --total-deadline 900 \
+    if [ "$headline_ok" -eq 1 ] && [ ! -e "$OUT/ffm_done" ]; then
+      timeout 1100 python bench.py $BENCH_WARM --model ffm --total-deadline 900 \
         > "$OUT/ffm_sweep.out" 2> "$OUT/ffm_sweep.err"
       frc=$?
       fval=$(best_value "$OUT/ffm_sweep.out")
@@ -80,8 +99,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     fi
     # Window 3+: the config-5 DeepFM rate (never measured on-chip —
     # projections used the FM rate as a proxy until now).
-    if [ "$rc" -eq 0 ] && [ -e "$OUT/ffm_done" ] && [ ! -e "$OUT/deepfm_done" ]; then
-      timeout 1100 python bench.py --model deepfm --total-deadline 900 \
+    if [ "$headline_ok" -eq 1 ] && [ -e "$OUT/ffm_done" ] && [ ! -e "$OUT/deepfm_done" ]; then
+      timeout 1100 python bench.py $BENCH_WARM --model deepfm --total-deadline 900 \
         > "$OUT/deepfm_sweep.out" 2> "$OUT/deepfm_sweep.err"
       drc=$?
       dval=$(best_value "$OUT/deepfm_sweep.out")
@@ -94,8 +113,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # own metric + MEASURED entry, so no conflation with the headline).
     # BEFORE the b262 A/B: a brand-new MEASURED entry outranks an A/B
     # that by design can never update MEASURED.json.
-    if [ "$rc" -eq 0 ] && [ -e "$OUT/deepfm_done" ] && [ ! -e "$OUT/kaggle_done" ]; then
-      timeout 1100 python bench.py --model fm_kaggle --total-deadline 900 \
+    if [ "$headline_ok" -eq 1 ] && [ -e "$OUT/deepfm_done" ] && [ ! -e "$OUT/kaggle_done" ]; then
+      timeout 1100 python bench.py $BENCH_WARM --model fm_kaggle --total-deadline 900 \
         > "$OUT/kaggle_sweep.out" 2> "$OUT/kaggle_sweep.err"
       krc=$?
       kval=$(best_value "$OUT/kaggle_sweep.out")
@@ -108,8 +127,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # amortizes every batch-independent cost; cap 26624 bounds the
     # measured 20,109 max unique at that batch — bench.py grid notes).
     # The /b262144 label suffix keeps the rate's provenance distinct.
-    if [ "$rc" -eq 0 ] && [ -e "$OUT/kaggle_done" ] && [ ! -e "$OUT/b262_done" ]; then
-      timeout 1100 python bench.py --batch 262144 --compact-cap 26624 \
+    if [ "$headline_ok" -eq 1 ] && [ -e "$OUT/kaggle_done" ] && [ ! -e "$OUT/b262_done" ]; then
+      timeout 1100 python bench.py --compile-cache --batch 262144 --compact-cap 26624 \
         --param-dtype bfloat16 --compute-dtype bfloat16 \
         --sparse-update dedup_sr --host-dedup \
         --gfull-fused --segtotal-pallas --total-deadline 900 \
