@@ -57,13 +57,34 @@ class Checkpointer:
         # fails in a background thread, long after training moved on.
         self.directory = os.path.abspath(str(directory))
         self.save_every = int(save_every)
-        self._mgr = ocp.CheckpointManager(
+        self._max_to_keep = int(max_to_keep)
+        self._async_save = bool(async_save)
+        self._mgr = self._make_mgr()
+
+    def _make_mgr(self):
+        return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                enable_async_checkpointing=async_save,
+                max_to_keep=self._max_to_keep,
+                enable_async_checkpointing=self._async_save,
             ),
         )
+
+    def reopen(self) -> None:
+        """Rebuild the underlying orbax manager over the same directory.
+
+        The device-loss recovery path (``FMTrainer.fit`` with a
+        resilience supervisor) calls this before restoring: an async
+        save that was in flight when the device died can leave the old
+        manager wedged on dead buffers, and committed checkpoints on
+        disk are the only state that matters for the resume. Closing the
+        wedged manager is best-effort — its failure is exactly the
+        condition being recovered from."""
+        try:
+            self._mgr.close()
+        except Exception:
+            pass
+        self._mgr = self._make_mgr()
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

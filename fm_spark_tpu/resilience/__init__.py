@@ -1,0 +1,54 @@
+"""Device-fault supervision: retry/backoff runtime + fault injection.
+
+Why this subsystem exists (ISSUE 2 / VERDICT r5 "What's weak" #1): a
+flaky TPU attachment nulled three consecutive driver bench rounds, and
+every defense against it was ad-hoc — retry/probe logic in bash
+(``tpu_watch.sh``), hand-rolled watchdogs in ``bench.py``, and no way to
+exercise any failure path (init hang, rc=3 init failure, mid-step device
+loss, SIGTERM mid-sweep) deterministically in tests. This package makes
+failure handling a tested subsystem:
+
+- :mod:`fm_spark_tpu.resilience.faults` — deterministic, env/flag-driven
+  fault injection (CPU-backend testable) simulating every observed
+  failure mode, so each recovery path has a repeatable test.
+- :mod:`fm_spark_tpu.resilience.supervisor` — the retry/timeout/backoff
+  state machine (bounded exponential backoff + deterministic jitter,
+  cheap device-enumeration health probe, circuit-breaker escalation)
+  emitting a structured health-event JSONL journal
+  (:class:`fm_spark_tpu.utils.logging.EventLog`).
+
+Consumers: ``bench.py`` (per-leg supervision + ``--resume-sweep``),
+``FMTrainer.fit`` (device-loss → checkpoint resume with loss
+continuity), and ``tools/tpu_watch.py`` (the supervised attachment
+watcher that replaced the bash poll loop).
+"""
+
+from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience.faults import (
+    FaultInjected,
+    FaultPlan,
+    InjectedDeviceLoss,
+    inject,
+    is_device_loss,
+)
+from fm_spark_tpu.resilience.supervisor import (
+    BackoffPolicy,
+    CircuitOpen,
+    RetriesExhausted,
+    Supervisor,
+    device_probe,
+)
+
+__all__ = [
+    "BackoffPolicy",
+    "CircuitOpen",
+    "FaultInjected",
+    "FaultPlan",
+    "InjectedDeviceLoss",
+    "RetriesExhausted",
+    "Supervisor",
+    "device_probe",
+    "faults",
+    "inject",
+    "is_device_loss",
+]

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import optax
 
 from fm_spark_tpu.ops import losses as losses_lib
+from fm_spark_tpu.resilience import faults
 from fm_spark_tpu.utils import metrics as metrics_lib
 from fm_spark_tpu.utils.logging import MetricsLogger
 
@@ -343,7 +344,7 @@ class FMTrainer:
 
     def fit(self, batches: Iterable, num_steps: int | None = None,
             checkpointer=None, preemption_guard=None, eval_batches=None,
-            prefetch: int = 0):
+            prefetch: int = 0, supervisor=None):
         """Run the training loop; ``batches`` yields (ids, vals, labels, w).
 
         With a :class:`fm_spark_tpu.checkpoint.Checkpointer`, training
@@ -362,23 +363,33 @@ class FMTrainer:
         (the producer reads ahead immediately, so it must see the
         restored cursor), overlapping host batch assembly with device
         compute.
+
+        ``supervisor`` (a :class:`fm_spark_tpu.resilience.Supervisor`,
+        requires ``checkpointer``) turns a mid-run DEVICE LOSS from a
+        crash into a degradation: the loss is journaled, the supervisor
+        probes the attachment and backs off (circuit-breaking after its
+        threshold of consecutive losses), device state is rebuilt fresh,
+        and the run resumes from the latest committed checkpoint with
+        the pipeline cursor restored — so the resumed loss curve is the
+        uninterrupted one (the same continuity contract as
+        kill-and-resume, tests/test_checkpoint.py). Non-device errors
+        propagate unchanged.
         """
         total = num_steps if num_steps is not None else self.config.num_steps
         log_every = max(self.config.log_every, 1)
-        start = 0
+        if supervisor is not None and checkpointer is None:
+            raise ValueError(
+                "supervised training needs a checkpointer: device-loss "
+                "recovery without committed state to resume from would "
+                "silently restart the run from scratch"
+            )
         if checkpointer is not None:
-            from fm_spark_tpu import checkpoint as ckpt_lib
-
             if not (hasattr(batches, "state") and hasattr(batches, "restore")):
                 raise ValueError(
                     "checkpointed training needs a resumable batch source "
                     "with state()/restore() (e.g. data.Batches); a plain "
                     "iterator would silently replay data after resume"
                 )
-            # With a checkpointer, num_steps is a GLOBAL step target: a
-            # resumed run continues toward it (and a finished run is a
-            # no-op). Without one, fit() runs num_steps more steps.
-            start = ckpt_lib.resume_or_init(self, checkpointer, batches=batches)
 
         def save(force=False):
             if checkpointer is None:
@@ -394,16 +405,79 @@ class FMTrainer:
                 checkpointer.wait()
             else:
                 checkpointer.save(*args)
+            if supervisor is not None:
+                # A committed post-recovery checkpoint IS real progress:
+                # close the breaker so it counts CONSECUTIVE losses, not
+                # lifetime ones — a long run whose attachment flaps once
+                # a day must never accumulate toward CircuitOpen.
+                supervisor.note_success("train")
 
         from fm_spark_tpu.data import wrap_prefetch
 
-        batches, close_prefetch = wrap_prefetch(batches, prefetch)
-        try:
-            return self._fit_loop(batches, start, total, log_every,
-                                  checkpointer, preemption_guard,
-                                  eval_batches, save)
-        finally:
-            close_prefetch()
+        source = batches
+        need_rebuild = False
+        while True:
+            try:
+                if need_rebuild:
+                    # Rebuild EVERYTHING that lived on the dead device —
+                    # params/opt state (also donated, so host handles
+                    # are stale either way) and the jitted steps. This
+                    # runs INSIDE the supervised try: a rebuild against
+                    # a still-dead attachment raises another device-loss
+                    # error, which cycles back through recover() and is
+                    # bounded by the circuit breaker instead of escaping
+                    # uncaught.
+                    checkpointer.reopen()
+                    self.params = self.spec.init(
+                        jax.random.key(self.config.seed))
+                    self.opt_state = self.optimizer.init(self.params)
+                    self.step_count = 0
+                    self.loss_history = []
+                    self._train_step = make_train_step(
+                        self.spec, self.config, self.optimizer)
+                    self._eval_step = make_eval_step(self.spec)
+                    need_rebuild = False
+                start = 0
+                if checkpointer is not None:
+                    from fm_spark_tpu import checkpoint as ckpt_lib
+
+                    # With a checkpointer, num_steps is a GLOBAL step
+                    # target: a resumed run continues toward it (and a
+                    # finished run is a no-op). Without one, fit() runs
+                    # num_steps more steps.
+                    start = ckpt_lib.resume_or_init(self, checkpointer,
+                                                    batches=source)
+                batches, close_prefetch = wrap_prefetch(source, prefetch)
+                try:
+                    result = self._fit_loop(batches, start, total,
+                                            log_every, checkpointer,
+                                            preemption_guard,
+                                            eval_batches, save)
+                    if supervisor is not None:
+                        supervisor.note_success("train")
+                    return result
+                finally:
+                    close_prefetch()
+            except Exception as e:  # noqa: BLE001 — classified below
+                from fm_spark_tpu.resilience import is_device_loss
+
+                if supervisor is None or not is_device_loss(e):
+                    raise
+                # Device loss: journal + probe + bounded backoff (raises
+                # CircuitOpen after the supervisor's threshold of
+                # consecutive losses), then loop back to rebuild device
+                # state and resume from the latest committed checkpoint.
+                import time as _time
+
+                t_recover = _time.perf_counter()
+                supervisor.recover("train", e)
+                need_rebuild = True
+                # Recovery wall-clock (probe + backoff) must not deflate
+                # the next throughput window — same contract as the
+                # periodic-eval pause. (The rebuild itself is timed into
+                # the next window's pause only via this call on a repeat
+                # failure; its cost is one init + re-jit.)
+                self.logger.add_pause(_time.perf_counter() - t_recover)
 
     def _fit_loop(self, batches, start, total, log_every, checkpointer,
                   preemption_guard, eval_batches, save):
@@ -413,6 +487,10 @@ class FMTrainer:
             if preemption_guard is not None and preemption_guard.should_stop:
                 save(force=True)
                 return self.params
+            # Deterministic mid-step device loss for the recovery tests
+            # (resilience/faults.py); a single is-None check when no
+            # fault plan is active.
+            faults.inject("train_step")
             try:
                 ids, vals, labels, weights = next(it)
             except StopIteration:

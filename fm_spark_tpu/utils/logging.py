@@ -57,3 +57,65 @@ class MetricsLogger:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+class EventLog:
+    """Append-only JSONL health-event journal (resilience subsystem).
+
+    One JSON object per line — ``{"ts": ..., "event": "...", ...}`` —
+    written by :class:`fm_spark_tpu.resilience.Supervisor` for every
+    state transition (attempt / failure / probe / backoff /
+    circuit_open / recovered), so a round's failure handling is a
+    machine-readable artifact instead of scattered stderr prose.
+    Separate from :class:`MetricsLogger`: health events are sparse,
+    schema'd by ``event``, and must never interleave with a consumer's
+    stdout result stream — the default sink is a file only.
+
+    Best-effort by contract: a journal write must never take down the
+    operation it is narrating (same policy as bench.py's incremental
+    artifact writes).
+    """
+
+    def __init__(self, path: str | None = None, stream=None):
+        self._fh = open(path, "a") if path else None
+        self._stream = stream
+
+    def emit(self, event: str, **fields) -> dict:
+        record = {"ts": round(time.time(), 3), "event": event, **fields}
+        try:
+            line = json.dumps(record)
+            if self._stream is not None:
+                print(line, file=self._stream, flush=True)
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+        except (OSError, TypeError, ValueError):
+            # TypeError included: an unserializable field (a numpy/jax
+            # scalar) must degrade to a dropped event, not abort the
+            # recovery path being narrated.
+            pass
+        return record
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse an :class:`EventLog` JSONL file (tools + tests); unparseable
+    lines (a torn tail write) are skipped, not fatal."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,64 @@
+"""Collection-time guard against silently shadowed tests (ISSUE 2).
+
+Round-5 shipped two ``def test_dp_supports_ffm_and_deepfm`` in
+tests/test_parallel.py; Python keeps only the last binding, so the
+stricter @slow loss-equivalence variant was NEVER COLLECTED and its
+coverage silently vanished (VERDICT r5 weak #2 — flake8 F811's exact
+failure mode, but this suite has no lint step in the tier-1 gate). This
+test IS the lint step: it AST-parses every test module and asserts no
+scope defines the same test name twice, so a shadowed test can't recur
+without turning the suite red.
+"""
+
+import ast
+import os
+
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _test_files():
+    return sorted(
+        f for f in os.listdir(TESTS_DIR)
+        if f.startswith("test_") and f.endswith(".py")
+    )
+
+
+def _duplicate_defs(scope_body, scope_name):
+    """Duplicate test_*/Test* definitions within one scope body, plus
+    recursion into class scopes (methods shadow within their class)."""
+    seen: dict[str, int] = {}
+    dups = []
+    for node in scope_body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = node.name
+            if not name.startswith("test"):
+                continue
+        elif isinstance(node, ast.ClassDef):
+            dups.extend(
+                _duplicate_defs(node.body, f"{scope_name}::{node.name}")
+            )
+            name = node.name
+            if not name.startswith("Test"):
+                continue
+        else:
+            continue
+        if name in seen:
+            dups.append(
+                f"{scope_name}: {name!r} defined at line {seen[name]} "
+                f"is shadowed by a redefinition at line {node.lineno} — "
+                "the first definition is silently never collected; "
+                "rename one of them"
+            )
+        seen[name] = node.lineno
+    return dups
+
+
+@pytest.mark.parametrize("filename", _test_files())
+def test_no_duplicate_test_names(filename):
+    path = os.path.join(TESTS_DIR, filename)
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=filename)
+    dups = _duplicate_defs(tree.body, filename)
+    assert not dups, "\n".join(dups)

@@ -1,0 +1,354 @@
+"""Fault-matrix tests for the resilience subsystem (ISSUE 2).
+
+Every observed attachment failure mode — init hang (rc=3 via the bench
+watchdog, covered in tests/test_bench_faults.py), init failure, mid-step
+device loss, SIGTERM — maps to a deterministic injection here, and every
+supervisor transition (retry, backoff delay, probe, circuit open /
+half-open / recovery) plus the health-event journal contents is asserted
+on the CPU backend. The end-to-end training recovery (device loss →
+checkpoint resume with loss continuity) lives at the bottom.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu.resilience import (
+    BackoffPolicy,
+    CircuitOpen,
+    FaultPlan,
+    InjectedDeviceLoss,
+    RetriesExhausted,
+    Supervisor,
+    faults,
+    is_device_loss,
+)
+from fm_spark_tpu.resilience.faults import FaultInjected
+from fm_spark_tpu.utils.logging import EventLog, read_events
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Isolate every test from ambient fault plans and shared state."""
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------- faults.py
+
+
+def test_fault_spec_parses_points_and_occurrences():
+    plan = FaultPlan.from_spec(
+        "backend_init@1=hang:300;sweep_leg@2=device_loss;"
+        "train_step@7=error;probe@1=exit:3"
+    )
+    assert plan.points == {"backend_init", "sweep_leg", "train_step",
+                           "probe"}
+    assert plan.rule_for("sweep_leg", 2).action == "device_loss"
+    assert plan.rule_for("sweep_leg", 1) is None
+    assert plan.rule_for("backend_init", 1).param == "300"
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense", "point@=hang", "point@1=", "point@1=not_an_action",
+    "point=hang",
+])
+def test_fault_spec_rejects_malformed_rules(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.from_spec(bad)
+
+
+def test_inject_fires_at_exact_occurrence_only():
+    faults.activate("p@3=device_loss")
+    faults.inject("p")
+    faults.inject("p")
+    with pytest.raises(InjectedDeviceLoss):
+        faults.inject("p")
+    faults.inject("p")  # occurrence 4: past the rule, quiet again
+    faults.inject("other")  # unrelated point never fires
+
+
+def test_inject_noop_without_plan():
+    faults.inject("anything")  # must be a cheap no-op, not an error
+
+
+def test_occurrence_counters_survive_process_respawn(tmp_path,
+                                                     monkeypatch):
+    """The cross-process state file: a bench parent respawns its child,
+    and 'hang the FIRST init, not every init' must stay expressible."""
+    state = tmp_path / "state.json"
+    monkeypatch.setenv(faults.ENV_STATE, str(state))
+    faults.activate("init@1=error")
+    with pytest.raises(FaultInjected):
+        faults.inject("init")
+    # "New process": fresh in-memory counters, same state file.
+    faults.activate("init@1=error")
+    faults.inject("init")  # persistent occurrence 2 — no fire
+    assert json.loads(state.read_text())["init"] == 2
+
+
+def test_env_plan_loaded_lazily(monkeypatch):
+    monkeypatch.setenv(faults.ENV_PLAN, "envpt@1=device_loss")
+    faults.clear()  # force the env re-read
+    with pytest.raises(InjectedDeviceLoss):
+        faults.inject("envpt")
+
+
+def test_is_device_loss_classification():
+    assert is_device_loss(InjectedDeviceLoss("p", 1))
+    assert is_device_loss(RuntimeError(
+        "INTERNAL: Unable to initialize backend 'tpu'"))
+    assert is_device_loss(RuntimeError("DATA_LOSS: device lost"))
+    # Program bugs must NOT classify as device loss — retrying them
+    # burns the whole deadline re-crashing.
+    assert not is_device_loss(ValueError("shape mismatch [8] vs [4]"))
+    assert not is_device_loss(KeyboardInterrupt())
+    assert not is_device_loss(SystemExit(3))
+
+
+# --------------------------------------------------------- BackoffPolicy
+
+
+def test_backoff_delay_is_bounded_exponential():
+    p = BackoffPolicy(initial=2.0, multiplier=2.0, max_delay=30.0,
+                      jitter=0.0, max_attempts=8)
+    assert [p.delay(k) for k in (1, 2, 3, 4, 5, 6)] == [
+        2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
+
+
+def test_backoff_jitter_is_seeded_deterministic():
+    import random
+
+    p = BackoffPolicy(initial=10.0, jitter=0.1)
+    a = [p.delay(1, random.Random(7)) for _ in range(3)]
+    b = [p.delay(1, random.Random(7)) for _ in range(3)]
+    assert a == b
+    assert all(9.0 <= d <= 11.0 for d in a)
+    assert a[0] != 10.0  # jitter actually applied
+
+
+# ------------------------------------------------------------ Supervisor
+
+
+def _supervisor(tmp_path, *, probe=True, max_attempts=3,
+                breaker_threshold=3):
+    delays = []
+    journal_path = str(tmp_path / "health.jsonl")
+    sup = Supervisor(
+        policy=BackoffPolicy(initial=1.0, multiplier=2.0, jitter=0.0,
+                             max_attempts=max_attempts),
+        journal=EventLog(journal_path),
+        probe=(probe if callable(probe) else (lambda: probe)),
+        breaker_threshold=breaker_threshold,
+        sleep=delays.append,
+    )
+    return sup, delays, journal_path
+
+
+def test_run_retries_device_loss_then_succeeds(tmp_path):
+    sup, delays, journal = _supervisor(tmp_path)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise InjectedDeviceLoss("step", calls["n"])
+        return "ok"
+
+    assert sup.run(flaky, op="leg") == "ok"
+    assert calls["n"] == 3
+    assert delays == [1.0, 2.0]  # exponential, per consecutive failure
+    assert sup.state == "closed" and sup.consecutive_failures == 0
+    events = [e["event"] for e in read_events(journal)]
+    assert events == ["attempt", "failure", "probe", "backoff",
+                      "attempt", "failure", "probe", "backoff",
+                      "attempt"]
+    rec = read_events(journal)[1]
+    assert rec["op"] == "leg" and rec["retryable"] is True
+    assert "InjectedDeviceLoss" in rec["error"]
+
+
+def test_run_does_not_retry_program_errors(tmp_path):
+    sup, delays, journal = _supervisor(tmp_path)
+    calls = {"n": 0}
+
+    def buggy():
+        calls["n"] += 1
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        sup.run(buggy, op="leg")
+    assert calls["n"] == 1 and delays == []
+    assert read_events(journal)[-1]["retryable"] is False
+
+
+def test_run_exhaustion_raises_with_cause_and_counts_op_failure(tmp_path):
+    sup, delays, _ = _supervisor(tmp_path, max_attempts=2)
+
+    def always():
+        raise InjectedDeviceLoss("step", 0)
+
+    with pytest.raises(RetriesExhausted) as exc:
+        sup.run(always, op="leg")
+    assert isinstance(exc.value.__cause__, InjectedDeviceLoss)
+    assert len(delays) == 1  # no backoff after the final attempt
+    assert sup.consecutive_failures == 1
+
+
+def test_circuit_opens_after_consecutive_op_failures(tmp_path):
+    sup, _, journal = _supervisor(tmp_path, probe=False, max_attempts=1,
+                                  breaker_threshold=2)
+
+    def always():
+        raise InjectedDeviceLoss("step", 0)
+
+    for _ in range(2):
+        with pytest.raises(RetriesExhausted):
+            sup.run(always, op="leg")
+    assert sup.state == "open"
+    # Open + unhealthy probe: the operation is rejected WITHOUT running.
+    ran = {"n": 0}
+    with pytest.raises(CircuitOpen):
+        sup.run(lambda: ran.__setitem__("n", 1), op="leg")
+    assert ran["n"] == 0
+    events = [e["event"] for e in read_events(journal)]
+    assert "circuit_open" in events and "circuit_rejected" in events
+
+
+def test_circuit_half_opens_on_healthy_probe_and_closes_on_success(
+        tmp_path):
+    health = {"ok": False}
+    sup, _, journal = _supervisor(tmp_path,
+                                  probe=lambda: health["ok"],
+                                  max_attempts=1, breaker_threshold=1)
+    with pytest.raises(RetriesExhausted):
+        sup.run(lambda: (_ for _ in ()).throw(
+            InjectedDeviceLoss("s", 0)), op="leg")
+    assert sup.state == "open"
+    health["ok"] = True  # attachment recovered
+    assert sup.run(lambda: "back", op="leg") == "back"
+    assert sup.state == "closed" and sup.consecutive_failures == 0
+    events = [e["event"] for e in read_events(journal)]
+    assert "circuit_half_open" in events and "recovered" in events
+
+
+def test_recover_backs_off_then_circuit_breaks(tmp_path):
+    sup, delays, journal = _supervisor(tmp_path, breaker_threshold=3)
+    exc = InjectedDeviceLoss("train", 1)
+    sup.recover("train", exc)
+    sup.recover("train", exc)
+    assert delays == [1.0, 2.0]
+    with pytest.raises(CircuitOpen):
+        sup.recover("train", exc)
+    events = [e["event"] for e in read_events(journal)]
+    assert events.count("backoff") == 2
+    assert events[-1] == "circuit_open"
+
+
+def test_event_log_roundtrip_and_best_effort(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    log = EventLog(path)
+    log.emit("probe", healthy=True)
+    log.emit("backoff", delay_s=1.5, op="leg:x")
+    log.close()
+    with open(path, "a") as f:
+        f.write("{torn line\n")  # a torn tail write must not break reads
+    events = read_events(path)
+    assert len(events) == 2
+    assert events[0]["event"] == "probe" and events[0]["ts"] > 0
+    assert events[1]["delay_s"] == 1.5
+
+
+def test_device_probe_healthy_on_cpu_and_injectable():
+    from fm_spark_tpu.resilience import device_probe
+
+    assert device_probe(timeout=60.0) is True
+    faults.activate("probe@1=device_loss")
+    assert device_probe(timeout=60.0) is False
+
+
+# ------------------------------- end-to-end: training device-loss resume
+
+
+def test_train_device_loss_resumes_with_loss_continuity(tmp_path):
+    """ISSUE 2 acceptance: a training run that loses its device mid-run
+    resumes from checkpoint with step-count and loss continuity — the
+    faulted run's logged losses are EXACTLY the uninterrupted run's
+    (same pipeline cursor replay as kill-and-resume)."""
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.data.synthetic import synthetic_ctr
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    ids, vals, labels = synthetic_ctr(
+        num_examples=256, num_features=64, nnz=5, seed=3)
+    spec = models.FMSpec(num_features=64, rank=4, init_std=0.05)
+    config = TrainConfig(num_steps=10, batch_size=32, learning_rate=0.1,
+                         lr_schedule="constant", log_every=1)
+
+    golden = FMTrainer(spec, config)
+    golden.fit(Batches(ids, vals, labels, config.batch_size, seed=7))
+
+    # Faulted run: device loss at the 6th step call; checkpoints every
+    # 2 steps, so recovery resumes from step 4 and replays 5..10.
+    faults.activate("train_step@6=device_loss")
+    sup = Supervisor(
+        policy=BackoffPolicy(initial=1.0, jitter=0.0),
+        journal=EventLog(str(tmp_path / "health.jsonl")),
+        probe=lambda: True, sleep=lambda s: None,
+    )
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=2,
+                      async_save=False)
+    trainer = FMTrainer(spec, config)
+    trainer.fit(Batches(ids, vals, labels, config.batch_size, seed=7),
+                checkpointer=ck, supervisor=sup)
+    ck.close()
+
+    assert trainer.step_count == golden.step_count == 10
+    assert trainer.loss_history == golden.loss_history  # bit-identical
+    np.testing.assert_array_equal(
+        np.asarray(golden.params["v"]), np.asarray(trainer.params["v"]))
+    events = [e["event"] for e in
+              read_events(str(tmp_path / "health.jsonl"))]
+    assert "failure" in events and "backoff" in events
+    assert "recovered" in events  # note_success after the resumed run
+
+
+def test_supervised_fit_requires_checkpointer():
+    from fm_spark_tpu import models
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.data.synthetic import synthetic_ctr
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    ids, vals, labels = synthetic_ctr(
+        num_examples=64, num_features=32, nnz=4, seed=0)
+    spec = models.FMSpec(num_features=32, rank=2)
+    trainer = FMTrainer(spec, TrainConfig(num_steps=2, batch_size=32))
+    with pytest.raises(ValueError, match="supervised training"):
+        trainer.fit(Batches(ids, vals, labels, 32, seed=1),
+                    supervisor=Supervisor(probe=lambda: True,
+                                          sleep=lambda s: None))
+
+
+def test_checkpointer_reopen_preserves_committed_state(tmp_path):
+    import jax
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+
+    spec = models.FMSpec(num_features=16, rank=2)
+    params = spec.init(jax.random.key(0))
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=1,
+                      async_save=False)
+    ck.save(5, params, {}, {"epoch": 0}, {"loss_history": [0.7]})
+    ck.reopen()  # the device-loss recovery path
+    restored = ck.restore(params, {})
+    assert restored["step"] == 5
+    assert restored["extra"]["loss_history"] == [0.7]
+    ck.close()

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Supervised TPU-attachment watcher: the round-7 replacement for
+tpu_watch.sh's bash poll loop (ISSUE 2).
+
+Same job as rounds 5-6 — poll the flaky attachment, and whenever it
+comes up run the pending on-chip measurements (gfull micro-probe, the
+warm-start headline sweep, then the one-time ffm → deepfm → kaggle →
+b262 queue), keeping the BEST sweep by parsed headline value — but the
+probe/backoff/journal machinery is now the tested
+:mod:`fm_spark_tpu.resilience` subsystem instead of inlined bash:
+
+- the attachment probe is :class:`Supervisor`'s (device enumeration in
+  a CHILD process — a dead attachment hangs/poisons whatever process
+  INITIALIZES a backend, so the watcher itself never does; importing
+  the resilience package does pull in jax, but import alone never
+  touches the attachment — only ``jax.devices()`` does, and that runs
+  in the probe child);
+- down-time polling backs off by :class:`BackoffPolicy` (bounded
+  exponential, deterministic jitter) instead of a fixed ``sleep 45``,
+  resetting when the attachment answers;
+- every transition (probe result, backoff, sweep outcome, queue
+  advance) lands in ``<out>/health.jsonl``
+  (:class:`~fm_spark_tpu.utils.logging.EventLog`) next to the raw
+  captures, so a round's watch history is machine-readable.
+
+The file layout and one-time markers (``ffm_done``/``deepfm_done``/
+``kaggle_done``/``b262_done``, ``bench_sweep.out`` = best sweep) are
+unchanged from the shell version, so existing round tooling keeps
+working. Killed by the builder before round end, same as always.
+
+Usage::
+
+    python tools/tpu_watch.py [deadline_seconds]    # default 36000 (10h)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from fm_spark_tpu.resilience import BackoffPolicy, Supervisor  # noqa: E402
+from fm_spark_tpu.utils.logging import EventLog  # noqa: E402
+
+#: Warm-start flags every bench run gets (round-6: the first healthy
+#: window pays XLA once; every later window deserializes and measures
+#: the recorded winner first).
+BENCH_WARM = ["--fast-first", "--compile-cache"]
+
+#: The one-time measurement queue: (marker_file, bench argv tail,
+#: timeout_s). Each entry runs once the headline has landed, in order,
+#: and is retried in later windows until its output parses a value > 0.
+QUEUE = [
+    ("ffm_done",
+     BENCH_WARM + ["--model", "ffm", "--total-deadline", "900"], 1100),
+    ("deepfm_done",
+     BENCH_WARM + ["--model", "deepfm", "--total-deadline", "900"], 1100),
+    ("kaggle_done",
+     BENCH_WARM + ["--model", "fm_kaggle", "--total-deadline", "900"],
+     1100),
+    # The doubled-batch A/B of the composed winner (provenance-stamped
+    # /b262144 label — by design never updates MEASURED.json).
+    ("b262_done",
+     ["--compile-cache", "--batch", "262144", "--compact-cap", "26624",
+      "--param-dtype", "bfloat16", "--compute-dtype", "bfloat16",
+      "--sparse-update", "dedup_sr", "--host-dedup",
+      "--gfull-fused", "--segtotal-pallas", "--total-deadline", "900"],
+     1100),
+]
+
+
+def best_value(path: str) -> float:
+    """Best parsed ``value`` from a bench output file (-1.0 if none) —
+    the queue gate is a PARSED result, never the exit code (the outer
+    timeout wrapper reports 124 on its own kill no matter what bench
+    salvaged)."""
+    best = -1.0
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                v = d.get("value")
+                if isinstance(v, (int, float)) and v > best:
+                    best = float(v)
+    except OSError:
+        pass
+    return best
+
+
+class TpuWatch:
+    """The watch loop, with every external effect injectable so the
+    policy logic unit-tests without a device, a bench run, or
+    wall-clock (tests/test_tpu_watch.py)."""
+
+    def __init__(self, out_dir: str, deadline_s: float,
+                 runner=None, probe=None, sleep=time.sleep,
+                 clock=time.monotonic, journal=None,
+                 policy: BackoffPolicy | None = None):
+        self.out = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.deadline = clock() + deadline_s
+        self.sleep = sleep
+        self.clock = clock
+        self.journal = journal if journal is not None else EventLog(
+            os.path.join(out_dir, "health.jsonl"))
+        # Down-time poll cadence: starts near the shell loop's 45s and
+        # backs off toward 3 min — a long outage stops burning CPU on
+        # this single-core VM, while the jitter keeps restarts from
+        # synchronizing; resets the moment the attachment answers.
+        self.policy = policy or BackoffPolicy(
+            initial=45.0, multiplier=1.5, max_delay=180.0, jitter=0.1)
+        self.sup = Supervisor(policy=self.policy, journal=self.journal,
+                              probe=probe or self._probe_attachment,
+                              sleep=sleep)
+        self.runner = runner or self._run_cmd
+        self.best_val = -1.0
+        self.down_streak = 0
+
+    # ---------------------------------------------------- external effects
+
+    def _probe_attachment(self) -> bool:
+        """Cheap probe in a CHILD process: device enumeration returns in
+        seconds when healthy; 75 s is generous for a cold backend init,
+        and a hang (the observed dead-attachment mode) only costs the
+        child."""
+        try:
+            rc = subprocess.run(
+                [sys.executable, "-c", "import jax; assert jax.devices()"],
+                timeout=75, cwd=_REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ).returncode
+            return rc == 0
+        except subprocess.TimeoutExpired:
+            return False
+
+    def _run_cmd(self, argv: list, timeout_s: int, out_path: str,
+                 err_path: str) -> int:
+        """Run one measurement command, stdout/stderr to files (the
+        audit trail the shell version kept); a timeout is rc 124 like
+        timeout(1).
+
+        Timeout delivery matters: like timeout(1) — and unlike
+        ``subprocess.run(timeout=)``, whose expiry SIGKILLs — the first
+        signal is SIGTERM, because bench.py's handler needs to run: it
+        kills bench's own inner measurement child (an orphan would keep
+        holding the exclusive TPU attachment and poison every later
+        window) and emits the salvaged best-so-far line. SIGKILL only
+        after a grace period."""
+        with open(out_path, "w") as out, open(err_path, "w") as err:
+            proc = subprocess.Popen(
+                [sys.executable] + argv, cwd=_REPO,
+                stdout=out, stderr=err,
+            )
+            try:
+                return proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                return 124
+
+    # -------------------------------------------------------- window work
+
+    def _bench(self, name: str, argv_tail: list, timeout_s: int) -> float:
+        out = os.path.join(self.out, f"{name}.out")
+        err = os.path.join(self.out, f"{name}.err")
+        rc = self.runner(["bench.py"] + argv_tail, timeout_s, out, err)
+        val = best_value(out)
+        self.journal.emit("bench_done", name=name, rc=rc, value=val)
+        return val
+
+    def measure_window(self) -> None:
+        """One healthy-window pass: gfull micro-probe once, headline
+        sweep keep-best, then the one-time queue in order."""
+        ts = time.strftime("%H%M%S", time.gmtime())
+        gfull = os.path.join(self.out, "gfull_probe.jsonl")
+        if not (os.path.exists(gfull) and os.path.getsize(gfull)):
+            rc = self.runner(
+                ["bench_micro.py", "gfull"], 900, gfull,
+                os.path.join(self.out, "gfull_probe.err"))
+            self.journal.emit("gfull_probe", rc=rc)
+
+        val = self._bench(
+            f"sweep_{ts}",
+            BENCH_WARM + ["--total-deadline", "1500"], 1700)
+        headline_ok = val > 0
+        if val > self.best_val:
+            # Keep the BEST sweep across windows: a later, healthier
+            # window replaces an early throttled one.
+            self.best_val = val
+            for ext in (".out", ".err"):
+                src = os.path.join(self.out, f"sweep_{ts}{ext}")
+                dst = os.path.join(self.out, f"bench_sweep{ext}")
+                try:
+                    with open(src, "rb") as s, open(dst, "wb") as d:
+                        d.write(s.read())
+                except OSError:
+                    pass
+            self.journal.emit("new_best_sweep", value=val)
+
+        if not headline_ok:
+            return
+        for marker, argv_tail, timeout_s in QUEUE:
+            mpath = os.path.join(self.out, marker)
+            if os.path.exists(mpath):
+                continue
+            qval = self._bench(marker.removesuffix("_done") + "_sweep",
+                               argv_tail, timeout_s)
+            if qval > 0:
+                with open(mpath, "w"):
+                    pass
+                self.journal.emit("queue_advanced", marker=marker,
+                                  value=qval)
+            # One queue entry per window beyond the first failure: a
+            # value<=0 means the window flapped mid-queue — stop and
+            # let the next healthy window retry this entry.
+            if qval <= 0:
+                return
+
+    def queue_drained(self) -> bool:
+        return os.path.exists(os.path.join(self.out, QUEUE[-1][0]))
+
+    # --------------------------------------------------------------- loop
+
+    def watch(self) -> float:
+        self.journal.emit("watch_start",
+                          deadline_s=round(self.deadline - self.clock()))
+        while self.clock() < self.deadline:
+            if self.sup.probe():
+                self.down_streak = 0
+                self.sup.note_success("attachment")
+                self.measure_window()
+                # Queue drained → keep-best re-sweeps only: back WAY
+                # off so the watcher stops contending with the
+                # builder's CPU work; while draining, re-probe quickly.
+                self.sleep(1500 if self.queue_drained() else 120)
+            else:
+                self.down_streak += 1
+                delay = self.policy.delay(self.down_streak,
+                                          self.sup._rng)
+                self.journal.emit("down", streak=self.down_streak,
+                                  next_probe_s=round(delay, 1))
+                self.sleep(delay)
+        self.journal.emit("watch_end", best=self.best_val)
+        return self.best_val
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    deadline = float(args[0]) if args else 36000.0
+    watch = TpuWatch(os.path.join(_REPO, "tpu_watch_out"), deadline)
+    watch.watch()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
