@@ -348,6 +348,12 @@ def _completed_legs(art_dir, model, labels, device_kind,
                         continue
                     if float(rec.get("ts") or 0.0) < since:
                         continue
+                    if rec.get("degraded"):
+                        # A shrunk-denominator salvage rate must not
+                        # ride a resume into an undegraded payload —
+                        # the restarted process may have full capacity
+                        # back, so the leg is simply re-measured.
+                        continue
                     out[rec["variant"]] = rec
                 except (AttributeError, TypeError, ValueError):
                     continue
@@ -412,15 +418,33 @@ def _last_measured_block():
         return None
 
 
-def _error_line(msg):
+def _error_line(msg, permanent=None):
     payload = {
         "metric": METRIC, "value": None, "unit": UNIT,
         "vs_baseline": None, "error": msg,
     }
+    if permanent:
+        # The parent's fault classifier concluded the attachment is
+        # DEAD (N identical consecutive failures), not flapping —
+        # downstream consumers should reschedule, not retry.
+        payload["permanent"] = True
     last = _last_measured_block()
     if last is not None:
         payload["last_measured"] = last
     return json.dumps(payload)
+
+
+def _classify_diags(diags, threshold=3):
+    """Transient-vs-permanent verdict over the parent's child-failure
+    diagnostics (resilience/elastic.py's classifier; lazy import so the
+    happy path never pays it, best-effort so classification can never
+    break the final-line contract)."""
+    try:
+        from fm_spark_tpu.resilience.elastic import classify_failures
+
+        return classify_failures(diags, threshold)
+    except Exception:
+        return "transient"
 
 
 def inner_main(args):
@@ -437,6 +461,7 @@ def inner_main(args):
     from fm_spark_tpu.resilience import (
         BackoffPolicy,
         CircuitOpen,
+        RetriesExhausted,
         Supervisor,
         faults,
         is_device_loss,
@@ -687,6 +712,21 @@ def inner_main(args):
                              max_attempts=3),
         journal=journal, breaker_threshold=3,
     )
+    # Elastic degraded mode (ISSUE 4): when a leg's retries exhaust on a
+    # PERMANENT fault (identical consecutive device losses — dead
+    # capacity, not a flap), shed chips instead of abandoning the sweep:
+    # the controller halves the device set, the breaker re-arms, the leg
+    # re-runs, and every subsequent rate is normalized per SURVIVING
+    # chip with the payload stamped degraded — a measured result on a
+    # shrunk mesh instead of an error-only artifact.
+    elastic = None
+    if args.elastic:
+        from fm_spark_tpu.resilience import ElasticController
+
+        elastic = ElasticController(devices=devs,
+                                    max_shrinks=args.max_shrinks,
+                                    journal=journal)
+    n_chips = len(devs)
 
     t_first_result = None  # wall-clock to the FIRST emitted result
     results = []
@@ -718,6 +758,11 @@ def inner_main(args):
         }
         if resumed:
             payload["resumed_legs"] = len(resumed)
+        if elastic is not None and elastic.degraded:
+            # A shrunk-mesh rate must never masquerade as a full-mesh
+            # one: stamp the degraded provenance (chips = the surviving
+            # count the per-chip rate is normalized to).
+            payload.update(elastic.summary())
         print(json.dumps(payload), flush=True)
         return payload
 
@@ -869,23 +914,71 @@ def inner_main(args):
         # this attachment a dead backend hangs rather than raises — so
         # that mode stays the parent watchdog's job: attempt timeout →
         # kill → respawn → auto --resume-sweep of the banked legs.
-        try:
-            dt, final_loss = sup.run(measure, op=f"leg:{label}",
-                                     retryable=is_device_loss)
-        except CircuitOpen as e:
-            _log(f"[inner] circuit open ({e}) -- abandoning the "
-                 "remaining legs; completed measurements still count")
+        outcome = None
+        while outcome is None:
+            try:
+                dt, final_loss = sup.run(measure, op=f"leg:{label}",
+                                         retryable=is_device_loss)
+                outcome = "ok"
+            except (CircuitOpen, RetriesExhausted) as e:
+                if (elastic is not None and sup.permanent()
+                        and elastic.can_shrink()):
+                    # Permanent fault + capacity to shed: degrade
+                    # instead of abandoning. The shrink is journaled,
+                    # the breaker re-arms, and the SAME leg re-runs.
+                    # What the shrink changes here is the ACCOUNTING,
+                    # not the placement: the leg is a single-process
+                    # measurement whose per-chip rate divides by the
+                    # fleet the result claims to represent, so the
+                    # denominator drops to the surviving count and the
+                    # payload is stamped degraded (and never keep-bests
+                    # into MEASURED.json). A fresh retry window is the
+                    # other half of the value — bounded by max_shrinks,
+                    # so a default device that is truly dead still
+                    # abandons after the ladder is spent.
+                    prev_chips = n_chips
+                    n_chips = len(elastic.shrink(f"leg:{label}"))
+                    # Keep every banked rate on ONE denominator: legs
+                    # measured before the shrink re-normalize to the
+                    # surviving count, so max() ranks variants on
+                    # comparable per-chip figures instead of letting a
+                    # post-shrink leg win on a 2x smaller divisor.
+                    results[:] = [
+                        (r * prev_chips / n_chips, lb, d, fl)
+                        for r, lb, d, fl in results
+                    ]
+                    sup.reset(f"leg:{label}")
+                    _log(f"[inner] [{label}] permanent device fault -- "
+                         f"degraded mode: retrying on {n_chips} chip(s) "
+                         f"(shrink {elastic.shrinks}/{elastic.max_shrinks})")
+                    continue
+                if isinstance(e, CircuitOpen):
+                    _log(f"[inner] circuit open ({e}) -- abandoning the "
+                         "remaining legs; completed measurements still "
+                         "count")
+                    outcome = "abandon"
+                else:
+                    # A device loss that exhausted its retries (mixed
+                    # failure modes, or no elastic capacity left); its
+                    # history is in the health journal.
+                    _log(f"[inner] [{label}] FAILED "
+                         f"({type(e).__name__}): "
+                         f"{(str(e).splitlines() or [''])[0][:200]}"
+                         " -- skipping variant")
+                    outcome = "skip"
+            except Exception as e:  # noqa: BLE001 — one broken variant
+                # (e.g. a Mosaic lowering reject, round 5's segtotal
+                # block-spec ValueError) must not kill the remaining
+                # A/Bs; the parent's retry would re-crash on the same
+                # variant and the sweep would never price the rest.
+                # Hangs are the watchdog's job.
+                _log(f"[inner] [{label}] FAILED ({type(e).__name__}): "
+                     f"{(str(e).splitlines() or [''])[0][:200]}"
+                     " -- skipping variant")
+                outcome = "skip"
+        if outcome == "abandon":
             break
-        except Exception as e:  # noqa: BLE001 — one broken variant (e.g.
-            # a Mosaic lowering reject, round 5's segtotal block-spec
-            # ValueError) must not kill the remaining A/Bs; the parent's
-            # retry would re-crash on the same variant and the sweep
-            # would never price the rest. Hangs are the watchdog's job;
-            # a device loss that exhausted its retries lands here as
-            # RetriesExhausted with its history in the health journal.
-            _log(f"[inner] [{label}] FAILED ({type(e).__name__}): "
-                 f"{(str(e).splitlines() or [''])[0][:200]}"
-                 " -- skipping variant")
+        if outcome == "skip":
             continue
         if not np.isfinite(final_loss):
             # compact_device signals cap overflow by POISONING the loss
@@ -897,7 +990,7 @@ def inner_main(args):
                  f"({final_loss}) — overflow/divergence poison; "
                  "skipping variant")
             continue
-        rate = steps_timed * batch / dt / jax.device_count()
+        rate = steps_timed * batch / dt / n_chips
         results.append((rate, label, dt, final_loss))
         _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "
              f"(dt={dt:.3f}s loss={final_loss:.4f})")
@@ -913,21 +1006,27 @@ def inner_main(args):
         # reports null when any leg completed. ``ts`` stamps the record
         # so --resume-since can tell THIS run's legs from a prior
         # round's.
-        _persist_incremental(art_dir, args.model, payload, {
+        leg_record = {
             "variant": label, "value": round(rate, 1), "unit": UNIT,
             "dt_s": round(dt, 3), "loss": round(final_loss, 6),
             "device": devs[0].device_kind,
             "ts": round(time.time(), 3),
             "t_since_start_s": round(time.perf_counter() - t_start, 1),
-        })
+        }
+        if elastic is not None and elastic.degraded:
+            leg_record["chips"] = n_chips
+            leg_record["degraded"] = True
+        _persist_incremental(art_dir, args.model, payload, leg_record)
 
     if not results:
         _log("[inner] every variant failed; no measurement")
         return 1
     rate, label, dt, final_loss = max(results)
     _log(f"[inner] device={devs[0].device_kind} "
-         f"chips={jax.device_count()} best={label} batch={batch} "
-         f"steps={steps_timed} dt={dt:.3f}s loss={final_loss:.4f}")
+         f"chips={n_chips} best={label} batch={batch} "
+         f"steps={steps_timed} dt={dt:.3f}s loss={final_loss:.4f}"
+         + (f" DEGRADED (shrinks={elastic.shrinks})"
+            if elastic is not None and elastic.degraded else ""))
     return 0
 
 
@@ -943,7 +1042,8 @@ def inner_main(args):
 # child would keep holding the exclusive TPU attachment). RLock: the
 # handler runs on the main thread, which may already hold the lock when
 # the signal lands.
-_SALVAGE = {"line": None, "failures": [], "emitted": False, "proc": None}
+_SALVAGE = {"line": None, "failures": [], "emitted": False, "proc": None,
+            "permanent": False}
 _SALVAGE_LOCK = threading.RLock()
 
 
@@ -985,6 +1085,14 @@ def _emit_final():
                         f"non-default-shape variant "
                         f"{parsed.get('variant')!r}; not comparable with "
                         "the recorded default-shape rate")
+                # A degraded (shrunk-mesh) rate is a salvage artifact,
+                # not the attachment's measured capability — it must
+                # never become the recorded keep-best.
+                if parsed.get("degraded"):
+                    raise RuntimeError(
+                        f"degraded measurement on {parsed.get('chips')} "
+                        "chip(s) after an elastic shrink; keeping the "
+                        "recorded full-mesh rate")
                 # Keep-best: MEASURED.json records the best measured
                 # on-chip capability. A later throttled window (this
                 # attachment streams at 5-10% of nominal HBM on bad
@@ -1021,7 +1129,9 @@ def _emit_final():
                 _log(f"[parent] MEASURED.json update failed: {e!r}")
         else:
             print(_error_line("; ".join(_SALVAGE["failures"])
-                              or "no attempt completed"), flush=True)
+                              or "no attempt completed",
+                              permanent=_SALVAGE["permanent"]),
+                  flush=True)
 
 
 def _parse_result_line(line):
@@ -1072,8 +1182,11 @@ def _run_attempt(argv, timeout_s):
     def heartbeat():
         t0 = time.perf_counter()
         while not hb_stop.wait(30):
-            _log(f"[parent] attempt alive, {time.perf_counter() - t0:.0f}s "
-                 f"elapsed (timeout {timeout_s}s)")
+            # One-decimal durations everywhere a duration is
+            # interpolated: BENCH_r05's tail printed the raw float
+            # ("timeout 125.98949042700042s").
+            _log(f"[parent] attempt alive, {time.perf_counter() - t0:.1f}s "
+                 f"elapsed (timeout {timeout_s:.1f}s)")
 
     hb = threading.Thread(target=heartbeat, daemon=True)
     hb.start()
@@ -1164,6 +1277,19 @@ def main():
                          "drops from minutes to seconds. "
                          "FM_SPARK_COMPILE_CACHE=<dir|1> without the "
                          "flag")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic degraded mode: a sweep leg whose "
+                         "retries exhaust on a PERMANENT fault (N "
+                         "identical consecutive device losses) sheds "
+                         "chips (8>4>2>1) and re-runs instead of "
+                         "abandoning the sweep; the result JSON is "
+                         "stamped degraded with per-surviving-chip "
+                         "normalization, and never keep-bests into "
+                         "MEASURED.json")
+    ap.add_argument("--max-shrinks", type=int, default=3,
+                    dest="max_shrinks",
+                    help="with --elastic: how many times the device "
+                         "set may halve before the fault propagates")
     ap.add_argument("--resume-sweep", action="store_true",
                     dest="resume_sweep",
                     help="skip sweep legs already completed in "
@@ -1265,6 +1391,8 @@ def main():
         argv.append("--segtotal-pallas")
     if args.fast_first:
         argv.append("--fast-first")
+    if args.elastic:
+        argv += ["--elastic", "--max-shrinks", str(args.max_shrinks)]
     if args.compile_cache is not None:
         argv.append("--compile-cache")
         if args.compile_cache:
@@ -1299,6 +1427,7 @@ def main():
 
     deadline = time.perf_counter() + args.total_deadline
     t_epoch = time.time()  # auto-resume cutoff: only THIS run's legs
+    raw_diags = []  # un-prefixed child failure diags for classification
     for attempt in range(1, args.attempts + 1):
         remaining = deadline - time.perf_counter()
         if remaining < 90:
@@ -1332,9 +1461,25 @@ def main():
                 _SALVAGE["line"] = line
             _emit_final()
             return 0
+        raw_diags.append(diag)
         with _SALVAGE_LOCK:
             _SALVAGE["failures"].append(f"attempt {attempt}: {diag}")
         _log(f"[parent] {diag}")
+        # Transient-vs-permanent classification (ISSUE 4 satellite — the
+        # BENCH_r05 failure mode: six supervised attempts burned against
+        # a permanently dead attachment): N identical consecutive child
+        # failures mean the attachment is DEAD, so re-spawning and
+        # re-sleeping the remaining attempts only burns the deadline.
+        if _classify_diags(raw_diags, threshold=3) == "permanent":
+            with _SALVAGE_LOCK:
+                _SALVAGE["permanent"] = True
+                _SALVAGE["failures"].append(
+                    f"classified permanent after {len(raw_diags)} "
+                    "identical consecutive failures -- abandoning the "
+                    f"{args.attempts - attempt} remaining attempt(s)")
+            _log(f"[parent] permanent fault: {len(raw_diags)} identical "
+                 "consecutive failures -- stopping retries")
+            break
         # Provisional artifact NOW: if the outer window kills us later,
         # the last stdout line is already parseable.
         with _SALVAGE_LOCK:
@@ -1343,9 +1488,16 @@ def main():
                 f"{attempt}: " + "; ".join(_SALVAGE["failures"])),
                 flush=True)
         if attempt < args.attempts:
+            if _classify_diags(raw_diags, threshold=2) == "permanent":
+                # Two identical failures already: suspected permanent.
+                # The next attempt is the cheap confirmation probe —
+                # spend the budget on it, not on a backoff sleep.
+                _log("[parent] identical consecutive failures -- "
+                     "skipping backoff (suspected permanent fault)")
+                continue
             backoff = min(10 * attempt, max(0, deadline - time.perf_counter() - 90))
             if backoff > 0:
-                _log(f"[parent] backing off {backoff:.0f}s before retry "
+                _log(f"[parent] backing off {backoff:.1f}s before retry "
                      "(flaky TPU attachment)")
                 time.sleep(backoff)
 

@@ -14,27 +14,120 @@ pipeline's (seed, epoch, index) cursor is saved alongside the arrays, and
 (data/pipeline.py). The kill-and-resume integration test asserts exactly
 this loss-curve continuity (tests/test_checkpoint.py).
 
+Crash-consistent chain (ISSUE 4): "the last good checkpoint" is a
+GUARANTEE here, not a hope. Orbax already commits each step directory
+atomically (write-then-rename), but commit is not verification — a
+SIGKILL can land between the data commit and anything that vouches for
+it, and bytes on a flaky attachment's disk can rot. So every committed
+save additionally gets a MANIFEST (per-array crc32 checksums of the
+exact state handed to ``save``, written atomically as
+``manifests/<step>.json``) and only a manifest-verified step may become
+the persisted ``last_good`` pointer (``last_good.json``, atomic
+replace). :meth:`Checkpointer.restore` walks the chain newest-first:
+a step whose manifest is missing (torn save) or whose restored bytes
+mismatch their checksums (corruption) is skipped — with a journal
+event, never an exception — until the newest verified step restores.
+The divergence guard and the elastic mesh-shrink path both resume
+through exactly this ``last_good`` contract.
+
 Final-model export (the reference's ``FMModel.save``) is separate and
 lighter: :mod:`fm_spark_tpu.models.io`.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import threading
+import time
+import zlib
 from typing import Any
 
 import orbax.checkpoint as ocp
 
+from fm_spark_tpu.resilience import faults
+
+
+def _tree_checksums(state) -> dict | None:
+    """Per-leaf crc32 of the exact state handed to ``save`` — the
+    manifest's byte-level identity. Keyed by tree path (the examples pin
+    the structure at restore, so keys round-trip). Returns None when a
+    leaf cannot be materialized on this host (multi-process sharded
+    arrays own only local shards): the manifest then records commit
+    verification without byte checksums instead of failing the save."""
+    import jax
+    import numpy as np
+
+    try:
+        leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+        out = {}
+        for path, leaf in leaves:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            try:
+                # Hash the host buffer in place — tobytes() would make
+                # a SECOND full copy of each multi-GB table per save.
+                buf = memoryview(arr).cast("B")
+            except (TypeError, ValueError):
+                buf = arr.tobytes()
+            out[jax.tree_util.keystr(path)] = (
+                f"{arr.dtype.str}:{arr.shape}:{zlib.crc32(buf):08x}"
+            )
+        return out
+    except Exception:
+        return None
+
+
+def _meta_crc(meta: dict) -> str | None:
+    """Checksum of the JSON meta block (pipeline cursor + extra) over
+    its canonical serialization — the same bytes orbax round-trips."""
+    try:
+        return f"{zlib.crc32(json.dumps(meta, sort_keys=True).encode()):08x}"
+    except (TypeError, ValueError):
+        return None
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class CheckpointChainBroken(RuntimeError):
+    """Checkpoints exist but NONE passed verification (every step torn
+    or corrupt). Restarting from scratch silently would discard the
+    operator's training budget without telling them — surface it."""
+
 
 class Checkpointer:
-    """Orbax-backed training-state checkpointer.
+    """Orbax-backed training-state checkpointer with a crash-consistent
+    verification chain.
 
     Saves are asynchronous by default (the next train step overlaps the
     write). ``save_every`` gives steady-state cadence; :meth:`save` with
     ``force=True`` writes regardless (used for the preemption flush and
     the final step).
+
+    Chain semantics (ISSUE 4): orbax's own step commit is atomic
+    (write-then-rename), and on top of that every committed save gets a
+    per-save MANIFEST with array checksums, written atomically AFTER the
+    data commit; the persisted ``last_good`` pointer advances only to
+    manifest-verified steps. :meth:`restore` trusts nothing it cannot
+    verify: a torn latest save (manifest missing) or a corrupt one
+    (checksum mismatch, unreadable bytes) is skipped and the chain walks
+    back to the newest verified step.
+
+    Cost: ``verify="checksum"`` (the default) materializes the state on
+    host and CRCs it ON THE TRAINING THREAD at each cadence save — a
+    second full d2h pass beside orbax's own copy. That is the price of
+    byte-level verification; runs whose tables are large enough for it
+    to bite (or whose leaves must not be host-gathered at all — the
+    ``--ckpt-sharded`` live mesh arrays) pass ``verify="commit"``:
+    manifests without checksums, keeping torn-save detection and the
+    ``last_good`` contract while skipping the byte pass.
 
     Usage::
 
@@ -52,6 +145,8 @@ class Checkpointer:
         save_every: int = 1000,
         max_to_keep: int = 3,
         async_save: bool = True,
+        journal=None,
+        verify: str = "checksum",
     ):
         # orbax requires absolute paths; with async saves a relative path
         # fails in a background thread, long after training moved on.
@@ -59,6 +154,21 @@ class Checkpointer:
         self.save_every = int(save_every)
         self._max_to_keep = int(max_to_keep)
         self._async_save = bool(async_save)
+        if verify not in ("checksum", "commit"):
+            raise ValueError(
+                f"verify must be 'checksum' or 'commit', got {verify!r}"
+            )
+        # 'checksum' records per-array crc32s (full byte verification at
+        # restore). 'commit' records the manifest without checksums —
+        # torn-save detection only — for states whose leaves must not be
+        # host-gathered at save time (--ckpt-sharded live mesh arrays).
+        self._verify = verify
+        # Optional EventLog: verification outcomes (torn/corrupt skips,
+        # last_good advances) are health events, not stdout noise.
+        self.journal = journal
+        # Manifests for saves whose orbax commit has not been observed
+        # yet (async): flushed at the next save boundary / wait / close.
+        self._pending: list[tuple[int, dict]] = []
         self._mgr = self._make_mgr()
 
     def _make_mgr(self):
@@ -85,9 +195,110 @@ class Checkpointer:
         except Exception:
             pass
         self._mgr = self._make_mgr()
+        # A save whose DATA committed before the fault is verifiable
+        # NOW: flush its pending manifest so recovery resumes from it
+        # instead of walking back a full checkpoint window (the
+        # walk-back must skip genuinely torn saves, not ones the crash
+        # merely left unverified in memory). Best-effort — an
+        # unflushable manifest just means the older verified step wins.
+        try:
+            self._flush_pending()
+        except Exception:
+            pass
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    # ------------------------------------------------- verification chain
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.emit(event, **fields)
+
+    @property
+    def _manifest_dir(self) -> str:
+        return os.path.join(self.directory, "manifests")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir, f"{int(step)}.json")
+
+    @property
+    def _last_good_path(self) -> str:
+        return os.path.join(self.directory, "last_good.json")
+
+    def _chain_active(self) -> bool:
+        """Has THIS directory ever written a manifest? Legacy dirs
+        (pre-chain saves) restore without verification; once the chain
+        exists, an unmanifested step newer than ``last_good`` is a torn
+        save, never a trusted one."""
+        try:
+            return any(f.endswith(".json")
+                       for f in os.listdir(self._manifest_dir))
+        except OSError:
+            return False
+
+    def _read_manifest(self, step: int) -> dict | None:
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def last_good_step(self) -> int | None:
+        """The persisted last VERIFIED step — advanced only after a
+        save's data commit was observed and its manifest written."""
+        try:
+            with open(self._last_good_path) as f:
+                step = json.load(f).get("step")
+            return int(step) if step is not None else None
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def _flush_pending(self) -> None:
+        """Commit manifests (then ``last_good``) for saves whose orbax
+        step directory has landed. Called with no save in flight — the
+        save/wait/close boundaries — so membership in ``all_steps()`` IS
+        the commit observation. Crash windows are safe at every point:
+        before the manifest write the step is simply unverified (restore
+        walks past it); the manifest and pointer writes are atomic."""
+        if not self._pending:
+            return
+        committed = set(self._mgr.all_steps())
+        still = []
+        for step, manifest in self._pending:
+            if step not in committed:
+                still.append((step, manifest))
+                continue
+            # Deterministic crash point for the SIGKILL-mid-save test:
+            # data committed, manifest not yet written = a torn save the
+            # chain must never reference.
+            faults.inject("ckpt_commit")
+            os.makedirs(self._manifest_dir, exist_ok=True)
+            _atomic_write_json(self._manifest_path(step), manifest)
+            prev = self.last_good_step()
+            if prev is None or step > prev:
+                _atomic_write_json(self._last_good_path,
+                                   {"step": step,
+                                    "ts": round(time.time(), 3)})
+            self._emit("checkpoint_verified", step=step,
+                       last_good=max(step, prev or step))
+        self._pending = still
+        # Manifest hygiene: drop manifests for steps orbax has garbage-
+        # collected (max_to_keep), so the chain directory tracks the
+        # data directory instead of growing forever.
+        pending_steps = {s for s, _ in self._pending}
+        try:
+            for fname in os.listdir(self._manifest_dir):
+                if not fname.endswith(".json"):
+                    continue
+                try:
+                    s = int(fname[:-5])
+                except ValueError:
+                    continue
+                if s not in committed and s not in pending_steps:
+                    os.unlink(os.path.join(self._manifest_dir, fname))
+        except OSError:
+            pass
 
     def due_window(self, step: int, window: int) -> bool:
         """True iff a save-multiple falls in ``(step - window, step]`` —
@@ -115,8 +326,24 @@ class Checkpointer:
              pipeline_state: dict | None = None,
              extra: dict | None = None, force: bool = False) -> bool:
         meta: dict[str, Any] = {"pipeline": pipeline_state, "extra": extra}
+        # Boundary discipline for the chain: the previous async save (if
+        # any) must have committed before a new one starts, which makes
+        # this the safe point to flush its manifest. The async overlap
+        # that matters — serialization riding under the training steps
+        # between two save boundaries — is preserved.
+        self._mgr.wait_until_finished()
+        self._flush_pending()
+        manifest = {
+            "step": int(step),
+            "checksums": (
+                _tree_checksums({"params": params, "opt_state": opt_state})
+                if self._verify == "checksum" else None
+            ),
+            "meta_crc": _meta_crc(meta),
+            "ts": round(time.time(), 3),
+        }
         try:
-            return self._mgr.save(
+            saved = self._mgr.save(
                 int(step),
                 args=ocp.args.Composite(
                     state=ocp.args.StandardSave(
@@ -130,19 +357,15 @@ class Checkpointer:
             # A cadence save already committed this step; training state at
             # a given step is unique, so the existing checkpoint IS this one.
             return True
+        if saved:
+            self._pending.append((int(step), manifest))
+            if not self._async_save:
+                # Sync saves have already committed — verify immediately
+                # so last_good never lags a completed synchronous write.
+                self._flush_pending()
+        return saved
 
-    def restore(self, params_example, opt_state_example,
-                step: int | None = None):
-        """Restore the latest (or given) step.
-
-        The examples pin the pytree structure so optax NamedTuple states
-        come back as the right types, not dicts. Returns ``None`` if no
-        checkpoint exists, else a dict with keys ``params, opt_state,
-        step, pipeline, extra``.
-        """
-        step = self.latest_step() if step is None else int(step)
-        if step is None:
-            return None
+    def _restore_step(self, step: int, params_example, opt_state_example):
         example = {"params": params_example, "opt_state": opt_state_example}
         restored = self._mgr.restore(
             step,
@@ -155,17 +378,106 @@ class Checkpointer:
         return {
             "params": restored.state["params"],
             "opt_state": restored.state["opt_state"],
-            "step": step,
+            "step": int(step),
             "pipeline": meta.get("pipeline"),
             "extra": meta.get("extra"),
         }
 
+    def _verified(self, step: int, result: dict, manifest: dict) -> bool:
+        """Do the restored bytes match the manifest recorded at save?"""
+        checks = manifest.get("checksums")
+        if checks is not None:
+            got = _tree_checksums({"params": result["params"],
+                                   "opt_state": result["opt_state"]})
+            if got != checks:
+                return False
+        want_meta = manifest.get("meta_crc")
+        if want_meta is not None:
+            got_meta = _meta_crc({"pipeline": result["pipeline"],
+                                  "extra": result["extra"]})
+            if got_meta != want_meta:
+                return False
+        return True
+
+    def restore(self, params_example, opt_state_example,
+                step: int | None = None):
+        """Restore the newest VERIFIED step (or exactly ``step``).
+
+        The examples pin the pytree structure so optax NamedTuple states
+        come back as the right types, not dicts. Returns ``None`` if no
+        checkpoint exists, else a dict with keys ``params, opt_state,
+        step, pipeline, extra``.
+
+        Walk-back contract (ISSUE 4): the newest step is restored only
+        if it verifies — its manifest exists (else it is a torn save)
+        and the restored arrays match the recorded checksums (else it is
+        corrupt). A failing step is skipped with a journal event and the
+        next-older one is tried, down the chain. Directories predating
+        the manifest chain restore unverified (legacy behavior). If
+        checkpoints exist but NONE verifies, :class:`CheckpointChainBroken`
+        is raised — silently restarting from scratch would discard the
+        run's progress without telling anyone. An explicit ``step``
+        bypasses the walk-back (the caller asked for exactly that step)
+        but still fails loudly on checksum mismatch.
+        """
+        if step is not None:
+            result = self._restore_step(int(step), params_example,
+                                        opt_state_example)
+            manifest = self._read_manifest(int(step))
+            if manifest is not None and not self._verified(int(step),
+                                                           result, manifest):
+                raise CheckpointChainBroken(
+                    f"checkpoint step {step} fails its manifest checksums "
+                    "(corrupt bytes); pick another step or restore without "
+                    "an explicit step to walk back automatically"
+                )
+            return result
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            return None
+        chain_active = self._chain_active()
+        last_good = self.last_good_step()
+        for s in steps:
+            manifest = self._read_manifest(s)
+            if manifest is None:
+                if chain_active and (last_good is None or s > last_good):
+                    # Data committed but never verified — the torn-save
+                    # window (e.g. SIGKILL between commit and manifest).
+                    self._emit("checkpoint_unverified_skipped", step=s)
+                    continue
+                # Legacy (pre-chain) step: restore without verification.
+            try:
+                result = self._restore_step(s, params_example,
+                                            opt_state_example)
+            except Exception as e:  # noqa: BLE001 — unreadable bytes are
+                # exactly the condition the walk-back exists for
+                self._emit("checkpoint_unreadable", step=s,
+                           error=f"{type(e).__name__}: "
+                                 f"{(str(e).splitlines() or [''])[0][:200]}")
+                continue
+            if manifest is not None and not self._verified(s, result,
+                                                           manifest):
+                self._emit("checkpoint_corrupt", step=s)
+                continue
+            if s != steps[0]:
+                self._emit("checkpoint_walked_back", from_step=steps[0],
+                           to_step=s)
+            return result
+        raise CheckpointChainBroken(
+            f"{len(steps)} checkpoint step(s) exist under "
+            f"{self.directory} but none passed verification (all torn "
+            "or corrupt); refusing to silently restart from scratch"
+        )
+
     def wait(self) -> None:
-        """Block until any in-flight async save has committed."""
+        """Block until any in-flight async save has committed, then
+        verify it (manifest + ``last_good``)."""
         self._mgr.wait_until_finished()
+        self._flush_pending()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_pending()
         self._mgr.close()
 
 

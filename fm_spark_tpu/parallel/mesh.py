@@ -25,8 +25,15 @@ def make_mesh(
         ``len(devices) // n_feat`` (use everything).
       n_feat: devices along the feature/row-shard axis.
       devices: explicit device list (defaults to ``jax.devices()``).
+        The elastic degraded-mode path (resilience/elastic.py) passes
+        the SURVIVING subset here to rebuild a smaller mesh after a
+        permanent device loss — the mesh never enumerates devices
+        itself when the caller knows better.
     """
     devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise ValueError("empty device list: no surviving devices to "
+                         "build a mesh from")
     if n_data is None:
         if len(devices) % n_feat:
             raise ValueError(

@@ -16,6 +16,12 @@ failure handling a tested subsystem:
   cheap device-enumeration health probe, circuit-breaker escalation)
   emitting a structured health-event JSONL journal
   (:class:`fm_spark_tpu.utils.logging.EventLog`).
+- :mod:`fm_spark_tpu.resilience.elastic` — degraded-mode policy on top
+  of the supervisor (ISSUE 4): N identical consecutive failures are
+  classified PERMANENT (a dead attachment, not a flap), and the
+  :class:`ElasticController` sheds capacity — shrink the mesh 8→4→2→1,
+  restore the last good checkpoint under the new sharding, renormalize
+  per-chip metrics — instead of burning the deadline re-probing.
 
 Consumers: ``bench.py`` (per-leg supervision + ``--resume-sweep``),
 ``FMTrainer.fit`` (device-loss → checkpoint resume with loss
@@ -24,6 +30,11 @@ watcher that replaced the bash poll loop).
 """
 
 from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience.elastic import (
+    ElasticController,
+    ElasticExhausted,
+    classify_failures,
+)
 from fm_spark_tpu.resilience.faults import (
     FaultInjected,
     FaultPlan,
@@ -42,11 +53,14 @@ from fm_spark_tpu.resilience.supervisor import (
 __all__ = [
     "BackoffPolicy",
     "CircuitOpen",
+    "ElasticController",
+    "ElasticExhausted",
     "FaultInjected",
     "FaultPlan",
     "InjectedDeviceLoss",
     "RetriesExhausted",
     "Supervisor",
+    "classify_failures",
     "device_probe",
     "faults",
     "inject",

@@ -1,0 +1,133 @@
+"""Divergence guard: NaN/Inf and loss-spike detection with rollback.
+
+A numeric blowup is the third run-killer this subsystem covers (after
+transient flaps and permanent device loss): one bad batch or an
+optimizer excursion turns the loss to NaN, the NaN writes into the
+tables on the very next step, and every checkpoint from then on
+snapshots poisoned state — by the time a human reads the metrics, the
+run is unsalvageable. The guard makes that cost ONE CHECKPOINT WINDOW:
+
+- :meth:`DivergenceGuard.check` watches every fetched training loss.
+  Non-finite is divergence, full stop. A finite loss is a SPIKE when it
+  exceeds ``spike_factor`` × the median of the trailing window (the
+  median is robust to the window itself containing the start of the
+  blowup; no trigger until ``min_history`` losses are banked, so warmup
+  noise cannot fire it).
+- On detection it raises :class:`DivergenceDetected`;
+  ``FMTrainer.fit`` catches it BEFORE the step's state can reach a
+  checkpoint, restores ``last_good`` (the crash-consistent chain,
+  checkpoint.py), and resumes with a REDUCED STEP BUDGET — the run now
+  targets the last step before the spike. Deterministic pipelines
+  replay the same batches, so retrying through the same poison batch
+  would diverge identically forever; stopping just short converts a
+  blowup into a complete, slightly-shorter run with verified-good
+  final state (the loss at the restored step is bit-identical to the
+  pre-spike value, by the same replay contract as kill-and-resume).
+- ``max_rollbacks`` bounds the policy: a loss landscape that keeps
+  spiking at new places is a modeling problem, not a robustness one,
+  and propagates after the budget is spent.
+
+Every decision is journaled through
+:class:`~fm_spark_tpu.utils.logging.EventLog` (``divergence_detected``
+/ ``divergence_rollback``) — the lint in tools/resilience_lint.py holds
+this module to the same no-bare-print contract as the rest of the
+subsystem.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+
+__all__ = ["DivergenceDetected", "DivergenceGuard"]
+
+
+class DivergenceDetected(RuntimeError):
+    """Raised by :meth:`DivergenceGuard.check` at the first diverged
+    loss; carries the step and value so the rollback can journal them
+    and truncate the resumed budget to ``step - 1``."""
+
+    def __init__(self, step: int, loss: float, reason: str):
+        super().__init__(
+            f"divergence at step {step}: loss={loss!r} ({reason})"
+        )
+        self.step = int(step)
+        self.loss = float(loss)
+        self.reason = reason
+
+
+class DivergenceGuard:
+    """Opt-in training-loop monitor (see module docstring).
+
+    ``spike_factor``: a finite loss > factor × trailing-median is a
+    spike. ``window``/``min_history``: trailing-median shape. On
+    detection :meth:`check` raises; the trainer calls
+    :meth:`note_rollback` once per recovery — it returns the truncated
+    step target and raises the original detection when the rollback
+    budget is spent.
+    """
+
+    def __init__(self, spike_factor: float = 10.0, window: int = 16,
+                 min_history: int = 3, max_rollbacks: int = 2,
+                 journal=None):
+        if spike_factor <= 1.0:
+            raise ValueError(
+                f"spike_factor must be > 1, got {spike_factor}"
+            )
+        self.spike_factor = float(spike_factor)
+        self.min_history = max(int(min_history), 1)
+        self.max_rollbacks = int(max_rollbacks)
+        self.journal = journal
+        self.rollbacks = 0
+        self._recent: deque[float] = deque(maxlen=max(int(window), 2))
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.emit(event, **fields)
+
+    def _baseline(self) -> float | None:
+        if len(self._recent) < self.min_history:
+            return None
+        ordered = sorted(self._recent)
+        return ordered[len(ordered) // 2]
+
+    def check(self, step: int, loss: float) -> None:
+        """Bank a healthy loss, or raise :class:`DivergenceDetected`.
+
+        Call with every fetched loss BEFORE it can be logged or reach a
+        checkpoint snapshot — the poisoned step's state must never be
+        savable.
+        """
+        loss = float(loss)
+        reason = None
+        if not math.isfinite(loss):
+            reason = "non-finite loss"
+        else:
+            baseline = self._baseline()
+            if baseline is not None and loss > self.spike_factor * max(
+                    baseline, 1e-12):
+                reason = (f"loss spike: {loss:.6g} > {self.spike_factor}x "
+                          f"trailing median {baseline:.6g}")
+        if reason is not None:
+            self._emit("divergence_detected", step=step, loss=repr(loss),
+                       reason=reason, rollbacks=self.rollbacks)
+            raise DivergenceDetected(step, loss, reason)
+        self._recent.append(loss)
+
+    def note_rollback(self, detected: DivergenceDetected,
+                      restored_step: int) -> int:
+        """Account one rollback; returns the reduced step target (stop
+        just before the diverging step). Re-raises the detection when
+        ``max_rollbacks`` is exhausted. Clears the trailing window — the
+        replayed losses re-bank from the restored point."""
+        if self.rollbacks >= self.max_rollbacks:
+            self._emit("divergence_rollback_exhausted",
+                       step=detected.step, rollbacks=self.rollbacks)
+            raise detected
+        self.rollbacks += 1
+        self._recent.clear()
+        target = max(detected.step - 1, int(restored_step))
+        self._emit("divergence_rollback", step=detected.step,
+                   restored_step=int(restored_step),
+                   reduced_target=target, rollbacks=self.rollbacks)
+        return target

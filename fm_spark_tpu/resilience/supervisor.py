@@ -127,6 +127,11 @@ class Supervisor:
         self.breaker_threshold = breaker_threshold
         self.state = "closed"
         self.consecutive_failures = 0
+        # Identity tracking for the transient-vs-permanent verdict
+        # (resilience/elastic.py): a run of IDENTICAL failures (numerals
+        # normalized) is the signature of a dead attachment, not a flap.
+        self.last_failure: str | None = None
+        self.identical_failures = 0
         self._probe = probe
         self._sleep = sleep
         self._rng = random.Random(seed)
@@ -141,6 +146,40 @@ class Supervisor:
     def _describe(exc: BaseException) -> str:
         first = (str(exc).splitlines() or [""])[0]
         return f"{type(exc).__name__}: {first[:200]}"
+
+    def _note_failure_identity(self, exc: BaseException) -> None:
+        """Track runs of identical failures (the permanent-fault
+        signature — elastic.classify_failures semantics)."""
+        from fm_spark_tpu.resilience.elastic import normalize_failure
+
+        desc = self._describe(exc)
+        if (self.last_failure is not None
+                and normalize_failure(desc)
+                == normalize_failure(self.last_failure)):
+            self.identical_failures += 1
+        else:
+            self.identical_failures = 1
+        self.last_failure = desc
+
+    def permanent(self, threshold: int | None = None) -> bool:
+        """Is the current failure run classified PERMANENT — the same
+        failure, ``threshold`` (default: ``breaker_threshold``) times in
+        a row? The elastic controller's shrink trigger; a mixed failure
+        run keeps the transient verdict (keep retrying/backing off)."""
+        t = self.breaker_threshold if threshold is None else threshold
+        return self.identical_failures >= max(t, 1)
+
+    def reset(self, op: str = "op") -> None:
+        """Re-arm the breaker after the caller changed the world (an
+        elastic mesh shrink): the new, smaller gang deserves a fresh
+        failure budget. Journaled — a silent reset would make the
+        health journal's consecutive counts unexplainable."""
+        self._emit("supervisor_reset", op=op,
+                   after_failures=self.consecutive_failures)
+        self.consecutive_failures = 0
+        self.identical_failures = 0
+        self.last_failure = None
+        self.state = "closed"
 
     # ------------------------------------------------------------- probe
 
@@ -176,7 +215,8 @@ class Supervisor:
                 and self.consecutive_failures >= self.breaker_threshold):
             self.state = "open"
             self._emit("circuit_open", op=op,
-                       consecutive_failures=self.consecutive_failures)
+                       consecutive_failures=self.consecutive_failures,
+                       permanent=self.permanent())
 
     def note_success(self, op: str = "op") -> None:
         """Close the circuit and zero the consecutive-failure count
@@ -186,6 +226,8 @@ class Supervisor:
             self._emit("recovered", op=op,
                        after_failures=self.consecutive_failures)
         self.consecutive_failures = 0
+        self.identical_failures = 0
+        self.last_failure = None
         self.state = "closed"
 
     # --------------------------------------------------------- run/recover
@@ -218,9 +260,21 @@ class Supervisor:
                 # attempt's fresh init — exactly the two-resident-sets
                 # condition retries must avoid.
                 last.__traceback__ = None
+                self._note_failure_identity(e)
                 self._emit("failure", op=op, attempt=attempt,
                            error=self._describe(e), retryable=True)
                 if attempt == self.policy.max_attempts:
+                    break
+                if self.permanent():
+                    # N identical consecutive failures: the attachment
+                    # is DEAD, not flapping — re-probing and re-sleeping
+                    # the remaining attempts only burns the deadline
+                    # (the BENCH_r05 failure mode). Exhaust now; the
+                    # elastic controller decides whether to shrink.
+                    self._emit("permanent_fault", op=op,
+                               identical_failures=self.identical_failures,
+                               skipped_attempts=(self.policy.max_attempts
+                                                 - attempt))
                     break
                 healthy = self.probe()
                 delay = self.policy.delay(attempt, self._rng)
@@ -243,13 +297,15 @@ class Supervisor:
         make progress on an attachment that keeps dying), else probe and
         back off before the caller rebuilds from its checkpoint."""
         self.consecutive_failures += 1
+        self._note_failure_identity(exc)
         self._emit("failure", op=op, error=self._describe(exc),
                    retryable=True,
                    consecutive_failures=self.consecutive_failures)
         if self.consecutive_failures >= self.breaker_threshold:
             self.state = "open"
             self._emit("circuit_open", op=op,
-                       consecutive_failures=self.consecutive_failures)
+                       consecutive_failures=self.consecutive_failures,
+                       permanent=self.permanent())
             raise CircuitOpen(
                 f"{op}: {self.consecutive_failures} consecutive device "
                 "losses — escalating instead of thrashing the checkpoint"

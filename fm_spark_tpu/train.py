@@ -25,6 +25,7 @@ import optax
 
 from fm_spark_tpu.ops import losses as losses_lib
 from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience.divergence import DivergenceDetected
 from fm_spark_tpu.utils import metrics as metrics_lib
 from fm_spark_tpu.utils.logging import MetricsLogger
 
@@ -344,7 +345,8 @@ class FMTrainer:
 
     def fit(self, batches: Iterable, num_steps: int | None = None,
             checkpointer=None, preemption_guard=None, eval_batches=None,
-            prefetch: int = 0, supervisor=None):
+            prefetch: int = 0, supervisor=None, elastic=None,
+            divergence_guard=None):
         """Run the training loop; ``batches`` yields (ids, vals, labels, w).
 
         With a :class:`fm_spark_tpu.checkpoint.Checkpointer`, training
@@ -374,6 +376,25 @@ class FMTrainer:
         uninterrupted one (the same continuity contract as
         kill-and-resume, tests/test_checkpoint.py). Non-device errors
         propagate unchanged.
+
+        ``elastic`` (a :class:`fm_spark_tpu.resilience.ElasticController`,
+        requires ``supervisor``) upgrades the supervisor's terminal
+        verdict: when the breaker opens on a PERMANENT fault (N
+        identical consecutive device losses — a dead attachment, not a
+        flap), the controller sheds capacity instead of dying — the
+        shrink is journaled, per-chip metrics re-normalize to the
+        surviving chip count, the breaker re-arms, and the run resumes
+        from the last good checkpoint. Mixed-mode circuit opens (a
+        genuinely thrashing attachment) still raise.
+
+        ``divergence_guard`` (a :class:`fm_spark_tpu.resilience
+        .divergence.DivergenceGuard`, requires ``checkpointer``) watches
+        every step's loss — NaN/Inf, or a configurable spike over the
+        trailing median — and on detection rolls back to the last good
+        checkpoint and resumes with a reduced step budget (stop just
+        before the diverging step), so a numeric blowup costs one
+        checkpoint window instead of the run. Costs one device→host
+        loss fetch per step while enabled.
         """
         total = num_steps if num_steps is not None else self.config.num_steps
         log_every = max(self.config.log_every, 1)
@@ -381,6 +402,17 @@ class FMTrainer:
             raise ValueError(
                 "supervised training needs a checkpointer: device-loss "
                 "recovery without committed state to resume from would "
+                "silently restart the run from scratch"
+            )
+        if elastic is not None and supervisor is None:
+            raise ValueError(
+                "elastic degraded mode needs a supervisor: the shrink "
+                "trigger is the supervisor's permanent-fault verdict"
+            )
+        if divergence_guard is not None and checkpointer is None:
+            raise ValueError(
+                "divergence-guard training needs a checkpointer: "
+                "rollback without committed good state to restore would "
                 "silently restart the run from scratch"
             )
         if checkpointer is not None:
@@ -415,6 +447,13 @@ class FMTrainer:
         from fm_spark_tpu.data import wrap_prefetch
 
         source = batches
+        # A recovery retry with NO committed checkpoint yet must rewind
+        # the batch source to its pre-run cursor — resume_or_init only
+        # restores a cursor a checkpoint recorded, and replaying from
+        # mid-stream would silently skip the already-consumed window.
+        initial_cursor = (source.state()
+                          if checkpointer is not None
+                          and hasattr(source, "state") else None)
         need_rebuild = False
         while True:
             try:
@@ -428,6 +467,9 @@ class FMTrainer:
                     # bounded by the circuit breaker instead of escaping
                     # uncaught.
                     checkpointer.reopen()
+                    if (initial_cursor is not None
+                            and checkpointer.latest_step() is None):
+                        source.restore(initial_cursor)
                     self.params = self.spec.init(
                         jax.random.key(self.config.seed))
                     self.opt_state = self.optimizer.init(self.params)
@@ -452,12 +494,27 @@ class FMTrainer:
                     result = self._fit_loop(batches, start, total,
                                             log_every, checkpointer,
                                             preemption_guard,
-                                            eval_batches, save)
+                                            eval_batches, save,
+                                            divergence_guard)
                     if supervisor is not None:
                         supervisor.note_success("train")
                     return result
                 finally:
                     close_prefetch()
+            except DivergenceDetected as e:
+                # Rollback: resume from the last good checkpoint with a
+                # REDUCED budget (stop before the diverging step —
+                # deterministic replay would re-diverge identically).
+                # note_rollback re-raises when its budget is spent.
+                restored = (checkpointer.last_good_step()
+                            if hasattr(checkpointer, "last_good_step")
+                            else checkpointer.latest_step()) or 0
+                total = min(total, divergence_guard.note_rollback(
+                    e, restored))
+                # Full rebuild: the poisoned params were donated into
+                # the step and must never survive the rollback; the
+                # resume path then restores the verified state.
+                need_rebuild = True
             except Exception as e:  # noqa: BLE001 — classified below
                 from fm_spark_tpu.resilience import is_device_loss
 
@@ -469,8 +526,31 @@ class FMTrainer:
                 # state and resume from the latest committed checkpoint.
                 import time as _time
 
+                from fm_spark_tpu.resilience.supervisor import CircuitOpen
+
                 t_recover = _time.perf_counter()
-                supervisor.recover("train", e)
+                try:
+                    supervisor.recover("train", e)
+                except CircuitOpen:
+                    # Terminal verdict — unless the failure run is
+                    # PERMANENT (identical losses: dead capacity, not a
+                    # thrashing attachment) and the elastic controller
+                    # can still shed chips: shrink, re-normalize the
+                    # per-chip metrics, re-arm the breaker, resume from
+                    # the last good checkpoint on the smaller gang.
+                    if (elastic is None or not supervisor.permanent()
+                            or not elastic.can_shrink()):
+                        raise
+                    prev_chips = elastic.n_chips
+                    elastic.shrink("train")
+                    # Re-normalize per-chip metrics ONLY if the logger
+                    # was tracking the controller's fleet view — a
+                    # single-chip trainer (n_chips=1) paired with a
+                    # fleet-wide controller must not start dividing its
+                    # one-device rate by the surviving fleet size.
+                    if self.logger._n_chips == prev_chips:
+                        self.logger.set_n_chips(elastic.n_chips)
+                    supervisor.reset("train")
                 need_rebuild = True
                 # Recovery wall-clock (probe + backoff) must not deflate
                 # the next throughput window — same contract as the
@@ -480,7 +560,8 @@ class FMTrainer:
                 self.logger.add_pause(_time.perf_counter() - t_recover)
 
     def _fit_loop(self, batches, start, total, log_every, checkpointer,
-                  preemption_guard, eval_batches, save):
+                  preemption_guard, eval_batches, save,
+                  divergence_guard=None):
         it = iter(batches)
         steps_since_log = 0
         for step_i in range(start, total):
@@ -506,6 +587,11 @@ class FMTrainer:
             )
             self.step_count += 1
             steps_since_log += 1
+            if divergence_guard is not None:
+                # One device→host sync per step — the opt-in price of
+                # catching the blowup BEFORE its state can be logged,
+                # evaluated, or reach a checkpoint snapshot below.
+                divergence_guard.check(self.step_count, float(m["loss"]))
             if self.step_count % log_every == 0 or step_i == total - 1:
                 loss = float(m["loss"])
                 self.loss_history.append(loss)

@@ -53,6 +53,13 @@ class MetricsLogger:
         alignment between pause and log cadence."""
         self._paused += max(float(seconds), 0.0)
 
+    def set_n_chips(self, n_chips: int):
+        """Re-normalize the per-chip rate denominator — the elastic
+        mesh-shrink path (resilience/elastic.py) calls this after a
+        degraded run sheds capacity, so ``samples_per_sec_per_chip``
+        stays an honest per-surviving-chip figure."""
+        self._n_chips = max(int(n_chips), 1)
+
     def close(self):
         if self._fh is not None:
             self._fh.close()

@@ -141,6 +141,111 @@ def test_error_artifact_carries_last_measured(tmp_path):
     assert "MEASURED.json" in last["provenance"]
 
 
+def test_resume_sweep_never_loads_degraded_leg_records(tmp_path):
+    """A degraded (shrunk-denominator) leg record must not ride
+    --resume-sweep into a fresh, undegraded payload: its inflated
+    per-chip rate would win max() without the degraded stamp and slip
+    past the MEASURED.json keep-best guard. Degraded legs re-measure."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    art = tmp_path / "art"
+    art.mkdir()
+    records = [
+        {"variant": "a", "value": 100.0, "device": "cpu", "ts": 5.0,
+         "dt_s": 1.0, "loss": 0.5},
+        {"variant": "b", "value": 400.0, "device": "cpu", "ts": 6.0,
+         "dt_s": 1.0, "loss": 0.5, "degraded": True, "chips": 2},
+    ]
+    with open(art / "sweep_fm.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    out = bench._completed_legs(str(art), "fm", {"a", "b"},
+                                device_kind="cpu")
+    assert set(out) == {"a"}  # the degraded leg is re-measured
+
+
+def test_parent_classifies_permanent_and_stops_early(tmp_path):
+    """ISSUE 4 satellite: identical consecutive child failures (the
+    BENCH_r05 rc=3 run) classify PERMANENT — the parent stops burning
+    its deadline on further attempts/backoffs and the error JSON
+    surfaces ``permanent: true``."""
+    proc = _run_bench(
+        ["--attempts", "5", "--attempt-timeout", "60",
+         "--total-deadline", "240",
+         "--artifacts-dir", str(tmp_path / "art")],
+        env={
+            "FM_SPARK_FAULTS": ";".join(
+                f"backend_init@{i}=exit:3" for i in range(1, 6)),
+            "FM_SPARK_FAULTS_STATE": str(tmp_path / "faults_state.json"),
+        },
+        timeout=280,
+    )
+    assert proc.returncode == 1
+    final = _last_json(proc.stdout)
+    assert final["value"] is None
+    assert final["permanent"] is True
+    # Stopped at the classification threshold (3 identical), not the
+    # attempt budget (5): attempts 4 and 5 never ran.
+    assert "classified permanent after 3" in final["error"]
+    assert "attempt 4" not in final["error"]
+    assert "skipping backoff" in proc.stderr  # 2-identical probe fast path
+
+
+def test_elastic_degraded_sweep_completes_on_shrunk_mesh(tmp_path):
+    """ISSUE 4 acceptance: an injected PERMANENT device loss (three
+    identical consecutive failures on the leg) with ``--elastic`` on a
+    forced 8-device CPU host completes the measurement on a shrunk mesh
+    and emits a valid result JSON with ``degraded: true`` and per-chip
+    throughput re-normalized to the 4 survivors — instead of an
+    error-only artifact."""
+    art = tmp_path / "art"
+    proc = _run_bench(
+        ["--model", "fm_kaggle", "--batch", "128", "--steps", "2",
+         "--elastic", "--max-shrinks", "2",
+         "--attempts", "1", "--attempt-timeout", "300",
+         "--total-deadline", "420", "--artifacts-dir", str(art)],
+        env={
+            "FM_SPARK_FAULTS":
+                "sweep_leg@1=device_loss;sweep_leg@2=device_loss;"
+                "sweep_leg@3=device_loss",
+            "FM_SPARK_FAULTS_STATE": str(tmp_path / "faults_state.json"),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+        timeout=460,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    final = _last_json(proc.stdout)
+    assert final["value"] is not None and final["value"] > 0
+    assert final.get("error") is None
+    assert final["degraded"] is True
+    assert final["chips"] == 4 and final["shrinks"] == 1
+
+    # The health journal narrates the whole degradation: the three
+    # identical failures, the shrink 8 -> 4, and the re-armed breaker.
+    with open(art / "health_fm_kaggle.jsonl") as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    names = [e["event"] for e in events]
+    assert names.count("failure") == 3
+    assert "supervisor_reset" in names
+    shrink = next(e for e in events if e["event"] == "mesh_shrink")
+    assert shrink["from_chips"] == 8 and shrink["to_chips"] == 4
+
+    # The per-leg sweep record carries the degraded provenance, and the
+    # rate is normalized per SURVIVING chip.
+    with open(art / "sweep_fm_kaggle.jsonl") as f:
+        rec = json.loads(f.readline())
+    assert rec["degraded"] is True and rec["chips"] == 4
+    # value == steps*batch/dt/4 survivors. dt_s is persisted rounded to
+    # 3 decimals and a warm CPU leg can run in single-digit ms, so the
+    # bound is loose — it only needs to rule out the WRONG denominator
+    # (a /8 normalization would miss by a factor of 2).
+    assert abs(rec["value"] * 4 * rec["dt_s"] / (2 * 128) - 1) < 0.25
+
+
 @pytest.mark.slow
 def test_sigterm_mid_sweep_salvages_with_faults_active(tmp_path):
     """The SIGTERM fault injection composes with the salvage path: the

@@ -1,0 +1,544 @@
+"""Elastic degraded-mode training (ISSUE 4 tentpole): permanent-fault
+classification, mesh-shrink resharding, and divergence rollback.
+
+The CPU acceptance scenario lives at the bottom: an 8-fake-device
+field-sharded run suffers a PERMANENT injected device fault (three
+identical consecutive losses), shrinks to 4 devices, restores the last
+good checkpoint onto the half mesh, and finishes — with final
+parameters BIT-IDENTICAL to a clean resume-on-4 of the same checkpoint
+(the loss-continuity contract: an elastic shrink is exactly a clean
+resume, just decided by the classifier instead of an operator).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fm_spark_tpu.resilience import (
+    BackoffPolicy,
+    CircuitOpen,
+    ElasticController,
+    ElasticExhausted,
+    InjectedDeviceLoss,
+    RetriesExhausted,
+    Supervisor,
+    classify_failures,
+    faults,
+)
+from fm_spark_tpu.resilience.divergence import (
+    DivergenceDetected,
+    DivergenceGuard,
+)
+from fm_spark_tpu.utils.logging import EventLog, read_events
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------ classify_failures
+
+
+def test_classify_identical_tail_is_permanent():
+    diags = ["child exited rc=3 without a result line"] * 3
+    assert classify_failures(diags) == "permanent"
+    assert classify_failures(diags[:2]) == "transient"  # below threshold
+
+
+def test_classify_normalizes_numerals():
+    # BENCH_r05's tail: the same hang diagnosed with different measured
+    # durations is the SAME failure mode.
+    diags = [
+        "child hung: no result within 126s (killed)",
+        "child hung: no result within 125s (killed)",
+        "child hung: no result within 127.5s (killed)",
+    ]
+    assert classify_failures(diags) == "permanent"
+
+
+def test_classify_preserves_exit_codes():
+    # rc=1 (program bug) vs rc=3 (init watchdog) are DIFFERENT failure
+    # modes even though only a numeral distinguishes them.
+    diags = ["child exited rc=1 without a result line",
+             "child exited rc=3 without a result line",
+             "child exited rc=3 without a result line"]
+    assert classify_failures(diags) == "transient"
+    assert classify_failures(
+        ["child exited rc=3 without a result line"] * 3) == "permanent"
+
+
+def test_classify_mixed_modes_stay_transient():
+    diags = ["child exited rc=3 without a result line",
+             "child hung: no result within 126s (killed)",
+             "child exited rc=3 without a result line"]
+    assert classify_failures(diags) == "transient"
+    # A long run whose TAIL is identical classifies on the tail.
+    diags += ["child exited rc=3 without a result line"] * 2
+    assert classify_failures(diags) == "permanent"
+
+
+# ------------------------------------------------------ ElasticController
+
+
+def test_controller_shrinks_8_4_2_1_and_exhausts(tmp_path):
+    journal = str(tmp_path / "j.jsonl")
+    ctl = ElasticController(devices=list(range(8)), max_shrinks=3,
+                            journal=EventLog(journal))
+    assert not ctl.degraded and ctl.n_chips == 8
+    assert ctl.shrink("train") == [0, 1, 2, 3]
+    assert ctl.shrink("train") == [0, 1]
+    assert ctl.shrink("train") == [0]
+    assert ctl.degraded and ctl.shrinks == 3
+    with pytest.raises(ElasticExhausted):
+        ctl.shrink("train")
+    events = read_events(journal)
+    shrinks = [e for e in events if e["event"] == "mesh_shrink"]
+    assert [(e["from_chips"], e["to_chips"]) for e in shrinks] == [
+        (8, 4), (4, 2), (2, 1)]
+    assert events[-1]["event"] == "elastic_exhausted"
+    assert ctl.summary() == {"degraded": True, "chips": 1, "shrinks": 3}
+
+
+def test_controller_note_failure_classifies_and_journals(tmp_path):
+    journal = str(tmp_path / "j.jsonl")
+    ctl = ElasticController(devices=[0, 1], journal=EventLog(journal))
+    e = InjectedDeviceLoss("step", 1)
+    assert ctl.note_failure("train", e) == "transient"
+    assert ctl.note_failure("train", e) == "transient"
+    assert ctl.note_failure("train", InjectedDeviceLoss("step", 2)) \
+        == "permanent"  # numerals normalized: same mode
+    # A different mode resets the identical run.
+    assert ctl.note_failure("train", ValueError("shape")) == "transient"
+    events = read_events(journal)
+    assert [e["classification"] for e in events] == [
+        "transient", "transient", "permanent", "transient"]
+
+
+def test_controller_min_devices_floor():
+    ctl = ElasticController(devices=list(range(6)), max_shrinks=5,
+                            min_devices=2)
+    assert ctl.shrink() == [0, 1, 2]
+    assert ctl.shrink() == [0, 1]   # floored at min_devices, not 1
+    assert not ctl.can_shrink()
+
+
+# ------------------------------------- Supervisor permanent-fault verdict
+
+
+def test_supervisor_tracks_identical_failures_and_skips_backoff(tmp_path):
+    delays = []
+    journal = str(tmp_path / "h.jsonl")
+    sup = Supervisor(
+        policy=BackoffPolicy(initial=1.0, jitter=0.0, max_attempts=6),
+        journal=EventLog(journal), probe=lambda: False,
+        breaker_threshold=3, sleep=delays.append,
+    )
+
+    def always():
+        raise InjectedDeviceLoss("step", 1)
+
+    with pytest.raises(RetriesExhausted):
+        sup.run(always, op="leg")
+    # Three identical failures classified PERMANENT: attempts 4..6 and
+    # their backoff sleeps are SKIPPED (the BENCH_r05 budget burn).
+    assert sup.permanent()
+    assert len(delays) == 2
+    events = [e["event"] for e in read_events(journal)]
+    assert "permanent_fault" in events
+    rec = next(e for e in read_events(journal)
+               if e["event"] == "permanent_fault")
+    assert rec["identical_failures"] == 3
+    assert rec["skipped_attempts"] == 3
+
+
+def test_supervisor_mixed_failures_not_permanent(tmp_path):
+    sup = Supervisor(
+        policy=BackoffPolicy(initial=1.0, jitter=0.0, max_attempts=3),
+        probe=lambda: False, breaker_threshold=3, sleep=lambda s: None,
+    )
+    errors = [InjectedDeviceLoss("a", 1),
+              RuntimeError("DATA_LOSS: device lost"),
+              InjectedDeviceLoss("a", 1)]
+
+    def flaky():
+        raise errors.pop(0)
+
+    with pytest.raises(RetriesExhausted):
+        sup.run(flaky, op="leg")
+    assert not sup.permanent()
+
+
+def test_supervisor_reset_rearms_breaker(tmp_path):
+    sup = Supervisor(probe=lambda: False, breaker_threshold=2,
+                     sleep=lambda s: None,
+                     policy=BackoffPolicy(max_attempts=1, jitter=0.0))
+    for _ in range(2):
+        with pytest.raises(RetriesExhausted):
+            sup.run(lambda: (_ for _ in ()).throw(
+                InjectedDeviceLoss("s", 0)), op="leg")
+    assert sup.state == "open"
+    sup.reset("leg")
+    assert sup.state == "closed" and sup.consecutive_failures == 0
+    assert not sup.permanent()
+    assert sup.run(lambda: "ok", op="leg") == "ok"
+
+
+# --------------------------------------------------------- DivergenceGuard
+
+
+def test_guard_triggers_on_nonfinite_and_spike(tmp_path):
+    journal = str(tmp_path / "g.jsonl")
+    g = DivergenceGuard(spike_factor=10.0, min_history=3,
+                        journal=EventLog(journal))
+    for i, loss in enumerate([0.7, 0.69, 0.68, 0.67]):
+        g.check(i, loss)
+    with pytest.raises(DivergenceDetected, match="spike"):
+        g.check(5, 7.0)  # 7.0 > 10x the 0.69 trailing median
+    with pytest.raises(DivergenceDetected, match="non-finite"):
+        g.check(6, float("nan"))
+    events = read_events(journal)
+    assert [e["event"] for e in events] == ["divergence_detected"] * 2
+
+
+def test_guard_tolerates_noise_below_factor():
+    g = DivergenceGuard(spike_factor=10.0, min_history=3)
+    for i, loss in enumerate([0.7, 0.6, 0.8, 0.65, 3.0, 0.62]):
+        g.check(i, loss)  # 3.0 < 10x median: banked, not a spike
+    # And no trigger before min_history losses are banked.
+    g2 = DivergenceGuard(spike_factor=2.0, min_history=3)
+    g2.check(0, 1.0)
+    g2.check(1, 100.0)  # only one banked loss: no baseline yet
+
+
+def test_guard_rollback_budget_exhausts():
+    g = DivergenceGuard(spike_factor=10.0, max_rollbacks=1)
+    det = DivergenceDetected(7, float("inf"), "non-finite loss")
+    assert g.note_rollback(det, restored_step=4) == 6
+    with pytest.raises(DivergenceDetected):
+        g.note_rollback(det, restored_step=4)
+    assert g.rollbacks == 1
+
+
+# -------------------------------- FMTrainer: divergence rollback (e2e)
+
+
+class _PoisonOnce:
+    """Resumable batch source that poisons the Nth FETCHED batch once
+    (process-local count — the replay after rollback yields the clean
+    batch, but the guard's reduced budget stops before it anyway)."""
+
+    def __init__(self, inner, at, scale=1e12):
+        self.inner, self.at, self.scale = inner, at, scale
+        self.n = 0
+
+    def state(self):
+        return self.inner.state()
+
+    def restore(self, s):
+        self.inner.restore(s)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.n += 1
+        ids, vals, labels, w = next(self.inner)
+        if self.n == self.at:
+            vals = vals * self.scale  # loss blows up this step
+        return ids, vals, labels, w
+
+
+def _problem():
+    from fm_spark_tpu import models
+    from fm_spark_tpu.data.synthetic import synthetic_ctr
+    from fm_spark_tpu.train import TrainConfig
+
+    ids, vals, labels = synthetic_ctr(
+        num_examples=256, num_features=64, nnz=5, seed=3)
+    spec = models.FMSpec(num_features=64, rank=4, init_std=0.05)
+    config = TrainConfig(num_steps=10, batch_size=32, learning_rate=0.1,
+                         lr_schedule="constant", log_every=1)
+    return spec, config, (ids, vals, labels)
+
+
+def test_divergence_rollback_restores_pre_spike_state(tmp_path):
+    """ISSUE 4 acceptance: the guard rolls back to last_good and resumes
+    with a reduced budget; the result is bit-identical to a clean run
+    stopped just before the spike."""
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    spec, config, (ids, vals, labels) = _problem()
+
+    # Golden: a clean run of 6 steps (the spike below lands at step 7).
+    import dataclasses as _dc
+
+    golden = FMTrainer(spec, _dc.replace(config, num_steps=6))
+    golden.fit(Batches(ids, vals, labels, config.batch_size, seed=7))
+
+    journal = str(tmp_path / "h.jsonl")
+    guard = DivergenceGuard(spike_factor=10.0, journal=EventLog(journal))
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=2,
+                      async_save=False)
+    trainer = FMTrainer(spec, config)
+    batches = _PoisonOnce(
+        Batches(ids, vals, labels, config.batch_size, seed=7), at=7)
+    trainer.fit(batches, checkpointer=ck, divergence_guard=guard)
+    ck.close()
+
+    # Stopped just before the poisoned step, state bit-identical to the
+    # clean 6-step run (rollback to step 6's checkpoint, replay none).
+    assert trainer.step_count == 6
+    assert guard.rollbacks == 1
+    assert trainer.loss_history == golden.loss_history
+    np.testing.assert_array_equal(
+        np.asarray(golden.params["v"]), np.asarray(trainer.params["v"]))
+    np.testing.assert_array_equal(
+        np.asarray(golden.params["w"]), np.asarray(trainer.params["w"]))
+    events = [e["event"] for e in read_events(journal)]
+    assert "divergence_detected" in events
+    assert "divergence_rollback" in events
+
+
+def test_divergence_guard_requires_checkpointer():
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.train import FMTrainer
+
+    spec, config, (ids, vals, labels) = _problem()
+    trainer = FMTrainer(spec, config)
+    with pytest.raises(ValueError, match="divergence"):
+        trainer.fit(Batches(ids, vals, labels, 32, seed=1),
+                    divergence_guard=DivergenceGuard())
+
+
+# ------------------------------ FMTrainer: elastic continue (single-chip)
+
+
+def test_trainer_elastic_continues_past_permanent_fault(tmp_path):
+    """A permanent device fault (3 identical losses -> CircuitOpen) with
+    an elastic controller downgrades to a shrink + resume instead of
+    killing the run; per-chip metrics renormalize to the survivors."""
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.train import FMTrainer
+
+    spec, config, (ids, vals, labels) = _problem()
+    golden = FMTrainer(spec, config)
+    golden.fit(Batches(ids, vals, labels, config.batch_size, seed=7))
+
+    journal = str(tmp_path / "h.jsonl")
+    jlog = EventLog(journal)
+    faults.activate("train_step@5=device_loss;train_step@6=device_loss;"
+                    "train_step@7=device_loss")
+    sup = Supervisor(policy=BackoffPolicy(initial=1.0, jitter=0.0),
+                     journal=jlog, probe=lambda: True,
+                     sleep=lambda s: None, breaker_threshold=3)
+    elastic = ElasticController(max_shrinks=1, journal=jlog)
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=2,
+                      async_save=False)
+    # n_chips tracks the controller's fleet view, so the shrink
+    # re-normalizes the per-chip metrics (a default n_chips=1 trainer
+    # would keep its single-chip normalization — see fit()).
+    trainer = FMTrainer(spec, config, n_chips=elastic.n_chips)
+    trainer.fit(Batches(ids, vals, labels, config.batch_size, seed=7),
+                checkpointer=ck, supervisor=sup, elastic=elastic)
+    ck.close()
+
+    assert trainer.step_count == golden.step_count == 10
+    assert trainer.loss_history == golden.loss_history  # bit-identical
+    np.testing.assert_array_equal(
+        np.asarray(golden.params["v"]), np.asarray(trainer.params["v"]))
+    assert elastic.degraded and elastic.shrinks == 1
+    assert trainer.logger._n_chips == elastic.n_chips
+    events = [e["event"] for e in read_events(journal)]
+    assert "circuit_open" in events
+    assert "mesh_shrink" in events
+    assert "supervisor_reset" in events
+
+
+def test_trainer_recovery_before_first_checkpoint_rewinds_batches(tmp_path):
+    """A device loss BEFORE the first committed checkpoint must rewind
+    the batch source to its pre-run cursor on retry — resuming
+    mid-stream would silently skip the consumed window."""
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.train import FMTrainer
+
+    spec, config, (ids, vals, labels) = _problem()
+    golden = FMTrainer(spec, config)
+    golden.fit(Batches(ids, vals, labels, config.batch_size, seed=7))
+
+    faults.activate("train_step@2=device_loss")
+    sup = Supervisor(policy=BackoffPolicy(initial=1.0, jitter=0.0),
+                     probe=lambda: True, sleep=lambda s: None)
+    # save_every far beyond the run: only the final forced save lands,
+    # so the recovery at step 2 has NO checkpoint to restore.
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=1000,
+                      async_save=False)
+    trainer = FMTrainer(spec, config)
+    trainer.fit(Batches(ids, vals, labels, config.batch_size, seed=7),
+                checkpointer=ck, supervisor=sup)
+    ck.close()
+
+    assert trainer.step_count == golden.step_count == 10
+    assert trainer.loss_history == golden.loss_history  # full replay
+    np.testing.assert_array_equal(
+        np.asarray(golden.params["v"]), np.asarray(trainer.params["v"]))
+
+
+def test_trainer_elastic_requires_supervisor():
+    from fm_spark_tpu.data.pipeline import Batches
+    from fm_spark_tpu.train import FMTrainer
+
+    spec, config, (ids, vals, labels) = _problem()
+    trainer = FMTrainer(spec, config)
+    with pytest.raises(ValueError, match="elastic"):
+        trainer.fit(Batches(ids, vals, labels, 32, seed=1),
+                    elastic=ElasticController())
+
+
+def test_elastic_wrapper_progress_between_flaps_never_accumulates(
+        tmp_path):
+    """Three device losses SEPARATED by checkpointed progress are three
+    independent flaps, not a permanent fault: the wrapper clears the
+    failure run when a newer checkpoint committed since the last loss,
+    so a healthy-but-flappy fleet is never shrunk."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake CPU devices")
+    import dataclasses as _dc
+
+    from fm_spark_tpu import cli, configs as configs_lib
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data import Batches, synthetic_ctr
+    from fm_spark_tpu.data.packed import field_local
+
+    small = _dc.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"],
+        name="elflap", bucket=32, num_fields=5, rank=4,
+        batch_size=64, num_steps=10,
+    )
+    configs_lib.CONFIGS["elflap"] = small
+    try:
+        cfg = configs_lib.get_config("elflap")
+        ids, vals, labels = synthetic_ctr(
+            512, cfg.num_features, cfg.num_fields, seed=cfg.seed)
+        batches = Batches(field_local(ids, cfg.bucket), vals, labels,
+                          cfg.batch_size, seed=cfg.seed)
+        # Losses at inject occurrences 3, 8, 12: each retry makes >= 2
+        # checkpointed steps of progress before the next loss lands.
+        faults.activate("train_step@3=device_loss;"
+                        "train_step@8=device_loss;"
+                        "train_step@12=device_loss")
+        ck = Checkpointer(str(tmp_path / "ck"), save_every=2)
+        sup = Supervisor(policy=BackoffPolicy(initial=1.0, jitter=0.0),
+                         probe=lambda: True, sleep=lambda s: None,
+                         breaker_threshold=3)
+        params, elastic = cli._fit_field_sparse_elastic(
+            spec=cfg.spec(), tconfig=cfg.train_config(log_every=10),
+            batches=batches, checkpointer=ck, eval_source=None,
+            prefetch=0, row_shards=1, steps_per_call=1, max_shrinks=2,
+            journal=None, metrics_path=None, supervisor=sup)
+        ck.close()
+        assert not elastic.degraded and elastic.shrinks == 0
+        assert ck.last_good_step() == 10
+    finally:
+        faults.clear()
+        del configs_lib.CONFIGS["elflap"]
+
+
+# --------------------- CLI field_sparse: mesh-shrink resharding (e2e)
+
+
+def test_cli_elastic_shrink_resumes_on_half_mesh(tmp_path):
+    """ISSUE 4 acceptance (CPU, forced 8-device host platform): a
+    permanent injected device fault mid-run shrinks the field-sharded
+    mesh 8 -> 4, restores the last good checkpoint onto the survivors,
+    and finishes — bit-identical to a CLEAN resume of the same
+    checkpoint on 4 devices."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake CPU devices")
+    import dataclasses as _dc
+
+    from fm_spark_tpu import cli, configs as configs_lib
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data import Batches, synthetic_ctr
+    from fm_spark_tpu.data.packed import field_local
+    from fm_spark_tpu.utils.logging import MetricsLogger
+
+    small = _dc.replace(
+        configs_lib.CONFIGS["criteo1tb_fm_r64"],
+        name="elshrink", bucket=32, num_fields=5, rank=4,
+        batch_size=64, num_steps=8,
+    )
+    configs_lib.CONFIGS["elshrink"] = small
+    try:
+        def run_cli(ckdir, steps, extra=()):
+            rc = cli.main([
+                "train", "--config", "elshrink", "--synthetic", "512",
+                "--steps", str(steps), "--strategy", "field_sparse",
+                "--checkpoint-dir", str(ckdir), "--checkpoint-every",
+                "2", "--test-fraction", "0", "--log-every", "4",
+                *extra,
+            ])
+            assert rc == 0
+
+        def make_batches():
+            cfg = configs_lib.get_config("elshrink")
+            ids, vals, labels = synthetic_ctr(
+                512, cfg.num_features, cfg.num_fields, seed=cfg.seed)
+            if cfg.field_local_ids:
+                ids = field_local(ids, cfg.bucket)
+            return Batches(ids, vals, labels, cfg.batch_size,
+                           seed=cfg.seed)
+
+        # Golden: 4 steps on the full 8-device mesh (checkpointed),
+        # then a CLEAN resume to 8 on an explicit 4-device half mesh.
+        ck_g = tmp_path / "golden"
+        run_cli(ck_g, 4)
+        cfg = configs_lib.get_config("elshrink")
+        spec, tconfig = cfg.spec(), cfg.train_config(log_every=4)
+        ckg = Checkpointer(str(ck_g), save_every=2)
+        params_golden = cli._fit_field_sparse(
+            spec, tconfig, make_batches(),
+            MetricsLogger(stream=None, n_chips=4), ckg,
+            devices=jax.devices()[:4],
+        )
+        ckg.close()
+
+        # Elastic: same run end-to-end through the CLI; steps 1-4 train
+        # on 8 devices (checkpoints at 2 and 4), then three identical
+        # injected device losses at step 5 classify PERMANENT and the
+        # wrapper shrinks to 4 devices and resumes from step 4.
+        faults.activate(
+            "train_step@5=device_loss;train_step@6=device_loss;"
+            "train_step@7=device_loss")
+        ck_e = tmp_path / "elastic"
+        run_cli(ck_e, 8, extra=("--elastic", "--max-shrinks", "2",
+                                "--model-out",
+                                str(tmp_path / "model")))
+        faults.clear()
+
+        from fm_spark_tpu import models as models_lib
+
+        _, params_elastic = models_lib.load_model(str(tmp_path / "model"))
+        for a, b in zip(jax.tree_util.tree_leaves(params_golden),
+                        jax.tree_util.tree_leaves(params_elastic)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        events = read_events(str(ck_e / "health.jsonl"))
+        names = [e["event"] for e in events]
+        assert "circuit_open" in names
+        assert "supervisor_reset" in names
+        shrink = next(e for e in events if e["event"] == "mesh_shrink")
+        assert shrink["from_chips"] == 8 and shrink["to_chips"] == 4
+        assert "degraded_complete" in names
+        done = next(e for e in events if e["event"] == "degraded_complete")
+        assert done["degraded"] is True and done["chips"] == 4
+    finally:
+        del configs_lib.CONFIGS["elshrink"]

@@ -1,0 +1,97 @@
+"""Tier-1 wiring for tools/resilience_lint.py (ISSUE 4 satellite):
+every resilience/ state transition goes through utils/logging.EventLog
+— no bare print, no ad-hoc JSON writes. The lint module owns the rules;
+this suite (a) holds the shipped subsystem to them and (b) pins the
+lint's own detection so a future refactor can't quietly lobotomize it.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "resilience_lint_tool",
+        os.path.join(REPO, "tools", "resilience_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_resilience_package_is_clean():
+    lint = _load_lint()
+    found = lint.violations()
+    assert found == [], "\n".join(found)
+
+
+def test_lint_catches_bare_print_and_adhoc_json(tmp_path):
+    lint = _load_lint()
+    (tmp_path / "bad.py").write_text(
+        "import json, sys\n"
+        "def transition(state):\n"
+        "    print('circuit open')\n"
+        "    sys.stderr.write('backing off\\n')\n"
+        "    with open('events.json', 'w') as f:\n"
+        "        json.dump({'event': 'backoff'}, f)\n"
+        "    return json.dumps(state)\n"
+    )
+    found = lint.violations(str(tmp_path))
+    assert len(found) == 4
+    assert any("bare print" in v for v in found)
+    assert any("json.dump)" in v for v in found)
+    assert any("json.dumps)" in v for v in found)
+    assert any("sys.stderr.write" in v for v in found)
+    # Every violation names file, line, and enclosing function.
+    assert all(v.startswith("bad.py:") and "[transition]" in v
+               for v in found)
+
+
+def test_lint_allowlist_is_scoped_to_the_named_function(tmp_path):
+    lint = _load_lint()
+    # Same call in a DIFFERENT function of the allowlisted file: flagged.
+    (tmp_path / "faults.py").write_text(
+        "import json\n"
+        "def _next_count(point):\n"
+        "    return json.dumps({point: 1})\n"   # allowlisted
+        "def other(point):\n"
+        "    return json.dumps({point: 1})\n"   # not allowlisted
+    )
+    found = lint.violations(str(tmp_path))
+    assert len(found) == 1
+    assert "[other]" in found[0]
+
+
+def test_lint_cli_exit_status(tmp_path, capsys, monkeypatch):
+    lint = _load_lint()
+    assert lint.main() == 0  # the shipped package is clean
+    monkeypatch.setattr(lint, "RESILIENCE_DIR", str(tmp_path))
+    (tmp_path / "m.py").write_text("print('x')\n")
+    monkeypatch.setattr(
+        lint, "violations",
+        lambda root=str(tmp_path): lint._violations_in_tree(
+            __import__("ast").parse("print('x')"), "m.py"))
+    assert lint.main() == 1
+
+
+@pytest.mark.parametrize("fname", sorted(
+    f for f in os.listdir(os.path.join(REPO, "fm_spark_tpu", "resilience"))
+    if f.endswith(".py")
+))
+def test_every_resilience_module_is_covered(fname, tmp_path):
+    """The lint actually VISITS every module of the real package: a
+    planted violation appended to a copy of each shipped file is
+    flagged — so an exclusion bug (or a skipped file) turns the suite
+    red instead of silently shrinking coverage."""
+    lint = _load_lint()
+    src = os.path.join(lint.RESILIENCE_DIR, fname)
+    with open(src) as f:
+        body = f.read()
+    (tmp_path / fname).write_text(
+        body + "\n\ndef _planted_violation():\n    print('x')\n")
+    found = lint.violations(str(tmp_path))
+    assert any(v.startswith(f"{fname}:") and "_planted_violation" in v
+               for v in found), found

@@ -1,0 +1,113 @@
+"""AST lint: resilience/ state transitions go through EventLog, period.
+
+The resilience subsystem's whole value is that a degraded round leaves a
+MACHINE-READABLE account of what happened (utils/logging.EventLog —
+JSONL, schema'd by ``event``). That property dies the day someone adds a
+``print(...)`` or hand-rolls a JSON write inside a recovery path: the
+transition becomes stderr prose (or a second, uncoordinated artifact
+format) that no tool can consume, and nothing turns red. Same failure
+shape as the shadowed-test bug (tests/test_no_shadowed_tests.py): a
+silent convention, enforced by nobody.
+
+This lint IS the enforcement, wired into tier-1 via
+tests/test_resilience_lint.py. It AST-parses every module under
+``fm_spark_tpu/resilience/`` and flags:
+
+- any ``print(...)`` call (state narration belongs in the journal);
+- any ``json.dump``/``json.dumps`` call (an ad-hoc JSON write bypassing
+  EventLog's schema/atomicity/best-effort contract);
+- any ``sys.stdout``/``sys.stderr`` write.
+
+Allowlist: ``faults.py::_next_count`` persists cross-process occurrence
+COUNTERS (bookkeeping the injection harness needs before a journal can
+even exist) — it is not a state transition. Anything else wanting an
+exemption should probably be an EventLog event instead.
+
+Usage::
+
+    python tools/resilience_lint.py        # exit 1 on violations
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESILIENCE_DIR = os.path.join(REPO, "fm_spark_tpu", "resilience")
+
+#: (filename, enclosing function) pairs exempt from the JSON-write rule.
+ALLOWLIST = {
+    ("faults.py", "_next_count"),
+}
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of the called object, best-effort ('' if dynamic)."""
+    parts = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def _violations_in_tree(tree: ast.AST, filename: str) -> list[str]:
+    out = []
+    # Parent-function context: walk with an explicit stack so each Call
+    # knows its enclosing def (the allowlist granularity).
+    def visit(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name == "print":
+                out.append(
+                    f"{filename}:{node.lineno} [{func or '<module>'}] "
+                    "bare print() — emit a journal event "
+                    "(utils/logging.EventLog) instead"
+                )
+            elif name in ("json.dump", "json.dumps"):
+                if (filename, func) not in ALLOWLIST:
+                    out.append(
+                        f"{filename}:{node.lineno} [{func or '<module>'}] "
+                        f"ad-hoc JSON write ({name}) — state transitions "
+                        "go through EventLog, not hand-rolled JSON"
+                    )
+            elif name in ("sys.stdout.write", "sys.stderr.write"):
+                out.append(
+                    f"{filename}:{node.lineno} [{func or '<module>'}] "
+                    f"direct {name} — emit a journal event instead"
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, None)
+    return out
+
+
+def violations(root: str = RESILIENCE_DIR) -> list[str]:
+    out = []
+    for fname in sorted(os.listdir(root)):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(root, fname)) as f:
+            tree = ast.parse(f.read(), filename=fname)
+        out.extend(_violations_in_tree(tree, fname))
+    return out
+
+
+def main() -> int:
+    found = violations()
+    for v in found:
+        print(v, file=sys.stderr)
+    if found:
+        print(f"{len(found)} resilience-logging violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
