@@ -447,6 +447,79 @@ def _classify_diags(diags, threshold=3):
         return "transient"
 
 
+def _dirty_input_leg(art_dir, model, log):
+    """Hardened-ingest leg (ISSUE 5, ``--dirty-input``): stream a
+    synthetic 3-shard Criteo-shaped dataset with deterministically
+    corrupted mid-shard lines through the quarantine policy and measure
+    the host-side ingest rate. Host-only (no device involvement) and
+    cheap, so it runs before the sweep and its stats land in the result
+    JSON even when the attachment later dies: ``bad_records`` is the
+    dead-lettered count and ``quarantine_exact`` asserts it equals the
+    injected corruption — the bench-level witness that dirty input
+    degrades to quarantine accounting instead of a crash or silent
+    noise."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from fm_spark_tpu.data import criteo
+    from fm_spark_tpu.data.stream import (
+        RecordGuard,
+        ShardReader,
+        StreamBatches,
+        line_parser,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="fm_dirty_")
+    try:
+        rng = np.random.default_rng(0)
+        paths = []
+        n_per, n_shards, injected = 2000, 3, 0
+        for s in range(n_shards):
+            p = os.path.join(tmp, f"shard{s}.tsv")
+            criteo.synthesize_tsv(p, n_per, seed=s)
+            with open(p, "rb") as f:
+                lines = f.read().splitlines(keepends=True)
+            # Flip bytes mid-shard: ~1% of lines, deterministic.
+            for k in rng.choice(np.arange(10, n_per - 10),
+                                size=n_per // 100, replace=False):
+                lines[int(k)] = b"\x00corrupt\t" + lines[int(k)][:9] + b"\n"
+                injected += 1
+            with open(p, "wb") as f:
+                f.write(b"".join(lines))
+            paths.append(p)
+        qdir = os.path.join(art_dir, f"quarantine_{model}")
+        shutil.rmtree(qdir, ignore_errors=True)
+        guard = RecordGuard("quarantine", quarantine_dir=qdir,
+                            max_bad_frac=0.5)
+        bucket = 1 << 14
+        batches = StreamBatches(
+            ShardReader(paths), line_parser("criteo", bucket), 512,
+            criteo.NUM_FIELDS, guard=guard,
+            num_features=criteo.NUM_FIELDS * bucket,
+        )
+        total = n_shards * n_per
+        t0 = time.perf_counter()
+        while guard.n_ok + guard.n_bad < total:
+            batches.next_batch()
+        dt = time.perf_counter() - t0
+        stats = {
+            "rows": total,
+            "bad_records": guard.n_bad,
+            "injected_bad": injected,
+            "quarantine_exact": guard.n_bad == injected,
+            "rows_per_sec": round(total / dt, 1),
+            "policy": "quarantine",
+        }
+        log(f"[inner] [dirty-input] {total} rows in {dt:.2f}s "
+            f"({stats['rows_per_sec']:,.0f} rows/sec); "
+            f"{guard.n_bad}/{injected} corrupt lines quarantined")
+        return stats
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def inner_main(args):
     t_start = time.perf_counter()
     _log("[inner] importing jax + initializing backend "
@@ -728,6 +801,17 @@ def inner_main(args):
                                     journal=journal)
     n_chips = len(devs)
 
+    dirty_stats = None
+    if args.dirty_input:
+        # Best-effort: a broken dirty leg must not forfeit the device
+        # sweep (the mirror of the per-variant guards below).
+        try:
+            dirty_stats = _dirty_input_leg(art_dir, args.model, _log)
+            journal.emit("dirty_input_leg", **dirty_stats)
+        except Exception as e:  # noqa: BLE001 — diagnosable, not fatal
+            _log(f"[inner] [dirty-input] FAILED ({type(e).__name__}): "
+                 f"{(str(e).splitlines() or [''])[0][:200]}")
+
     t_first_result = None  # wall-clock to the FIRST emitted result
     results = []
     resumed = {}
@@ -758,6 +842,11 @@ def inner_main(args):
         }
         if resumed:
             payload["resumed_legs"] = len(resumed)
+        if dirty_stats is not None:
+            # The dirty-input leg's quarantine accounting rides the
+            # result JSON (ISSUE 5): bad_records = dead-lettered count.
+            payload["dirty_input"] = dirty_stats
+            payload["bad_records"] = dirty_stats["bad_records"]
         if elastic is not None and elastic.degraded:
             # A shrunk-mesh rate must never masquerade as a full-mesh
             # one: stamp the degraded provenance (chips = the surviving
@@ -1290,6 +1379,14 @@ def main():
                     dest="max_shrinks",
                     help="with --elastic: how many times the device "
                          "set may halve before the fault propagates")
+    ap.add_argument("--dirty-input", action="store_true",
+                    dest="dirty_input",
+                    help="run the hardened-ingest leg before the sweep "
+                         "(ISSUE 5): stream a synthetic 3-shard dataset "
+                         "with deterministically corrupted lines through "
+                         "the quarantine policy and stamp its "
+                         "bad_records / rows_per_sec accounting into "
+                         "the result JSON (host-only, ~seconds)")
     ap.add_argument("--resume-sweep", action="store_true",
                     dest="resume_sweep",
                     help="skip sweep legs already completed in "
@@ -1391,6 +1488,8 @@ def main():
         argv.append("--segtotal-pallas")
     if args.fast_first:
         argv.append("--fast-first")
+    if args.dirty_input:
+        argv.append("--dirty-input")
     if args.elastic:
         argv += ["--elastic", "--max-shrinks", str(args.max_shrinks)]
     if args.compile_cache is not None:

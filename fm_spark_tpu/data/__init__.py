@@ -26,3 +26,11 @@ from fm_spark_tpu.data.packed import (  # noqa: F401
     shuffle_packed,
 )
 from fm_spark_tpu.data.libsvm import load_libsvm, save_libsvm  # noqa: F401
+from fm_spark_tpu.data.stream import (  # noqa: F401
+    BadRecord,
+    IngestAborted,
+    RecordGuard,
+    ShardReader,
+    StreamBatches,
+    line_parser,
+)

@@ -24,22 +24,24 @@ NUM_CAT = 26
 NUM_FIELDS = NUM_INT + NUM_CAT
 
 
-def parse_lines(lines: list[bytes], bucket: int, per_field: bool = True):
-    """Pure-Python Criteo parser — the semantic spec for fm_parse_criteo.
+def parse_line(line: bytes, bucket: int, per_field: bool = True):
+    """Parse ONE Criteo TSV line → ``(label, ids_row list[int])``.
 
-    Returns (ids[N,39] int32, labels[N] int8). Malformed lines (wrong
-    column count) raise — garbage in the id space is worse than a crash.
+    Raises ``ValueError`` on a wrong column count or a non-integer label
+    — WITHOUT source context (callers add ``path:lineno``). The
+    pre-hardening behavior let field-conversion errors escape as raw
+    ``ValueError``/``IndexError`` with no way to tell which line; this
+    is the single per-record parse both :func:`parse_lines` and the
+    streaming ingest (:mod:`fm_spark_tpu.data.stream`) route through.
     """
-    n = len(lines)
-    ids = np.empty((n, NUM_FIELDS), np.int32)
-    labels = np.empty(n, np.int8)
-    for r, line in enumerate(lines):
-        cols = line.rstrip(b"\n").split(b"\t")
-        if len(cols) != NUM_FIELDS + 1:
-            raise ValueError(
-                f"criteo line has {len(cols)} columns, want {NUM_FIELDS + 1}"
-            )
-        labels[r] = 1 if int(cols[0]) > 0 else 0  # non-integer label raises
+    cols = line.rstrip(b"\r\n").split(b"\t")
+    if len(cols) != NUM_FIELDS + 1:
+        raise ValueError(
+            f"criteo line has {len(cols)} columns, want {NUM_FIELDS + 1}"
+        )
+    try:
+        label = 1 if int(cols[0]) > 0 else 0
+        row = [0] * NUM_FIELDS
         for f in range(NUM_INT):
             tok = cols[1 + f]
             if tok == b"":
@@ -48,10 +50,42 @@ def parse_lines(lines: list[bytes], bucket: int, per_field: bool = True):
                 key = 1 << 40  # NEG_KEY
             else:
                 key = int(np.floor(np.log1p(float(int(tok))) ** 2))
-            ids[r, f] = hashing.hash_int_u64_spec(f, key, bucket, per_field)
+            row[f] = hashing.hash_int_u64_spec(f, key, bucket, per_field)
         for f in range(NUM_INT, NUM_FIELDS):
-            ids[r, f] = hashing.hash_token(f, cols[1 + f], bucket, per_field)
-    return ids, labels
+            row[f] = hashing.hash_token(f, cols[1 + f], bucket, per_field)
+    except (ValueError, OverflowError) as e:
+        raise ValueError(f"bad criteo field ({e})") from None
+    return label, row
+
+
+def parse_lines(lines: list[bytes], bucket: int, per_field: bool = True,
+                on_error=None, path: str = "<criteo>",
+                start_lineno: int = 1):
+    """Pure-Python Criteo parser — the semantic spec for fm_parse_criteo.
+
+    Returns (ids[N,39] int32, labels[N] int8). Malformed lines (wrong
+    column count, non-integer label/count) raise by default — garbage in
+    the id space is worse than a crash; with
+    ``on_error(path, lineno, line, reason)`` they are reported with
+    ``path:lineno`` context and DROPPED (the hardened-ingest quarantine
+    path), so N shrinks to the good-row count.
+    """
+    n = len(lines)
+    ids = np.empty((n, NUM_FIELDS), np.int32)
+    labels = np.empty(n, np.int8)
+    r = 0
+    for k, line in enumerate(lines):
+        try:
+            label, row = parse_line(line, bucket, per_field)
+        except ValueError as e:
+            if on_error is None:
+                raise
+            on_error(path, start_lineno + k, line.rstrip(b"\r\n"), str(e))
+            continue
+        labels[r] = label
+        ids[r] = row
+        r += 1
+    return ids[:r], labels[:r]
 
 
 def preprocess(src_paths, out_dir: str, bucket: int, per_field: bool = True,

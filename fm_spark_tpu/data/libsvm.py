@@ -6,43 +6,104 @@ sparse vectors (SURVEY.md §3.3). The TPU-native representation is fixed-nnz
 padded with ``val=0`` entries (a zero value contributes nothing to any FM
 term — ops/fm.py), rows with more raise by default (truncation is opt-in,
 silent data loss is not).
+
+Error path (ISSUE 5): :func:`parse_libsvm_line` raises a DISTINCT
+``ValueError`` per failure mode (missing label vs malformed ``idx:val``
+pair vs unparseable label) with the offending token repr-escaped, and
+:func:`load_libsvm` either raises with ``path:lineno`` context and the
+truncated offending line, or — given ``on_error`` — reports and DROPS
+the bad line (the hardened-ingest quarantine path,
+:mod:`fm_spark_tpu.data.stream`).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from fm_spark_tpu.data.stream import preview_line
+
+
+def parse_libsvm_line(line: bytes, zero_based: bool = False):
+    """Parse ONE libSVM line (comments/terminator already stripped) →
+    ``(label, idx, val)``.
+
+    Raises ``ValueError`` with a failure-mode-specific message: a line
+    whose first token is an ``idx:val`` pair is a MISSING LABEL (a
+    common truncation artifact), distinct from an unparseable label and
+    from a malformed ``idx:val`` pair — the pre-hardening parser
+    collapsed all three into one opaque error. No source context here;
+    callers (load_libsvm, stream.RecordGuard) add ``path:lineno``.
+    """
+    if isinstance(line, str):
+        line = line.encode()
+    parts = line.split(b"#")[0].split()
+    if not parts:
+        raise ValueError("blank line")
+    head = parts[0]
+    if b":" in head:
+        raise ValueError(
+            f"missing label (line starts with feature pair "
+            f"{preview_line(head, 40)})"
+        )
+    try:
+        label = float(head)
+    except ValueError:
+        raise ValueError(
+            f"unparseable label {preview_line(head, 40)}"
+        ) from None
+    idx, val = [], []
+    for p in parts[1:]:
+        i, sep, v = p.partition(b":")
+        if not sep or not i or not v:
+            raise ValueError(
+                f"malformed idx:val pair {preview_line(p, 40)}"
+            )
+        try:
+            idx.append(int(i) - (0 if zero_based else 1))
+            val.append(float(v))
+        except ValueError:
+            raise ValueError(
+                f"malformed idx:val pair {preview_line(p, 40)}"
+            ) from None
+    if idx and min(idx) < 0:
+        raise ValueError(
+            "negative feature index — file is probably zero-based; "
+            "pass zero_based=True"
+        )
+    return label, idx, val
+
 
 def load_libsvm(path: str, max_nnz: int | None = None,
-                truncate: bool = False, zero_based: bool = False):
+                truncate: bool = False, zero_based: bool = False,
+                on_error=None):
     """Parse a libSVM file → ``(ids[N,S] int32, vals[N,S] f32, labels[N] f32)``.
 
     ``max_nnz`` fixes S (default: the file's max row nnz). One-based
     indices (the libSVM convention) are shifted to zero-based unless
-    ``zero_based``.
+    ``zero_based``. A malformed line raises with ``path:lineno`` context
+    and the truncated, repr-escaped offending line; with
+    ``on_error(path, lineno, line, reason)`` it is reported and DROPPED
+    instead (the quarantine path).
     """
     rows: list[tuple[float, list[int], list[float]]] = []
     widest = 0
     with open(path, "rb") as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.split(b"#")[0].strip()
+        for lineno, raw in enumerate(f, 1):
+            stripped = raw.rstrip(b"\r\n")
+            line = raw.split(b"#")[0].strip()
             if not line:
                 continue
-            parts = line.split()
             try:
-                label = float(parts[0])
-                idx, val = [], []
-                for p in parts[1:]:
-                    i, v = p.split(b":")
-                    idx.append(int(i) - (0 if zero_based else 1))
-                    val.append(float(v))
+                label, idx, val = parse_libsvm_line(line,
+                                                    zero_based=zero_based)
             except ValueError as e:
-                raise ValueError(f"{path}:{lineno}: bad libsvm line") from e
-            if idx and min(idx) < 0:
+                if on_error is not None:
+                    on_error(path, lineno, stripped, str(e))
+                    continue
                 raise ValueError(
-                    f"{path}:{lineno}: negative feature index — file is "
-                    "probably zero-based; pass zero_based=True"
-                )
+                    f"{path}:{lineno}: bad libsvm line ({e}) — "
+                    f"{preview_line(stripped)}"
+                ) from e
             widest = max(widest, len(idx))
             rows.append((label, idx, val))
     S = max_nnz if max_nnz is not None else max(widest, 1)

@@ -279,6 +279,10 @@ class DedupAuxBatches:
         self._pre_split_state = None
         self._source.restore(state)
 
+    @property
+    def guard(self):
+        return getattr(self._source, "guard", None)
+
 
 class MappedBatches:
     """Batch-source wrapper applying ``fn`` to each yielded batch in the
@@ -305,6 +309,10 @@ class MappedBatches:
 
     def restore(self, state) -> None:
         self._source.restore(state)
+
+    @property
+    def guard(self):
+        return getattr(self._source, "guard", None)
 
 
 class StackedBatches:
@@ -360,6 +368,10 @@ class StackedBatches:
 
     def restore(self, state) -> None:
         self._source.restore(state)
+
+    @property
+    def guard(self):
+        return getattr(self._source, "guard", None)
 
 
 class Prefetcher:
@@ -451,6 +463,13 @@ class Prefetcher:
             "restore the wrapped source BEFORE constructing the Prefetcher "
             "(the producer thread starts reading ahead immediately)"
         )
+
+    @property
+    def guard(self):
+        """The wrapped source's ingest RecordGuard, if any — surfaces
+        quarantine counters through the wrapper chain (train.py logs
+        them at end of fit)."""
+        return getattr(self._source, "guard", None)
 
     def close(self) -> None:
         self._stop.set()

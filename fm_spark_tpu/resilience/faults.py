@@ -47,6 +47,7 @@ import time
 __all__ = [
     "ENV_PLAN",
     "ENV_STATE",
+    "KNOWN_POINTS",
     "FaultInjected",
     "FaultPlan",
     "InjectedDeviceLoss",
@@ -59,6 +60,24 @@ __all__ = [
 #: Environment variables read lazily at the first :func:`inject` call.
 ENV_PLAN = "FM_SPARK_FAULTS"
 ENV_STATE = "FM_SPARK_FAULTS_STATE"
+
+#: Production injection points (the registry the fault-matrix test
+#: pins, tests/test_resilience.py). Device/runtime faults: backend
+#: init, per-sweep-leg, per-train-step, the health probe, and the
+#: checkpoint commit window. Data faults (ISSUE 5): ``ingest_truncate``
+#: fires per chunk read in data/stream.ShardReader (a failing/truncated
+#: shard read), ``ingest_corrupt`` fires per record before parse in
+#: StreamBatches (an injected ``error`` there IS a corrupt record and
+#: takes the active quarantine/strict policy path).
+KNOWN_POINTS = (
+    "backend_init",
+    "sweep_leg",
+    "train_step",
+    "probe",
+    "ckpt_commit",
+    "ingest_corrupt",
+    "ingest_truncate",
+)
 
 _ACTIONS = ("hang", "sleep", "exit", "device_loss", "error", "sigterm")
 
@@ -200,8 +219,9 @@ def _next_count(point: str) -> int:
 def inject(point: str) -> None:
     """Fault point: a no-op without an active plan; with one, the
     matching rule for this point's Nth occurrence fires (sleep / raise /
-    exit / signal). Call sites name the observable failure surface:
-    ``backend_init``, ``sweep_leg``, ``train_step``, ``probe``."""
+    exit / signal). Call sites name the observable failure surface —
+    see :data:`KNOWN_POINTS` for the registry (device/runtime faults
+    plus the streaming-ingest data faults)."""
     global _plan
     if _plan is None:
         _plan = FaultPlan.from_env() or False

@@ -353,7 +353,15 @@ class FMTrainer:
         state (params, optimizer state, step, pipeline cursor) is saved on
         the checkpointer's cadence, the run resumes from the latest saved
         step automatically, and a ``PreemptionGuard`` (if given) turns
-        SIGTERM into an orderly flush-and-return (SURVEY.md §5).
+        SIGTERM into an orderly flush-and-return (SURVEY.md §5). The
+        pipeline-cursor slot carries whatever ``batches.state()``
+        returns — for the streaming ingest source
+        (:class:`fm_spark_tpu.data.StreamBatches`) that is the
+        ``(epoch, shard, byte_offset, records)`` cursor plus the
+        quarantine counters, so a kill-and-resume run consumes every
+        record exactly once and its dead-letter accounting continues
+        instead of resetting; a run whose guard quarantined anything
+        logs a final ``bad_records`` metrics line.
 
         ``eval_batches`` (a zero-arg callable returning a finite batch
         iterable, e.g. ``lambda: iterate_once(*te, bs)``) enables periodic
@@ -498,6 +506,16 @@ class FMTrainer:
                                             divergence_guard)
                     if supervisor is not None:
                         supervisor.note_success("train")
+                    ingest_guard = getattr(source, "guard", None)
+                    if ingest_guard is not None and ingest_guard.n_bad:
+                        # Quarantined-record accounting is part of the
+                        # run's record (the ISSUE 5 dirty-data
+                        # contract): one summary metrics line; the
+                        # per-record detail lives in the dead-letter
+                        # journal.
+                        self.logger.log(self.step_count,
+                                        bad_records=ingest_guard.n_bad,
+                                        good_records=ingest_guard.n_ok)
                     return result
                 finally:
                     close_prefetch()

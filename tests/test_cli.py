@@ -702,3 +702,127 @@ def test_cli_supervised_train_recovers_from_device_loss(tmp_path, capsys):
               for e in read_events(str(tmp_path / "ck" / "health.jsonl"))]
     assert "failure" in events and "backoff" in events
     assert "recovered" in events
+
+
+# ------------------------------------------- streaming text ingest (ISSUE 5)
+
+
+def _dirty_shards(tmp_path, n_shards=2, rows=60, bad_lines=(6,)):
+    from fm_spark_tpu.data import criteo
+
+    paths = []
+    for s in range(n_shards):
+        p = str(tmp_path / f"s{s}.tsv")
+        criteo.synthesize_tsv(p, rows, seed=s)
+        paths.append(p)
+    with open(paths[-1], "rb") as f:
+        lines = f.read().splitlines(keepends=True)
+    for ln in bad_lines:
+        lines[ln - 1] = b"\x00garbage line\n"
+    with open(paths[-1], "wb") as f:
+        f.write(b"".join(lines))
+    return paths
+
+
+def test_cli_streaming_text_quarantine_trains_and_dead_letters(tmp_path,
+                                                               capsys):
+    """--data with a comma-separated shard list streams raw dirty text;
+    quarantine policy finishes the run and dead-letters the corrupt
+    line with path:lineno."""
+    from fm_spark_tpu.utils.logging import read_events
+
+    paths = _dirty_shards(tmp_path)
+    qdir = str(tmp_path / "quar")
+    rc = cli.main([
+        "train", "--config", "criteo_kaggle_fm_r32",
+        "--data", ",".join(paths),
+        "--steps", "5", "--batch-size", "16", "--test-fraction", "0",
+        "--data-policy", "quarantine", "--quarantine-dir", qdir,
+        "--log-every", "5",
+    ])
+    assert rc == 0
+    bad = [e for e in read_events(qdir + "/deadletter.jsonl")
+           if e["event"] == "bad_record"]
+    assert len(bad) == 1
+    assert bad[0]["path"] == paths[-1] and bad[0]["lineno"] == 6
+    # The run's summary metrics line carries the quarantine accounting.
+    out = capsys.readouterr().out
+    assert any('"bad_records": 1' in l for l in out.splitlines())
+
+
+def test_cli_streaming_text_strict_fails_with_path_lineno(tmp_path):
+    from fm_spark_tpu.data.stream import BadRecord
+
+    paths = _dirty_shards(tmp_path)
+    with pytest.raises(BadRecord, match=r"s1\.tsv:6"):
+        cli.main([
+            "train", "--config", "criteo_kaggle_fm_r32",
+            "--data", ",".join(paths),
+            "--steps", "5", "--batch-size", "16", "--test-fraction", "0",
+        ])
+
+
+def test_cli_streaming_text_breaker_aborts_above_max_bad_frac(tmp_path):
+    from fm_spark_tpu.data.stream import IngestAborted
+
+    paths = _dirty_shards(tmp_path, bad_lines=tuple(range(5, 35)))
+    with pytest.raises(IngestAborted, match="max_bad_frac"):
+        cli.main([
+            "train", "--config", "criteo_kaggle_fm_r32",
+            "--data", ",".join(paths),
+            "--steps", "8", "--batch-size", "16", "--test-fraction", "0",
+            "--data-policy", "quarantine",
+            "--quarantine-dir", str(tmp_path / "quar"),
+            "--max-bad-frac", "0.1",
+        ])
+
+
+def test_cli_streaming_text_guards(tmp_path):
+    paths = _dirty_shards(tmp_path, bad_lines=())
+    # quarantine without a dead-letter destination is a config error.
+    with pytest.raises(SystemExit, match="quarantine-dir"):
+        cli.main([
+            "train", "--config", "criteo_kaggle_fm_r32",
+            "--data", ",".join(paths), "--steps", "2",
+            "--batch-size", "16", "--test-fraction", "0",
+            "--data-policy", "quarantine",
+        ])
+    # streaming holds out no eval split: an implicit test fraction must
+    # hard-fail, never silently train on 100% while reporting nothing.
+    with pytest.raises(SystemExit, match="test-fraction"):
+        cli.main([
+            "train", "--config", "criteo_kaggle_fm_r32",
+            "--data", ",".join(paths), "--steps", "2",
+            "--batch-size", "16",
+        ])
+    # a missing shard names itself.
+    with pytest.raises(SystemExit, match="missing shard"):
+        cli.main([
+            "train", "--config", "criteo_kaggle_fm_r32",
+            "--data", paths[0] + ",/nonexistent/x.tsv", "--steps", "2",
+            "--batch-size", "16", "--test-fraction", "0",
+        ])
+
+
+@pytest.mark.slow
+def test_cli_streaming_checkpoint_resume_continues_cursor(tmp_path,
+                                                          capsys):
+    """The streaming cursor rides the CLI checkpoint path: a second
+    invocation with the same --checkpoint-dir resumes and finishes the
+    remaining steps instead of replaying from scratch."""
+    paths = _dirty_shards(tmp_path, bad_lines=())
+    ck = str(tmp_path / "ck")
+    common = [
+        "train", "--config", "criteo_kaggle_fm_r32",
+        "--data", ",".join(paths), "--batch-size", "16",
+        "--test-fraction", "0", "--checkpoint-dir", ck,
+        "--checkpoint-every", "2", "--log-every", "1", "--prefetch", "0",
+    ]
+    assert cli.main(common + ["--steps", "4"]) == 0
+    first = capsys.readouterr().out
+    assert cli.main(common + ["--steps", "8"]) == 0
+    second = capsys.readouterr().out
+    steps_logged = [json.loads(l)["step"] for l in second.splitlines()
+                    if l.startswith('{"step"')]
+    # Resumed at 5, not 1 — the cursor (and step count) came back.
+    assert min(steps_logged) == 5 and max(steps_logged) == 8

@@ -407,6 +407,102 @@ def test_libsvm_overflow_raises(tmp_path):
     assert ids.shape == (2, 2)
 
 
+# -------------------------------------------- parser error paths (ISSUE 5)
+
+
+def test_libsvm_errors_distinguish_failure_modes(tmp_path):
+    """A missing label, an unparseable label, and a malformed idx:val
+    pair get DISTINCT messages (they collapsed into one opaque 'bad
+    libsvm line' before), each with path:lineno and the offending
+    content."""
+    path = str(tmp_path / "d.svm")
+    with open(path, "w") as f:
+        f.write("1 1:0.5\n2:1.0 3:1.0\n")   # line 2: forgot the label
+    with pytest.raises(ValueError,
+                       match=r"d\.svm:2: bad libsvm line \(missing label"):
+        libsvm.load_libsvm(path)
+    with open(path, "w") as f:
+        f.write("1 1:0.5\n0 4:x\n")
+    with pytest.raises(ValueError, match=r"malformed idx:val pair.*4:x"):
+        libsvm.load_libsvm(path)
+    with open(path, "w") as f:
+        f.write("zzz 1:0.5\n")
+    with pytest.raises(ValueError, match="unparseable label"):
+        libsvm.load_libsvm(path)
+
+
+def test_libsvm_error_includes_truncated_repr_escaped_line(tmp_path):
+    path = str(tmp_path / "d.svm")
+    with open(path, "wb") as f:
+        f.write(b"1 1:0.5\n0 9:" + b"\xff" * 500 + b"\n")
+    with pytest.raises(ValueError) as exc:
+        libsvm.load_libsvm(path)
+    msg = str(exc.value)
+    assert "d.svm:2" in msg
+    assert "\\xff" in msg          # repr-escaped, not raw bytes
+    assert "bytes)" in msg         # truncation marker carries full size
+    assert len(msg) < 1000         # the 500-byte line was truncated
+
+
+def test_libsvm_on_error_drops_bad_lines(tmp_path):
+    path = str(tmp_path / "d.svm")
+    with open(path, "w") as f:
+        f.write("1 1:0.5\nGARBAGE\n0 2:1.0\n")
+    errs = []
+    ids, vals, labels = libsvm.load_libsvm(
+        path, on_error=lambda p, ln, line, reason: errs.append((ln, reason))
+    )
+    assert labels.shape[0] == 2            # the bad line was dropped
+    assert errs and errs[0][0] == 2
+
+
+def test_criteo_parse_lines_on_error_gets_path_lineno(tmp_path):
+    path = str(tmp_path / "c.tsv")
+    criteo.synthesize_tsv(path, 4, seed=2)
+    lines = open(path, "rb").read().splitlines(True)
+    lines.insert(2, b"wrong\tcolumn\tcount\n")
+    errs = []
+    ids, labels = criteo.parse_lines(
+        lines, 4096, on_error=lambda p, ln, line, r: errs.append((p, ln, r)),
+        path="day0.tsv", start_lineno=10,
+    )
+    assert ids.shape[0] == 4               # bad row dropped, not raised
+    assert errs == [("day0.tsv", 12, "criteo line has 3 columns, want 40")]
+    # A non-integer count field routes through the same path.
+    good = b"1" + b"\t1" * 13 + b"\tcafe" * 26 + b"\n"
+    errs.clear()
+    ids, labels = criteo.parse_lines(
+        [good.replace(b"\t1\t", b"\txy\t", 1)], 4096,
+        on_error=lambda p, ln, line, r: errs.append(r),
+    )
+    assert ids.shape[0] == 0 and "bad criteo field" in errs[0]
+    # Without on_error the raise survives (garbage ids beat a crash).
+    with pytest.raises(ValueError):
+        criteo.parse_lines([b"wrong\tcount\n"], 4096)
+
+
+def test_avazu_parse_lines_on_error_gets_path_lineno(tmp_path):
+    path = str(tmp_path / "a.csv")
+    avazu.synthesize_csv(path, 4, seed=2)
+    lines = open(path, "rb").read().splitlines(True)[1:]  # drop header
+    lines.insert(1, b"short,row\n")
+    bad_hour = lines[3].split(b",")
+    bad_hour[2] = b"99xx9999"
+    lines.append(b",".join(bad_hour))
+    errs = []
+    ids, labels = avazu.parse_lines(
+        lines, 1 << 14,
+        on_error=lambda p, ln, line, r: errs.append((p, ln, r)),
+        path="a.csv", start_lineno=2,
+    )
+    assert ids.shape[0] == 4               # both bad rows dropped
+    assert errs[0][0] == "a.csv" and errs[0][1] == 3
+    assert "columns" in errs[0][2]
+    assert "bad hour field" in errs[1][2]
+    with pytest.raises(ValueError, match="columns"):
+        avazu.parse_lines([b"short,row\n"], 1 << 14)
+
+
 @pytest.mark.slow
 def test_packed_end_to_end_training(tmp_path):
     """Criteo TSV → packed → PackedBatches → FMTrainer: the full L2 path."""

@@ -179,6 +179,62 @@ def test_prefetcher_close_unblocks_producer():
         pf.next_batch()
 
 
+def test_prefetcher_close_is_idempotent():
+    import pytest
+
+    from fm_spark_tpu.data import Prefetcher
+
+    ids, vals, labels = _data(n=100)
+    pf = Prefetcher(Batches(ids, vals, labels, batch_size=16, seed=0),
+                    depth=1)
+    pf.next_batch()
+    pf.close()
+    pf.close()  # second close: no hang, no error, thread stays down
+    assert not pf._thread.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.next_batch()
+
+
+def test_prefetcher_producer_error_keeps_reraising_without_blocking():
+    """A producer crash must re-raise on EVERY subsequent next_batch()
+    — the terminal sentinel is enqueued exactly once, so a second call
+    that blocked on the dead queue would hang the training loop."""
+    import pytest
+
+    from fm_spark_tpu.data import Prefetcher
+
+    class Boom:
+        def __init__(self):
+            self.n = 0
+
+        def next_batch(self):
+            self.n += 1
+            if self.n > 1:
+                raise RuntimeError("producer crashed")
+            return (np.zeros(3),)
+
+        def state(self):
+            return {"n": self.n}
+
+    with Prefetcher(Boom(), depth=1) as pf:
+        pf.next_batch()
+        for _ in range(3):
+            with pytest.raises(RuntimeError, match="producer crashed"):
+                pf.next_batch()
+
+
+def test_prefetcher_restore_after_start_raises_documented_error():
+    import pytest
+
+    from fm_spark_tpu.data import Prefetcher
+
+    ids, vals, labels = _data(n=64)
+    src = Batches(ids, vals, labels, batch_size=16, seed=3)
+    with Prefetcher(src, depth=2) as pf:
+        with pytest.raises(RuntimeError, match="BEFORE constructing"):
+            pf.restore({"epoch": 0, "index": 0, "seed": 3})
+
+
 # ------------------------------------------------------- BernoulliBatches
 
 

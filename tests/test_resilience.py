@@ -111,6 +111,76 @@ def test_is_device_loss_classification():
     assert not is_device_loss(SystemExit(3))
 
 
+# ------------------------------------------- ingest fault points (ISSUE 5)
+
+
+def test_ingest_fault_points_registered_and_deterministic():
+    """The two data-fault points join the registry and behave exactly
+    like the device faults: per-point occurrence counters, fire at the
+    exact Nth occurrence only."""
+    from fm_spark_tpu.resilience.faults import KNOWN_POINTS
+
+    assert {"ingest_corrupt", "ingest_truncate"} <= set(KNOWN_POINTS)
+    faults.activate(
+        "ingest_corrupt@2=error;ingest_truncate@3=device_loss")
+    faults.inject("ingest_corrupt")
+    with pytest.raises(FaultInjected):
+        faults.inject("ingest_corrupt")
+    faults.inject("ingest_corrupt")  # past the rule — quiet again
+    faults.inject("ingest_truncate")  # counters are PER POINT
+    faults.inject("ingest_truncate")
+    with pytest.raises(InjectedDeviceLoss):
+        faults.inject("ingest_truncate")
+
+
+def test_ingest_occurrence_counters_survive_process_respawn(
+        tmp_path, monkeypatch):
+    state = tmp_path / "state.json"
+    monkeypatch.setenv(faults.ENV_STATE, str(state))
+    faults.activate("ingest_corrupt@2=error")
+    faults.inject("ingest_corrupt")
+    faults.activate("ingest_corrupt@2=error")  # "new process"
+    with pytest.raises(FaultInjected):
+        faults.inject("ingest_corrupt")
+    assert json.loads(state.read_text())["ingest_corrupt"] == 2
+
+
+def test_ingest_fault_points_wired_into_stream_layer(tmp_path):
+    """The production call sites reach the named points: the shard
+    reader's chunk read fires ``ingest_truncate``; the batcher's
+    per-record hook fires ``ingest_corrupt`` and the injected error
+    takes the active policy path like any corrupt record (strict raise
+    with path:lineno / quarantine + dead-letter)."""
+    from fm_spark_tpu.data.stream import (
+        BadRecord,
+        RecordGuard,
+        ShardReader,
+        StreamBatches,
+        line_parser,
+    )
+
+    p = tmp_path / "s.svm"
+    p.write_text("".join(f"1 {i + 1}:1.0\n" for i in range(8)))
+    faults.activate("ingest_truncate@1=error")
+    with pytest.raises(FaultInjected):
+        ShardReader([str(p)]).next_line()
+    faults.activate("ingest_corrupt@3=error")
+    b = StreamBatches(ShardReader([str(p)]), line_parser("libsvm"), 4, 2)
+    with pytest.raises(BadRecord, match=r"s\.svm:3"):
+        b.next_batch()
+    faults.activate("ingest_corrupt@3=error")
+    guard = RecordGuard("quarantine",
+                        quarantine_dir=str(tmp_path / "q"))
+    b2 = StreamBatches(ShardReader([str(p)]), line_parser("libsvm"),
+                       4, 2, guard=guard)
+    b2.next_batch()
+    b2.next_batch()
+    assert guard.n_bad == 1 and guard.n_ok == 7
+    events = read_events(guard.dead_letter_path)
+    assert len(events) == 1 and events[0]["lineno"] == 3
+    assert "injected" in events[0]["reason"]
+
+
 # --------------------------------------------------------- BackoffPolicy
 
 

@@ -77,6 +77,30 @@ def test_lint_cli_exit_status(tmp_path, capsys, monkeypatch):
     assert lint.main() == 1
 
 
+def test_lint_default_surface_includes_data_stream(tmp_path, monkeypatch):
+    """ISSUE 5: data/stream.py's quarantine/abort transitions carry the
+    same EventLog-only contract, so the DEFAULT lint surface must scan
+    it — a planted violation in a swapped-in copy is flagged, proving
+    the extra-files hook actually runs (not just lists)."""
+    lint = _load_lint()
+    assert any(p.endswith(os.path.join("data", "stream.py"))
+               for p in lint.EXTRA_FILES)
+    src = lint.EXTRA_FILES[0]
+    with open(src) as f:
+        body = f.read()
+    planted = tmp_path / "stream.py"
+    planted.write_text(
+        body + "\n\ndef _planted_violation():\n    print('x')\n")
+    monkeypatch.setattr(lint, "EXTRA_FILES", (str(planted),))
+    found = lint.violations()
+    assert any(v.startswith("stream.py:") and "_planted_violation" in v
+               for v in found), found
+    # An explicit-root call (the tmp-dir test idiom) stays scoped to
+    # that root — extra files are a default-surface property.
+    assert lint.violations(os.path.join(REPO, "fm_spark_tpu",
+                                        "resilience")) == []
+
+
 @pytest.mark.parametrize("fname", sorted(
     f for f in os.listdir(os.path.join(REPO, "fm_spark_tpu", "resilience"))
     if f.endswith(".py")

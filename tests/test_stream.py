@@ -1,0 +1,496 @@
+"""Hardened streaming ingest (ISSUE 5): bounded-memory shard reading,
+per-record error policies, and the exactly-once resumable cursor.
+
+The two acceptance drills live here: the CORRUPTION drill (flip bytes
+mid-shard in a synthetic multi-file dataset; quarantine finishes
+training and dead-letters exactly the injected records, strict fails
+with a ``path:lineno`` error, and an injected bad fraction above the
+breaker threshold aborts) and the EXACTLY-ONCE drill (SIGKILL a
+training run mid-epoch on a 3-shard dataset, resume from the
+checkpoint, and assert the concatenated record stream and loss curve
+are bit-identical to an uninterrupted run).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu.data.stream import (
+    BadRecord,
+    IngestAborted,
+    RecordGuard,
+    ShardReader,
+    StreamBatches,
+    line_parser,
+)
+from fm_spark_tpu.utils.logging import read_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_shards(tmp_path, n_shards=3, rows=32, name="shard{}.svm"):
+    """Synthetic libsvm shards; record j (global) has ids (j+1, j+2) so
+    the first id column identifies records uniquely."""
+    paths = []
+    j = 0
+    for s in range(n_shards):
+        p = str(tmp_path / name.format(s))
+        with open(p, "w") as f:
+            for _ in range(rows):
+                f.write(f"{j % 2} {j + 1}:1.5 {j + 2}:0.5\n")
+                j += 1
+        paths.append(p)
+    return paths, j
+
+
+def _corrupt(path, linenos, garbage=b"\x00garbage \xff"):
+    with open(path, "rb") as f:
+        lines = f.read().splitlines(keepends=True)
+    for ln in linenos:
+        lines[ln - 1] = garbage + b"\n"
+    with open(path, "wb") as f:
+        f.write(b"".join(lines))
+
+
+# ------------------------------------------------------------ ShardReader
+
+
+@pytest.mark.parametrize("chunk", [1, 7, 64, 1 << 20])
+def test_shard_reader_walks_files_in_order_at_any_chunk_size(tmp_path,
+                                                             chunk):
+    paths, total = _write_shards(tmp_path)
+    r = ShardReader(paths, chunk_bytes=chunk)
+    seen = []
+    while True:
+        try:
+            shard, lineno, line = r.next_line()
+        except StopIteration:
+            break
+        seen.append(int(line.split()[1].split(b":")[0]) - 1)
+    assert seen == list(range(total))
+    assert r.records == total
+
+
+def test_shard_reader_handles_missing_trailing_newline(tmp_path):
+    p = str(tmp_path / "s.svm")
+    with open(p, "w") as f:
+        f.write("1 1:1.0\n0 2:1.0")  # final line unterminated
+    r = ShardReader([p], chunk_bytes=5)
+    assert r.next_line()[2] == b"1 1:1.0"
+    assert r.next_line()[2] == b"0 2:1.0"
+    with pytest.raises(StopIteration):
+        r.next_line()
+
+
+def test_shard_reader_cursor_roundtrips_mid_shard(tmp_path):
+    paths, total = _write_shards(tmp_path)
+    r1 = ShardReader(paths, chunk_bytes=11)
+    for _ in range(40):  # into shard 1
+        r1.next_line()
+    state = r1.state()
+    assert state["shard"] == 1 and state["records"] == 40
+    want = [r1.next_line() for _ in range(30)]
+    r2 = ShardReader(paths, chunk_bytes=1 << 16)
+    r2.restore(state)
+    got = [r2.next_line() for _ in range(30)]
+    assert want == got
+
+
+def test_shard_reader_rejects_cursor_from_different_shard_list(tmp_path):
+    paths, _ = _write_shards(tmp_path)
+    state = ShardReader(paths).state()
+    with pytest.raises(ValueError, match="shard list changed"):
+        ShardReader(paths[:2]).restore(state)
+
+
+def test_shard_reader_header_prefix_skips_by_match_not_position(
+        tmp_path):
+    """A split(1)-sharded headered CSV carries the header in shard 0
+    only — the skip must MATCH the header, never blindly eat line 1 of
+    every shard (that would silently drop one real record per shard)."""
+    p0 = str(tmp_path / "h0.csv")
+    with open(p0, "w") as f:
+        f.write("id,click,hour\nrow0\n")
+    p1 = str(tmp_path / "h1.csv")  # headerless continuation shard
+    with open(p1, "w") as f:
+        f.write("row1\nrow2\n")
+    r = ShardReader([p0, p1], header_prefix=b"id,")
+    assert r.next_line()[2] == b"row0"
+    assert r.next_line()[2] == b"row1"  # NOT skipped: no header match
+    assert r.next_line()[2] == b"row2"
+    assert r.records == 3  # headers never count as records
+
+
+# ---------------------------------------------------------- StreamBatches
+
+
+def test_stream_batches_epoch_coverage_padding_and_fixed_shapes(tmp_path):
+    paths, total = _write_shards(tmp_path)  # 96 records
+    b = StreamBatches(ShardReader(paths, chunk_bytes=17),
+                      line_parser("libsvm"), 20, 3, num_features=128)
+    seen = []
+    for _ in range(5):  # 4 full + 1 padded partial = one epoch
+        ids, vals, labels, w = b.next_batch()
+        assert ids.shape == (20, 3) and w.shape == (20,)
+        seen.extend(ids[w > 0][:, 0].tolist())
+    assert sorted(seen) == list(range(total))  # every record exactly once
+    st = b.state()
+    assert st["epoch"] == 1 and st["shard"] == 0 and st["offset"] == 0
+    assert st["ok"] == total
+    # Epoch 2 starts over.
+    ids, _, _, w = b.next_batch()
+    assert ids[0, 0] == 0 and w.sum() == 20
+
+
+def test_stream_batches_exactly_once_state_roundtrip(tmp_path):
+    paths, _ = _write_shards(tmp_path)
+    b1 = StreamBatches(ShardReader(paths, chunk_bytes=13),
+                       line_parser("libsvm"), 16, 3, num_features=128)
+    for _ in range(3):
+        b1.next_batch()
+    state = b1.state()
+    want = [b1.next_batch() for _ in range(6)]  # crosses the epoch seam
+    b2 = StreamBatches(ShardReader(paths, chunk_bytes=1 << 16),
+                       line_parser("libsvm"), 16, 3, num_features=128)
+    b2.restore(state)
+    got = [b2.next_batch() for _ in range(6)]
+    for a, c in zip(want, got):
+        for x, y in zip(a, c):
+            np.testing.assert_array_equal(x, y)
+    assert b1.state() == b2.state()
+
+
+def test_stream_batches_all_garbage_dataset_raises(tmp_path):
+    p = str(tmp_path / "g.svm")
+    with open(p, "w") as f:
+        f.write("GARBAGE\n" * 5)
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"))
+    b = StreamBatches(ShardReader([p]), line_parser("libsvm"), 4, 2,
+                      guard=guard)
+    with pytest.raises(ValueError, match="no parseable records"):
+        b.next_batch()
+
+
+# ------------------------------------------------------------ RecordGuard
+
+
+def test_record_guard_schema_contract(tmp_path):
+    g = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"))
+    ok = lambda *row, **kw: g.admit("p", 1, b"l", *row, **kw)
+    assert ok(1.0, [1, 2], [0.5, 0.5], num_features=64, max_nnz=4)
+    assert not ok(float("nan"), [1], [1.0])             # non-finite label
+    assert not ok(1.0, [1], [float("inf")])             # non-finite value
+    assert not ok(1.0, [64], [1.0], num_features=64)    # id out of bucket
+    assert not ok(1.0, [-1], [1.0])                     # negative id
+    assert not ok(1.0, [1, 2, 3], [1.0] * 3, max_nnz=2)  # nnz > S
+    assert g.n_ok == 1 and g.n_bad == 5
+    reasons = [e["reason"] for e in read_events(g.dead_letter_path)]
+    assert len(reasons) == 5
+    assert any("hash bucket" in r for r in reasons)
+    assert any("non-finite label" in r for r in reasons)
+    assert any("non-zeros" in r for r in reasons)
+
+
+def test_record_guard_strict_raises_with_context():
+    g = RecordGuard("strict")
+    with pytest.raises(BadRecord, match=r"day0\.tsv:7: boom"):
+        g.bad("day0.tsv", 7, b"the line", "boom")
+
+
+def test_record_guard_unwindowed_mode_for_bulk_loads(tmp_path):
+    """The in-memory loaders report all bad lines during the parse and
+    the good count in one post-parse ok_many() — out of stream order.
+    windowed=False must not misread that as a 100%-bad burst (a 0.15%
+    dirty file used to abort against max_bad_frac=0.1); the whole-load
+    check_overall() still enforces the real rate."""
+    g = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"),
+                    max_bad_frac=0.1, windowed=False)
+    for i in range(150):
+        g.bad("f", i + 1, b"x", "bad")     # would trip a windowed guard
+    g.ok_many(99_850)
+    g.check_overall()                       # 0.15% overall: fine
+    g2 = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q2"),
+                     max_bad_frac=0.1, windowed=False)
+    for i in range(30):
+        g2.bad("f", i + 1, b"x", "bad")
+    g2.ok_many(70)
+    with pytest.raises(IngestAborted):      # 30% overall: aborts
+        g2.check_overall()
+
+
+def test_stream_libsvm_comment_lines_are_skipped_not_quarantined(
+        tmp_path):
+    """load_libsvm silently skips '#'-comment lines; the streaming path
+    must agree — a commented header is not a bad record (it used to
+    raise BadRecord under strict and count toward the breaker)."""
+    p = str(tmp_path / "c.svm")
+    with open(p, "w") as f:
+        f.write("# generated by exporter v2\n")
+        f.write("1 1:1.0  # trailing comment\n")
+        f.write("0 2:1.0\n")
+    b = StreamBatches(ShardReader([p]), line_parser("libsvm"), 2, 2,
+                      num_features=16)  # default strict guard
+    ids, vals, labels, w = b.next_batch()
+    assert w.sum() == 2 and b.guard.n_bad == 0
+    np.testing.assert_array_equal(ids[:, 0], [0, 1])
+
+
+def test_record_guard_rejects_bad_config():
+    with pytest.raises(ValueError, match="policy"):
+        RecordGuard("lenient")
+    with pytest.raises(ValueError, match="max_bad_frac"):
+        RecordGuard("quarantine", max_bad_frac=1.5)
+
+
+# ------------------------------------------- acceptance: corruption drill
+
+
+def test_corruption_drill_quarantine_trains_strict_raises_breaker_aborts(
+        tmp_path):
+    """ISSUE 5 acceptance: flip bytes mid-shard in a synthetic 3-shard
+    dataset. quarantine finishes training and dead-letters EXACTLY the
+    injected records; strict fails with a path:lineno error; with the
+    injected fraction above --max-bad-frac the breaker aborts."""
+    from fm_spark_tpu import models
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    paths, total = _write_shards(tmp_path)
+    _corrupt(paths[1], [10, 17])  # mid-shard byte flips
+    spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+    config = TrainConfig(num_steps=6, batch_size=16, learning_rate=0.1,
+                         lr_schedule="constant", log_every=6)
+
+    # quarantine: training finishes, dead-letter count matches exactly.
+    # (prefetch=0: a read-ahead producer would legitimately consume
+    # into the next epoch and re-quarantine the same lines — the exact
+    # per-epoch count is only observable without read-ahead.)
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"),
+                        max_bad_frac=0.5)
+    batches = StreamBatches(ShardReader(paths, chunk_bytes=37),
+                            line_parser("libsvm"), 16, 3, guard=guard,
+                            num_features=128)
+    trainer = FMTrainer(spec, config)
+    trainer.fit(batches)
+    assert trainer.step_count == 6
+    assert np.isfinite(trainer.loss_history[-1])
+    assert guard.n_bad == 2  # exactly the injected records, once each
+    assert guard.n_ok == total - 2  # one full epoch, nothing skipped
+    events = read_events(guard.dead_letter_path)
+    bad = [e for e in events if e["event"] == "bad_record"]
+    assert len(bad) == 2
+    assert all(e["path"] == paths[1] for e in bad)
+    assert sorted(e["lineno"] for e in bad) == [10, 17]
+
+    # strict: the same dataset fails loudly with path:lineno context.
+    batches = StreamBatches(ShardReader(paths), line_parser("libsvm"),
+                            16, 3, num_features=128)
+    with pytest.raises(BadRecord, match=r"shard1\.svm:10"):
+        FMTrainer(spec, config).fit(batches)
+
+    # breaker: injected bad fraction above max_bad_frac aborts the run
+    # (raised out of the producer thread through the prefetcher).
+    _corrupt(paths[1], range(5, 25))  # 20/96 ≈ 21% bad
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q2"),
+                        max_bad_frac=0.1, window=32, min_records=32)
+    batches = StreamBatches(ShardReader(paths), line_parser("libsvm"),
+                            16, 3, guard=guard, num_features=128)
+    with pytest.raises(IngestAborted, match="max_bad_frac"):
+        FMTrainer(spec, config).fit(batches, prefetch=2)
+    aborted = [e for e in read_events(guard.dead_letter_path)
+               if e["event"] == "ingest_aborted"]
+    assert len(aborted) == 1 and aborted[0]["bad_frac"] > 0.1
+
+
+def test_quarantine_counters_ride_the_checkpoint_cursor(tmp_path):
+    """A resumed run's dead-letter ACCOUNTING continues (counters live
+    in the pipeline cursor) instead of resetting to zero."""
+    paths, _ = _write_shards(tmp_path)
+    _corrupt(paths[0], [3])
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"))
+    b = StreamBatches(ShardReader(paths), line_parser("libsvm"), 16, 3,
+                      guard=guard, num_features=128)
+    b.next_batch()
+    state = b.state()
+    assert state["bad"] == 1 and state["ok"] == 16
+    guard2 = RecordGuard("quarantine",
+                         quarantine_dir=str(tmp_path / "q2"))
+    b2 = StreamBatches(ShardReader(paths), line_parser("libsvm"), 16, 3,
+                       guard=guard2, num_features=128)
+    b2.restore(state)
+    assert guard2.n_bad == 1 and guard2.n_ok == 16
+
+
+# ----------------------------------------- acceptance: exactly-once drill
+
+
+_KILL_CHILD = """
+import json, os, sys
+
+sys.path.insert(0, {repo!r})
+from fm_spark_tpu import models
+from fm_spark_tpu.checkpoint import Checkpointer
+from fm_spark_tpu.data.stream import ShardReader, StreamBatches, line_parser
+from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+shard_dir, ck_dir, tap_path, steps = sys.argv[1:5]
+paths = sorted(os.path.join(shard_dir, f) for f in os.listdir(shard_dir))
+
+
+class Tap:
+    def __init__(self, source, path):
+        self._source = source
+        self._f = open(path, "a")
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        self._f.write(",".join(str(int(x)) for x in ids[w > 0][:, 0]))
+        self._f.write("\\n")
+        self._f.flush()
+        return ids, vals, labels, w
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, s):
+        self._source.restore(s)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+config = TrainConfig(num_steps=int(steps), batch_size=16,
+                     learning_rate=0.1, lr_schedule="constant",
+                     log_every=1)
+ck = Checkpointer(ck_dir, save_every=4, async_save=False)
+batches = Tap(StreamBatches(ShardReader(paths, chunk_bytes=64),
+                            line_parser("libsvm"), 16, 3,
+                            num_features=128), tap_path)
+trainer = FMTrainer(spec, config)
+trainer.fit(batches, checkpointer=ck)
+ck.close()
+print(json.dumps({{"done": trainer.step_count}}), flush=True)
+"""
+
+
+class _Tap:
+    """Parent-side batch recorder: one line per step listing the REAL
+    record ids consumed — the concatenated record stream the acceptance
+    criterion compares."""
+
+    def __init__(self, source, path):
+        self._source = source
+        self._path = path
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        with open(self._path, "a") as f:
+            f.write(",".join(str(int(x)) for x in ids[w > 0][:, 0]))
+            f.write("\n")
+        return ids, vals, labels, w
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, s):
+        self._source.restore(s)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+def test_sigkill_mid_epoch_resume_is_exactly_once(tmp_path):
+    """ISSUE 5 acceptance: SIGKILL a training run mid-epoch on a
+    3-shard dataset, resume from the checkpoint, and the concatenated
+    record stream and loss curve are bit-identical to an uninterrupted
+    run — no record consumed twice or skipped."""
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    paths, _ = _write_shards(shard_dir)  # 96 records, 6 steps/epoch
+    steps = 24
+
+    spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+    config = TrainConfig(num_steps=steps, batch_size=16,
+                         learning_rate=0.1, lr_schedule="constant",
+                         log_every=1)
+
+    # Golden: uninterrupted run over the same stream.
+    golden_tap = str(tmp_path / "tap_golden.txt")
+    golden = FMTrainer(spec, config)
+    golden.fit(_Tap(StreamBatches(ShardReader(paths, chunk_bytes=64),
+                                  line_parser("libsvm"), 16, 3,
+                                  num_features=128), golden_tap))
+
+    # Faulted run: child is SIGKILLed once it has logged step >= 13
+    # (mid-epoch 3; checkpoints every 4 steps).
+    script = tmp_path / "child.py"
+    script.write_text(_KILL_CHILD.format(repo=REPO))
+    ck_dir = str(tmp_path / "ck")
+    kill_tap = str(tmp_path / "tap_kill.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(shard_dir), ck_dir, kill_tap,
+         str(steps)],
+        stdout=subprocess.PIPE, text=True, cwd=REPO, env=env,
+    )
+    try:
+        deadline = time.time() + 240
+        for line in proc.stdout:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("step", 0) >= 13 or "done" in rec:
+                break
+            assert time.time() < deadline, "child never reached step 13"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        proc.stdout.close()
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+
+    # Resume in-process from the killed run's checkpoint chain.
+    resume_tap = str(tmp_path / "tap_resume.txt")
+    ck = Checkpointer(ck_dir, save_every=4, async_save=False)
+    batches = _Tap(StreamBatches(ShardReader(paths, chunk_bytes=1 << 16),
+                                 line_parser("libsvm"), 16, 3,
+                                 num_features=128), resume_tap)
+    resumed = FMTrainer(spec, config)
+    resumed.fit(batches, checkpointer=ck)
+    ck.close()
+
+    # Loss curve bit-identical (restored prefix + replayed suffix).
+    assert resumed.step_count == golden.step_count == steps
+    assert resumed.loss_history == golden.loss_history
+    np.testing.assert_array_equal(np.asarray(golden.params["v"]),
+                                  np.asarray(resumed.params["v"]))
+
+    # Concatenated record stream: the checkpointed prefix of the killed
+    # run plus the resumed suffix IS the golden stream — no record
+    # consumed twice, none skipped.
+    golden_lines = open(golden_tap).read().splitlines()
+    kill_lines = open(kill_tap).read().splitlines()
+    resume_lines = open(resume_tap).read().splitlines()
+    restored_step = steps - len(resume_lines)
+    assert 0 < restored_step < steps  # it really resumed mid-run
+    assert restored_step % 4 == 0     # from a checkpoint boundary
+    assert kill_lines[:restored_step] == golden_lines[:restored_step]
+    assert resume_lines == golden_lines[restored_step:]

@@ -11,7 +11,10 @@ silent convention, enforced by nobody.
 
 This lint IS the enforcement, wired into tier-1 via
 tests/test_resilience_lint.py. It AST-parses every module under
-``fm_spark_tpu/resilience/`` and flags:
+``fm_spark_tpu/resilience/`` — plus the hardened-ingest module
+``fm_spark_tpu/data/stream.py`` (ISSUE 5), whose quarantine/abort state
+transitions (dead-letter records, the rate-breaker abort) carry the
+same machine-readability contract — and flags:
 
 - any ``print(...)`` call (state narration belongs in the journal);
 - any ``json.dump``/``json.dumps`` call (an ad-hoc JSON write bypassing
@@ -34,6 +37,12 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESILIENCE_DIR = os.path.join(REPO, "fm_spark_tpu", "resilience")
+
+#: Modules OUTSIDE resilience/ held to the same EventLog-only rule:
+#: data/stream.py journals quarantine/abort transitions (ISSUE 5).
+EXTRA_FILES = (
+    os.path.join(REPO, "fm_spark_tpu", "data", "stream.py"),
+)
 
 #: (filename, enclosing function) pairs exempt from the JSON-write rule.
 ALLOWLIST = {
@@ -87,14 +96,27 @@ def _violations_in_tree(tree: ast.AST, filename: str) -> list[str]:
     return out
 
 
-def violations(root: str = RESILIENCE_DIR) -> list[str]:
+def _check_file(path: str) -> list[str]:
+    fname = os.path.basename(path)
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=fname)
+    return _violations_in_tree(tree, fname)
+
+
+def violations(root: str | None = None) -> list[str]:
+    """Violations under ``root`` (a directory); with the default root,
+    the shipped surface is checked — every resilience/ module plus
+    :data:`EXTRA_FILES` (data/stream.py)."""
+    default = root is None
+    root = root or RESILIENCE_DIR
     out = []
     for fname in sorted(os.listdir(root)):
         if not fname.endswith(".py"):
             continue
-        with open(os.path.join(root, fname)) as f:
-            tree = ast.parse(f.read(), filename=fname)
-        out.extend(_violations_in_tree(tree, fname))
+        out.extend(_check_file(os.path.join(root, fname)))
+    if default:
+        for path in EXTRA_FILES:
+            out.extend(_check_file(path))
     return out
 
 
