@@ -464,12 +464,7 @@ def _dirty_input_leg(art_dir, model, log):
     import numpy as np
 
     from fm_spark_tpu.data import criteo
-    from fm_spark_tpu.data.stream import (
-        RecordGuard,
-        ShardReader,
-        StreamBatches,
-        line_parser,
-    )
+    from fm_spark_tpu.data.stream import RecordGuard, ShardReader
 
     tmp = tempfile.mkdtemp(prefix="fm_dirty_")
     try:
@@ -489,21 +484,32 @@ def _dirty_input_leg(art_dir, model, log):
             with open(p, "wb") as f:
                 f.write(b"".join(lines))
             paths.append(p)
-        qdir = os.path.join(art_dir, f"quarantine_{model}")
-        shutil.rmtree(qdir, ignore_errors=True)
-        guard = RecordGuard("quarantine", quarantine_dir=qdir,
-                            max_bad_frac=0.5)
         bucket = 1 << 14
-        batches = StreamBatches(
-            ShardReader(paths), line_parser("criteo", bucket), 512,
-            criteo.NUM_FIELDS, guard=guard,
-            num_features=criteo.NUM_FIELDS * bucket,
-        )
         total = n_shards * n_per
-        t0 = time.perf_counter()
-        while guard.n_ok + guard.n_bad < total:
-            batches.next_batch()
-        dt = time.perf_counter() - t0
+
+        def _run(native_ingest, qdir):
+            """One full pass under quarantine; returns (guard, dt)."""
+            from fm_spark_tpu.data.native_stream import make_stream_batches
+
+            shutil.rmtree(qdir, ignore_errors=True)
+            guard = RecordGuard("quarantine", quarantine_dir=qdir,
+                                max_bad_frac=0.5)
+            batches = make_stream_batches(
+                ShardReader(paths), "criteo", 512, criteo.NUM_FIELDS,
+                guard=guard, num_features=criteo.NUM_FIELDS * bucket,
+                bucket=bucket,
+                native_ingest=native_ingest,
+            )
+            t0 = time.perf_counter()
+            while guard.n_ok + guard.n_bad < total:
+                batches.next_batch()
+            return guard, time.perf_counter() - t0
+
+        # Priced BOTH ways (ISSUE 6): the per-line Python parser and the
+        # native chunk parser run the same dirty pass with identical
+        # quarantine semantics — the result JSON carries both rates so
+        # the native win (and any accounting drift) stays attributable.
+        guard, dt = _run(False, os.path.join(art_dir, f"quarantine_{model}"))
         stats = {
             "rows": total,
             "bad_records": guard.n_bad,
@@ -513,8 +519,21 @@ def _dirty_input_leg(art_dir, model, log):
             "policy": "quarantine",
         }
         log(f"[inner] [dirty-input] {total} rows in {dt:.2f}s "
-            f"({stats['rows_per_sec']:,.0f} rows/sec); "
+            f"({stats['rows_per_sec']:,.0f} rows/sec, python parse); "
             f"{guard.n_bad}/{injected} corrupt lines quarantined")
+        from fm_spark_tpu.data.native_stream import native_stream_supported
+
+        if native_stream_supported("criteo", criteo.NUM_FIELDS, bucket):
+            nguard, ndt = _run(
+                "auto", os.path.join(art_dir, f"quarantine_{model}_native"))
+            stats["rows_per_sec_native"] = round(total / ndt, 1)
+            stats["native_quarantine_exact"] = nguard.n_bad == injected
+            stats["native_counters_match"] = (
+                nguard.counters() == guard.counters())
+            log(f"[inner] [dirty-input] {total} rows in {ndt:.2f}s "
+                f"({stats['rows_per_sec_native']:,.0f} rows/sec, native "
+                f"chunk parse); {nguard.n_bad}/{injected} quarantined, "
+                f"counters match: {stats['native_counters_match']}")
         return stats
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

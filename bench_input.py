@@ -16,8 +16,23 @@ each pipeline stage so regressions are attributable:
   stage 4  + Prefetcher         stage 3 with the producer thread hiding
                                 assembly+transfer behind the consumer
 
-Synthesizes its own packed data (one-time, reused across runs via
---data-dir) so it never depends on real Criteo being present.
+Streaming-ingest ladder (ISSUE 6 — raw dirty-tolerant TEXT, not the
+preprocessed binary; the rates that close ROADMAP open item 2):
+
+  stream_py                 StreamBatches, per-line Python parse (the
+                            PR-4 hardened path — round-9's ~1.2k rows/s)
+  stream_native             NativeStreamBatches, C++ chunk parse with
+                            identical guard/cursor semantics
+  stream_native+prefetch    + Prefetcher producer thread parsing chunk
+                            N+1 while batch N is consumed, device_put
+                            double-buffered
+
+A ``streaming_rows_per_sec`` block lands in the output JSON so the win
+stays attributable against the in-memory ``packed_batches`` stage.
+
+Synthesizes its own packed data AND text shards (one-time, reused
+across runs via --data-dir) so it never depends on real Criteo being
+present.
 """
 
 import argparse
@@ -52,6 +67,56 @@ def synthesize_packed(path: str, rows: int, num_fields: int = 39,
                                 dtype=np.int64) + offs).astype(np.int32)
             labels = (rng.random(n) < 0.25).astype(np.int8)
             w.append(ids, labels)
+
+
+def synthesize_tsv_fast(path: str, rows: int, seed: int = 0,
+                        vocab_per_field: int = 1000,
+                        missing_rate: float = 0.05,
+                        chunk: int = 100_000) -> None:
+    """Criteo-shaped synthetic TSV, vectorized (data/criteo.py's
+    synthesize_tsv is a per-value Python loop — fine for 6k bench rows,
+    too slow for the multi-million-row streaming ladder)."""
+    from fm_spark_tpu.data.criteo import NUM_CAT, NUM_INT
+
+    rng = np.random.default_rng(seed)
+    with open(path, "wb") as f:
+        for start in range(0, rows, chunk):
+            n = min(chunk, rows - start)
+            label = (rng.random(n) < 0.25).astype(np.int8)
+            ints = (rng.zipf(1.5, size=(n, NUM_INT)) - 1).astype(np.int64)
+            cats = rng.zipf(1.3, size=(n, NUM_CAT)) % vocab_per_field
+            miss = rng.random((n, NUM_INT + NUM_CAT)) < missing_rate
+            out = []
+            for r in range(n):
+                cols = [b"1" if label[r] else b"0"]
+                cols += [b"" if miss[r, c] else str(ints[r, c]).encode()
+                         for c in range(NUM_INT)]
+                cols += [b"" if miss[r, NUM_INT + c] else
+                         b"%08x" % int(cats[r, c]) for c in range(NUM_CAT)]
+                out.append(b"\t".join(cols))
+            f.write(b"\n".join(out) + b"\n")
+
+
+def _text_shards(data_dir: str, rows: int, n_shards: int = 3):
+    """Create/reuse the streaming ladder's text shards under data_dir."""
+    tdir = os.path.join(data_dir, "text")
+    meta = os.path.join(tdir, "meta.json")
+    paths = [os.path.join(tdir, f"shard{s}.tsv") for s in range(n_shards)]
+    if os.path.exists(meta):
+        with open(meta) as f:
+            if json.load(f).get("rows") == rows:
+                return paths
+    os.makedirs(tdir, exist_ok=True)
+    _log(f"synthesizing {rows} text rows into {tdir}...")
+    t0 = time.perf_counter()
+    per = rows // n_shards
+    for s, p in enumerate(paths):
+        synthesize_tsv_fast(p, per + (rows - per * n_shards
+                                      if s == n_shards - 1 else 0), seed=s)
+    with open(meta, "w") as f:
+        json.dump({"rows": rows}, f)
+    _log(f"text synthesized in {time.perf_counter() - t0:.1f}s")
+    return paths
 
 
 def _rate(make_iter, seconds: float, batch: int,
@@ -90,6 +155,23 @@ def main():
                     help="add the DedupAuxBatches stage (per-batch argsort "
                          "+ segment maps on the host) — the feed-rate cost "
                          "of TrainConfig.host_dedup")
+    ap.add_argument("--no-stream", action="store_true", dest="no_stream",
+                    help="skip the streaming-ingest ladder (text "
+                         "synthesis + stream_py/stream_native stages)")
+    ap.add_argument("--stream-rows", type=int, default=1_500_000,
+                    dest="stream_rows",
+                    help="synthetic text rows for the streaming ladder "
+                         "(3 shards; epochs cycle if the window drains "
+                         "them)")
+    ap.add_argument("--stream-py-batch", type=int, default=2048,
+                    dest="stream_py_batch",
+                    help="batch size for the stream_py stage only (the "
+                         "pure-Python parser is ~3 orders of magnitude "
+                         "slower; a headline-sized batch would blow the "
+                         "measurement window)")
+    ap.add_argument("--stream-py-seconds", type=float, default=6.0,
+                    dest="stream_py_seconds",
+                    help="measurement window for the stream_py stage")
     args = ap.parse_args()
     if args.compact_cap and not args.host_dedup:
         ap.error("--compact-cap requires --host-dedup")
@@ -176,14 +258,66 @@ def main():
         _log(f"{name:16s} {r:12.0f} samples/s "
              f"({r / TARGET_PER_CHIP:.2f}x one chip's need)")
 
+    streaming = None
+    if not args.no_stream:
+        # Streaming-ingest ladder (ISSUE 6): raw text through the
+        # hardened ShardReader/RecordGuard path, priced per parser.
+        from fm_spark_tpu.data import NativeStreamBatches, ShardReader
+        from fm_spark_tpu.data.stream import StreamBatches, line_parser
+        from fm_spark_tpu.data.native_stream import native_stream_supported
+        from fm_spark_tpu.data.criteo import NUM_FIELDS
+
+        paths = _text_shards(args.data_dir, args.stream_rows)
+        nf = NUM_FIELDS * bucket
+
+        def stream_py():
+            return StreamBatches(
+                ShardReader(paths), line_parser("criteo", bucket),
+                args.stream_py_batch, NUM_FIELDS, num_features=nf)
+
+        def stream_native():
+            return NativeStreamBatches(
+                ShardReader(paths, chunk_bytes=1 << 22), "criteo",
+                args.batch, NUM_FIELDS, num_features=nf, bucket=bucket)
+
+        streaming = {}
+        r = _rate(stream_py, args.stream_py_seconds, args.stream_py_batch)
+        streaming["stream_py"] = r
+        _log(f"{'stream_py':22s} {r:12.0f} rows/s (per-line Python parse)")
+        if native_stream_supported("criteo", NUM_FIELDS, bucket):
+            r = _rate(stream_native, args.seconds, args.batch)
+            streaming["stream_native"] = r
+            _log(f"{'stream_native':22s} {r:12.0f} rows/s")
+            r = _rate(
+                lambda: Prefetcher(stream_native(),
+                                   depth=args.prefetch_depth,
+                                   device_put=True),
+                args.seconds, args.batch,
+                lambda b: jax.block_until_ready(b))
+            streaming["stream_native+prefetch"] = r
+            _log(f"{'stream_native+prefetch':22s} {r:12.0f} rows/s")
+        else:
+            _log("stream_native SKIPPED (native chunk parser unavailable)")
+        streaming = {k: round(v, 1) for k, v in streaming.items()}
+        if "stream_native+prefetch" in streaming:
+            streaming["speedup_vs_py"] = round(
+                streaming["stream_native+prefetch"]
+                / streaming["stream_py"], 1)
+            streaming["vs_packed_batches"] = round(
+                streaming["stream_native+prefetch"]
+                / rates["packed_batches"], 4)
+
     end_to_end = rates["+prefetcher"]
-    print(json.dumps({
+    payload = {
         "metric": METRIC,
         "value": round(end_to_end, 1),
         "unit": "samples/sec",
         "vs_baseline": round(end_to_end / TARGET_PER_CHIP, 4),
         "stages": {k: round(v, 1) for k, v in rates.items()},
-    }))
+    }
+    if streaming is not None:
+        payload["streaming_rows_per_sec"] = streaming
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -1278,11 +1278,38 @@ def cmd_train(args) -> int:
         reader = ShardReader(paths,
                              header_prefix=(b"id," if cfg.dataset ==
                                             "avazu" else None))
-        batches = StreamBatches(
-            reader, line_parser(cfg.dataset, cfg.bucket),
-            tconfig.batch_size, max_nnz=cfg.num_fields,
-            guard=_ingest_guard(args), num_features=cfg.num_features,
-        )
+        if args.native_ingest:
+            # Native-rate ingest (ISSUE 6): C++ chunk parse with the
+            # exactly-once cursor and quarantine semantics preserved
+            # bit-identically; falls back to the per-line Python path
+            # automatically when libfmfast.so is absent or the config
+            # is outside the native contract.
+            from fm_spark_tpu.data.native_stream import (
+                NativeStreamBatches,
+                make_stream_batches,
+                native_stream_unsupported_reason,
+            )
+
+            batches = make_stream_batches(
+                reader, cfg.dataset, tconfig.batch_size,
+                max_nnz=cfg.num_fields, guard=_ingest_guard(args),
+                num_features=cfg.num_features, bucket=cfg.bucket,
+                native_ingest="auto",
+            )
+            if not isinstance(batches, NativeStreamBatches):
+                print(
+                    "cli: --native-ingest fell back to the pure-Python "
+                    "streaming parser: "
+                    + str(native_stream_unsupported_reason(
+                        cfg.dataset, cfg.num_fields, cfg.bucket)),
+                    file=sys.stderr,
+                )
+        else:
+            batches = StreamBatches(
+                reader, line_parser(cfg.dataset, cfg.bucket),
+                tconfig.batch_size, max_nnz=cfg.num_fields,
+                guard=_ingest_guard(args), num_features=cfg.num_features,
+            )
         if cfg.field_local_ids:
             # Producer-thread id conversion, same placement as the
             # packed StreamingBatches path; the guard surfaces through
@@ -1835,6 +1862,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--prefetch", type=int, default=2,
                    help="background batch read-ahead depth (0 = off); "
                         "overlaps host batch assembly with device compute")
+    t.add_argument("--native-ingest", action="store_true",
+                   dest="native_ingest",
+                   help="parse streaming raw-text shards with the C++ "
+                        "chunk parser (ISSUE 6): same exactly-once "
+                        "cursor, quarantine semantics, and record "
+                        "stream as the per-line Python path, at native "
+                        "rate; falls back to the Python parser "
+                        "automatically when libfmfast.so is absent")
     t.add_argument("--data-policy", default="strict", dest="data_policy",
                    choices=["strict", "quarantine"],
                    help="per-record error policy for raw-text ingest "

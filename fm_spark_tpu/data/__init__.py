@@ -34,3 +34,8 @@ from fm_spark_tpu.data.stream import (  # noqa: F401
     StreamBatches,
     line_parser,
 )
+from fm_spark_tpu.data.native_stream import (  # noqa: F401
+    NativeStreamBatches,
+    make_stream_batches,
+    native_stream_supported,
+)

@@ -384,29 +384,37 @@ class RecordGuard:
 
     # -------------------------------------------------- schema contract
 
+    @staticmethod
+    def violation(label, idx, val, *, num_features: int = 0,
+                  max_nnz: int = 0) -> str | None:
+        """Side-effect-free value-contract classifier: the reason string
+        a parsed row would be rejected with, or ``None`` if admissible.
+        Split out of :meth:`admit` so the native chunk path
+        (data/native_stream.py) can classify at parse time and defer
+        the guard's counters/policy to consume time — reason strings
+        stay bit-identical between the two ingest paths."""
+        if not math.isfinite(label):
+            return f"non-finite label {label!r}"
+        if max_nnz and len(idx) > max_nnz:
+            return f"row has {len(idx)} non-zeros, max_nnz is {max_nnz}"
+        for v in val:
+            if not math.isfinite(v):
+                return f"non-finite value {v!r}"
+        for i in idx:
+            if i < 0 or (num_features and i >= num_features):
+                return (
+                    f"feature id {i} outside the hash bucket "
+                    f"[0, {num_features})" if num_features
+                    else f"negative feature id {i}"
+                )
+        return None
+
     def admit(self, path, lineno, line, label, idx, val, *,
               num_features: int = 0, max_nnz: int = 0) -> bool:
         """Validate one PARSED row against the value contract; counts it
         (ok or bad per policy) and returns whether it may train."""
-        reason = None
-        if not math.isfinite(label):
-            reason = f"non-finite label {label!r}"
-        if reason is None and max_nnz and len(idx) > max_nnz:
-            reason = f"row has {len(idx)} non-zeros, max_nnz is {max_nnz}"
-        if reason is None:
-            for v in val:
-                if not math.isfinite(v):
-                    reason = f"non-finite value {v!r}"
-                    break
-        if reason is None:
-            for i in idx:
-                if i < 0 or (num_features and i >= num_features):
-                    reason = (
-                        f"feature id {i} outside the hash bucket "
-                        f"[0, {num_features})" if num_features
-                        else f"negative feature id {i}"
-                    )
-                    break
+        reason = self.violation(label, idx, val, num_features=num_features,
+                                max_nnz=max_nnz)
         if reason is not None:
             self.bad(path, lineno, line, reason)
             return False

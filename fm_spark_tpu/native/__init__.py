@@ -94,6 +94,24 @@ def _load():
                 ctypes.c_int32, ctypes.c_int, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
             ]
+        for sym in ("fm_parse_criteo_rows", "fm_parse_avazu_rows"):
+            if hasattr(lib, sym):
+                fn = getattr(lib, sym)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p,
+                ]
+        if hasattr(lib, "fm_parse_libsvm_rows"):
+            lib.fm_parse_libsvm_rows.restype = ctypes.c_int64
+            lib.fm_parse_libsvm_rows.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
         _lib = lib
         return _lib
 
@@ -317,3 +335,88 @@ def gather_rows_native(ids: np.ndarray, vals: np.ndarray | None,
         out_labels.ctypes.data,
     )
     return out_ids, out_vals, out_labels
+
+
+# -------------------------------------------------- streaming chunk parse
+
+#: Per-row status codes shared with the C++ chunk-row parsers: OK rows
+#: are guaranteed bit-identical to the pure-Python parser AND pre-
+#: validated against the RecordGuard value contract; SKIP rows carry no
+#: record (blank / libsvm comment); REPARSE rows route back through the
+#: per-line Python oracle so every verdict and error string stays exact.
+STREAM_OK, STREAM_SKIP, STREAM_REPARSE = 0, 1, 2
+
+_STREAM_SYMBOLS = {
+    "criteo": "fm_parse_criteo_rows",
+    "avazu": "fm_parse_avazu_rows",
+    "libsvm": "fm_parse_libsvm_rows",
+}
+
+#: Hashed fields per fixed-field dataset (mirrors data/criteo.py and
+#: data/avazu.py NUM_FIELDS without importing them — the data layer
+#: imports this module).
+STREAM_FIELDS = {"criteo": 39, "avazu": 23}
+
+
+def stream_parse_available(dataset: str) -> bool:
+    """True iff the native chunk-row parser for ``dataset`` is live
+    (library loaded AND the symbol present — a stale cached .so must
+    degrade to the pure-Python streaming path, never AttributeError)."""
+    lib = _load()
+    sym = _STREAM_SYMBOLS.get(dataset)
+    return lib is not None and sym is not None and hasattr(lib, sym)
+
+
+def parse_stream_chunk(dataset: str, chunk: bytes, *, bucket: int = 0,
+                       per_field: bool = True, num_features: int = 0,
+                       max_nnz: int = 0, zero_based: bool = False):
+    """Chunk-row parse for the streaming ingest (data/native_stream.py).
+
+    ``chunk`` must end on a line boundary (terminating ``\\n``). Returns
+    ``(ids, vals, labels, status, rowlen)`` where ``ids`` is
+    ``[n_lines, F]`` int32 (``F = max_nnz`` for libsvm, the dataset's
+    field count otherwise), ``vals`` is ``[n_lines, max_nnz]`` float32
+    for libsvm and ``None`` for the all-ones criteo/avazu formats,
+    ``labels`` float32, ``status`` uint8 per :data:`STREAM_OK` /
+    :data:`STREAM_SKIP` / :data:`STREAM_REPARSE`, and ``rowlen`` int64
+    per-row consumed bytes (newline included) — the exactly-once
+    cursor's advance array. Returns ``None`` when the native parser is
+    unavailable or the id space overflows int32 (callers fall back to
+    the pure-Python path).
+    """
+    lib = _load()
+    sym = _STREAM_SYMBOLS.get(dataset)
+    if lib is None or sym is None or not hasattr(lib, sym):
+        return None
+    n = chunk.count(b"\n")
+    status = np.empty(n, np.uint8)
+    rowlen = np.empty(n, np.int64)
+    labels = np.empty(n, np.float32)
+    if dataset == "libsvm":
+        S = int(max_nnz)
+        if S < 1:
+            return None
+        ids = np.empty((n, S), np.int32)
+        vals = np.empty((n, S), np.float32)
+        got = lib.fm_parse_libsvm_rows(
+            chunk, len(chunk), int(zero_based), S, int(num_features), n,
+            ids.ctypes.data, vals.ctypes.data, labels.ctypes.data,
+            status.ctypes.data, rowlen.ctypes.data,
+        )
+    else:
+        F = STREAM_FIELDS[dataset]
+        if per_field and F * int(bucket) > np.iinfo(np.int32).max:
+            return None  # id space overflows int32 — let Python decide
+        ids = np.empty((n, F), np.int32)
+        vals = None
+        got = getattr(lib, sym)(
+            chunk, len(chunk), int(bucket), int(per_field),
+            int(num_features), n, ids.ctypes.data, labels.ctypes.data,
+            status.ctypes.data, rowlen.ctypes.data,
+        )
+    if got != n:
+        raise RuntimeError(
+            f"native {dataset} chunk parse scanned {got} of {n} lines — "
+            "the chunk did not end on a line boundary"
+        )
+    return ids, vals, labels, status, rowlen

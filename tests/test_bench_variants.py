@@ -220,6 +220,15 @@ def test_dirty_input_leg_quarantines_exactly_the_injected_lines(tmp_path):
     assert stats["bad_records"] == 60
     assert stats["quarantine_exact"] is True
     assert stats["rows_per_sec"] > 0
+    # Priced both ways (ISSUE 6): when the native chunk parser is
+    # available the leg re-runs under it and asserts the quarantine
+    # accounting is identical, not just similar.
+    from fm_spark_tpu.data.native_stream import native_stream_supported
+
+    if native_stream_supported("criteo", 39, 1 << 14):
+        assert stats["rows_per_sec_native"] > 0
+        assert stats["native_quarantine_exact"] is True
+        assert stats["native_counters_match"] is True
     # The dead-letter journal landed beside the artifacts.
     from fm_spark_tpu.utils.logging import read_events
 

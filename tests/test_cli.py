@@ -777,6 +777,46 @@ def test_cli_streaming_text_breaker_aborts_above_max_bad_frac(tmp_path):
         ])
 
 
+def test_cli_streaming_native_ingest_quarantines_identically(tmp_path,
+                                                             capsys):
+    """--native-ingest routes the same shard list through the C++ chunk
+    parser: identical quarantine accounting in the summary line, and an
+    automatic fallback (with a stderr notice) when the native parser is
+    unavailable."""
+    from unittest import mock
+
+    from fm_spark_tpu import native
+    from fm_spark_tpu.utils.logging import read_events
+
+    if not native.stream_parse_available("criteo"):
+        pytest.skip(f"native chunk parser unavailable: "
+                    f"{native.build_error()}")
+    paths = _dirty_shards(tmp_path)
+    qdir = str(tmp_path / "quar")
+    argv = [
+        "train", "--config", "criteo_kaggle_fm_r32",
+        "--data", ",".join(paths),
+        "--steps", "5", "--batch-size", "16", "--test-fraction", "0",
+        "--data-policy", "quarantine", "--quarantine-dir", qdir,
+        "--log-every", "5", "--native-ingest", "--prefetch", "0",
+    ]
+    assert cli.main(argv) == 0
+    bad = [e for e in read_events(qdir + "/deadletter.jsonl")
+           if e["event"] == "bad_record"]
+    assert len(bad) == 1
+    assert bad[0]["path"] == paths[-1] and bad[0]["lineno"] == 6
+    out = capsys.readouterr()
+    assert any('"bad_records": 1' in l for l in out.out.splitlines())
+    assert "fell back" not in out.err
+    # .so unavailable: same command falls back to the Python parser and
+    # says so, instead of failing.
+    with mock.patch.object(native, "stream_parse_available",
+                           lambda dataset: False):
+        assert cli.main(argv + ["--quarantine-dir",
+                                str(tmp_path / "quar2")]) == 0
+    assert "fell back" in capsys.readouterr().err
+
+
 def test_cli_streaming_text_guards(tmp_path):
     paths = _dirty_shards(tmp_path, bad_lines=())
     # quarantine without a dead-letter destination is a config error.

@@ -1,0 +1,719 @@
+"""Native-rate streaming ingest (ISSUE 6): the C++ chunk parse must be
+indistinguishable from the per-line Python path — record stream, cursor,
+quarantine accounting, error text, and kill-and-resume behavior all
+bit-identical — while parsing orders of magnitude faster.
+
+Three layers of assurance:
+
+1. **Differential fuzz** — ~5k synthetic lines per dataset mixing clean
+   rows with every RecordGuard corruption class (plus the nasty middle
+   ground: rows Python's ``int()``/``float()`` accept but the strict
+   native grammar routes back through the oracle), streamed through
+   both paths batch-by-batch with full array/cursor/dead-letter
+   comparison at every step.
+2. **Protocol drills** — cross-path checkpoint restore (a cursor written
+   by one parser resumes on the other), Prefetcher producer-thread
+   error surfacing, the ingest fault points on the chunk path.
+3. **The SIGKILL drill with native ingest** — kill a native-ingest
+   training run mid-epoch, resume natively, and the concatenated record
+   stream and loss curve equal a pure-Python golden run's.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu import native
+from fm_spark_tpu.data.native_stream import (
+    NativeStreamBatches,
+    make_stream_batches,
+    native_stream_supported,
+)
+from fm_spark_tpu.data.stream import (
+    BadRecord,
+    IngestAborted,
+    RecordGuard,
+    ShardReader,
+    StreamBatches,
+    line_parser,
+)
+from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.utils.logging import read_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_native = pytest.mark.skipif(
+    not (native.stream_parse_available("criteo")
+         and native.stream_parse_available("avazu")
+         and native.stream_parse_available("libsvm")),
+    reason=f"native chunk parsers unavailable: {native.build_error()}",
+)
+
+
+# ------------------------------------------------------- line generators
+
+
+def _criteo_lines(rng, n):
+    """Clean Criteo TSV rows + every corruption class, ~10:1."""
+    from fm_spark_tpu.data.criteo import NUM_CAT, NUM_INT
+
+    dirty = [
+        b"\x00garbage \xff\xfe",                      # binary noise
+        b"1\tonly\tthree\tcols",                      # wrong column count
+        b"",                                          # blank (skip)
+        b"   \t  ",                                   # whitespace-only
+        b"x" + b"\t1" * (NUM_INT + NUM_CAT),          # non-integer label
+        b"1\tfoo" + b"\t1" * (NUM_INT + NUM_CAT - 1),  # bad count token
+        b"1" + b"\t2" * (NUM_INT + NUM_CAT) + b"\t",  # trailing extra col
+        # Python-parseable, outside the strict native grammar — must
+        # come back bit-identical through the oracle fallback:
+        b"+1" + b"\t3" * (NUM_INT + NUM_CAT),          # '+' label
+        b"1\t+7" + b"\t4" * (NUM_INT + NUM_CAT - 1),   # '+' count token
+        b"1\t" + b"1" * 21 + b"\t5" * (NUM_INT + NUM_CAT - 1),  # 21-digit
+        b"1\t-abc" + b"\t6" * (NUM_INT + NUM_CAT - 1),  # '-junk' = NEG_KEY
+    ]
+    out = []
+    for i in range(n):
+        if i % 10 == 3 and i // 10 < len(dirty) * 40:
+            out.append(dirty[(i // 10) % len(dirty)])
+            continue
+        cols = [b"1" if rng.random() < 0.3 else b"0"]
+        for _ in range(NUM_INT):
+            cols.append(b"" if rng.random() < 0.1
+                        else str(int(rng.integers(0, 5000))).encode())
+        for _ in range(NUM_CAT):
+            cols.append(b"" if rng.random() < 0.1
+                        else b"%06x" % int(rng.integers(0, 4000)))
+        out.append(b"\t".join(cols))
+    return out
+
+
+def _avazu_lines(rng, n):
+    dirty = [
+        b"\x00garbage",
+        b"1,2,3",                                     # wrong column count
+        b"",
+        b"id,click,hour" + b",h" * 21,                # header-shaped mid-file
+        b"1,1,14bad103" + b",t" * 21,                 # non-digit hour
+        b"1,0,14134108" + b",t" * 21,                 # month 13
+        b"1,0,14103208" + b",t" * 21,                 # day 32
+        b"1,0,1410" + b",t" * 21,                     # hour too short
+        b"1,0,+1102108" + b",t" * 21,                 # '+' date: Python-ok
+    ]
+    out = []
+    for i in range(n):
+        if i % 10 == 4 and i // 10 < len(dirty) * 40:
+            out.append(dirty[(i // 10) % len(dirty)])
+            continue
+        day = int(rng.integers(21, 29))
+        hh = int(rng.integers(0, 24))
+        cols = [str(10_000_000 + i).encode(),
+                b"1" if rng.random() < 0.2 else b"0",
+                f"1410{day:02d}{hh:02d}".encode()]
+        cols += [b"%05x" % int(rng.integers(0, 3000)) for _ in range(21)]
+        out.append(b",".join(cols))
+    return out
+
+
+def _libsvm_lines(rng, n, num_features=512, max_nnz=6):
+    dirty = [
+        b"# a full-line comment",                     # skip, not a record
+        b"",
+        b"1:2.5 3:1",                                  # missing label
+        b"abc 1:2",                                    # unparseable label
+        b"1 2:3:4",                                    # malformed pair
+        b"1 :5",                                       # empty idx
+        b"1 5:",                                       # empty val
+        b"1 -3:1",                                     # negative idx
+        b"0 0:1",                                      # one-based 0 -> -1
+        b"1 9999:1",                                   # id out of bucket
+        b"1 " + b" ".join(b"%d:1" % (i + 1) for i in range(9)),  # nnz > S
+        b"1 2:inf",                                    # non-finite value
+        b"inf 2:1",                                    # non-finite label
+        b"1e999 2:1",                                  # overflow label
+        # Python-parseable, native-REPARSE — oracle fallback must agree:
+        b"+1.5 2:1.25",
+        b"1 1_0:2.5",                                  # int('1_0') == 10
+        b"1 3:1_0.5",                                  # float('1_0.5')
+        b"1",                                          # zero-nnz row: valid
+        b"1 4:1e2  # trailing comment",
+    ]
+    out = []
+    for i in range(n):
+        if i % 8 == 2 and i // 8 < len(dirty) * 40:
+            out.append(dirty[(i // 8) % len(dirty)])
+            continue
+        nnz = int(rng.integers(1, max_nnz + 1))
+        idx = rng.choice(num_features, size=nnz, replace=False) + 1
+        pairs = b" ".join(b"%d:%s" % (int(ix), f"{v:.6g}".encode())
+                          for ix, v in zip(idx, rng.normal(size=nnz)))
+        out.append(b"%d %s" % (i % 2, pairs))
+    return out
+
+
+def _write_shards(tmp_path, lines, n_shards=3, name="shard{}.txt",
+                  header=None, crlf_every=0, unterminated=False):
+    paths = []
+    per = (len(lines) + n_shards - 1) // n_shards
+    for s in range(n_shards):
+        part = lines[s * per: (s + 1) * per]
+        p = str(tmp_path / name.format(s))
+        with open(p, "wb") as f:
+            if header is not None and s == 0:
+                f.write(header + b"\n")
+            for j, line in enumerate(part):
+                term = b"\r\n" if crlf_every and j % crlf_every == 1 \
+                    else b"\n"
+                f.write(line + term)
+            if unterminated and s == n_shards - 1:
+                f.write(b"0 1:1" if name.endswith(".svm")
+                        else part[0] if part else b"")
+        paths.append(p)
+    return paths
+
+
+# ------------------------------------------------- differential equivalence
+
+
+def _pair(paths, dataset, tmp_path, tag, batch_size, max_nnz,
+          num_features, bucket=0, chunk_py=97, chunk_nat=311,
+          max_bad_frac=1.0, header_prefix=None):
+    """(python_batches, native_batches) over the same shards with
+    separate quarantine dirs, deliberately different chunk sizes (the
+    cursor must not care where chunk boundaries fall)."""
+    gp = RecordGuard("quarantine", quarantine_dir=str(tmp_path / f"qp{tag}"),
+                     max_bad_frac=max_bad_frac)
+    gn = RecordGuard("quarantine", quarantine_dir=str(tmp_path / f"qn{tag}"),
+                     max_bad_frac=max_bad_frac)
+    py = StreamBatches(
+        ShardReader(paths, chunk_bytes=chunk_py,
+                    header_prefix=header_prefix),
+        line_parser(dataset, bucket), batch_size, max_nnz, guard=gp,
+        num_features=num_features)
+    nat = NativeStreamBatches(
+        ShardReader(paths, chunk_bytes=chunk_nat,
+                    header_prefix=header_prefix),
+        dataset, batch_size, max_nnz, guard=gn,
+        num_features=num_features, bucket=bucket)
+    return py, nat
+
+
+def _assert_equivalent(py, nat, n_batches):
+    for i in range(n_batches):
+        a, b = py.next_batch(), nat.next_batch()
+        for name, x, y in zip(("ids", "vals", "labels", "weights"), a, b):
+            np.testing.assert_array_equal(
+                x, y, err_msg=f"batch {i} {name} diverged")
+        assert py.state() == nat.state(), f"cursor diverged at batch {i}"
+    assert py.guard.counters() == nat.guard.counters()
+    kp = [(e["path"], e["lineno"], e["reason"], e["line"])
+          for e in read_events(py.guard.dead_letter_path)
+          if e["event"] == "bad_record"]
+    kn = [(e["path"], e["lineno"], e["reason"], e["line"])
+          for e in read_events(nat.guard.dead_letter_path)
+          if e["event"] == "bad_record"]
+    assert kp == kn, "dead-letter journals diverged"
+
+
+@needs_native
+def test_differential_fuzz_criteo(tmp_path, rng):
+    from fm_spark_tpu.data.criteo import NUM_FIELDS
+
+    bucket = 1 << 14
+    lines = _criteo_lines(rng, 5000)
+    paths = _write_shards(tmp_path, lines, name="s{}.tsv", crlf_every=7)
+    py, nat = _pair(paths, "criteo", tmp_path, "c", 256, NUM_FIELDS,
+                    NUM_FIELDS * bucket, bucket=bucket)
+    # ~1.3 epochs: crosses every shard seam and the epoch rewind.
+    _assert_equivalent(py, nat, 24)
+    assert py.guard.n_bad > 100  # the corruption classes actually fired
+    assert nat.state()["epoch"] >= 1
+
+
+@needs_native
+def test_differential_fuzz_avazu(tmp_path, rng):
+    from fm_spark_tpu.data.avazu import NUM_FIELDS
+
+    bucket = 1 << 13
+    lines = _avazu_lines(rng, 5000)
+    paths = _write_shards(tmp_path, lines, name="s{}.csv",
+                          header=b"id,click,hour" + b",h" * 21)
+    py, nat = _pair(paths, "avazu", tmp_path, "a", 256, NUM_FIELDS,
+                    NUM_FIELDS * bucket, bucket=bucket,
+                    header_prefix=b"id,")
+    _assert_equivalent(py, nat, 24)
+    assert py.guard.n_bad > 100
+    assert nat.state()["epoch"] >= 1
+
+
+@needs_native
+def test_differential_fuzz_libsvm(tmp_path, rng):
+    lines = _libsvm_lines(rng, 5000)
+    paths = _write_shards(tmp_path, lines, name="s{}.svm", crlf_every=5)
+    py, nat = _pair(paths, "libsvm", tmp_path, "l", 256, 6, 512)
+    _assert_equivalent(py, nat, 30)
+    assert py.guard.n_bad > 100
+    assert nat.state()["epoch"] >= 1
+
+
+@needs_native
+def test_unterminated_final_line_and_tiny_chunks(tmp_path):
+    """A shard whose last line has no newline, read through chunk sizes
+    down to 1 byte — offsets must stay byte-exact."""
+    p = str(tmp_path / "u.svm")
+    with open(p, "wb") as f:
+        f.write(b"1 1:1.0\n0 2:1.0\r\n1 3:2.5")  # unterminated final line
+    for chunk in (1, 3, 64, 1 << 16):
+        nat = NativeStreamBatches(ShardReader([p], chunk_bytes=chunk),
+                                  "libsvm", 2, 2, num_features=16)
+        py = StreamBatches(ShardReader([p], chunk_bytes=5),
+                           line_parser("libsvm"), 2, 2, num_features=16)
+        for _ in range(3):
+            a, b = py.next_batch(), nat.next_batch()
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+            assert py.state() == nat.state()
+
+
+@needs_native
+def test_strict_policy_raises_identical_badrecord(tmp_path):
+    lines = [b"1 1:1.0", b"garbage line", b"0 2:1.0"]
+    paths = _write_shards(tmp_path, lines, n_shards=1, name="s{}.svm")
+    msgs = []
+    for cls, kwargs in ((StreamBatches,
+                         dict(parse=line_parser("libsvm"))),
+                        (NativeStreamBatches, dict(dataset="libsvm"))):
+        src = (cls(ShardReader(paths), kwargs.get("parse"), 4, 2,
+                   num_features=16) if cls is StreamBatches else
+               cls(ShardReader(paths), "libsvm", 4, 2, num_features=16))
+        with pytest.raises(BadRecord) as ei:
+            src.next_batch()
+        msgs.append(str(ei.value))
+    assert msgs[0] == msgs[1]
+    assert "s0.svm:2" in msgs[0]
+
+
+@needs_native
+def test_breaker_aborts_on_native_path(tmp_path):
+    lines = [b"1 1:1.0"] * 20 + [b"garbage"] * 40 + [b"0 2:1.0"] * 20
+    paths = _write_shards(tmp_path, lines, n_shards=1, name="s{}.svm")
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"),
+                        max_bad_frac=0.2, window=32, min_records=16)
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 8, 2,
+                              guard=guard, num_features=16)
+    with pytest.raises(IngestAborted, match="max_bad_frac"):
+        for _ in range(12):
+            nat.next_batch()
+    aborted = [e for e in read_events(guard.dead_letter_path)
+               if e["event"] == "ingest_aborted"]
+    assert len(aborted) == 1
+
+
+# --------------------------------------------------- cross-path checkpoints
+
+
+@needs_native
+def test_cursor_cross_restores_between_python_and_native(tmp_path, rng):
+    """A checkpoint cursor written by either ingest path resumes on the
+    other with a bit-identical continuation — the operational guarantee
+    behind flipping --native-ingest on an existing run."""
+    lines = _libsvm_lines(rng, 600)
+    paths = _write_shards(tmp_path, lines, name="s{}.svm")
+
+    def fresh(kind, tag):
+        guard = RecordGuard("quarantine",
+                            quarantine_dir=str(tmp_path / f"q{tag}"))
+        if kind == "py":
+            return StreamBatches(ShardReader(paths, chunk_bytes=53),
+                                 line_parser("libsvm"), 32, 6, guard=guard,
+                                 num_features=512)
+        return NativeStreamBatches(ShardReader(paths, chunk_bytes=201),
+                                   "libsvm", 32, 6, guard=guard,
+                                   num_features=512)
+
+    for src_kind, dst_kind in (("py", "native"), ("native", "py")):
+        src = fresh(src_kind, f"s_{src_kind}")
+        for _ in range(5):
+            src.next_batch()
+        state = src.state()
+        want = [src.next_batch() for _ in range(8)]
+        dst = fresh(dst_kind, f"d_{dst_kind}")
+        dst.restore(state)
+        got = [dst.next_batch() for _ in range(8)]
+        for a, b in zip(want, got):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+        assert src.state() == dst.state()
+
+
+# ------------------------------------------------------- prefetcher drills
+
+
+@needs_native
+def test_prefetcher_surfaces_producer_exceptions_not_hang(tmp_path):
+    """Producer-thread failures mid-chunk must surface on the consumer
+    as the original BadRecord / IngestAborted, promptly."""
+    from fm_spark_tpu.data import Prefetcher
+
+    lines = [b"1 1:1.0"] * 40 + [b"garbage"] * 60
+    paths = _write_shards(tmp_path, lines, n_shards=1, name="s{}.svm")
+    # strict: BadRecord out of the producer thread.
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 8, 2,
+                              num_features=16)
+    with Prefetcher(nat, depth=2) as pf:
+        t0 = time.time()
+        with pytest.raises(BadRecord, match=r"s0\.svm:41"):
+            for _ in range(12):
+                pf.next_batch()
+        assert time.time() - t0 < 30
+    # breaker: IngestAborted out of the producer thread.
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"),
+                        max_bad_frac=0.2, window=32, min_records=16)
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 8, 2,
+                              guard=guard, num_features=16)
+    with Prefetcher(nat, depth=2) as pf:
+        with pytest.raises(IngestAborted):
+            for _ in range(12):
+                pf.next_batch()
+
+
+@needs_native
+def test_prefetcher_state_restore_through_native_batch_boundary(tmp_path,
+                                                                rng):
+    """Prefetcher.state() is the cursor of the last CONSUMED batch; a
+    restore from it onto a fresh native source (restore-then-wrap, per
+    the Prefetcher contract) replays exactly the unseen batches."""
+    from fm_spark_tpu.data import Prefetcher
+
+    lines = [b"%d %d:1.5 %d:0.5" % (j % 2, j + 1, j + 2)
+             for j in range(400)]
+    paths = _write_shards(tmp_path, lines, name="s{}.svm")
+
+    def fresh():
+        return NativeStreamBatches(ShardReader(paths, chunk_bytes=173),
+                                   "libsvm", 32, 6, num_features=512)
+
+    golden_src = fresh()
+    golden = [golden_src.next_batch() for _ in range(10)]
+
+    src = fresh()
+    pf = Prefetcher(src, depth=3)
+    for i in range(4):
+        batch = pf.next_batch()
+        for x, y in zip(golden[i], batch):
+            np.testing.assert_array_equal(x, y)
+    state = pf.state()
+    pf.close()
+
+    resumed = fresh()
+    resumed.restore(state)
+    with Prefetcher(resumed, depth=3) as pf2:
+        for i in range(4, 10):
+            batch = pf2.next_batch()
+            for x, y in zip(golden[i], batch):
+                np.testing.assert_array_equal(x, y)
+
+
+# ------------------------------------------------------------ fault points
+
+
+@needs_native
+def test_ingest_corrupt_fault_takes_policy_path_on_native_chunk(tmp_path):
+    lines = [b"1 1:1.0"] * 10
+    paths = _write_shards(tmp_path, lines, n_shards=1, name="s{}.svm")
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "q"))
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 4, 2,
+                              guard=guard, num_features=16)
+    faults.activate("ingest_corrupt@1=error")
+    try:
+        nat.next_batch()
+    finally:
+        faults.clear()
+    # The chunk's first record went through quarantine with the injected
+    # reason; everything else parsed.
+    assert guard.n_bad == 1
+    events = read_events(guard.dead_letter_path)
+    assert any("ingest_corrupt" in e["reason"] for e in events)
+
+    # strict: the same injection raises BadRecord.
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 4, 2,
+                              num_features=16)
+    faults.activate("ingest_corrupt@1=error")
+    try:
+        with pytest.raises(BadRecord):
+            nat.next_batch()
+    finally:
+        faults.clear()
+    # A leading blank line is never the fault's victim (the per-record
+    # path skips blanks BEFORE its inject point): the first REAL record
+    # takes the hit.
+    paths2 = _write_shards(tmp_path, [b"", b"   ", b"1 1:1.0", b"0 2:1.0"],
+                           n_shards=1, name="b{}.svm")
+    guard = RecordGuard("quarantine", quarantine_dir=str(tmp_path / "qb"))
+    nat = NativeStreamBatches(ShardReader(paths2), "libsvm", 2, 2,
+                              guard=guard, num_features=16)
+    faults.activate("ingest_corrupt@1=error")
+    try:
+        nat.next_batch()
+    finally:
+        faults.clear()
+    events = read_events(guard.dead_letter_path)
+    assert len(events) == 1 and events[0]["lineno"] == 3
+
+
+@needs_native
+def test_ingest_fault_device_loss_and_truncate_propagate(tmp_path):
+    lines = [b"1 1:1.0"] * 10
+    paths = _write_shards(tmp_path, lines, n_shards=1, name="s{}.svm")
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 4, 2,
+                              num_features=16)
+    faults.activate("ingest_corrupt@1=device_loss")
+    try:
+        with pytest.raises(faults.InjectedDeviceLoss):
+            nat.next_batch()
+    finally:
+        faults.clear()
+    nat = NativeStreamBatches(ShardReader(paths), "libsvm", 4, 2,
+                              num_features=16)
+    faults.activate("ingest_truncate@1=error")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            nat.next_batch()
+    finally:
+        faults.clear()
+
+
+# ------------------------------------------------------- factory / fallback
+
+
+@needs_native
+def test_factory_picks_native_and_falls_back(tmp_path):
+    lines = [b"1 1:1.0", b"0 2:1.0"]
+    paths = _write_shards(tmp_path, lines, n_shards=1, name="s{}.svm")
+    got = make_stream_batches(ShardReader(paths), "libsvm", 2, 2,
+                              num_features=16)
+    assert isinstance(got, NativeStreamBatches)
+    # .so absent -> silent fallback under "auto", hard error under True.
+    with mock.patch.object(native, "stream_parse_available",
+                           lambda dataset: False):
+        got = make_stream_batches(ShardReader(paths), "libsvm", 2, 2,
+                                  num_features=16)
+        assert isinstance(got, StreamBatches)
+        assert not isinstance(got, NativeStreamBatches)
+        with pytest.raises(RuntimeError, match="native ingest requested"):
+            make_stream_batches(ShardReader(paths), "libsvm", 2, 2,
+                                num_features=16, native_ingest=True)
+    # Fixed-field formats need max_nnz >= field count to be expressible.
+    assert not native_stream_supported("criteo", max_nnz=10, bucket=1 << 10)
+    assert native_stream_supported("criteo", max_nnz=39, bucket=1 << 10)
+
+
+# ------------------------------------------- acceptance: SIGKILL drill
+
+
+_KILL_CHILD = """
+import json, os, sys
+
+sys.path.insert(0, {repo!r})
+from fm_spark_tpu import models
+from fm_spark_tpu.checkpoint import Checkpointer
+from fm_spark_tpu.data.stream import ShardReader
+from fm_spark_tpu.data.native_stream import NativeStreamBatches
+from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+shard_dir, ck_dir, tap_path, steps = sys.argv[1:5]
+paths = sorted(os.path.join(shard_dir, f) for f in os.listdir(shard_dir))
+
+
+class Tap:
+    def __init__(self, source, path):
+        self._source = source
+        self._f = open(path, "a")
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        self._f.write(",".join(str(int(x)) for x in ids[w > 0][:, 0]))
+        self._f.write("\\n")
+        self._f.flush()
+        return ids, vals, labels, w
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, s):
+        self._source.restore(s)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+config = TrainConfig(num_steps=int(steps), batch_size=16,
+                     learning_rate=0.1, lr_schedule="constant",
+                     log_every=1)
+ck = Checkpointer(ck_dir, save_every=4, async_save=False)
+batches = Tap(NativeStreamBatches(ShardReader(paths, chunk_bytes=64),
+                                  "libsvm", 16, 3, num_features=128),
+              tap_path)
+trainer = FMTrainer(spec, config)
+trainer.fit(batches, checkpointer=ck)
+ck.close()
+print(json.dumps({{"done": trainer.step_count}}), flush=True)
+"""
+
+
+class _Tap:
+    def __init__(self, source, path):
+        self._source = source
+        self._path = path
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        with open(self._path, "a") as f:
+            f.write(",".join(str(int(x)) for x in ids[w > 0][:, 0]))
+            f.write("\n")
+        return ids, vals, labels, w
+
+    def state(self):
+        return self._source.state()
+
+    def restore(self, s):
+        self._source.restore(s)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+@needs_native
+def test_sigkill_native_ingest_resume_matches_python_golden(tmp_path):
+    """ISSUE 6 acceptance: SIGKILL a NATIVE-ingest run mid-epoch, resume
+    natively from the checkpoint, and the record stream, cursor, and
+    loss curve are bit-identical to an uninterrupted PURE-PYTHON run —
+    exactly-once, across parsers."""
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    paths = []
+    j = 0
+    for s in range(3):
+        p = str(shard_dir / f"shard{s}.svm")
+        with open(p, "w") as f:
+            for _ in range(32):
+                f.write(f"{j % 2} {j + 1}:1.5 {j + 2}:0.5\n")
+                j += 1
+        paths.append(p)
+    steps = 24
+
+    spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+    config = TrainConfig(num_steps=steps, batch_size=16,
+                         learning_rate=0.1, lr_schedule="constant",
+                         log_every=1)
+
+    # Golden: uninterrupted PYTHON-path run over the same stream.
+    golden_tap = str(tmp_path / "tap_golden.txt")
+    golden_src = StreamBatches(ShardReader(paths, chunk_bytes=64),
+                               line_parser("libsvm"), 16, 3,
+                               num_features=128)
+    golden = FMTrainer(spec, config)
+    golden.fit(_Tap(golden_src, golden_tap))
+
+    # Native child SIGKILLed mid-epoch 3 (checkpoints every 4 steps).
+    script = tmp_path / "child.py"
+    script.write_text(_KILL_CHILD.format(repo=REPO))
+    ck_dir = str(tmp_path / "ck")
+    kill_tap = str(tmp_path / "tap_kill.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(shard_dir), ck_dir, kill_tap,
+         str(steps)],
+        stdout=subprocess.PIPE, text=True, cwd=REPO, env=env,
+    )
+    try:
+        deadline = time.time() + 240
+        for line in proc.stdout:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("step", 0) >= 13 or "done" in rec:
+                break
+            assert time.time() < deadline, "child never reached step 13"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        proc.stdout.close()
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+
+    # Resume NATIVELY in-process from the killed run's checkpoint chain.
+    resume_tap = str(tmp_path / "tap_resume.txt")
+    ck = Checkpointer(ck_dir, save_every=4, async_save=False)
+    resume_src = NativeStreamBatches(ShardReader(paths, chunk_bytes=1 << 16),
+                                     "libsvm", 16, 3, num_features=128)
+    resumed = FMTrainer(spec, config)
+    resumed.fit(_Tap(resume_src, resume_tap), checkpointer=ck)
+    ck.close()
+
+    assert resumed.step_count == golden.step_count == steps
+    assert resumed.loss_history == golden.loss_history
+    np.testing.assert_array_equal(np.asarray(golden.params["v"]),
+                                  np.asarray(resumed.params["v"]))
+    assert resume_src.state() == golden_src.state()
+
+    golden_lines = open(golden_tap).read().splitlines()
+    kill_lines = open(kill_tap).read().splitlines()
+    resume_lines = open(resume_tap).read().splitlines()
+    restored_step = steps - len(resume_lines)
+    assert 0 < restored_step < steps
+    assert restored_step % 4 == 0
+    assert kill_lines[:restored_step] == golden_lines[:restored_step]
+    assert resume_lines == golden_lines[restored_step:]
+
+
+# ------------------------------------------------------- build script
+
+
+def test_build_native_check_mode(tmp_path):
+    """tools/build_native.py --check rebuilds with the pinned flags and
+    diffs exported symbols; skips cleanly when no compiler exists."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ on PATH")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "build_native.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "symbol check OK" in proc.stdout
+
+
+def test_build_native_expected_symbols_cover_bindings():
+    """Every symbol the ctypes layer binds is registered in the build
+    script's expected-symbol list (the --check contract)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "build_native_tool", os.path.join(REPO, "tools", "build_native.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for sym in ("fm_parse_criteo_rows", "fm_parse_avazu_rows",
+                "fm_parse_libsvm_rows", "fm_gather_rows", "fm_compact_aux"):
+        assert sym in mod.EXPECTED_SYMBOLS

@@ -11,10 +11,12 @@ silent convention, enforced by nobody.
 
 This lint IS the enforcement, wired into tier-1 via
 tests/test_resilience_lint.py. It AST-parses every module under
-``fm_spark_tpu/resilience/`` — plus the hardened-ingest module
-``fm_spark_tpu/data/stream.py`` (ISSUE 5), whose quarantine/abort state
-transitions (dead-letter records, the rate-breaker abort) carry the
-same machine-readability contract — and flags:
+``fm_spark_tpu/resilience/`` — plus the hardened-ingest modules
+``fm_spark_tpu/data/stream.py`` (ISSUE 5) and the native chunk path
+``fm_spark_tpu/data/native_stream.py`` / ``fm_spark_tpu/native/
+__init__.py`` (ISSUE 6), whose quarantine/abort state transitions
+(dead-letter records, the rate-breaker abort) carry the same
+machine-readability contract — and flags:
 
 - any ``print(...)`` call (state narration belongs in the journal);
 - any ``json.dump``/``json.dumps`` call (an ad-hoc JSON write bypassing
@@ -39,9 +41,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESILIENCE_DIR = os.path.join(REPO, "fm_spark_tpu", "resilience")
 
 #: Modules OUTSIDE resilience/ held to the same EventLog-only rule:
-#: data/stream.py journals quarantine/abort transitions (ISSUE 5).
+#: data/stream.py journals quarantine/abort transitions (ISSUE 5);
+#: data/native_stream.py replays the same guard policy from the native
+#: chunk parse and native/__init__.py is its binding layer (ISSUE 6) —
+#: a stray print/JSON write in either would fork the dead-letter
+#: contract the moment ingest goes native.
 EXTRA_FILES = (
     os.path.join(REPO, "fm_spark_tpu", "data", "stream.py"),
+    os.path.join(REPO, "fm_spark_tpu", "data", "native_stream.py"),
+    os.path.join(REPO, "fm_spark_tpu", "native", "__init__.py"),
 )
 
 #: (filename, enclosing function) pairs exempt from the JSON-write rule.
