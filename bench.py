@@ -107,6 +107,34 @@ METRIC_ENTRY = {
 METRIC, TARGET_PER_CHIP = METRICS["fm"]
 UNIT = "samples/sec/chip"
 
+# The run id shared by the parent and every child attempt (ISSUE 7):
+# all of a run's telemetry — trace/metrics/flight streams AND the
+# health_<model>.jsonl journal — lands under ONE per-run directory,
+# <artifacts>/obs/<run_id>/, and the id is echoed in the result JSON
+# (error lines included) so consumers can find the evidence.
+_RUN_ID = None
+
+
+def _gen_run_id():
+    """Parent-side run-id mint (no fm_spark_tpu import: the parent must
+    stay light — the package pulls jax)."""
+    return time.strftime("%Y%m%d-%H%M%S", time.gmtime()) + f"-p{os.getpid()}"
+
+
+def _obs_run_dir(art_dir, run_id):
+    return os.path.join(art_dir, "obs", run_id)
+
+
+def _renormalize_results(results, prev_chips, n_chips):
+    """Re-normalize banked per-chip rates onto the surviving-chip
+    denominator after an elastic shrink, so ``max()`` ranks every leg
+    on comparable figures (a post-shrink leg must not win on a smaller
+    divisor). Entries are ``(rate, label, dt, loss)``."""
+    if prev_chips == n_chips:
+        return list(results)
+    return [(r * prev_chips / n_chips, label, dt, loss)
+            for r, label, dt, loss in results]
+
 
 def default_variants(model, batch):
     """The default sweep's staged A/B grid: ``(head, tail)`` lists of
@@ -423,6 +451,8 @@ def _error_line(msg, permanent=None):
         "metric": METRIC, "value": None, "unit": UNIT,
         "vs_baseline": None, "error": msg,
     }
+    if _RUN_ID:
+        payload["run_id"] = _RUN_ID
     if permanent:
         # The parent's fault classifier concluded the attachment is
         # DEAD (N identical consecutive failures), not flapping —
@@ -558,11 +588,23 @@ def inner_main(args):
         faults,
         is_device_loss,
     )
+    from fm_spark_tpu import obs
     from fm_spark_tpu.utils.logging import EventLog
 
     art_dir = _artifacts_dir(args)
-    journal = EventLog(os.path.join(art_dir,
-                                    f"health_{args.model}.jsonl"))
+    # Per-run telemetry directory (ISSUE 7): every stream this run
+    # emits — spans, metrics snapshots, the flight-recorder window, and
+    # the health journal — lives under <artifacts>/obs/<run_id>/. The
+    # parent mints the run id and passes it down so retried attempts
+    # append to the SAME run (journal included), and the id is echoed
+    # in every result line.
+    global _RUN_ID
+    run_id = _RUN_ID = args.run_id or _gen_run_id()
+    obs_dir = _obs_run_dir(art_dir, run_id)
+    obs.configure(obs_dir, run_id=run_id, install_signals=True)
+    journal = EventLog(os.path.join(obs_dir,
+                                    f"health_{args.model}.jsonl"),
+                       mirror_to_flight=True)
     journal.emit("backend_init_start", model=args.model)
 
     # Init watchdog: on this attachment an init that has not completed in
@@ -858,6 +900,11 @@ def inner_main(args):
             "all_variants": {l: round(r, 1) for r, l, _, _ in results},
             "legs_completed": len(results),
             "t_first_result_s": t_first_result,
+            "run_id": run_id,
+            # Step-time percentiles (per-leg mean step times), ingest
+            # rate/accounting, fault timeline — the substrate ROADMAP
+            # items 1/3/5 read their numbers from (ISSUE 7).
+            "telemetry": obs.telemetry_block(),
         }
         if resumed:
             payload["resumed_legs"] = len(resumed)
@@ -882,9 +929,16 @@ def inner_main(args):
         # salvageable from its first second, without re-measuring what
         # already landed.
         for label, rec in resumed.items():
+            dt_banked = float(rec.get("dt_s", 0.0))
             results.append((float(rec["value"]), label,
-                            float(rec.get("dt_s", 0.0)),
-                            float(rec.get("loss", 0.0))))
+                            dt_banked, float(rec.get("loss", 0.0))))
+            # Banked legs still belong in the telemetry percentiles:
+            # obs.configure reset the registry for this attempt, so
+            # without replaying the banked per-leg mean the final
+            # telemetry block would cover only re-measured legs.
+            if dt_banked > 0:
+                obs.histogram("step_time_ms").observe(
+                    dt_banked / steps_timed * 1e3)
         remaining = sum(1 for l, _, _ in variants if l not in resumed)
         _log(f"[inner] --resume-sweep: {len(resumed)} completed leg(s) "
              f"loaded from the sweep artifact; {remaining} remaining")
@@ -1023,6 +1077,7 @@ def inner_main(args):
         # that mode stays the parent watchdog's job: attempt timeout →
         # kill → respawn → auto --resume-sweep of the banked legs.
         outcome = None
+        t_leg_wall, t_leg0 = time.time(), time.perf_counter()
         while outcome is None:
             try:
                 dt, final_loss = sup.run(measure, op=f"leg:{label}",
@@ -1051,10 +1106,8 @@ def inner_main(args):
                     # surviving count, so max() ranks variants on
                     # comparable per-chip figures instead of letting a
                     # post-shrink leg win on a 2x smaller divisor.
-                    results[:] = [
-                        (r * prev_chips / n_chips, lb, d, fl)
-                        for r, lb, d, fl in results
-                    ]
+                    results[:] = _renormalize_results(results, prev_chips,
+                                                      n_chips)
                     sup.reset(f"leg:{label}")
                     _log(f"[inner] [{label}] permanent device fault -- "
                          f"degraded mode: retrying on {n_chips} chip(s) "
@@ -1084,6 +1137,12 @@ def inner_main(args):
                      f"{(str(e).splitlines() or [''])[0][:200]}"
                      " -- skipping variant")
                 outcome = "skip"
+        # Retroactive per-leg span (compile+warmup+timed window+any
+        # retries): the report's phase breakdown attributes the sweep's
+        # wall-clock leg by leg without fencing inside the measurement.
+        obs.emit_span("bench/leg", t_leg_wall,
+                      time.perf_counter() - t_leg0,
+                      label=label, outcome=outcome)
         if outcome == "abandon":
             break
         if outcome == "skip":
@@ -1100,6 +1159,11 @@ def inner_main(args):
             continue
         rate = steps_timed * batch / dt / n_chips
         results.append((rate, label, dt, final_loss))
+        # One step-time sample per leg (the timed window's mean step —
+        # the fori_loop rolls the steps into one program, so per-step
+        # fencing would change the measurement): percentiles across
+        # legs land in the telemetry block.
+        obs.histogram("step_time_ms").observe(dt / steps_timed * 1e3)
         _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "
              f"(dt={dt:.3f}s loss={final_loss:.4f})")
         # Emit the best-so-far line after EVERY variant: if a later
@@ -1125,9 +1189,13 @@ def inner_main(args):
             leg_record["chips"] = n_chips
             leg_record["degraded"] = True
         _persist_incremental(art_dir, args.model, payload, leg_record)
+        # Metrics snapshot after every leg: a later kill still leaves
+        # the run's numeric record in <obs_dir>/metrics.jsonl.
+        obs.export_snapshot()
 
     if not results:
         _log("[inner] every variant failed; no measurement")
+        obs.shutdown()
         return 1
     rate, label, dt, final_loss = max(results)
     _log(f"[inner] device={devs[0].device_kind} "
@@ -1135,6 +1203,7 @@ def inner_main(args):
          f"steps={steps_timed} dt={dt:.3f}s loss={final_loss:.4f}"
          + (f" DEGRADED (shrinks={elastic.shrinks})"
             if elastic is not None and elastic.degraded else ""))
+    obs.shutdown()
     return 0
 
 
@@ -1454,6 +1523,13 @@ def main():
                     help="child-side backend init watchdog: an init that "
                          "has not finished by then never finishes here; "
                          "the child exits early for a cheap retry")
+    ap.add_argument("--run-id", default=None, dest="run_id",
+                    help="telemetry run id (ISSUE 7): every stream this "
+                         "run emits lands under <artifacts>/obs/"
+                         "<run_id>/ and the id is echoed in the result "
+                         "JSON. Default: minted fresh — the parent "
+                         "passes its mint to every child attempt so "
+                         "retries append to the SAME run")
     args = ap.parse_args()
 
     if (args.host_dedup or args.compact_device) and (
@@ -1476,6 +1552,11 @@ def main():
 
     # Re-build the child argv from the variant knobs only.
     _set_model(args.model)
+    # Mint the run id HERE so every retried child appends to the same
+    # per-run telemetry directory and the parent's own error JSON
+    # carries the id of the evidence it left behind.
+    global _RUN_ID
+    _RUN_ID = args.run_id or _gen_run_id()
     # Config errors must fail HERE, not in the child: the parent treats
     # a child death as a retryable attachment flake and would burn the
     # whole --total-deadline re-spawning a guaranteed failure.
@@ -1490,6 +1571,7 @@ def main():
         "--batch", str(args.batch),
         "--steps", str(args.steps),
         "--init-timeout", str(args.init_timeout),
+        "--run-id", _RUN_ID,
     ]
     if args.rank is not None:
         argv += ["--rank", str(args.rank)]

@@ -46,6 +46,7 @@ from typing import Any
 
 import orbax.checkpoint as ocp
 
+from fm_spark_tpu import obs
 from fm_spark_tpu.resilience import faults
 
 
@@ -273,13 +274,14 @@ class Checkpointer:
             # data committed, manifest not yet written = a torn save the
             # chain must never reference.
             faults.inject("ckpt_commit")
-            os.makedirs(self._manifest_dir, exist_ok=True)
-            _atomic_write_json(self._manifest_path(step), manifest)
-            prev = self.last_good_step()
-            if prev is None or step > prev:
-                _atomic_write_json(self._last_good_path,
-                                   {"step": step,
-                                    "ts": round(time.time(), 3)})
+            with obs.span("checkpoint/verify", step=int(step)):
+                os.makedirs(self._manifest_dir, exist_ok=True)
+                _atomic_write_json(self._manifest_path(step), manifest)
+                prev = self.last_good_step()
+                if prev is None or step > prev:
+                    _atomic_write_json(self._last_good_path,
+                                       {"step": step,
+                                        "ts": round(time.time(), 3)})
             self._emit("checkpoint_verified", step=step,
                        last_good=max(step, prev or step))
         self._pending = still
@@ -326,54 +328,64 @@ class Checkpointer:
              pipeline_state: dict | None = None,
              extra: dict | None = None, force: bool = False) -> bool:
         meta: dict[str, Any] = {"pipeline": pipeline_state, "extra": extra}
-        # Boundary discipline for the chain: the previous async save (if
-        # any) must have committed before a new one starts, which makes
-        # this the safe point to flush its manifest. The async overlap
-        # that matters — serialization riding under the training steps
-        # between two save boundaries — is preserved.
-        self._mgr.wait_until_finished()
-        self._flush_pending()
-        manifest = {
-            "step": int(step),
-            "checksums": (
-                _tree_checksums({"params": params, "opt_state": opt_state})
-                if self._verify == "checksum" else None
-            ),
-            "meta_crc": _meta_crc(meta),
-            "ts": round(time.time(), 3),
-        }
-        try:
-            saved = self._mgr.save(
-                int(step),
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardSave(
-                        {"params": params, "opt_state": opt_state}
-                    ),
-                    meta=ocp.args.JsonSave(meta),
+        with obs.span("checkpoint/save", step=int(step),
+                      force=bool(force)) as _sp:
+            # Boundary discipline for the chain: the previous async save
+            # (if any) must have committed before a new one starts, which
+            # makes this the safe point to flush its manifest. The async
+            # overlap that matters — serialization riding under the
+            # training steps between two save boundaries — is preserved.
+            self._mgr.wait_until_finished()
+            self._flush_pending()
+            manifest = {
+                "step": int(step),
+                "checksums": (
+                    _tree_checksums({"params": params,
+                                     "opt_state": opt_state})
+                    if self._verify == "checksum" else None
                 ),
-                force=force,
-            )
-        except ocp.checkpoint_manager.StepAlreadyExistsError:
-            # A cadence save already committed this step; training state at
-            # a given step is unique, so the existing checkpoint IS this one.
-            return True
-        if saved:
-            self._pending.append((int(step), manifest))
-            if not self._async_save:
-                # Sync saves have already committed — verify immediately
-                # so last_good never lags a completed synchronous write.
-                self._flush_pending()
+                "meta_crc": _meta_crc(meta),
+                "ts": round(time.time(), 3),
+            }
+            try:
+                saved = self._mgr.save(
+                    int(step),
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardSave(
+                            {"params": params, "opt_state": opt_state}
+                        ),
+                        meta=ocp.args.JsonSave(meta),
+                    ),
+                    force=force,
+                )
+            except ocp.checkpoint_manager.StepAlreadyExistsError:
+                # A cadence save already committed this step; training
+                # state at a given step is unique, so the existing
+                # checkpoint IS this one.
+                _sp.set(already_exists=True)
+                return True
+            if saved:
+                obs.counter("checkpoint.saves_total").add(1)
+                self._pending.append((int(step), manifest))
+                if not self._async_save:
+                    # Sync saves have already committed — verify
+                    # immediately so last_good never lags a completed
+                    # synchronous write.
+                    self._flush_pending()
+            _sp.set(saved=bool(saved))
         return saved
 
     def _restore_step(self, step: int, params_example, opt_state_example):
         example = {"params": params_example, "opt_state": opt_state_example}
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(example),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        with obs.span("checkpoint/restore", step=int(step)):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(example),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+        obs.counter("checkpoint.restores_total").add(1)
         meta = restored.meta or {}
         return {
             "params": restored.state["params"],

@@ -68,9 +68,17 @@ def _ingest_guard(args, windowed: bool = True):
     qdir = getattr(args, "quarantine_dir", None)
     frac = getattr(args, "max_bad_frac", None)
     if policy == "quarantine" and not qdir:
+        # ISSUE 7 consolidation: without an explicit quarantine dir the
+        # dead-letter journal joins the run's other telemetry under the
+        # per-run obs directory.
+        from fm_spark_tpu import obs
+
+        qdir = obs.run_dir()
+    if policy == "quarantine" and not qdir:
         raise SystemExit(
-            "--data-policy quarantine needs --quarantine-dir (the "
-            "dead-letter journal has to land somewhere)"
+            "--data-policy quarantine needs --quarantine-dir or an "
+            "active --obs-dir (the dead-letter journal has to land "
+            "somewhere)"
         )
     return RecordGuard(policy=policy, quarantine_dir=qdir,
                        max_bad_frac=1.0 if frac is None else frac,
@@ -1147,6 +1155,21 @@ def cmd_train(args) -> int:
     else:
         compile_cache.enable_from_env()
 
+    # Telemetry plane (ISSUE 7): on by default — every stream this run
+    # emits (spans, metrics snapshots, the flight-recorder window, any
+    # dead-letter journal) lands under <obs-dir>/<run_id>/.
+    _obs_dir = getattr(args, "obs_dir", None)
+    if _obs_dir and _obs_dir.lower() != "none":
+        import os as _os_obs
+
+        from fm_spark_tpu import obs
+
+        _obs_run = obs.new_run_id()
+        obs.configure(_os_obs.path.join(_obs_dir, _obs_run),
+                      run_id=_obs_run, install_signals=True)
+        print(json.dumps({"run_id": _obs_run, "obs_dir": obs.run_dir()}),
+              flush=True)
+
     _maybe_init_distributed(args)
 
     batch_size = args.batch_size
@@ -1350,8 +1373,14 @@ def cmd_train(args) -> int:
             from fm_spark_tpu.utils.logging import EventLog
 
             _os0.makedirs(args.checkpoint_dir, exist_ok=True)
+            # The journal stays WITH the checkpoint chain (one chain
+            # dir can serve many runs; its narrative must not split
+            # per-run), but every event is mirrored into the flight
+            # ring so the run's fault timeline, flight_dump.json, and
+            # obs_report carry the retry story too.
             health_journal = EventLog(
-                _os0.path.join(args.checkpoint_dir, "health.jsonl")
+                _os0.path.join(args.checkpoint_dir, "health.jsonl"),
+                mirror_to_flight=True,
             )
         checkpointer = Checkpointer(
             args.checkpoint_dir, save_every=args.checkpoint_every,
@@ -1934,6 +1963,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "budget — a numeric blowup costs one "
                         "checkpoint window, not the run. Costs one "
                         "loss fetch per step")
+    import os as _os_parser
+
+    t.add_argument("--obs-dir", dest="obs_dir",
+                   default=_os_parser.environ.get("FM_SPARK_OBS_DIR",
+                                                  "artifacts/obs"),
+                   help="telemetry root (ISSUE 7): span traces, metrics "
+                        "snapshots, and the crash flight recorder land "
+                        "under <obs-dir>/<run_id>/ (the run_id is "
+                        "echoed as the first JSON line); 'none' "
+                        "disables the plane entirely. Default "
+                        "overridable via FM_SPARK_OBS_DIR — the test "
+                        "harness sets it to 'none' so hundreds of "
+                        "in-process train calls don't each open a run "
+                        "directory")
     t.add_argument("--force", action="store_true",
                    help="override safety guardrails (currently: the "
                         "strategy=row >=1M-feature check) with a "
@@ -1996,7 +2039,15 @@ def main(argv=None) -> int:
 
     force_cpu_platform()
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    finally:
+        # Clean-run flush for the telemetry plane (no-op when the
+        # command never configured it): the final metrics snapshot and
+        # flight dump land even when a command exits via SystemExit.
+        from fm_spark_tpu import obs
+
+        obs.shutdown()
 
 
 if __name__ == "__main__":

@@ -51,11 +51,12 @@ supervisor. Occurrence counters are per CHUNK here, not per record
 
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import numpy as np
 
-from fm_spark_tpu import native
+from fm_spark_tpu import native, obs
 from fm_spark_tpu.data.stream import (
     RecordGuard,
     ShardReader,
@@ -272,11 +273,15 @@ class NativeStreamBatches(StreamBatches):
             forced_reason = str(e) or type(e).__name__
         if unterminated:
             data += b"\n"
-        parsed = native.parse_stream_chunk(
-            self._dataset, data, bucket=self._bucket,
-            num_features=self.num_features, max_nnz=self.max_nnz,
-            zero_based=self._zero_based,
-        )
+        with obs.span("ingest/chunk_parse", shard=shard,
+                      bytes=len(data)) as _sp:
+            parsed = native.parse_stream_chunk(
+                self._dataset, data, bucket=self._bucket,
+                num_features=self.num_features, max_nnz=self.max_nnz,
+                zero_based=self._zero_based,
+            )
+            if parsed is not None:
+                _sp.set(rows=int(parsed[3].shape[0]))
         if parsed is None:  # library vanished mid-run: fail loudly
             raise RuntimeError(
                 f"native chunk parser for {self._dataset!r} became "
@@ -442,6 +447,7 @@ class NativeStreamBatches(StreamBatches):
         ``[B, S] / [B, S] / [B] / [B]``, advancing the cursor — the
         :class:`StreamBatches` contract, assembled by array slice
         instead of per-row Python."""
+        t_batch0 = time.perf_counter()
         b, S = self.batch_size, self.max_nnz
         ids = np.zeros((b, S), np.int32)
         vals = np.zeros((b, S), np.float32)
@@ -472,10 +478,13 @@ class NativeStreamBatches(StreamBatches):
         weights[:taken] = 1.0
         self._cursor = dict(self._reader.state(),
                             **self.guard.counters())
+        self._note_ingest(taken, time.perf_counter() - t_batch0)
         return ids, vals, labels, weights
 
     def _rewind_epoch(self) -> None:
         self._reader.rewind()
+        obs.event("ingest_epoch", epoch=self._reader.epoch,
+                  records=self._reader.records)
         self._read_shard = 0
         self._read_offset = 0
         self._read_lineno = 0

@@ -45,10 +45,12 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from collections import deque
 
 import numpy as np
 
+from fm_spark_tpu import obs
 from fm_spark_tpu.resilience import faults
 from fm_spark_tpu.utils.logging import EventLog
 
@@ -195,7 +197,8 @@ class ShardReader:
     def _fill(self) -> None:
         """Read ONE chunk into the pending-line buffer."""
         faults.inject("ingest_truncate")
-        chunk = self._fh.read(self.chunk_bytes)
+        with obs.span("ingest/chunk_read", shard=self.shard):
+            chunk = self._fh.read(self.chunk_bytes)
         if not chunk:
             if self._tail:
                 # Final unterminated line of the shard.
@@ -304,7 +307,14 @@ class RecordGuard:
             os.makedirs(str(quarantine_dir), exist_ok=True)
             self.dead_letter_path = os.path.join(str(quarantine_dir),
                                                  DEAD_LETTER_FILE)
-            self._dead = EventLog(self.dead_letter_path)
+            # Mirrored into the flight-recorder ring (ISSUE 7): the
+            # last-N crash window carries the quarantine narrative.
+            self._dead = EventLog(self.dead_letter_path,
+                                  mirror_to_flight=True)
+        # Process-wide quarantine accounting (obs.metrics): counters
+        # are always live; the registry aggregates across guards.
+        self._c_ok = obs.counter("ingest.rows_ok_total")
+        self._c_bad = obs.counter("ingest.rows_quarantined_total")
 
     # --------------------------------------------------------- reporting
 
@@ -326,6 +336,7 @@ class RecordGuard:
     def ok(self) -> None:
         """Count one record that passed the contract."""
         self.n_ok += 1
+        self._c_ok.add(1)
         self._push(0)
 
     def ok_many(self, n: int) -> None:
@@ -333,6 +344,7 @@ class RecordGuard:
         within the load carries no rate signal)."""
         n = int(n)
         self.n_ok += n
+        self._c_ok.add(n)
         for _ in range(min(n, self._window.maxlen)):
             self._push(0)
 
@@ -341,6 +353,7 @@ class RecordGuard:
         if self.policy == "strict":
             raise BadRecord(path, lineno, reason, line)
         self.n_bad += 1
+        self._c_bad.add(1)
         if self._dead is not None:
             self._dead.emit("bad_record", path=str(path),
                             lineno=int(lineno), reason=str(reason),
@@ -372,6 +385,13 @@ class RecordGuard:
             self._dead.emit("ingest_aborted", **fields)
         if self.journal is not None:
             self.journal.emit("ingest_aborted", **fields)
+        if self._dead is None and self.journal is None:
+            # No mirrored sink carried the event into the flight ring.
+            obs.event("ingest_aborted", **fields)
+        # Flight dump at the abort point (ISSUE 7): the last-N window —
+        # including the bad-record burst that tripped the breaker — is
+        # preserved atomically before the exception unwinds the run.
+        obs.flight_dump("ingest_aborted", **fields)
         raise IngestAborted(
             f"bad-record rate {frac:.1%} over the trailing {window} "
             f"record(s) exceeds max_bad_frac={self.max_bad_frac:.1%} "
@@ -479,6 +499,18 @@ class StreamBatches:
         self.guard = guard if guard is not None else RecordGuard()
         self._cursor = dict(self._reader.state(),
                             **self.guard.counters())
+        # Parse-side ingest rate (ISSUE 7): rows emitted per second of
+        # time spent INSIDE next_batch (consumer/train time excluded),
+        # published as the ``ingest.rows_per_sec`` gauge.
+        self._ingest_busy_s = 0.0
+        self._ingest_rows = 0
+        self._g_rate = obs.gauge("ingest.rows_per_sec")
+
+    def _note_ingest(self, rows: int, busy_s: float) -> None:
+        self._ingest_rows += int(rows)
+        self._ingest_busy_s += float(busy_s)
+        if self._ingest_busy_s > 0:
+            self._g_rate.set(self._ingest_rows / self._ingest_busy_s)
 
     def _next_row(self):
         """One good record, or ``None`` at an epoch boundary (the reader
@@ -488,6 +520,8 @@ class StreamBatches:
                 shard, lineno, line = self._reader.next_line()
             except StopIteration:
                 self._reader.rewind()
+                obs.event("ingest_epoch", epoch=self._reader.epoch,
+                          records=self._reader.records)
                 return None
             if not line.strip():
                 continue
@@ -518,6 +552,7 @@ class StreamBatches:
     def next_batch(self):
         """Return ``(ids, vals, labels, weights)`` with static shapes
         ``[B, S] / [B, S] / [B] / [B]``, advancing the cursor."""
+        t_batch0 = time.perf_counter()
         b, S = self.batch_size, self.max_nnz
         rows = []
         empty_passes = 0
@@ -547,6 +582,7 @@ class StreamBatches:
             weights[r] = 1.0
         self._cursor = dict(self._reader.state(),
                             **self.guard.counters())
+        self._note_ingest(len(rows), time.perf_counter() - t_batch0)
         return ids, vals, labels, weights
 
     def __iter__(self):

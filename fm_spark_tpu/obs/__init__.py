@@ -1,0 +1,325 @@
+"""Unified telemetry plane: span tracing, metrics, flight recorder.
+
+One per-run directory (the ISSUE 7 convention — ``artifacts/obs/
+<run_id>/``) holds every stream a run emits, so "where did this run
+spend its time, what faulted, and what did ingest/step-rate look like"
+is one directory instead of five formats:
+
+======================  ====================================================
+``trace.jsonl``         span records (:mod:`fm_spark_tpu.obs.trace`)
+``metrics.jsonl``       registry snapshots (:mod:`fm_spark_tpu.obs.metrics`)
+``flight.jsonl``        flight-recorder spool — last-N window, SIGKILL-safe
+``flight_dump.json``    atomic last-N dump on fault/SIGTERM/run end
+``health*.jsonl``       the resilience health journals (EventLog)
+``deadletter.jsonl``    quarantined-record journal (RecordGuard)
+======================  ====================================================
+
+``tools/obs_report.py`` renders a human-readable run report from such a
+directory; ``bench.py`` stamps :func:`telemetry_block` into its result
+JSON.
+
+This module is the instrumentation facade the rest of the codebase
+calls. Everything is a cheap no-op until :func:`configure` runs —
+library code instruments unconditionally and pays (almost) nothing in
+un-observed processes (the ≤1% disabled-path contract,
+tests/test_obs_overhead.py). The metrics registry is the exception: it
+is always live (memory only), so counters/gauges accumulate even
+without a run directory.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import signal as _signal
+import threading
+import time
+
+from fm_spark_tpu.obs.flight import FlightRecorder, read_spool
+from fm_spark_tpu.obs.metrics import MetricsRegistry, registry
+from fm_spark_tpu.obs.trace import NOOP_SPAN, Span, Tracer
+
+__all__ = [
+    "FAULT_KINDS",
+    "FlightRecorder",
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+    "configure",
+    "counter",
+    "emit_span",
+    "enabled",
+    "event",
+    "export_snapshot",
+    "fault_timeline",
+    "flight_dump",
+    "gauge",
+    "histogram",
+    "install_signal_dump",
+    "new_run_id",
+    "read_spool",
+    "registry",
+    "run_dir",
+    "run_id",
+    "shutdown",
+    "span",
+    "telemetry_block",
+    "traced",
+]
+
+TRACE_FILE = "trace.jsonl"
+METRICS_FILE = "metrics.jsonl"
+FLIGHT_FILE = "flight.jsonl"
+FLIGHT_DUMP_FILE = "flight_dump.json"
+
+#: Event kinds that belong on a run's fault/retry timeline (the health
+#: journals' state transitions plus the ingest/checkpoint failure
+#: events) — what :func:`fault_timeline` and the bench ``telemetry``
+#: block surface.
+FAULT_KINDS = frozenset({
+    "failure", "backoff", "attempt", "probe",
+    "circuit_open", "circuit_half_open", "circuit_rejected",
+    "permanent_fault", "recovered", "supervisor_reset",
+    "fault_classified", "mesh_shrink", "elastic_exhausted",
+    "divergence_detected", "divergence_rollback",
+    "divergence_rollback_exhausted",
+    "ingest_aborted", "bad_record",
+    "checkpoint_corrupt", "checkpoint_unverified_skipped",
+    "checkpoint_unreadable", "checkpoint_walked_back",
+    "backend_init_timeout", "down",
+})
+
+_lock = threading.Lock()
+_state = {"dir": None, "run_id": None, "tracer": None, "flight": None,
+          "sink": None}
+_prev_handlers: dict[int, object] = {}
+
+
+def new_run_id() -> str:
+    """UTC-timestamped, pid-suffixed run id — sortable and unique
+    enough for one host's runs."""
+    return time.strftime("%Y%m%d-%H%M%S", time.gmtime()) + f"-p{os.getpid()}"
+
+
+def configure(obs_dir: str, run_id: str | None = None,
+              enabled: bool = True, flight_capacity: int = 256,
+              install_signals: bool = False,
+              reset_metrics: bool = True) -> str:
+    """Point the telemetry plane at a run directory and arm it.
+
+    Creates ``obs_dir``, opens the trace sink (``trace.jsonl``) and the
+    flight spool (``flight.jsonl`` — appended, so a retried attempt
+    re-entering the same run dir continues the window), and (by
+    default) resets the process-wide metrics registry so the run starts
+    from a clean slate. Replaces any previous configuration (which is
+    shut down first). Returns the run id.
+    """
+    shutdown(reason=None)
+    obs_dir = os.path.abspath(str(obs_dir))
+    os.makedirs(obs_dir, exist_ok=True)
+    from fm_spark_tpu.utils.logging import EventLog
+
+    if reset_metrics:
+        registry().reset()
+    sink = EventLog(os.path.join(obs_dir, TRACE_FILE))
+    flight = FlightRecorder(flight_capacity,
+                            spool_path=os.path.join(obs_dir, FLIGHT_FILE))
+    tracer = Tracer(sink=sink, flight=flight, enabled=enabled)
+    with _lock:
+        _state.update(dir=obs_dir, run_id=run_id or new_run_id(),
+                      tracer=tracer, flight=flight, sink=sink)
+    flight.record("run_start", run_id=_state["run_id"])
+    if install_signals:
+        install_signal_dump()
+    return _state["run_id"]
+
+
+def shutdown(reason: str | None = "run_end") -> None:
+    """Flush and close the telemetry plane (no-op when unconfigured).
+    With a ``reason``, writes a final metrics snapshot and flight dump
+    first, so a clean run end leaves the same artifacts a fault would."""
+    with _lock:
+        flight, sink = _state["flight"], _state["sink"]
+        d = _state["dir"]
+        _state.update(dir=None, run_id=None, tracer=None, flight=None,
+                      sink=None)
+    if flight is None:
+        return
+    try:
+        if reason is not None:
+            flight.record(reason)
+            registry().export_jsonl(os.path.join(d, METRICS_FILE))
+            flight.dump(reason)
+        flight.close()
+        if sink is not None:
+            sink.close()
+    except Exception:
+        pass
+
+
+def enabled() -> bool:
+    tr = _state["tracer"]
+    return tr is not None and tr.enabled
+
+
+def run_dir() -> str | None:
+    return _state["dir"]
+
+
+def run_id() -> str | None:
+    return _state["run_id"]
+
+
+# ------------------------------------------------------------------ spans
+
+def span(name: str, **attrs):
+    """A span context manager, or the shared no-op when unconfigured."""
+    tr = _state["tracer"]
+    if tr is None:
+        return NOOP_SPAN
+    return tr.span(name, **attrs)
+
+
+def emit_span(name: str, t_start: float, dur_s: float, **attrs) -> None:
+    """Retroactive span record for a caller-timed interval (see
+    :meth:`Tracer.emit_span`); no-op when unconfigured."""
+    tr = _state["tracer"]
+    if tr is not None:
+        tr.emit_span(name, t_start, dur_s, **attrs)
+
+
+def traced(name: str | None = None):
+    """Decorator form of :func:`span`; binds the tracer at CALL time so
+    decoration at import (before :func:`configure`) still traces."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            tr = _state["tracer"]
+            if tr is None or not tr.enabled:
+                return fn(*args, **kwargs)
+            with tr.span(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# ----------------------------------------------------------------- events
+
+def event(kind: str, **fields) -> None:
+    """Record one event into the flight ring (no-op when unconfigured;
+    best-effort by the telemetry contract)."""
+    flight = _state["flight"]
+    if flight is None:
+        return
+    try:
+        fields.pop("seq", None)
+        fields.pop("kind", None)
+        flight.record(kind, **fields)
+    except Exception:
+        pass
+
+
+def flight_dump(reason: str, **extra) -> str | None:
+    """Atomically dump the last-N window now (fault endings call this)."""
+    flight = _state["flight"]
+    if flight is None:
+        return None
+    return flight.dump(reason, extra=extra or None)
+
+
+def fault_timeline(limit: int = 50) -> list[dict]:
+    """The flight ring filtered to fault/retry/breaker events, oldest
+    first, capped to the most recent ``limit``."""
+    flight = _state["flight"]
+    if flight is None:
+        return []
+    out = [e for e in flight.events() if e.get("kind") in FAULT_KINDS]
+    return out[-max(int(limit), 0):]
+
+
+# ---------------------------------------------------------------- metrics
+
+def counter(name: str):
+    return registry().counter(name)
+
+
+def gauge(name: str):
+    return registry().gauge(name)
+
+
+def histogram(name: str, buckets=None):
+    return registry().histogram(name, buckets=buckets)
+
+
+def export_snapshot() -> dict | None:
+    """Append one registry snapshot to the run dir's ``metrics.jsonl``
+    (no-op without a run dir)."""
+    d = _state["dir"]
+    if d is None:
+        return None
+    return registry().export_jsonl(os.path.join(d, METRICS_FILE))
+
+
+def telemetry_block() -> dict:
+    """The run's headline telemetry as one JSON-ready block — what
+    ``bench.py`` stamps into its result JSON: step-time percentiles
+    (the ``step_time_ms`` histogram), ingest rate/accounting, and the
+    fault-event timeline."""
+    reg = registry()
+    step = reg.histogram("step_time_ms").summary()
+    rate = reg.gauge("ingest.rows_per_sec").value
+    block = {
+        "run_id": _state["run_id"],
+        "obs_dir": _state["dir"],
+        "step_time_ms": {k: step[k] for k in
+                         ("count", "mean", "p50", "p95", "p99")},
+        "ingest_rows_per_sec": rate,
+        "ingest_rows_total": reg.counter("ingest.rows_ok_total").value,
+        "ingest_quarantined_total":
+            reg.counter("ingest.rows_quarantined_total").value,
+        "fault_events": [
+            {k: v for k, v in e.items() if k != "seq"}
+            for e in fault_timeline()
+        ],
+    }
+    return block
+
+
+# ---------------------------------------------------------------- signals
+
+def _signal_handler(signum, frame):
+    flight_dump(f"signal:{signum}")
+    export_snapshot()
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif prev != _signal.SIG_IGN:
+        # SIG_DFL — or None, a handler installed from C that we
+        # displaced and cannot re-invoke: restore the default action
+        # and re-raise so the signal still terminates the process.
+        # Swallowing it would turn SIGTERM into a no-op and leave the
+        # orchestrator to escalate to SIGKILL — the uncatchable ending
+        # this recorder exists to avoid.
+        _signal.signal(signum, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_signal_dump(signals=(_signal.SIGTERM,)) -> bool:
+    """Chain a dump-then-delegate handler onto ``signals`` so a SIGTERM
+    leaves the last-N window on disk before whatever handler (or the
+    default death) runs. Main-thread only (signal API restriction);
+    returns whether installation happened."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    for sig in signals:
+        prev = _signal.getsignal(sig)
+        if prev is _signal_handler:
+            continue
+        _prev_handlers[sig] = prev
+        _signal.signal(sig, _signal_handler)
+    return True

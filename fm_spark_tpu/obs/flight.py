@@ -1,0 +1,187 @@
+"""Flight recorder: a bounded ring of the last N telemetry events that
+survives any crash — SIGKILL included.
+
+Why (ISSUE 7): the flaky TPU attachment keeps destroying evidence —
+BENCH_r03–r05 died holding exactly the spans/metric deltas that would
+have explained them. The recorder keeps two copies of the last-N
+window:
+
+- an in-memory ring (``deque(maxlen=N)``) that :meth:`dump` writes
+  atomically (tmp + rename) with a reason and a metrics snapshot on
+  the *catchable* endings — SIGTERM, :class:`IngestAborted`, the
+  supervisor's permanent-failure verdicts;
+- an append-only JSONL **spool** flushed per record, compacted back to
+  the last N lines whenever it reaches 2N — so after an *uncatchable*
+  ending (SIGKILL, a hard hang killed from outside) the spool still
+  holds a parseable, complete last-N window (the tier-1 SIGKILL drill
+  in tests/test_obs_overhead.py asserts exactly this).
+
+On construction over an existing spool (a retried bench attempt
+re-entering the same run directory) the ring and the sequence counter
+are seeded from the spool's tail, so the window is continuous across
+process restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["FlightRecorder", "read_spool"]
+
+
+def read_spool(path: str) -> list[dict]:
+    """Parse a flight spool (JSONL); unparseable lines — the torn tail
+    a SIGKILL can leave — are skipped, never fatal."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+class FlightRecorder:
+    """Bounded last-N event ring with a crash-surviving disk spool."""
+
+    def __init__(self, capacity: int = 256, spool_path: str | None = None):
+        self.capacity = max(int(capacity), 1)
+        from collections import deque
+
+        self._ring: "deque[dict]" = deque(maxlen=self.capacity)
+        # RLock, not Lock: the SIGTERM dump handler runs on the main
+        # thread BETWEEN bytecodes, possibly while that same thread is
+        # inside record() — a non-reentrant lock would self-deadlock
+        # the process on the very dump the handler exists to write.
+        self._lock = threading.RLock()
+        self._seq = 0
+        self.spool_path = spool_path
+        self._spool = None
+        self._spool_lines = 0
+        if spool_path is not None:
+            prior = read_spool(spool_path)
+            for rec in prior[-self.capacity:]:
+                self._ring.append(rec)
+            if prior:
+                self._seq = max(int(r.get("seq", -1)) for r in prior) + 1
+            self._spool_lines = len(prior)
+            self._spool = open(spool_path, "a")
+
+    # ----------------------------------------------------------- record
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one event (ring + spool, flushed). Best-effort on the
+        disk side; the in-memory ring always advances. A ``ts`` in
+        ``fields`` overrides the recording time — mirrored journal
+        events keep their ORIGINAL stamp so the same transition carries
+        one timestamp in every stream (what the report's timeline
+        de-duplicates on)."""
+        ts = fields.pop("ts", None)
+        with self._lock:
+            rec = {"seq": self._seq,
+                   "ts": ts if ts is not None else round(time.time(), 3),
+                   "kind": kind}
+            self._seq += 1
+            for k, v in fields.items():
+                rec.setdefault(k, v)
+            self._ring.append(rec)
+            if self._spool is None and self.spool_path is not None:
+                # A failed compaction (below) may have dropped the
+                # handle; keep trying — the disk may have come back.
+                try:
+                    self._spool = open(self.spool_path, "a")
+                except OSError:
+                    pass
+            if self._spool is not None:
+                try:
+                    self._spool.write(json.dumps(rec) + "\n")
+                    self._spool.flush()
+                    self._spool_lines += 1
+                    if self._spool_lines >= 2 * self.capacity:
+                        self._compact_locked()
+                except (OSError, TypeError, ValueError):
+                    pass
+        return rec
+
+    def _compact_locked(self) -> None:
+        """Rewrite the spool to exactly the ring's contents (the last N
+        records), atomically, then continue appending. A failed rewrite
+        (ENOSPC, a vanished mount) must leave the recorder APPENDING,
+        never holding a closed handle that silently eats every later
+        write — the append handle is re-established in ``finally``."""
+        tmp = f"{self.spool_path}.tmp"
+        self._spool.close()
+        try:
+            with open(tmp, "w") as f:
+                for rec in self._ring:
+                    f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.spool_path)
+        finally:
+            # Reset the counter even on failure: retrying the rewrite
+            # on EVERY event would turn a full disk into a hot loop.
+            self._spool_lines = len(self._ring)
+            try:
+                self._spool = open(self.spool_path, "a")
+            except OSError:
+                self._spool = None  # record() retries on the next event
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    # ------------------------------------------------------------- dump
+
+    def dump(self, reason: str, path: str | None = None,
+             extra: dict | None = None) -> str | None:
+        """Atomically write the last-N window (+ a metrics snapshot) as
+        one JSON document. Default path: ``flight_dump.json`` next to
+        the spool. Best-effort: returns the path, or None on failure —
+        a dump must never take down the fault path invoking it."""
+        if path is None:
+            if self.spool_path is None:
+                return None
+            path = os.path.join(os.path.dirname(self.spool_path),
+                                "flight_dump.json")
+        try:
+            from fm_spark_tpu.obs.metrics import registry
+
+            doc = {
+                "reason": str(reason),
+                "ts": round(time.time(), 3),
+                "events": self.events(),
+                "metrics": registry().snapshot(),
+            }
+            if extra:
+                doc.update(extra)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._spool is not None:
+                try:
+                    self._spool.close()
+                except OSError:
+                    pass
+                self._spool = None

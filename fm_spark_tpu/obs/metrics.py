@@ -1,0 +1,287 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+The telemetry plane's numeric half (ISSUE 7). Three instrument kinds,
+all thread-safe and cheap enough for hot paths (reentrant locks
+throughout: the SIGTERM dump handler snapshots the registry on the
+main thread, possibly interrupting that same thread mid-``add`` — a
+plain Lock would self-deadlock):
+
+- :class:`Counter` — monotonically increasing totals (rows ingested,
+  failures, checkpoint saves).
+- :class:`Gauge` — last-written values (current per-chip count, ingest
+  rows/s).
+- :class:`Histogram` — fixed-bucket latency/size distributions with
+  p50/p95/p99 estimated by linear interpolation inside the bucket the
+  quantile lands in (exact ``min``/``max``/``sum``/``count`` ride
+  alongside, so the estimate is clamped to observed bounds).
+
+One :class:`MetricsRegistry` per process (:func:`registry`) is the
+convention — `utils.logging.MetricsLogger` is a thin facade over it
+(its samples/sec window math stays there; the instruments live here),
+and snapshots export two ways: JSONL lines (:meth:`MetricsRegistry.
+export_jsonl` — the obs dir's ``metrics.jsonl`` stream) and a
+Prometheus-style text dump (:meth:`MetricsRegistry.prometheus_text`)
+for anything that scrapes.
+
+No jax, no heavyweight imports: this module must be importable from
+every layer (including the ingest producer thread) without side
+effects.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+
+__all__ = [
+    "DEFAULT_BUCKETS_MS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+]
+
+#: Default histogram bucket upper bounds, tuned for millisecond
+#: latencies from a sub-ms CPU step to a multi-minute compile stall.
+DEFAULT_BUCKETS_MS = (
+    0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+    100.0, 200.0, 500.0, 1_000.0, 2_000.0, 5_000.0, 10_000.0,
+    30_000.0, 120_000.0, 600_000.0,
+)
+
+
+class Counter:
+    """Monotonic counter. ``add`` is the only mutator."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.RLock()
+        self._value = 0.0
+
+    def add(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written value; ``None`` until first set."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.RLock()
+        self._value: float | None = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float | None:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated percentiles.
+
+    ``bounds`` are bucket UPPER edges (ascending); one implicit
+    overflow bucket catches everything above the last bound.
+    ``percentile(p)`` walks the cumulative counts to the bucket the
+    rank lands in and interpolates linearly between the bucket's
+    edges, clamped to the exact observed ``min``/``max`` — coarse by
+    construction (the fixed-bucket trade), but monotone and bounded.
+    """
+
+    __slots__ = ("name", "bounds", "_lock", "_counts", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name: str, buckets=None):
+        self.name = name
+        self.bounds = tuple(sorted(float(b) for b in
+                                   (buckets or DEFAULT_BUCKETS_MS)))
+        if not self.bounds:
+            raise ValueError(f"histogram {self.name!r} needs >= 1 bucket")
+        self._lock = threading.RLock()
+        self._counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    def percentile(self, p: float) -> float | None:
+        """Interpolated p-quantile (``p`` in [0, 1]); None when empty."""
+        if not (0.0 <= p <= 1.0):
+            raise ValueError(f"percentile wants p in [0, 1], got {p}")
+        with self._lock:
+            if self.count == 0:
+                return None
+            target = p * self.count
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                if cum + c >= target:
+                    lb = self.bounds[i - 1] if i > 0 else self.min
+                    ub = (self.bounds[i] if i < len(self.bounds)
+                          else self.max)
+                    lb = max(lb, self.min)
+                    ub = min(ub, self.max) if ub is not None else self.max
+                    if ub <= lb:
+                        return float(lb)
+                    frac = (target - cum) / c
+                    return float(lb + frac * (ub - lb))
+                cum += c
+            return float(self.max)
+
+    def summary(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.sum
+            vmin, vmax = self.min, self.max
+        if count == 0:
+            return {"count": 0, "sum": 0.0, "mean": None, "min": None,
+                    "max": None, "p50": None, "p95": None, "p99": None}
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "mean": round(total / count, 6),
+            "min": round(vmin, 6),
+            "max": round(vmax, 6),
+            "p50": round(self.percentile(0.50), 6),
+            "p95": round(self.percentile(0.95), 6),
+            "p99": round(self.percentile(0.99), 6),
+        }
+
+
+class MetricsRegistry:
+    """Name → instrument map with get-or-create accessors.
+
+    Re-requesting a name returns the SAME instrument; requesting it as
+    a different kind is an error (two subsystems silently splitting one
+    name across kinds would corrupt every export).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._items: dict[str, object] = {}
+
+    def _get(self, name: str, kind, factory):
+        with self._lock:
+            item = self._items.get(name)
+            if item is None:
+                item = self._items[name] = factory()
+            elif not isinstance(item, kind):
+                raise TypeError(
+                    f"metric {name!r} is a {type(item).__name__}, "
+                    f"requested as {kind.__name__}"
+                )
+            return item
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter, lambda: Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge, lambda: Gauge(name))
+
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        return self._get(name, Histogram,
+                         lambda: Histogram(name, buckets=buckets))
+
+    def reset(self) -> None:
+        """Drop every instrument (a new run's clean slate; tests)."""
+        with self._lock:
+            self._items.clear()
+
+    def snapshot(self) -> dict:
+        """One point-in-time export of every instrument."""
+        with self._lock:
+            items = dict(self._items)
+        out = {"ts": round(time.time(), 3), "counters": {}, "gauges": {},
+               "histograms": {}}
+        for name in sorted(items):
+            item = items[name]
+            if isinstance(item, Counter):
+                out["counters"][name] = item.value
+            elif isinstance(item, Gauge):
+                out["gauges"][name] = item.value
+            elif isinstance(item, Histogram):
+                out["histograms"][name] = item.summary()
+        return out
+
+    def export_jsonl(self, path: str) -> dict:
+        """Append one snapshot line to ``path`` (best-effort by the
+        journal contract: telemetry must never kill the run it
+        narrates). Returns the snapshot either way."""
+        snap = self.snapshot()
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(snap) + "\n")
+        except (OSError, TypeError, ValueError):
+            pass
+        return snap
+
+    def prometheus_text(self, prefix: str = "fm_spark") -> str:
+        """Prometheus exposition-format dump (counters/gauges as-is,
+        histograms as summaries with quantile labels)."""
+
+        def clean(name: str) -> str:
+            safe = "".join(c if c.isalnum() or c == "_" else "_"
+                           for c in name)
+            return f"{prefix}_{safe}" if prefix else safe
+
+        snap = self.snapshot()
+        lines = []
+        for name, v in snap["counters"].items():
+            m = clean(name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {v:g}")
+        for name, v in snap["gauges"].items():
+            if v is None:
+                continue
+            m = clean(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {v:g}")
+        for name, s in snap["histograms"].items():
+            if not s["count"]:
+                continue
+            m = clean(name)
+            lines.append(f"# TYPE {m} summary")
+            for q in ("p50", "p95", "p99"):
+                lines.append(
+                    f'{m}{{quantile="0.{q[1:]}"}} {s[q]:g}')
+            lines.append(f"{m}_sum {s['sum']:g}")
+            lines.append(f"{m}_count {s['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem shares."""
+    return _GLOBAL

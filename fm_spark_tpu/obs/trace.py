@@ -1,0 +1,186 @@
+"""Lightweight span tracing: context-manager + decorator API, JSONL out.
+
+The telemetry plane's time axis (ISSUE 7). A span is a named interval
+with a monotonic-clock duration, a process-unique id, and the id of the
+span it nests inside (per-thread parent stack), emitted as one JSONL
+record through the existing :class:`fm_spark_tpu.utils.logging.EventLog`
+sink (``event: "span"``) and mirrored into the flight-recorder ring so
+the last-N window survives a crash.
+
+Hot-path contract: the DISABLED path must be nearly free — ``≤1%``
+step-time regression on a 200-step synthetic train loop, asserted by
+``tests/test_obs_overhead.py``. :meth:`Tracer.span` on a disabled
+tracer returns a shared no-op singleton (no allocation, trivial
+``__enter__``/``__exit__``), and the instrumented loops additionally
+latch ``obs.enabled()`` once so per-step work is a single attribute
+check.
+
+Usage::
+
+    with obs.span("train/eval", step=120) as sp:
+        metrics = evaluate(...)
+        sp.set(auc=metrics["auc"])
+
+    @obs.traced("ingest/chunk_parse")
+    def parse_chunk(...): ...
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import os
+import threading
+import time
+
+__all__ = ["NOOP_SPAN", "Span", "Tracer"]
+
+_SEQ = itertools.count(1)
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled fast path (no allocation)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One named interval. Use as a context manager; ``set()`` attaches
+    attributes any time before exit (they ride the emitted record)."""
+
+    __slots__ = ("tracer", "name", "attrs", "span_id", "parent_id",
+                 "ts", "_t0", "dur_s")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span_id = None
+        self.parent_id = None
+        self.ts = 0.0
+        self._t0 = 0.0
+        self.dur_s = 0.0
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        st = _stack()
+        self.parent_id = st[-1].span_id if st else None
+        self.span_id = f"{os.getpid():x}-{next(_SEQ):x}"
+        self.ts = time.time()
+        st.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur_s = time.perf_counter() - self._t0
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        else:
+            # Mis-nested manual open/close: drop this span wherever it
+            # sits rather than corrupting the siblings' parentage.
+            try:
+                st.remove(self)
+            except ValueError:
+                pass
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        self.tracer._finish(self)
+        return False
+
+
+class Tracer:
+    """Span factory bound to a JSONL sink + flight-recorder ring.
+
+    ``sink`` is anything with ``emit(event, **fields)`` (an
+    :class:`~fm_spark_tpu.utils.logging.EventLog`); ``flight`` anything
+    with ``record(kind, **fields)``. Both optional and best-effort —
+    tracing must never take down the operation it narrates.
+    """
+
+    def __init__(self, sink=None, flight=None, enabled: bool = True):
+        self.sink = sink
+        self.flight = flight
+        self.enabled = bool(enabled)
+
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            return NOOP_SPAN
+        return Span(self, name, attrs)
+
+    def traced(self, name: str | None = None):
+        """Decorator form; the label defaults to the qualname."""
+
+        def deco(fn):
+            label = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                if not self.enabled:
+                    return fn(*args, **kwargs)
+                with Span(self, label, {}):
+                    return fn(*args, **kwargs)
+
+            return wrapper
+
+        return deco
+
+    def emit_span(self, name: str, t_start: float, dur_s: float,
+                  **attrs) -> None:
+        """Emit a RETROACTIVE span record for an interval timed by the
+        caller (``t_start`` wall-clock, ``dur_s`` monotonic duration).
+        For windows that outlive any single ``with`` block — e.g. the
+        trainer's log windows, where holding an open span across loop
+        iterations would leak it onto the parent stack on an exception
+        mid-window. Parented to the current innermost open span."""
+        if not self.enabled:
+            return
+        sp = Span(self, name, attrs)
+        st = _stack()
+        sp.parent_id = st[-1].span_id if st else None
+        sp.span_id = f"{os.getpid():x}-{next(_SEQ):x}"
+        sp.ts = float(t_start)
+        sp.dur_s = float(dur_s)
+        self._finish(sp)
+
+    def _finish(self, span: Span) -> None:
+        fields = {
+            "name": span.name,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            "t_start": round(span.ts, 3),
+            "dur_ms": round(span.dur_s * 1e3, 3),
+            "thread": threading.get_ident(),
+        }
+        for k, v in span.attrs.items():
+            fields.setdefault(k, v)
+        try:
+            if self.sink is not None:
+                self.sink.emit("span", **fields)
+            if self.flight is not None:
+                self.flight.record("span", **fields)
+        except Exception:
+            pass

@@ -34,6 +34,7 @@ import random
 import threading
 import time
 
+from fm_spark_tpu import obs
 from fm_spark_tpu.resilience import faults
 from fm_spark_tpu.resilience.faults import is_device_loss
 
@@ -125,7 +126,7 @@ class Supervisor:
         self.journal = journal
         self.probe_timeout = probe_timeout
         self.breaker_threshold = breaker_threshold
-        self.state = "closed"
+        self._set_state("closed")
         self.consecutive_failures = 0
         # Identity tracking for the transient-vs-permanent verdict
         # (resilience/elastic.py): a run of IDENTICAL failures (numerals
@@ -138,9 +139,39 @@ class Supervisor:
 
     # ------------------------------------------------------------ events
 
+    _BREAKER_STATES = ("closed", "half_open", "open")
+
     def _emit(self, event: str, **fields) -> None:
         if self.journal is not None:
             self.journal.emit(event, **fields)
+        # Telemetry side-channel (ISSUE 7): failure/backoff totals as
+        # registry instruments, and a flight-recorder dump at the
+        # TERMINAL verdicts — the evidence a dead attachment used to
+        # destroy. Best-effort by the journal contract; the journal
+        # itself (mirror_to_flight) carries the event into the last-N
+        # ring. (The breaker-state gauge is set by _set_state, at the
+        # transition — several events fire BEFORE their transition
+        # lands, so sampling self.state here would latch stale values.)
+        try:
+            if event == "failure":
+                obs.counter("resilience.failures_total").add(1)
+            elif event == "backoff":
+                obs.counter("resilience.backoffs_total").add(1)
+            if event in ("circuit_open", "permanent_fault"):
+                obs.flight_dump(event, **{
+                    k: v for k, v in fields.items() if k != "reason"})
+        except Exception:
+            pass
+
+    def _set_state(self, state: str) -> None:
+        """The ONLY writer of breaker state: keeps the registry gauge
+        exactly in lockstep with every transition."""
+        self.state = state
+        try:
+            obs.gauge("resilience.breaker_state").set(
+                self._BREAKER_STATES.index(state))
+        except Exception:
+            pass
 
     @staticmethod
     def _describe(exc: BaseException) -> str:
@@ -179,7 +210,7 @@ class Supervisor:
         self.consecutive_failures = 0
         self.identical_failures = 0
         self.last_failure = None
-        self.state = "closed"
+        self._set_state("closed")
 
     # ------------------------------------------------------------- probe
 
@@ -187,10 +218,12 @@ class Supervisor:
         """Run the health probe (injected or the default device
         enumeration); an exception counts as unhealthy."""
         fn = self._probe or (lambda: device_probe(self.probe_timeout))
-        try:
-            healthy = bool(fn())
-        except Exception:
-            healthy = False
+        with obs.span("resilience/probe") as sp:
+            try:
+                healthy = bool(fn())
+            except Exception:
+                healthy = False
+            sp.set(healthy=healthy)
         self._emit("probe", healthy=healthy)
         return healthy
 
@@ -200,7 +233,7 @@ class Supervisor:
         if self.state != "open":
             return
         if self.probe():
-            self.state = "half_open"
+            self._set_state("half_open")
             self._emit("circuit_half_open", op=op)
             return
         self._emit("circuit_rejected", op=op)
@@ -213,7 +246,7 @@ class Supervisor:
         self.consecutive_failures += 1
         if (self.state != "open"
                 and self.consecutive_failures >= self.breaker_threshold):
-            self.state = "open"
+            self._set_state("open")
             self._emit("circuit_open", op=op,
                        consecutive_failures=self.consecutive_failures,
                        permanent=self.permanent())
@@ -228,7 +261,7 @@ class Supervisor:
         self.consecutive_failures = 0
         self.identical_failures = 0
         self.last_failure = None
-        self.state = "closed"
+        self._set_state("closed")
 
     # --------------------------------------------------------- run/recover
 
@@ -280,7 +313,9 @@ class Supervisor:
                 delay = self.policy.delay(attempt, self._rng)
                 self._emit("backoff", op=op, attempt=attempt,
                            delay_s=round(delay, 3), healthy=healthy)
-                self._sleep(delay)
+                with obs.span("resilience/backoff", op=op,
+                              delay_s=round(delay, 3)):
+                    self._sleep(delay)
             else:
                 self.note_success(op)
                 return result
@@ -302,7 +337,7 @@ class Supervisor:
                    retryable=True,
                    consecutive_failures=self.consecutive_failures)
         if self.consecutive_failures >= self.breaker_threshold:
-            self.state = "open"
+            self._set_state("open")
             self._emit("circuit_open", op=op,
                        consecutive_failures=self.consecutive_failures,
                        permanent=self.permanent())
@@ -310,8 +345,13 @@ class Supervisor:
                 f"{op}: {self.consecutive_failures} consecutive device "
                 "losses — escalating instead of thrashing the checkpoint"
             ) from exc
+        # The probe and backoff below each carry their own span: this
+        # is the wall-clock the trainer excludes from its throughput
+        # window (logger.add_pause), so the spans make it attributable.
         healthy = self.probe()
         delay = self.policy.delay(self.consecutive_failures, self._rng)
         self._emit("backoff", op=op, delay_s=round(delay, 3),
                    healthy=healthy)
-        self._sleep(delay)
+        with obs.span("resilience/backoff", op=op,
+                      delay_s=round(delay, 3)):
+            self._sleep(delay)

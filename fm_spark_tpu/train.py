@@ -17,12 +17,14 @@ matching MLlib's ``Updater`` semantics (SURVEY.md §0.2, §3.1).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Iterable
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from fm_spark_tpu import obs
 from fm_spark_tpu.ops import losses as losses_lib
 from fm_spark_tpu.resilience import faults
 from fm_spark_tpu.resilience.divergence import DivergenceDetected
@@ -582,6 +584,29 @@ class FMTrainer:
                   divergence_guard=None):
         it = iter(batches)
         steps_since_log = 0
+        # Telemetry (ISSUE 7): latched ONCE so an un-observed process
+        # pays a single attribute check per step (the ≤1% disabled-path
+        # contract, tests/test_obs_overhead.py). The first step's wall
+        # time is recorded separately with the compile-cache hit/miss
+        # delta (the PR-1 hooks) — the compile-vs-execute split — and
+        # excluded from the steady-state step-time histogram.
+        obs_on = obs.enabled()
+        hist_step = obs.histogram("step_time_ms") if obs_on else None
+        first_step_pending = obs_on
+        cc0 = None
+        if obs_on:
+            from fm_spark_tpu.utils import compile_cache
+
+            cc0 = compile_cache.cache_stats()
+        # Window spans are emitted RETROACTIVELY at each log boundary
+        # (one record per window, never an open span held across
+        # iterations — an exception mid-window must not leak a span
+        # onto the thread's parent stack). Step time is observed as
+        # the WINDOW mean, measured after the boundary's loss fetch —
+        # the d2h fence — because the jitted step returns at dispatch
+        # time: per-step host timing would record enqueue latency, not
+        # device step time, on an async backend.
+        win_ts, win_t0, win_steps = time.time(), time.perf_counter(), 0
         for step_i in range(start, total):
             if preemption_guard is not None and preemption_guard.should_stop:
                 save(force=True)
@@ -598,11 +623,36 @@ class FMTrainer:
                     "steps; pass an epoch-cycling iterator (data.Batches) "
                     "or lower num_steps"
                 ) from None
+            t_step0 = time.perf_counter() if first_step_pending else 0.0
             self.params, self.opt_state, m = self._train_step(
                 self.params, self.opt_state,
                 jnp.asarray(ids), jnp.asarray(vals),
                 jnp.asarray(labels), jnp.asarray(weights),
             )
+            if obs_on:
+                if first_step_pending:
+                    first_step_pending = False
+                    # Fence THIS step only: the compile-vs-execute
+                    # split wants the real first-step wall time, and
+                    # one d2h on the compile step is free next to the
+                    # compile itself.
+                    jax.block_until_ready(m)
+                    dt_ms = (time.perf_counter() - t_step0) * 1e3
+                    from fm_spark_tpu.utils import compile_cache
+
+                    cc1 = compile_cache.cache_stats()
+                    obs.histogram("train.first_step_ms").observe(dt_ms)
+                    obs.event("compile_split",
+                              first_step_ms=round(dt_ms, 3),
+                              cache_hits=cc1["hits"] - cc0["hits"],
+                              fresh_compiles=(cc1["misses"]
+                                              - cc0["misses"]))
+                    # Steady-state windows must not amortize the
+                    # compile step: restart the window after it.
+                    win_ts, win_t0, win_steps = (time.time(),
+                                                 time.perf_counter(), 0)
+                else:
+                    win_steps += 1
             self.step_count += 1
             steps_since_log += 1
             if divergence_guard is not None:
@@ -619,24 +669,45 @@ class FMTrainer:
                     loss=loss,
                     grad_norm=float(m["grad_norm"]),
                 )
+                if obs_on:
+                    # float(m["loss"]) above was the d2h fence: every
+                    # dispatched step in the window has executed, so
+                    # the window mean is honest device step time.
+                    win_dur = time.perf_counter() - win_t0
+                    if win_steps:
+                        hist_step.observe(win_dur * 1e3 / win_steps)
+                    # steps=win_steps, not steps_since_log: the first
+                    # window's timer restarts after the compile step,
+                    # so the span must count only the steps its
+                    # duration actually covers.
+                    obs.emit_span("train/steps", win_ts, win_dur,
+                                  steps=win_steps,
+                                  step=self.step_count, loss=loss)
+                    win_ts, win_t0, win_steps = (time.time(),
+                                                 time.perf_counter(), 0)
                 steps_since_log = 0
             if eval_batches is not None and (
                 (self.config.eval_every > 0
                  and self.step_count % self.config.eval_every == 0)
                 or step_i == total - 1  # always evaluate the final model
             ):
-                import time as _time
-
-                t_eval = _time.perf_counter()
-                em = self.evaluate(eval_batches())
+                t_eval = time.perf_counter()
+                with obs.span("train/eval", step=self.step_count) as sp:
+                    em = self.evaluate(eval_batches())
+                    sp.set(**{f"eval_{k}": round(float(v), 6)
+                              for k, v in em.items()})
                 self.last_eval = em
                 self.logger.log(
                     self.step_count,
                     **{f"eval_{k}": v for k, v in em.items()},
                 )
                 # Eval wall-clock must not deflate the next training
-                # throughput window.
-                self.logger.add_pause(_time.perf_counter() - t_eval)
+                # throughput window — nor inflate the step-time
+                # histogram's current window.
+                pause = time.perf_counter() - t_eval
+                self.logger.add_pause(pause)
+                if obs_on:
+                    win_t0 += pause
             save()
         save(force=True)
         return self.params

@@ -14,19 +14,42 @@ import time
 
 
 class MetricsLogger:
-    """Writes one JSON object per line; tracks wall-clock samples/sec."""
+    """Writes one JSON object per line; tracks wall-clock samples/sec.
+
+    Since ISSUE 7 this is a thin facade over the process-wide metrics
+    registry (:mod:`fm_spark_tpu.obs.metrics`): the samples/sec window
+    math and the JSONL transport live here, but every figure a ``log``
+    call computes is also published as a registry instrument
+    (``train.samples_total`` counter, ``train.samples_per_sec`` /
+    ``train.samples_per_sec_per_chip`` / ``train.n_chips`` gauges, and
+    a ``train.<metric>`` gauge per numeric keyword) — so snapshots,
+    the Prometheus dump, and the bench ``telemetry`` block see the
+    same numbers the stdout stream prints.
+    """
 
     def __init__(self, path: str | None = None, stream=None, n_chips: int = 1):
+        # Lazy import: utils.logging is imported by obs.trace (the
+        # EventLog sink), so a module-level import here would cycle.
+        from fm_spark_tpu.obs import metrics as obs_metrics
+
         self._fh = open(path, "a") if path else None
         self._stream = stream if stream is not None else sys.stdout
         self._n_chips = max(n_chips, 1)
         self._t0 = None
         self._paused = 0.0
+        self._registry = obs_metrics.registry()
+        self._c_samples = self._registry.counter("train.samples_total")
+        self._g_rate = self._registry.gauge("train.samples_per_sec")
+        self._g_rate_chip = self._registry.gauge(
+            "train.samples_per_sec_per_chip")
+        self._g_chips = self._registry.gauge("train.n_chips")
+        self._g_chips.set(self._n_chips)
 
     def log(self, step: int, samples: int = 0, **metrics) -> dict:
         now = time.perf_counter()
         record = {"step": step, "ts": time.time()}
         if samples:
+            self._c_samples.add(samples)
             if self._t0 is not None:
                 # ``samples`` covers exactly the window since the previous
                 # samples-bearing log — pair it with THIS window's
@@ -35,10 +58,14 @@ class MetricsLogger:
                 rate = samples / dt if dt > 0 else 0.0
                 record["samples_per_sec"] = round(rate, 2)
                 record["samples_per_sec_per_chip"] = round(rate / self._n_chips, 2)
+                self._g_rate.set(record["samples_per_sec"])
+                self._g_rate_chip.set(record["samples_per_sec_per_chip"])
             self._t0 = now
             self._paused = 0.0
         for k, v in metrics.items():
             record[k] = float(v) if hasattr(v, "__float__") else v
+            if isinstance(record[k], (int, float)):
+                self._registry.gauge(f"train.{k}").set(record[k])
         line = json.dumps(record)
         if self._stream is not None:
             print(line, file=self._stream, flush=True)
@@ -59,6 +86,7 @@ class MetricsLogger:
         degraded run sheds capacity, so ``samples_per_sec_per_chip``
         stays an honest per-surviving-chip figure."""
         self._n_chips = max(int(n_chips), 1)
+        self._g_chips.set(self._n_chips)
 
     def close(self):
         if self._fh is not None:
@@ -81,11 +109,20 @@ class EventLog:
     Best-effort by contract: a journal write must never take down the
     operation it is narrating (same policy as bench.py's incremental
     artifact writes).
+
+    ``mirror_to_flight=True`` additionally records every emitted event
+    into the flight-recorder ring (:mod:`fm_spark_tpu.obs`) so the
+    last-N crash window carries the health narrative — the ISSUE 7
+    consolidation wiring for health journals. Never set it on an
+    EventLog the obs plane itself writes through (the trace sink):
+    that would loop every span back into the ring twice.
     """
 
-    def __init__(self, path: str | None = None, stream=None):
+    def __init__(self, path: str | None = None, stream=None,
+                 mirror_to_flight: bool = False):
         self._fh = open(path, "a") if path else None
         self._stream = stream
+        self._mirror = bool(mirror_to_flight)
 
     def emit(self, event: str, **fields) -> dict:
         record = {"ts": round(time.time(), 3), "event": event, **fields}
@@ -101,6 +138,13 @@ class EventLog:
             # scalar) must degrade to a dropped event, not abort the
             # recovery path being narrated.
             pass
+        if self._mirror:
+            try:
+                from fm_spark_tpu import obs
+
+                obs.event(event, ts=record["ts"], **fields)
+            except Exception:
+                pass
         return record
 
     def close(self):

@@ -13,6 +13,13 @@ import os
 # (jaxtyping) import jax before this conftest, so setting the env var alone
 # is not enough — jax.config.update works at any point before backend init.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The telemetry plane is ON by default in `cli train` (ISSUE 7) —
+# right for production, wrong for a test suite where hundreds of
+# in-process cli.main() calls would each open a run directory in the
+# repo, reset the process-wide metrics registry mid-suite, and chain a
+# signal handler into the pytest process. Tests that exercise the
+# plane pass --obs-dir explicitly (tests/test_cli.py, test_obs*.py).
+os.environ.setdefault("FM_SPARK_OBS_DIR", "none")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -39,6 +39,24 @@ def _last_json(stdout):
     return json.loads(lines[-1])
 
 
+def _health_events(art, model):
+    """The run's health journal, merged across attempts. Since ISSUE 7
+    the journal lives under the per-run telemetry convention
+    (<artifacts>/obs/<run_id>/health_<model>.jsonl); the old flat path
+    is still read for back-compat."""
+    paths = sorted((art / "obs").glob(f"*/health_{model}.jsonl"),
+                   key=lambda p: p.stat().st_mtime)
+    old = art / f"health_{model}.jsonl"
+    if old.exists():
+        paths.insert(0, old)
+    assert paths, f"no health journal for {model} under {art}"
+    events = []
+    for p in paths:
+        with open(p) as f:
+            events.extend(json.loads(ln) for ln in f if ln.strip())
+    return events
+
+
 def test_sweep_survives_init_hang_then_device_loss_and_resumes(tmp_path):
     art = tmp_path / "art"
     cc = str(tmp_path / "cc")
@@ -74,11 +92,41 @@ def test_sweep_survives_init_hang_then_device_loss_and_resumes(tmp_path):
         assert first["last_measured"]["value"] > 0
         assert first["last_measured"]["stale"] is True
 
+    # ISSUE 7 acceptance: the result JSON carries the run's telemetry
+    # block — step-time percentiles across the completed legs (the
+    # fast-first leg included), the ingest-rate field, and the fault
+    # timeline with the injected device loss the supervisor retried.
+    assert final["run_id"]
+    tel = final["telemetry"]
+    assert tel["run_id"] == final["run_id"]
+    st = tel["step_time_ms"]
+    assert st["count"] >= final["legs_completed"]
+    assert all(st[p] is not None and st[p] > 0
+               for p in ("p50", "p95", "p99"))
+    assert "ingest_rows_per_sec" in tel
+    kinds = [e["kind"] for e in tel["fault_events"]]
+    assert "failure" in kinds and "backoff" in kinds
+
+    # ...and obs_report renders a report straight from this run's obs
+    # dir: per-leg phase rows, the step-time percentile table, and the
+    # retry narrative, all from one directory.
+    run_dir = art / "obs" / final["run_id"]
+    assert run_dir.is_dir()
+    report = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         str(run_dir)],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert report.returncode == 0, report.stderr[-2000:]
+    assert final["run_id"] in report.stdout
+    assert "bench/leg" in report.stdout
+    assert "step_time_ms" in report.stdout
+    assert "failure" in report.stdout and "backoff" in report.stdout
+
     # Health journal: init timeout on child 1; child 2 came up, lost the
-    # device on a leg, probed, backed off, and retried.
-    events = []
-    with open(art / "health_fm_kaggle.jsonl") as f:
-        events = [json.loads(ln) for ln in f if ln.strip()]
+    # device on a leg, probed, backed off, and retried. Both attempts
+    # share the parent-minted run id, so ONE journal holds the story.
+    events = _health_events(art, "fm_kaggle")
     names = [e["event"] for e in events]
     assert "backend_init_timeout" in names
     assert "backend_init_up" in names
@@ -226,8 +274,7 @@ def test_elastic_degraded_sweep_completes_on_shrunk_mesh(tmp_path):
 
     # The health journal narrates the whole degradation: the three
     # identical failures, the shrink 8 -> 4, and the re-armed breaker.
-    with open(art / "health_fm_kaggle.jsonl") as f:
-        events = [json.loads(ln) for ln in f if ln.strip()]
+    events = _health_events(art, "fm_kaggle")
     names = [e["event"] for e in events]
     assert names.count("failure") == 3
     assert "supervisor_reset" in names

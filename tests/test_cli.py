@@ -817,15 +817,48 @@ def test_cli_streaming_native_ingest_quarantines_identically(tmp_path,
     assert "fell back" in capsys.readouterr().err
 
 
+def test_cli_quarantine_defaults_into_obs_run_dir(tmp_path, capsys):
+    """ISSUE 7 consolidation: without --quarantine-dir the dead-letter
+    journal joins the run's other telemetry under <obs-dir>/<run_id>/,
+    and the run id is echoed as the first JSON line."""
+    import os
+
+    from fm_spark_tpu.utils.logging import read_events
+
+    paths = _dirty_shards(tmp_path)
+    obs_root = tmp_path / "obs"
+    assert cli.main([
+        "train", "--config", "criteo_kaggle_fm_r32",
+        "--data", ",".join(paths), "--steps", "5",
+        "--batch-size", "16", "--test-fraction", "0",
+        "--data-policy", "quarantine", "--log-every", "5",
+        "--obs-dir", str(obs_root),
+    ]) == 0
+    out = capsys.readouterr().out
+    run_line = json.loads(next(
+        l for l in out.splitlines() if '"obs_dir"' in l))
+    assert run_line["run_id"] in run_line["obs_dir"]
+    dead = read_events(os.path.join(run_line["obs_dir"],
+                                    "deadletter.jsonl"))
+    bad = [e for e in dead if e["event"] == "bad_record"]
+    assert len(bad) == 1
+    assert bad[0]["path"] == paths[-1] and bad[0]["lineno"] == 6
+    # The run's other streams landed beside it, one directory per run.
+    names = set(os.listdir(run_line["obs_dir"]))
+    assert {"trace.jsonl", "flight.jsonl", "deadletter.jsonl"} <= names
+
+
 def test_cli_streaming_text_guards(tmp_path):
     paths = _dirty_shards(tmp_path, bad_lines=())
-    # quarantine without a dead-letter destination is a config error.
+    # quarantine without a dead-letter destination: since ISSUE 7 the
+    # journal defaults into the per-run obs dir; with the telemetry
+    # plane off there is nowhere to land, so it stays a config error.
     with pytest.raises(SystemExit, match="quarantine-dir"):
         cli.main([
             "train", "--config", "criteo_kaggle_fm_r32",
             "--data", ",".join(paths), "--steps", "2",
             "--batch-size", "16", "--test-fraction", "0",
-            "--data-policy", "quarantine",
+            "--data-policy", "quarantine", "--obs-dir", "none",
         ])
     # streaming holds out no eval split: an implicit test fraction must
     # hard-fail, never silently train on 100% while reporting nothing.

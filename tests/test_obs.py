@@ -1,0 +1,486 @@
+"""Unit coverage for the unified telemetry plane (ISSUE 7):
+fm_spark_tpu/obs — span tracing, the process-wide metrics registry,
+the flight recorder — plus the MetricsLogger facade (including the
+PR-3 set_n_chips renormalization, previously untested), bench.py's
+degraded-leg per-chip rate renormalization, and tools/obs_report.py's
+rendering (per-run layout AND the pre-obs flat back-compat layout).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from fm_spark_tpu import obs  # noqa: E402
+from fm_spark_tpu.obs.flight import FlightRecorder, read_spool  # noqa: E402
+from fm_spark_tpu.obs.metrics import (  # noqa: E402
+    Histogram,
+    MetricsRegistry,
+)
+from fm_spark_tpu.obs.trace import NOOP_SPAN, Tracer  # noqa: E402
+from fm_spark_tpu.utils.logging import EventLog, MetricsLogger  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_dir(tmp_path):
+    """A configured obs plane torn down afterwards (the plane is
+    process-global; leaking a configuration would cross-talk suites)."""
+    d = tmp_path / "run"
+    obs.configure(str(d), run_id="test-run")
+    yield d
+    obs.shutdown(reason=None)
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, event, **fields):
+        self.records.append({"event": event, **fields})
+
+
+# ---------------------------------------------------------------- tracing
+
+
+def test_span_nesting_parent_ids_and_attrs():
+    sink = _ListSink()
+    tr = Tracer(sink=sink)
+    with tr.span("outer", a=1):
+        with tr.span("inner") as sp:
+            sp.set(rows=7)
+    inner, outer = sink.records  # inner exits (and emits) first
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["parent_id"] is None
+    assert inner["rows"] == 7 and outer["a"] == 1
+    assert inner["dur_ms"] >= 0.0
+
+
+def test_span_exception_is_recorded_and_propagates():
+    sink = _ListSink()
+    tr = Tracer(sink=sink)
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    assert sink.records[0]["error"] == "ValueError"
+
+
+def test_disabled_tracer_returns_the_shared_noop():
+    tr = Tracer(sink=_ListSink(), enabled=False)
+    assert tr.span("x") is NOOP_SPAN
+    # Unconfigured module API: same no-op, no error.
+    obs.shutdown(reason=None)
+    assert obs.span("y") is NOOP_SPAN
+    assert obs.enabled() is False
+
+
+def test_emit_span_is_retroactive_and_parented():
+    sink = _ListSink()
+    tr = Tracer(sink=sink)
+    with tr.span("outer"):
+        tr.emit_span("window", 123.0, 2.5, steps=50)
+    window, outer = sink.records
+    assert window["t_start"] == 123.0
+    assert window["dur_ms"] == 2500.0
+    assert window["steps"] == 50
+    assert window["parent_id"] == outer["span_id"]
+
+
+def test_traced_decorator_binds_at_call_time(obs_dir):
+    calls = []
+
+    @obs.traced("deco/fn")
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    assert fn(3) == 6
+    obs.shutdown(reason=None)
+    assert fn(4) == 8  # after shutdown: plain call, no error
+    assert calls == [3, 4]
+
+
+def test_spans_are_thread_local_parents():
+    sink = _ListSink()
+    tr = Tracer(sink=sink)
+    seen = {}
+
+    def worker():
+        with tr.span("t2") as sp:
+            seen["parent"] = sp.parent_id
+
+    with tr.span("main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    # The other thread's span must NOT parent onto main's open span.
+    assert seen["parent"] is None
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_counter_monotonic_and_negative_rejected():
+    reg = MetricsRegistry()
+    c = reg.counter("x")
+    c.add(2)
+    c.add(0.5)
+    assert c.value == 2.5
+    with pytest.raises(ValueError):
+        c.add(-1)
+
+
+def test_registry_same_name_same_instrument_kind_conflict_raises():
+    reg = MetricsRegistry()
+    assert reg.counter("a") is reg.counter("a")
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+
+
+def test_histogram_percentiles_bracket_uniform_data():
+    h = Histogram("lat", buckets=(1, 2, 5, 10, 20, 50, 100))
+    for v in range(1, 101):  # 1..100 ms uniform
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 1.0 and s["max"] == 100.0
+    # Fixed-bucket estimates are coarse but must bracket the truth.
+    assert 40 <= s["p50"] <= 60
+    assert 90 <= s["p95"] <= 100
+    assert 95 <= s["p99"] <= 100
+    assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+
+
+def test_histogram_overflow_bucket_and_empty_summary():
+    h = Histogram("x", buckets=(1.0,))
+    assert h.summary()["p50"] is None
+    h.observe(50.0)  # above every bound -> overflow bucket
+    s = h.summary()
+    assert s["count"] == 1 and s["p99"] == 50.0
+
+
+def test_snapshot_and_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("ingest.rows_ok_total").add(3)
+    reg.gauge("train.n_chips").set(4)
+    reg.histogram("step_time_ms", buckets=(10.0, 100.0)).observe(42.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["ingest.rows_ok_total"] == 3
+    assert snap["gauges"]["train.n_chips"] == 4
+    assert snap["histograms"]["step_time_ms"]["count"] == 1
+    text = reg.prometheus_text()
+    assert "# TYPE fm_spark_ingest_rows_ok_total counter" in text
+    assert "fm_spark_train_n_chips 4" in text
+    assert 'fm_spark_step_time_ms{quantile="0.50"}' in text
+    assert "fm_spark_step_time_ms_count 1" in text
+
+
+def test_export_jsonl_appends_parseable_snapshots(tmp_path):
+    reg = MetricsRegistry()
+    path = str(tmp_path / "metrics.jsonl")
+    reg.counter("c").add(1)
+    reg.export_jsonl(path)
+    reg.counter("c").add(1)
+    reg.export_jsonl(path)
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert [ln["counters"]["c"] for ln in lines] == [1, 2]
+
+
+# ----------------------------------------------------------------- flight
+
+
+def test_flight_ring_is_bounded_and_spool_compacts(tmp_path):
+    spool = str(tmp_path / "flight.jsonl")
+    fr = FlightRecorder(capacity=8, spool_path=spool)
+    for i in range(40):
+        fr.record("tick", i=i)
+    events = fr.events()
+    assert len(events) == 8
+    assert [e["i"] for e in events] == list(range(32, 40))
+    # The spool compacted (2N trigger) — bounded, never the full 40.
+    on_disk = read_spool(spool)
+    assert len(on_disk) <= 16
+    assert on_disk[-1]["i"] == 39
+    fr.close()
+
+
+def test_flight_reopen_continues_seq_and_window(tmp_path):
+    spool = str(tmp_path / "flight.jsonl")
+    fr = FlightRecorder(capacity=4, spool_path=spool)
+    for i in range(6):
+        fr.record("a", i=i)
+    last_seq = fr.events()[-1]["seq"]
+    fr.close()
+    # A retried attempt re-entering the same run dir: window continuous.
+    fr2 = FlightRecorder(capacity=4, spool_path=spool)
+    assert [e["i"] for e in fr2.events()] == [2, 3, 4, 5]
+    rec = fr2.record("b")
+    assert rec["seq"] == last_seq + 1
+    fr2.close()
+
+
+def test_flight_dump_is_atomic_json_with_metrics(tmp_path):
+    spool = str(tmp_path / "flight.jsonl")
+    fr = FlightRecorder(capacity=4, spool_path=spool)
+    fr.record("x")
+    path = fr.dump("unit_test", extra={"run_id": "r"})
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "unit_test" and doc["run_id"] == "r"
+    assert doc["events"][0]["kind"] == "x"
+    assert "metrics" in doc
+    fr.close()
+
+
+def test_flight_record_keeps_caller_ts(tmp_path):
+    fr = FlightRecorder(capacity=4)
+    rec = fr.record("mirrored", ts=123.456)
+    assert rec["ts"] == 123.456
+
+
+def test_read_spool_skips_torn_tail(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"seq": 0, "kind": "a"}\n{"seq": 1, "ki')
+    recs = read_spool(str(p))
+    assert len(recs) == 1 and recs[0]["kind"] == "a"
+
+
+# ----------------------------------------------- module facade / EventLog
+
+
+def test_configure_emits_all_streams_and_shutdown_dumps(tmp_path):
+    d = tmp_path / "run"
+    rid = obs.configure(str(d), run_id="r42")
+    assert rid == "r42" and obs.enabled() and obs.run_dir() == str(d)
+    with obs.span("phase/a"):
+        pass
+    obs.counter("c").add(1)
+    obs.event("failure", error="boom")
+    obs.shutdown()  # default reason="run_end" -> snapshot + dump
+    names = sorted(os.listdir(d))
+    assert names == ["flight.jsonl", "flight_dump.json",
+                     "metrics.jsonl", "trace.jsonl"]
+    with open(d / "trace.jsonl") as f:
+        span = json.loads(f.readline())
+    assert span["event"] == "span" and span["name"] == "phase/a"
+    with open(d / "flight_dump.json") as f:
+        doc = json.load(f)
+    assert doc["reason"] == "run_end"
+    assert any(e["kind"] == "failure" for e in doc["events"])
+    assert doc["metrics"]["counters"]["c"] == 1
+
+
+def test_fault_timeline_filters_and_orders(obs_dir):
+    obs.event("failure", error="x")
+    obs.event("not_a_fault_kind")
+    obs.event("backoff", delay_s=1.0)
+    tl = obs.fault_timeline()
+    assert [e["kind"] for e in tl] == ["failure", "backoff"]
+
+
+def test_telemetry_block_shape(obs_dir):
+    h = obs.histogram("step_time_ms")
+    for v in (10.0, 20.0, 30.0):
+        h.observe(v)
+    obs.counter("ingest.rows_ok_total").add(5)
+    obs.gauge("ingest.rows_per_sec").set(123.0)
+    obs.event("ingest_aborted", frac=0.5)
+    block = obs.telemetry_block()
+    assert block["run_id"] == "test-run"
+    assert block["step_time_ms"]["count"] == 3
+    assert block["step_time_ms"]["p50"] is not None
+    assert block["ingest_rows_per_sec"] == 123.0
+    assert block["ingest_rows_total"] == 5
+    assert block["fault_events"][-1]["kind"] == "ingest_aborted"
+    json.dumps(block)  # must be JSON-ready as stamped by bench.py
+
+
+def test_eventlog_mirror_to_flight_keeps_ts(obs_dir):
+    log = EventLog(stream=None, mirror_to_flight=True)
+    rec = log.emit("failure", error="boom")
+    tl = obs.fault_timeline()
+    assert tl and tl[-1]["kind"] == "failure"
+    # The mirrored copy carries the journal's ORIGINAL stamp, so the
+    # report's (ts, kind) de-duplication sees one transition, not two.
+    assert tl[-1]["ts"] == rec["ts"]
+
+
+# ------------------------------------------------------ MetricsLogger
+
+
+class _Sink:
+    def __init__(self):
+        self.lines = []
+
+    def write(self, s):
+        self.lines.append(s)
+
+    def flush(self):
+        pass
+
+
+def test_metrics_logger_publishes_registry_instruments():
+    obs.registry().reset()
+    logger = MetricsLogger(stream=_Sink(), n_chips=2)
+    logger.log(0, samples=1000)
+    logger.log(1, samples=1000, loss=0.5)
+    reg = obs.registry()
+    assert reg.counter("train.samples_total").value == 2000
+    rate = reg.gauge("train.samples_per_sec").value
+    per_chip = reg.gauge("train.samples_per_sec_per_chip").value
+    assert rate is not None and per_chip == pytest.approx(rate / 2)
+    assert reg.gauge("train.n_chips").value == 2
+    assert reg.gauge("train.loss").value == 0.5
+
+
+def test_set_n_chips_renormalizes_per_chip_rate(monkeypatch):
+    """The PR-3 elastic-shrink path: after ``set_n_chips(2)`` the
+    SAME global rate must report a 2x larger per-chip figure (honest
+    per-SURVIVING-chip accounting), previously untested."""
+    import fm_spark_tpu.utils.logging as fl
+
+    obs.registry().reset()
+    t = {"now": 100.0}
+    monkeypatch.setattr(fl.time, "perf_counter", lambda: t["now"])
+    logger = MetricsLogger(stream=_Sink(), n_chips=8)
+    logger.log(0, samples=8000)          # arms the window
+    t["now"] += 1.0
+    rec8 = logger.log(1, samples=8000)   # 8000 samples/s over 8 chips
+    assert rec8["samples_per_sec_per_chip"] == pytest.approx(1000.0)
+    logger.set_n_chips(2)                # elastic shrink 8 -> 2
+    assert obs.registry().gauge("train.n_chips").value == 2
+    t["now"] += 1.0
+    rec2 = logger.log(2, samples=8000)
+    assert rec2["samples_per_sec"] == pytest.approx(8000.0)
+    assert rec2["samples_per_sec_per_chip"] == pytest.approx(4000.0)
+    # Floor: a zero/negative count clamps to 1, never divides by zero.
+    logger.set_n_chips(0)
+    t["now"] += 1.0
+    assert logger.log(3, samples=100)["samples_per_sec_per_chip"] == \
+        pytest.approx(100.0)
+
+
+def test_trainer_step_time_window_instrumentation(obs_dir):
+    """The trainer's ENABLED path: steady-state step time lands as the
+    per-log-window mean (fenced at the window's loss fetch — per-step
+    host timing would record async dispatch, not device time), the
+    compile step rides train.first_step_ms + the compile_split event
+    and is excluded from the steady-state histogram, and each window
+    emits one retroactive train/steps span."""
+    from fm_spark_tpu import models
+    from fm_spark_tpu.data import Batches, synthetic_ctr
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    ids, vals, labels = synthetic_ctr(640, 50, 4, seed=0)
+    spec = models.FMSpec(num_features=50, rank=2)
+    config = TrainConfig(num_steps=20, batch_size=64, learning_rate=0.1,
+                         log_every=10, seed=0)
+    trainer = FMTrainer(spec, config)
+    trainer.fit(Batches(ids, vals, labels, 64, seed=0))
+
+    reg = obs.registry()
+    first = reg.histogram("train.first_step_ms")
+    assert first.count == 1
+    step_hist = reg.histogram("step_time_ms")
+    assert step_hist.count == 2  # two log windows; compile step excluded
+    assert step_hist.max < first.max  # window mean never holds the compile
+
+    obs.shutdown()  # flush the trace sink
+    with open(obs_dir / "trace.jsonl") as f:
+        spans = [json.loads(ln) for ln in f if ln.strip()]
+    windows = [s for s in spans if s.get("name") == "train/steps"]
+    # First window: 9 steps — its timer restarts after the compile
+    # step, and the span counts only what its duration covers.
+    assert [w["steps"] for w in windows] == [9, 10]
+    assert all(w["dur_ms"] > 0 for w in windows)
+    split = [e for e in read_spool(str(obs_dir / "flight.jsonl"))
+             if e.get("kind") == "compile_split"]
+    assert len(split) == 1
+    assert split[0]["fresh_compiles"] >= 0
+
+
+def test_bench_renormalize_results_degraded_denominator():
+    """bench.py's elastic accounting: rates banked before a shrink are
+    re-normalized onto the SURVIVING-chip denominator so max() ranks
+    every leg on comparable per-chip figures."""
+    results = [(1000.0, "a", 1.0, 0.5), (900.0, "b", 1.1, 0.6)]
+    out = bench._renormalize_results(results, prev_chips=8, n_chips=4)
+    assert [r for r, *_ in out] == [2000.0, 1800.0]
+    # Labels/dt/loss ride through untouched.
+    assert [lb for _, lb, _, _ in out] == ["a", "b"]
+    # No shrink -> identity (a fresh list, not the same object).
+    same = bench._renormalize_results(results, 4, 4)
+    assert same == results and same is not results
+
+
+# ------------------------------------------------------------ obs_report
+
+
+def _load_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_tool", os.path.join(REPO, "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_renders_a_real_run_dir(tmp_path, capsys):
+    d = tmp_path / "run"
+    obs.configure(str(d), run_id="report-run")
+    with obs.span("train/steps", steps=10):
+        pass
+    obs.histogram("step_time_ms").observe(12.0)
+    obs.event("failure", error="InjectedDeviceLoss")
+    obs.event("backoff", delay_s=0.5)
+    obs.export_snapshot()
+    obs.shutdown()
+    report = _load_report()
+    assert report.main([str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "report-run" in out
+    assert "train/steps" in out
+    assert "step_time_ms" in out
+    assert "failure" in out and "backoff" in out
+    # One transition = ONE timeline row (journal/flight dedup works).
+    assert out.count("InjectedDeviceLoss") == 1
+
+
+def test_obs_report_backcompat_flat_artifacts_layout(tmp_path, capsys):
+    """Pointed at a PRE-obs artifacts dir (flat health_<model>.jsonl +
+    deadletter.jsonl, no trace), the report still renders the fault
+    timeline and quarantine sections (ISSUE 7 back-compat satellite)."""
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    with open(art / "health_fm.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 1.0, "event": "failure",
+                            "error": "DeviceLost"}) + "\n")
+        f.write(json.dumps({"ts": 2.0, "event": "backoff",
+                            "delay_s": 3.0}) + "\n")
+    with open(art / "deadletter.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 1.5, "event": "bad_record",
+                            "reason": "label_unparseable"}) + "\n")
+    report = _load_report()
+    assert report.main([str(art)]) == 0
+    out = capsys.readouterr().out
+    assert "failure" in out and "DeviceLost" in out
+    assert "Quarantine" in out and "label_unparseable" in out
+    assert "no span trace" in out
+
+
+def test_obs_report_latest_picks_newest_run(tmp_path, capsys):
+    root = tmp_path / "obs"
+    for name, ts in (("old", 100.0), ("new", 200.0)):
+        d = root / name
+        d.mkdir(parents=True)
+        os.utime(d, (ts, ts))
+    report = _load_report()
+    assert report.main(["--latest", str(root)]) == 0
+    assert "obs/new" in capsys.readouterr().out.replace(os.sep, "/")

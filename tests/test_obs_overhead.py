@@ -1,0 +1,176 @@
+"""Tier-1 contracts for the telemetry plane's two hard promises
+(ISSUE 7):
+
+1. **Disabled-path overhead ≤1%** — library code instruments
+   unconditionally (``with obs.span(...)`` in checkpoint/stream/
+   supervisor, the latched ``obs.enabled()`` pattern in the trainer),
+   so an UN-observed process must pay (almost) nothing. A 200-step
+   synthetic train loop instrumented exactly like the hot paths is
+   timed against its bare twin.
+
+2. **SIGKILL-surviving flight recorder** — the whole point of the
+   spool is that an *uncatchable* ending still leaves a parseable,
+   complete last-N window on disk. A subprocess records events through
+   the spool (past the compaction threshold) and SIGKILLs itself
+   mid-stream; the parent asserts the window.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fm_spark_tpu import obs  # noqa: E402
+from fm_spark_tpu.obs.flight import read_spool  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- overhead
+
+
+def _spin(dur_s: float) -> int:
+    """Deterministic busy work (a calibrated spin, not sleep: sleep's
+    wake-up jitter would swamp a 1% bound)."""
+    n = 0
+    t_end = time.perf_counter() + dur_s
+    while time.perf_counter() < t_end:
+        n += 1
+    return n
+
+
+def _loop_bare(steps: int, step_s: float) -> float:
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        _spin(step_s)
+    return time.perf_counter() - t0
+
+
+def _loop_instrumented(steps: int, step_s: float) -> float:
+    """The library's disabled-path instrumentation pattern per step:
+    one unconditional ``with obs.span(...)`` (the stream/checkpoint
+    idiom) plus the latched-flag check (the trainer idiom)."""
+    obs_on = obs.enabled()
+    hist = obs.histogram("overhead_test_ms") if obs_on else None
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        with obs.span("overhead/step"):
+            _spin(step_s)
+        if obs_on:
+            hist.observe(0.0)
+    return time.perf_counter() - t0
+
+
+@pytest.mark.parametrize("steps,step_s", [(200, 0.0005)])
+def test_disabled_tracing_overhead_under_1pct(steps, step_s):
+    obs.shutdown(reason=None)  # the disabled path is the unconfigured one
+    assert not obs.enabled()
+    # Warm both loops (bytecode/alloc effects), then take the best of 3
+    # — min is the right statistic for a noise-floor comparison.
+    _loop_bare(20, step_s)
+    _loop_instrumented(20, step_s)
+    bare = min(_loop_bare(steps, step_s) for _ in range(3))
+    inst = min(_loop_instrumented(steps, step_s) for _ in range(3))
+    overhead = inst / bare - 1.0
+    # The contract is ≤1%; the spin calibration itself wobbles ~0.1%
+    # on a loaded CI core, so the assert keeps a little of the budget.
+    assert overhead <= 0.01, (
+        f"disabled-path tracing overhead {overhead:.2%} over "
+        f"{steps} steps (bare {bare:.4f}s vs instrumented {inst:.4f}s)")
+
+
+def test_disabled_span_is_allocation_free_singleton():
+    obs.shutdown(reason=None)
+    assert obs.span("a") is obs.span("b")
+
+
+# --------------------------------------------------------- SIGKILL drill
+
+_DRILL = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+from fm_spark_tpu import obs
+
+obs.configure({run_dir!r}, run_id="drill", flight_capacity=32,
+              install_signals=False)
+for i in range(100):          # 100 > 2*32: the spool compacts at least once
+    obs.event("tick", i=i)
+print("READY", flush=True)    # parent kills on this marker
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def test_flight_spool_survives_sigkill(tmp_path):
+    run_dir = str(tmp_path / "run")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _DRILL.format(repo=REPO, run_dir=run_dir)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    # SIGKILL death is the expected ending.
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+    assert "READY" in proc.stdout
+
+    window = read_spool(os.path.join(run_dir, "flight.jsonl"))
+    ticks = [e for e in window if e.get("kind") == "tick"]
+    # Complete last-N window: the final capacity's worth of events is
+    # all present, in order, with contiguous sequence numbers.
+    assert len(ticks) >= 32
+    tail = ticks[-32:]
+    assert [e["i"] for e in tail] == list(range(68, 100))
+    seqs = [e["seq"] for e in window]
+    assert seqs == sorted(seqs)
+    assert all(b - a == 1 for a, b in zip(seqs, seqs[1:]))
+
+    # A restarted process re-entering the run dir (the bench parent's
+    # retry path) seeds its ring from the spool: window continuous.
+    from fm_spark_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(32, spool_path=os.path.join(run_dir,
+                                                    "flight.jsonl"))
+    assert fr.events()[-1]["i"] == 99
+    assert fr.record("resumed")["seq"] == seqs[-1] + 1
+    fr.close()
+
+
+def test_sigterm_dump_chains_and_leaves_window(tmp_path):
+    """The *catchable* ending: obs.configure(install_signals=True)
+    chains a dump onto SIGTERM, so the atomic flight_dump.json lands
+    before death (what the flaky-attachment kills kept destroying)."""
+    run_dir = str(tmp_path / "run")
+    script = (
+        "import os, signal, sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from fm_spark_tpu import obs\n"
+        f"obs.configure({run_dir!r}, run_id='term', flight_capacity=16,\n"
+        "              install_signals=True)\n"
+        "for i in range(10):\n"
+        "    obs.event('tick', i=i)\n"
+        "print('READY', flush=True)\n"
+        "signal.pause()\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        proc.kill()
+    dump_path = os.path.join(run_dir, "flight_dump.json")
+    assert os.path.exists(dump_path)
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["reason"].startswith("signal:")
+    assert [e["i"] for e in doc["events"]
+            if e["kind"] == "tick"] == list(range(10))

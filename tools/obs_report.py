@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Render one human-readable run report from a telemetry directory.
+
+The consumer end of the ISSUE 7 telemetry plane: given a per-run obs
+directory (``artifacts/obs/<run_id>/`` — span trace, metrics snapshots,
+flight spool/dump, health journals, dead-letter stream), print a single
+report answering "where did this run spend its time, what faulted, and
+what did ingest/step-rate look like":
+
+- **Phase breakdown** — spans aggregated by name (count, total time,
+  mean, max, share of the run's observed wall-clock);
+- **Percentile tables** — the last metrics snapshot's histograms
+  (count/mean/p50/p95/p99) plus counters and gauges;
+- **Fault / retry timeline** — fault-kind events from the flight
+  window and every health journal, time-ordered with offsets relative
+  to the first observed event;
+- **Quarantine** — dead-letter reason counts, when ingest quarantined.
+
+Back-compat (ISSUE 7 satellite): pointed at a PRE-obs artifacts
+directory (flat ``health_<model>.jsonl`` / ``deadletter.jsonl``, no
+``trace.jsonl``), the report still renders the fault timeline and
+quarantine sections from the old flat layout.
+
+Usage::
+
+    python tools/obs_report.py artifacts/obs/<run_id>/
+    python tools/obs_report.py --latest            # newest run under
+                                                   # artifacts/obs/
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import Counter
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from fm_spark_tpu.obs import FAULT_KINDS, TRACE_FILE  # noqa: E402
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    """Best-effort JSONL parse: unparseable lines (the torn tail a kill
+    can leave) are skipped, a missing file is an empty stream."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def load_run(obs_dir: str) -> dict:
+    """Parse every stream in ``obs_dir`` into one report-ready dict.
+    Works on both the per-run layout and the old flat artifacts layout
+    (where only health/dead-letter journals exist)."""
+    spans = [r for r in _read_jsonl(os.path.join(obs_dir, TRACE_FILE))
+             if r.get("event") == "span"]
+    snapshots = _read_jsonl(os.path.join(obs_dir, "metrics.jsonl"))
+
+    flight_events = _read_jsonl(os.path.join(obs_dir, "flight.jsonl"))
+    dump = None
+    try:
+        with open(os.path.join(obs_dir, "flight_dump.json")) as f:
+            dump = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+    health = []
+    for fname in sorted(os.listdir(obs_dir)) if os.path.isdir(obs_dir) \
+            else []:
+        if fname.startswith("health") and fname.endswith(".jsonl"):
+            health.extend(_read_jsonl(os.path.join(obs_dir, fname)))
+
+    dead = _read_jsonl(os.path.join(obs_dir, "deadletter.jsonl"))
+
+    # Fault timeline: flight window + health journals, de-duplicated —
+    # the health journal is MIRRORED into the flight ring, so the same
+    # transition usually exists in both streams. The key is the FULL
+    # payload (minus the ring's own seq/kind bookkeeping), not just
+    # (ts, kind): a quarantine burst can emit many distinct bad_record
+    # events inside one rounded millisecond, and each must keep its
+    # own timeline row.
+    seen, timeline = set(), []
+    for rec in flight_events + health:
+        kind = rec.get("kind") or rec.get("event")
+        if kind not in FAULT_KINDS:
+            continue
+        key = (kind, json.dumps(
+            {k: v for k, v in rec.items() if k not in ("seq", "kind",
+                                                       "event")},
+            sort_keys=True, default=str))
+        if key in seen:
+            continue
+        seen.add(key)
+        timeline.append(dict(rec, kind=kind))
+    timeline.sort(key=lambda r: r.get("ts") or 0.0)
+
+    return {
+        "dir": os.path.abspath(obs_dir),
+        "run_id": (dump or {}).get("run_id") or next(
+            (e.get("run_id") for e in flight_events
+             if e.get("kind") == "run_start" and e.get("run_id")),
+            os.path.basename(os.path.normpath(obs_dir))),
+        "spans": spans,
+        "snapshot": snapshots[-1] if snapshots else
+        (dump or {}).get("metrics"),
+        "dump": dump,
+        "timeline": timeline,
+        "dead": dead,
+    }
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{v:,.2f}"
+
+
+def _phase_rows(spans: list[dict]) -> list[tuple]:
+    agg: dict[str, list[float]] = {}
+    for s in spans:
+        agg.setdefault(s.get("name", "?"), []).append(
+            float(s.get("dur_ms") or 0.0))
+    rows = []
+    for name, durs in agg.items():
+        rows.append((sum(durs), name, len(durs),
+                     sum(durs) / len(durs), max(durs)))
+    rows.sort(reverse=True)
+    return rows
+
+
+def render(run: dict) -> str:
+    """The report text (also what ``main`` prints)."""
+    out = [f"# fm_spark_tpu run report — {run['run_id']}",
+           f"obs dir: {run['dir']}", ""]
+
+    spans = run["spans"]
+    out.append(f"## Phase breakdown ({len(spans)} spans)")
+    if spans:
+        starts = [s.get("t_start") for s in spans
+                  if s.get("t_start") is not None]
+        ends = [s["t_start"] + s.get("dur_ms", 0.0) / 1e3 for s in spans
+                if s.get("t_start") is not None]
+        wall_s = (max(ends) - min(starts)) if starts else 0.0
+        out.append(f"observed wall-clock: {wall_s:,.3f} s")
+        out.append(f"{'name':32} {'count':>6} {'total_s':>10} "
+                   f"{'mean_ms':>10} {'max_ms':>10} {'share':>7}")
+        for total_ms, name, n, mean_ms, max_ms in _phase_rows(spans):
+            share = (total_ms / 1e3 / wall_s) if wall_s > 0 else 0.0
+            out.append(f"{name:32} {n:>6} {total_ms / 1e3:>10,.3f} "
+                       f"{mean_ms:>10,.2f} {max_ms:>10,.2f} "
+                       f"{share:>6.1%}")
+    else:
+        out.append("(no span trace — pre-obs layout or tracing disabled)")
+    out.append("")
+
+    snap = run["snapshot"]
+    out.append("## Metrics")
+    if snap:
+        hists = snap.get("histograms") or {}
+        if hists:
+            out.append(f"{'histogram':32} {'count':>8} {'mean':>10} "
+                       f"{'p50':>10} {'p95':>10} {'p99':>10}")
+            for name in sorted(hists):
+                s = hists[name]
+                out.append(
+                    f"{name:32} {s.get('count', 0):>8} "
+                    f"{_fmt_ms(s.get('mean')):>10} "
+                    f"{_fmt_ms(s.get('p50')):>10} "
+                    f"{_fmt_ms(s.get('p95')):>10} "
+                    f"{_fmt_ms(s.get('p99')):>10}")
+        for kind in ("counters", "gauges"):
+            vals = {k: v for k, v in (snap.get(kind) or {}).items()
+                    if v is not None}
+            if vals:
+                out.append(f"{kind}:")
+                for name in sorted(vals):
+                    out.append(f"  {name:40} {vals[name]:,.6g}")
+    else:
+        out.append("(no metrics snapshot)")
+    out.append("")
+
+    timeline = run["timeline"]
+    out.append(f"## Fault / retry timeline ({len(timeline)} events)")
+    if timeline:
+        t0 = timeline[0].get("ts") or 0.0
+        for rec in timeline:
+            extras = {k: v for k, v in rec.items()
+                      if k not in ("ts", "kind", "event", "seq")}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(
+                extras.items()))
+            out.append(f"  +{(rec.get('ts') or t0) - t0:>9.3f}s "
+                       f"{rec['kind']:28} {detail}"[:200])
+    else:
+        out.append("(clean run: no fault events)")
+    out.append("")
+
+    dead = run["dead"]
+    if dead:
+        out.append(f"## Quarantine ({len(dead)} dead-letter records)")
+        reasons = Counter(r.get("reason", "?") for r in dead
+                          if r.get("event") == "bad_record")
+        for reason, n in reasons.most_common():
+            out.append(f"  {n:>6}  {reason}")
+        out.append("")
+
+    dump = run["dump"]
+    if dump:
+        out.append(f"last flight dump: reason={dump.get('reason')!r} "
+                   f"events={len(dump.get('events') or [])}")
+    return "\n".join(out) + "\n"
+
+
+def _latest_run_dir(root: str) -> str | None:
+    try:
+        runs = [os.path.join(root, d) for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))]
+    except OSError:
+        return None
+    return max(runs, key=os.path.getmtime) if runs else None
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] == "--latest":
+        root = args[1] if len(args) > 1 else os.path.join(
+            _REPO, "artifacts", "obs")
+        obs_dir = _latest_run_dir(root)
+        if obs_dir is None:
+            print(f"no run directories under {root}", file=sys.stderr)
+            return 1
+    elif len(args) == 1:
+        obs_dir = args[0]
+    else:
+        print(__doc__, file=sys.stderr)
+        return 2
+    if not os.path.isdir(obs_dir):
+        print(f"not a directory: {obs_dir}", file=sys.stderr)
+        return 1
+    sys.stdout.write(render(load_run(obs_dir)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

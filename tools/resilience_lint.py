@@ -28,6 +28,18 @@ COUNTERS (bookkeeping the injection harness needs before a journal can
 even exist) — it is not a state transition. Anything else wanting an
 exemption should probably be an EventLog event instead.
 
+Since ISSUE 7 the lint is also the OBSERVABILITY lint: beyond the
+strict EventLog-only scope above, every library module under
+``fm_spark_tpu/`` is scanned for *bare* ``print()`` — a print with no
+``file=`` destination, i.e. stdout narration that bypasses the
+telemetry plane. Numbers belong in the metrics registry
+(:mod:`fm_spark_tpu.obs.metrics` / ``MetricsLogger``), narrative in
+``EventLog``/spans. A ``print(..., file=...)`` is a *directed*
+transport (MetricsLogger's own JSONL stream writes that way) and is
+allowed outside the strict scope. The CLI surface (``cli.py``,
+``cli_levers.py``, ``__main__.py``) is exempt — a command-line tool's
+stdout IS its interface.
+
 Usage::
 
     python tools/resilience_lint.py        # exit 1 on violations
@@ -56,6 +68,12 @@ EXTRA_FILES = (
 ALLOWLIST = {
     ("faults.py", "_next_count"),
 }
+
+#: The library-wide bare-print scan root (ISSUE 7).
+LIBRARY_DIR = os.path.join(REPO, "fm_spark_tpu")
+
+#: Top-level library modules whose stdout IS their interface.
+CLI_EXEMPT = frozenset({"cli.py", "cli_levers.py", "__main__.py"})
 
 
 def _call_name(node: ast.Call) -> str:
@@ -111,6 +129,52 @@ def _check_file(path: str) -> list[str]:
     return _violations_in_tree(tree, fname)
 
 
+def _bare_prints_in_tree(tree: ast.AST, filename: str) -> list[str]:
+    """Library-wide rule (ISSUE 7): ``print()`` with no ``file=``
+    destination is stdout narration — route it through the obs plane
+    (EventLog / MetricsLogger / obs spans) instead."""
+    out = []
+
+    def visit(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        if (isinstance(node, ast.Call) and _call_name(node) == "print"
+                and not any(kw.arg == "file" for kw in node.keywords)):
+            out.append(
+                f"{filename}:{node.lineno} [{func or '<module>'}] "
+                "bare print() in library code — use MetricsLogger/"
+                "EventLog/obs APIs (fm_spark_tpu.obs) instead"
+            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, None)
+    return out
+
+
+def library_print_violations(root: str | None = None) -> list[str]:
+    """Bare-print violations across every ``.py`` under ``root``
+    (default: the whole ``fm_spark_tpu`` package), CLI modules exempt.
+    Filenames are reported repo-relative so two modules sharing a
+    basename stay distinguishable."""
+    root = root or LIBRARY_DIR
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, REPO)
+            if (fname in CLI_EXEMPT
+                    and os.path.dirname(rel) == "fm_spark_tpu"):
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=rel)
+            out.extend(_bare_prints_in_tree(tree, rel))
+    return out
+
+
 def violations(root: str | None = None) -> list[str]:
     """Violations under ``root`` (a directory); with the default root,
     the shipped surface is checked — every resilience/ module plus
@@ -129,11 +193,11 @@ def violations(root: str | None = None) -> list[str]:
 
 
 def main() -> int:
-    found = violations()
+    found = violations() + library_print_violations()
     for v in found:
         print(v, file=sys.stderr)
     if found:
-        print(f"{len(found)} resilience-logging violation(s)",
+        print(f"{len(found)} observability-logging violation(s)",
               file=sys.stderr)
         return 1
     return 0

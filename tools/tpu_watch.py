@@ -107,14 +107,23 @@ class TpuWatch:
     def __init__(self, out_dir: str, deadline_s: float,
                  runner=None, probe=None, sleep=time.sleep,
                  clock=time.monotonic, journal=None,
-                 policy: BackoffPolicy | None = None):
+                 policy: BackoffPolicy | None = None,
+                 obs_dir: str | None = None):
         self.out = out_dir
         os.makedirs(out_dir, exist_ok=True)
         self.deadline = clock() + deadline_s
         self.sleep = sleep
         self.clock = clock
-        self.journal = journal if journal is not None else EventLog(
-            os.path.join(out_dir, "health.jsonl"))
+        if journal is None:
+            # ISSUE 7 consolidation: with an obs dir the watch journal
+            # joins the per-run telemetry convention
+            # (artifacts/obs/<run_id>/health.jsonl) instead of living
+            # only beside the raw captures; raw captures stay in
+            # out_dir either way.
+            jdir = obs_dir or out_dir
+            os.makedirs(jdir, exist_ok=True)
+            journal = EventLog(os.path.join(jdir, "health.jsonl"))
+        self.journal = journal
         # Down-time poll cadence: starts near the shell loop's 45s and
         # backs off toward 3 min — a long outage stops burning CPU on
         # this single-core VM, while the jitter keeps restarts from
@@ -263,7 +272,12 @@ class TpuWatch:
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     deadline = float(args[0]) if args else 36000.0
-    watch = TpuWatch(os.path.join(_REPO, "tpu_watch_out"), deadline)
+    from fm_spark_tpu import obs
+
+    run_id = obs.new_run_id() + "-tpuwatch"
+    watch = TpuWatch(
+        os.path.join(_REPO, "tpu_watch_out"), deadline,
+        obs_dir=os.path.join(_REPO, "artifacts", "obs", run_id))
     watch.watch()
     return 0
 
