@@ -206,6 +206,16 @@ def default_variants(model, batch):
              ("float32", "bfloat16", None),
              TrainConfig(**ffm_base, sparse_update="scatter_add",
                          sel_blocked=True)),
+            # ISSUE 8: the sel-blocked body as Pallas kernels — the
+            # [T, F, k] sel/dsel pair GUARANTEED tile-resident instead
+            # of fusion-dependent (ops/pallas_fused.ffm_sel_*; bit-
+            # exact fp32 vs the XLA selblk body). 'require' so a
+            # no-Pallas attachment skips rather than silently pricing
+            # the XLA body under this label.
+            ("float32/scatter_add/cd-bf16/selblk-pallas",
+             ("float32", "bfloat16", None),
+             TrainConfig(**ffm_base, sparse_update="scatter_add",
+                         sel_blocked=True, fused_embed="require")),
         ], [
             ("bfloat16/dedup_sr", ("bfloat16", "bfloat16", None),
              TrainConfig(**ffm_base, sparse_update="dedup_sr")),
@@ -276,6 +286,20 @@ def default_variants(model, batch):
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull/segtotal",
          dict(gfull_fused=True, segtotal_pallas=True), None),
     ]
+    # Fused Pallas backward (ISSUE 8, ROADMAP item 4): the challenger
+    # for the sel/dsel/dv HBM traffic the round-5 cd-bf16 probe priced
+    # at +23% — g_full rebuilt on-chip from the sorted scalar streams +
+    # the VMEM-resident urows block and segment-summed in the SAME
+    # kernel, subsuming gfull+segtotal for the update stage. Staged
+    # right after the composed winners (the round-5 selblk pattern):
+    # a dying window prices the incumbent first, the challenger next.
+    # fused_embed='require' so an attachment that cannot serve the
+    # kernel SKIPS the leg (construction raises PallasUnavailable, the
+    # per-variant guard logs it) instead of silently measuring the XLA
+    # path under a fused label — the fallback-never-keep-bests rule.
+    ranked.insert(1, (
+        f"bfloat16/dedup_sr/compact{floor_cap}/cd-bf16/fusedbwd",
+        dict(compact_cap=floor_cap, fused_embed="require"), None))
     ranked += [
         (f"bfloat16/dedup_sr/compact{cap}/cd-bf16/gfull",
          dict(gfull_fused=True), None),
@@ -745,7 +769,8 @@ def inner_main(args):
                       or args.table_layout != "row"
                       or args.compact_cap
                       or args.compact_device or args.gfull_fused
-                      or args.segtotal_pallas)
+                      or args.segtotal_pallas
+                      or args.fused_embed != "off")
     shape_explicit = (args.rank is not None or args.batch != 1 << 17
                       or args.steps != 20)
     # --fast-first keeps the tiered variant sweep even at a non-default
@@ -762,7 +787,9 @@ def inner_main(args):
         + ("/cd-bf16" if args.compute_dtype == "bfloat16" else "")
         + ("/colT" if args.table_layout == "col" else "")
         + ("/gfull" if args.gfull_fused else "")
-        + ("/segtotal" if args.segtotal_pallas else ""),
+        + ("/segtotal" if args.segtotal_pallas else "")
+        + (f"/fused-{args.fused_embed}" if args.fused_embed != "off"
+           else ""),
         (args.param_dtype, None, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
                     optimizer="sgd", sparse_update=args.sparse_update,
@@ -770,7 +797,8 @@ def inner_main(args):
                     compact_cap=args.compact_cap,
                     compact_device=args.compact_device,
                     gfull_fused=args.gfull_fused,
-                    segtotal_pallas=args.segtotal_pallas),
+                    segtotal_pallas=args.segtotal_pallas,
+                    fused_embed=args.fused_embed),
     )]
     if not explicit:
         head, tail = default_variants(args.model, batch)
@@ -875,6 +903,11 @@ def inner_main(args):
 
     t_first_result = None  # wall-clock to the FIRST emitted result
     results = []
+    # Labels whose fused_embed='auto' resolved to the XLA path (ISSUE
+    # 8): the rate is a valid XLA measurement, but its provenance says
+    # "fused requested, not served" — stamped into the leg record and
+    # the payload so the parent's keep-best gate can refuse it.
+    fused_fallback_legs = set()
     resumed = {}
     if args.resume_sweep:
         resumed = _completed_legs(
@@ -918,6 +951,12 @@ def inner_main(args):
             # one: stamp the degraded provenance (chips = the surviving
             # count the per-chip rate is normalized to).
             payload.update(elastic.summary())
+        if best_label in fused_fallback_legs:
+            # A fused-requested leg that ran the XLA path must never
+            # become the recorded keep-best under its fused label
+            # (ISSUE 8); the parent's _emit_final gate refuses this
+            # stamp exactly like a degraded one.
+            payload["fused_fallback"] = True
         print(json.dumps(payload), flush=True)
         return payload
 
@@ -932,6 +971,8 @@ def inner_main(args):
             dt_banked = float(rec.get("dt_s", 0.0))
             results.append((float(rec["value"]), label,
                             dt_banked, float(rec.get("loss", 0.0))))
+            if rec.get("fused_fallback"):
+                fused_fallback_legs.add(label)
             # Banked legs still belong in the telemetry percentiles:
             # obs.configure reset the registry for this attempt, so
             # without replaying the banked per-leg mean the final
@@ -964,6 +1005,17 @@ def inner_main(args):
                  f"{(str(e).splitlines() or [''])[0][:200]}"
                  " -- skipping variant")
             continue
+        if config.fused_embed == "auto":
+            # The 'auto' lever's fallback is queryable, never silent
+            # (ISSUE 8): resolve the plan ONCE here and stamp the leg
+            # when the XLA path is what actually runs.
+            from fm_spark_tpu.sparse import fused_embed_plan
+
+            fam, fb_reason = fused_embed_plan(spec, config)
+            if fam is None:
+                fused_fallback_legs.add(label)
+                _log(f"[inner] [{label}] fused-embed XLA fallback "
+                     f"({fb_reason}) -- leg will never keep-best")
         # n_steps is a DYNAMIC argument so the warmup call compiles the
         # exact program the timed call runs (a static count would
         # recompile inside the timed region). DeepFM threads its dense
@@ -1188,6 +1240,8 @@ def inner_main(args):
         if elastic is not None and elastic.degraded:
             leg_record["chips"] = n_chips
             leg_record["degraded"] = True
+        if label in fused_fallback_legs:
+            leg_record["fused_fallback"] = True
         _persist_incremental(art_dir, args.model, payload, leg_record)
         # Metrics snapshot after every leg: a later kill still leaves
         # the run's numeric record in <obs_dir>/metrics.jsonl.
@@ -1270,6 +1324,14 @@ def _emit_final():
                         f"degraded measurement on {parsed.get('chips')} "
                         "chip(s) after an elastic shrink; keeping the "
                         "recorded full-mesh rate")
+                # A fused-embed leg that fell back to XLA measured the
+                # wrong program for its label — never the keep-best
+                # (ISSUE 8; same contract as the degraded stamp).
+                if parsed.get("fused_fallback"):
+                    raise RuntimeError(
+                        "fused-embed run fell back to the XLA path; "
+                        "not a fused-kernel measurement — keeping the "
+                        "recorded rate")
                 # Keep-best: MEASURED.json records the best measured
                 # on-chip capability. A later throttled window (this
                 # attachment streams at 5-10% of nominal HBM on bad
@@ -1437,6 +1499,15 @@ def main():
                     help="Pallas sorted-run segment totals in the "
                          "compact update (no blocked-prefix "
                          "materialization; round-5 lever)")
+    ap.add_argument("--fused-embed", default="off",
+                    choices=["off", "auto", "require"],
+                    dest="fused_embed",
+                    help="fused Pallas embedding path (ISSUE 8): "
+                         "'require' measures exactly the fused kernel "
+                         "family (fails if unservable); 'auto' falls "
+                         "back to XLA — the leg is then stamped "
+                         "fused_fallback and never keep-bests into "
+                         "MEASURED.json")
     ap.add_argument("--fast-first", action="store_true",
                     dest="fast_first",
                     help="tiered sweep (warm-start): measure the "
@@ -1587,6 +1658,8 @@ def main():
         argv.append("--gfull-fused")
     if args.segtotal_pallas:
         argv.append("--segtotal-pallas")
+    if args.fused_embed != "off":
+        argv += ["--fused-embed", args.fused_embed]
     if args.fast_first:
         argv.append("--fast-first")
     if args.dirty_input:

@@ -299,13 +299,29 @@ def main():
         else:
             _log("stream_native SKIPPED (native chunk parser unavailable)")
         streaming = {k: round(v, 1) for k, v in streaming.items()}
-        if "stream_native+prefetch" in streaming:
+        # Exit-ratio stage (ROADMAP item 2): the in-memory PackedBatches
+        # rate AT THE STREAMING BATCH SIZE is the ladder's denominator —
+        # re-measured here (not reused from the samples/s ladder above)
+        # so the round-10 0.075x-on-2-cores figure re-prices cleanly on
+        # any host, and stamped with the cores the parse actually had.
+        streaming["packed_batches"] = round(
+            _rate(raw, min(args.seconds, 4.0), args.batch), 1)
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # non-Linux
+            cores = os.cpu_count() or 1
+        streaming["cores_used"] = cores
+        best_stream = next(
+            (streaming[kk] for kk in ("stream_native+prefetch",
+                                      "stream_native") if kk in streaming),
+            None)
+        if best_stream is not None:
             streaming["speedup_vs_py"] = round(
-                streaming["stream_native+prefetch"]
-                / streaming["stream_py"], 1)
+                best_stream / streaming["stream_py"], 1)
             streaming["vs_packed_batches"] = round(
-                streaming["stream_native+prefetch"]
-                / rates["packed_batches"], 4)
+                best_stream / streaming["packed_batches"], 4)
+            _log(f"{'exit ratio':22s} {streaming['vs_packed_batches']:12}"
+                 f" x of in-memory PackedBatches on {cores} core(s)")
 
     end_to_end = rates["+prefetcher"]
     payload = {

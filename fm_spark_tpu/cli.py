@@ -720,6 +720,21 @@ def _fit_field_sparse(spec, tconfig, batches, logger, checkpointer=None,
         ckpt_sharded,
     )
 
+    if tconfig.fused_embed == "auto" and not sharded:
+        # The 'auto' lever's fallback is silent in the step's OUTPUTS
+        # but never in its provenance (ISSUE 8): surface which fused
+        # Pallas family serves this run — or why the XLA path runs
+        # instead — before any compile happens.
+        from fm_spark_tpu.sparse import fused_embed_plan
+
+        family, reason = fused_embed_plan(spec, tconfig)
+        print(
+            (f"fused-embed: serving kernel family {family!r}"
+             if family else
+             f"fused-embed: XLA fallback ({reason})"),
+            file=sys.stderr,
+        )
+
     # ---- state init ---------------------------------------------------
     canonical = spec.init(jax.random.key(tconfig.seed))
     opt0 = {}

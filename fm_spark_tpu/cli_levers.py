@@ -98,6 +98,17 @@ def _v_sel_blocked(tc, ctx):
         )
 
 
+def _v_fused_embed(tc, ctx):
+    # 'require' on a sharded run dies later in the factory with a less
+    # situated message; 'auto' is always legal (queryable XLA fallback).
+    if tc.fused_embed == "require" and ctx["sharded"]:
+        return (
+            f"--fused-embed require is served by the SINGLE-CHIP fused "
+            f"Pallas bodies (found {ctx['n']} devices); use 'auto' for "
+            "fallback-to-XLA semantics on a sharded run"
+        )
+
+
 _LEVERS = (
     _Lever("--host-dedup", "host_dedup", "flag",
            "precompute per-batch dedup sort/segment maps on the host "
@@ -165,6 +176,18 @@ _LEVERS = (
            "accumulator — no [B, w] prefix materialization; "
            "ops/pallas_segsum.py). Needs --compact-cap; off-TPU runs "
            "interpret mode; the on-chip A/B prices it"),
+    _Lever("--fused-embed", "fused_embed", "choice",
+           "fused Pallas embedding path (ops/pallas_fused.py): 'auto' "
+           "uses the kernel family serving this (model, config, "
+           "backend) — the FieldFM compact backward (g_full rebuilt "
+           "on-chip + segment totals in one kernel; the per-field "
+           "gradient set never touches HBM) or the sel-blocked "
+           "FieldFFM kernels — and falls back to the XLA path with a "
+           "stderr notice when none does; 'require' hard-fails "
+           "instead of falling back (bench legs that must price the "
+           "kernel)",
+           choices=("off", "auto", "require"),
+           validate=_v_fused_embed),
 )
 
 

@@ -6,14 +6,31 @@ interaction term and its latent-factor gradient"), lifted from a per-example
 Scala loop into batched, jit-compiled JAX over gathered embedding rows.
 """
 
-from fm_spark_tpu.ops.fm import (  # noqa: F401
+
+class PallasUnavailable(ValueError):
+    """A Pallas kernel cannot serve this (backend, shape, dtype) request.
+
+    The STRUCTURED fallback signal of the kernel tier (ISSUE 8): every
+    ``ops/pallas_*.py`` module raises exactly this — never a bare
+    ``assert`` — when a hardware constraint (Mosaic lane alignment, the
+    scalar-prefetch SMEM budget, the VMEM residency budget) or a missing
+    Pallas lowering makes the kernel unusable, so callers holding an
+    ``auto`` lever (``TrainConfig.fused_embed='auto'``) can catch it and
+    degrade to the XLA path instead of dying mid-attachment
+    (tools/resilience_lint.py enforces the no-assert rule). Subclasses
+    ``ValueError`` so pre-existing callers pinning ``ValueError`` keep
+    working.
+    """
+
+
+from fm_spark_tpu.ops.fm import (  # noqa: F401,E402
     fm_scores,
     fm_partial_terms,
     fm_scores_from_partials,
     fm_scores_dense,
 )
-from fm_spark_tpu.ops.ffm import ffm_scores, ffm_scores_dense  # noqa: F401
-from fm_spark_tpu.ops.losses import (  # noqa: F401
+from fm_spark_tpu.ops.ffm import ffm_scores, ffm_scores_dense  # noqa: F401,E402
+from fm_spark_tpu.ops.losses import (  # noqa: F401,E402
     logistic_loss,
     squared_loss,
     loss_fn,

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fm_spark_tpu.ops import PallasUnavailable
+
 # Rows processed per grid program; also the DMA queue depth per phase.
 _TILE = 256
 
@@ -57,13 +59,13 @@ def _require_compilable(width: int, n_ids: int, interpret: bool, who: str):
     if interpret:
         return
     if width % _LANE:
-        raise ValueError(
+        raise PallasUnavailable(
             f"{who}: table width {width} must be a multiple of {_LANE} on "
             f"real TPU (Mosaic row-DMA lane alignment); pad the table "
             f"width or use the XLA path (use_pallas=False)"
         )
     if n_ids > _SMEM_ID_LIMIT:
-        raise ValueError(
+        raise PallasUnavailable(
             f"{who}: {n_ids} ids exceed the scalar-prefetch SMEM budget "
             f"({_SMEM_ID_LIMIT}); split the batch or use the XLA path"
         )
@@ -101,7 +103,8 @@ def gather_rows(table: jax.Array, ids: jax.Array,
     """
     b = ids.shape[0]
     if b % _TILE:
-        raise ValueError(f"ids length {b} must be a multiple of {_TILE}")
+        raise PallasUnavailable(
+            f"ids length {b} must be a multiple of {_TILE}")
     w = table.shape[1]
     _require_compilable(w, b, interpret, "gather_rows")
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -182,7 +185,8 @@ def update_rows_add(table: jax.Array, ids: jax.Array, valid: jax.Array,
     """
     b = ids.shape[0]
     if b % _TILE:
-        raise ValueError(f"ids length {b} must be a multiple of {_TILE}")
+        raise PallasUnavailable(
+            f"ids length {b} must be a multiple of {_TILE}")
     w = table.shape[1]
     _require_compilable(w, 2 * b, interpret, "update_rows_add")
     grid_spec = pltpu.PrefetchScalarGridSpec(

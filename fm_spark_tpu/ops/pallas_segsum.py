@@ -44,6 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fm_spark_tpu.ops import PallasUnavailable
+
 # Lanes per grid step. 512 makes the one-hot matmul a [512, 512]·[512, w]
 # MXU op and bounds the per-tile distinct-segment count by construction
 # (<= T), so the dynamic output window never needs more than T rows.
@@ -109,7 +111,7 @@ def segment_totals(sdelta: jax.Array, seg_sorted: jax.Array, cap: int,
     out_bytes = (cap + t + 8) * w * 4
     budget = 8 * 1024 * 1024  # leave room for the tile + one-hot blocks
     if out_bytes > budget:
-        raise ValueError(
+        raise PallasUnavailable(
             f"segtotal_pallas accumulator [(cap+{t + 8}), {w}] fp32 = "
             f"{out_bytes / 1e6:.1f}MB exceeds the {budget // 2**20}MB "
             "VMEM budget (the kernel keeps the whole output resident); "

@@ -412,6 +412,16 @@ def compact_apply(table, delta, caux, mode, key, urows, col: bool = False,
             return bl[pos // blk, pos % blk] + off[pos // blk]
 
         segsum = csum_at(segend) - csum_at(segstart) + sdelta[segstart]
+    return _compact_write(table, segsum, useg, mode, key, urows, col)
+
+
+def _compact_write(table, segsum, useg, mode, key, urows, col):
+    """The compact update's WRITE half: one unique+sorted cap-lane
+    write of the fp32 per-segment totals — ``add`` for ``dedup``,
+    stochastic-rounded ``set`` of ``urows + totals`` for ``dedup_sr``.
+    Single definition shared by :func:`compact_apply` (XLA/segtotal
+    totals) and :func:`compact_apply_totals` (the fused Pallas
+    backward's totals) so the write semantics can never drift."""
     if mode == "dedup":
         upd = segsum.astype(table.dtype)
         if col:
@@ -436,6 +446,21 @@ def compact_apply(table, delta, caux, mode, key, urows, col: bool = False,
         vals, mode="drop",
         unique_indices=True, indices_are_sorted=True,
     )
+
+
+def compact_apply_totals(table, totals, caux, mode, key, urows,
+                         col: bool = False):
+    """Apply PRECOMPUTED [cap, w] fp32 per-segment totals to ``table``
+    — the write half of :func:`compact_apply` for callers that already
+    hold the totals, i.e. the fused Pallas backward
+    (ops/pallas_fused.fm_bwd_segment_totals), whose output is exactly
+    the ``-lr·g_full`` segment sums the blocked prefix would produce.
+    ``caux``/``mode``/``key``/``urows``/``col`` as in
+    :func:`compact_apply`."""
+    useg = caux[0]
+    _check_sentinel_range(table.shape[1] if col else table.shape[0],
+                          useg.shape[-1])
+    return _compact_write(table, totals, useg, mode, key, urows, col)
 
 
 def _aux_apply(table, delta, aux, mode, key, old_rows):

@@ -101,6 +101,9 @@ def _make_deepfm_sharded_one_step(spec, config: TrainConfig, mesh):
     from fm_spark_tpu.sparse import _reject_sel_blocked
 
     _reject_sel_blocked(config, "the field-sharded DeepFM step")
+    from fm_spark_tpu.sparse import _reject_fused_embed_require
+
+    _reject_fused_embed_require(config, "the field-sharded DeepFM step")
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded DeepFM runs on a ('feat',) or ('feat', 'row') "

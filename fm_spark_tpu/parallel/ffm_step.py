@@ -217,6 +217,9 @@ def _make_ffm_local_step(spec, config: TrainConfig, mesh):
 
     _reject_score_sharded(config, "the field-sharded FFM step")
     _reject_deep_sharded(config, "the field-sharded FFM step")
+    from fm_spark_tpu.sparse import _reject_fused_embed_require
+
+    _reject_fused_embed_require(config, "the field-sharded FFM step")
     wire = _collective_dtype(config)
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(

@@ -451,6 +451,9 @@ def _make_field_local_step(spec, config: TrainConfig, mesh):
 
     _reject_deep_sharded(config, "the field-sharded FM step")
     _reject_sel_blocked(config, "the field-sharded FM step")
+    from fm_spark_tpu.sparse import _reject_fused_embed_require
+
+    _reject_fused_embed_require(config, "the field-sharded FM step")
     if set(mesh.axis_names) not in ({"feat"}, {"feat", "row"}):
         raise ValueError(
             "field-sharded step runs on a ('feat',) or ('feat', 'row') "

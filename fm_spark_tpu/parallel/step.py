@@ -219,6 +219,9 @@ def make_parallel_train_step(
 
     _reject_sel_blocked(config, "the dense optax parallel step")
     _reject_deep_sharded(config, "the dense optax parallel step")
+    from fm_spark_tpu.sparse import _reject_fused_embed_require
+
+    _reject_fused_embed_require(config, "the dense optax parallel step")
     # Grad psums here feed the optimizer DIRECTLY (no later fp32
     # re-derivation), a different precision contract from the fused
     # steps' activation collectives — not wired up; reject rather than

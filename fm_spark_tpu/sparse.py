@@ -300,6 +300,26 @@ def _reject_collective_dtype(config: TrainConfig, what: str):
         )
 
 
+def _s1_and_rv(s, n_lanes, k, cd, use_linear: bool, config: TrainConfig):
+    """The fused g_full construction's shared operands: ``s1`` =
+    ``[s, lin_on]`` ([B, k+1], col k carrying 1/0 for the linear term)
+    and ``rv`` = the per-column reg vector (factor cols → reg_factors,
+    col k → reg_linear; None when both regs are off, matching the
+    conditional add). ONE definition consumed by :func:`_gfull_grads`
+    (the XLA reference) and :func:`_fused_compact_updates` (the Pallas
+    backward's host-side operands) — the fp32 bit-exactness contract
+    between them rests on these never forking."""
+    lin_on = 1.0 if use_linear else 0.0
+    s1 = jnp.concatenate(
+        [s, jnp.full((n_lanes, 1), lin_on, cd)], axis=1)
+    rv = None
+    if config.reg_factors or config.reg_linear:
+        rv = jnp.asarray(
+            [config.reg_factors] * k
+            + [config.reg_linear if use_linear else 0.0], cd)
+    return s1, rv
+
+
 def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
                  use_linear: bool, config: TrainConfig, extra=None):
     """The fused g_full construction (``config.gfull_fused``), shared by
@@ -322,15 +342,8 @@ def _gfull_grads(dscores, vals_c, s, xv_fulls, rows, touched, k, cd,
     (DeepFM) is the deep-head pullback as ONE zero-padded
     [B, F_local, k+1] tensor (col k zero — the head never touches the
     linear weight), built with a single pad instead of F concats."""
-    lin_on = 1.0 if use_linear else 0.0
-    s1 = jnp.concatenate(
-        [s, jnp.full((dscores.shape[0], 1), lin_on, cd)], axis=1)
+    s1, rv = _s1_and_rv(s, dscores.shape[0], k, cd, use_linear, config)
     colmask = jnp.arange(k + 1) < k
-    rv = None
-    if config.reg_factors or config.reg_linear:
-        rv = jnp.asarray(
-            [config.reg_factors] * k
-            + [config.reg_linear if use_linear else 0.0], cd)
     g_fulls = []
     for f in range(len(rows)):
         base = dscores[:, None] * (
@@ -388,6 +401,134 @@ def _reject_sel_blocked(config: TrainConfig, what: str):
             f"sel_blocked is the FieldFFM fused body's lever (it blocks "
             f"the [B, F, F, k] interaction tensor), not {what}"
         )
+
+
+def fused_embed_plan(spec, config: TrainConfig):
+    """Resolve ``TrainConfig.fused_embed`` against (spec, config,
+    backend): returns ``(family, reason)`` — ``family`` is the fused
+    Pallas kernel family that will serve this step,
+    ``'fm_compact_bwd'`` (the FieldFM compact backward,
+    ops/pallas_fused.fm_bwd_segment_totals) or ``'ffm_sel'`` (the
+    sel-blocked FieldFFM interaction kernels), or None with ``reason``
+    naming why the XLA path runs instead.
+
+    The SINGLE decision point for the lever: the step factories, the
+    CLI's fallback notice, and bench.py's skip-fallback-legs guard all
+    consult it — so an ``'auto'`` fallback is silent only in the step's
+    outputs, never in its provenance."""
+    from fm_spark_tpu.models.field_ffm import FieldFFMSpec
+    from fm_spark_tpu.models.field_fm import FieldFMSpec
+
+    if config.fused_embed not in ("off", "auto", "require"):
+        raise ValueError(
+            f"unknown fused_embed {config.fused_embed!r} "
+            "(expected 'off', 'auto', or 'require')")
+    if config.fused_embed == "off":
+        return None, "fused_embed='off'"
+    from fm_spark_tpu.ops import pallas_fused
+
+    if type(spec) is FieldFMSpec:
+        if config.compact_cap <= 0:
+            return None, ("the fused FM backward rides the compact "
+                          "update; it needs compact_cap > 0")
+        if not spec.fused_linear:
+            return None, "the fused FM backward needs fused_linear=True"
+        if getattr(spec, "table_layout", "row") == "col":
+            return None, ("table_layout='col' stores transposed tables; "
+                          "the kernel's resident urows block is "
+                          "row-major")
+        reason = pallas_fused.fm_bwd_supported(
+            config.compact_cap, spec.rank + 1,
+            jnp.dtype(spec.pdtype).itemsize)
+        if reason:
+            return None, reason
+        return "fm_compact_bwd", None
+    if type(spec) is FieldFFMSpec:
+        if not config.sel_blocked:
+            return None, ("the Pallas FFM kernels mirror the "
+                          "sel-blocked body (set sel_blocked=True)")
+        reason = pallas_fused.ffm_sel_supported(
+            spec.num_fields, spec.rank, jnp.dtype(spec.cdtype).itemsize)
+        if reason:
+            return None, reason
+        return "ffm_sel", None
+    return None, f"no fused kernel family for {type(spec).__name__}"
+
+
+def _resolve_fused_embed(spec, config: TrainConfig):
+    """Factory-side resolution of the lever: the plan's family (or
+    None on 'off'/'auto' fallback), with ``'require'`` escalated to the
+    structured kernel-unavailable error so an attachment that cannot
+    serve the kernel fails actionably instead of silently measuring
+    the XLA path."""
+    family, reason = fused_embed_plan(spec, config)
+    if family is None and config.fused_embed == "require":
+        from fm_spark_tpu.ops import PallasUnavailable
+
+        raise PallasUnavailable(
+            f"fused_embed='require' cannot be served: {reason}")
+    return family
+
+
+def _reject_fused_embed_require(config: TrainConfig, what: str):
+    """Guard for step factories outside the fused Pallas families (the
+    sharded steps, the dense paths, the flat-table FM step):
+    ``fused_embed='auto'`` resolves to the XLA path there — that IS the
+    auto contract, queryable via :func:`fused_embed_plan` — but an
+    explicit ``'require'`` must hard-fail instead of silently training
+    without the kernel (no-silent-fallback rule)."""
+    if config.fused_embed not in ("off", "auto", "require"):
+        raise ValueError(
+            f"unknown fused_embed {config.fused_embed!r} "
+            "(expected 'off', 'auto', or 'require')")
+    if config.fused_embed == "require":
+        raise ValueError(
+            f"fused_embed='require' is served by the single-chip "
+            f"FieldFM compact backward and sel-blocked FieldFFM fused "
+            f"bodies, not {what}; use 'auto' for fallback-to-XLA "
+            "semantics")
+
+
+def _fused_compact_updates(tables, urows, aux, s, dscores, vals_c,
+                           touched, config: TrainConfig, sr_base_key,
+                           step_idx, lr, k, cd, use_linear: bool):
+    """COMPACT update via the fused Pallas backward
+    (ops/pallas_fused.fm_bwd_segment_totals): per field, the sorted
+    scalar streams (dscores, the field's x, touched, dense segment
+    ranks) plus the shared ``[s, lin_on]`` rows drive ONE kernel that
+    rebuilds ``-lr·g_full`` on-chip from the VMEM-resident ``urows``
+    block and accumulates the per-segment totals in the same pass — the
+    F × [B, k+1] gradient set of :func:`_gfull_grads` (ROADMAP item 4's
+    dominant HBM term) never materializes off-chip. The totals land
+    through ``scatter.compact_apply_totals`` (the same write half as
+    ``compact_apply``), so fp32 results are BIT-EXACT against the
+    gfull_fused + segtotal_pallas reference composition
+    (tests/test_pallas_fused.py)."""
+    from fm_spark_tpu.ops import pallas_fused
+    from fm_spark_tpu.ops import scatter as scatter_lib
+
+    order, inv = aux[3], aux[4]
+    cap = aux[0].shape[-1]
+    s1, rv = _s1_and_rv(s, dscores.shape[0], k, cd, use_linear, config)
+    interpret = pallas_fused.default_interpret()
+    new = []
+    for f in range(len(tables)):
+        o = order[f]
+        totals = pallas_fused.fm_bwd_segment_totals(
+            urows[f], s1[o], dscores[o], vals_c[o, f], touched[o],
+            inv[f][o], -lr, rv, k=k, cap=cap, interpret=interpret)
+        key = (
+            scatter_lib.sr_key(sr_base_key, step_idx, f)
+            if config.sparse_update == "dedup_sr"
+            else None
+        )
+        new.append(
+            scatter_lib.compact_apply_totals(
+                tables[f], totals, tuple(a[f] for a in aux),
+                config.sparse_update, key, urows[f],
+            )
+        )
+    return new
 
 
 def _reject_host_aux(config: TrainConfig, what: str):
@@ -492,6 +633,11 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
     _reject_score_sharded(config, "the single-chip FieldFM body")
     _reject_sel_blocked(config, "the single-chip FieldFM body")
     _reject_deep_sharded(config, "the single-chip FieldFM body")
+    # Fused Pallas backward (ISSUE 8): resolved ONCE at build time —
+    # 'auto' with no serving kernel family compiles the XLA path (the
+    # reason stays queryable via fused_embed_plan), 'require' raises
+    # PallasUnavailable here.
+    fused_bwd = _resolve_fused_embed(spec, config) == "fm_compact_bwd"
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     F = spec.num_fields
@@ -571,6 +717,21 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
             return g
 
         if spec.fused_linear:
+            if fused_bwd:
+                # Fused Pallas backward: -lr·g_full is rebuilt on-chip
+                # from the sorted scalar streams + the resident urows
+                # block and segment-summed in the SAME kernel — the
+                # F × [B, k+1] gradient set never touches HBM.
+                new_vw = _fused_compact_updates(
+                    params["vw"], urows, aux, s, dscores, vals_c,
+                    touched, config, sr_base_key, step_idx, lr, k, cd,
+                    spec.use_linear,
+                )
+                out = {"w0": w0, "vw": new_vw}
+                if spec.use_bias:
+                    out["w0"] = w0 - lr * (
+                        jnp.sum(dscores) + config.reg_bias * w0)
+                return out, _fold_overflow(loss, ovf, config)
             # ONE row-update per field: interaction grads in cols [:k], the
             # linear grad in col k (zeroed if the linear term is disabled).
             if gfull_fused:
@@ -699,6 +860,9 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     _reject_collective_dtype(config, "the single-chip FieldFFM body")
     _reject_score_sharded(config, "the single-chip FieldFFM body")
     _reject_deep_sharded(config, "the single-chip FieldFFM body")
+    # Pallas sel-blocked kernels (ISSUE 8): resolved once at build time
+    # (same contract as the FM body's fused_bwd).
+    ffm_pallas = _resolve_fused_embed(spec, config) == "ffm_sel"
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -719,7 +883,21 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
             compact, params["vw"], aux, cd, gat, ids,
             device_cap=config.compact_cap if config.compact_device else 0,
         )                                               # F × [B, F·k+1]
-        if config.sel_blocked:
+        rstk = None
+        if ffm_pallas:
+            # Pallas sel-blocked kernels (ISSUE 8): the same per-owner-
+            # field loop as the XLA sel_blocked branch below, but the
+            # [T, F, k] sel/selT pair is GUARANTEED tile-resident inside
+            # the kernel instead of relying on XLA fusing the blocked
+            # slices — loops mirror the XLA body operation-for-operation
+            # so fp32 results are bit-exact (tests/test_pallas_fused.py).
+            from fm_spark_tpu.ops import pallas_fused
+
+            interp = pallas_fused.default_interpret()
+            rstk = jnp.stack([r[:, : F * k] for r in rows], axis=1)
+            scores = 0.5 * pallas_fused.ffm_sel_scores(
+                rstk, vals_c, interpret=interp)
+        elif config.sel_blocked:
             # Per-owner-field blocks: sel[b, i, j] = Rv[i][b, j] * x_i
             # and its transpose-slice selT_i[b, j] = Rv[j][b, i] * x_j
             # are built on the fly from the (already needed) gathered
@@ -767,7 +945,16 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
         lr = lr_at(step_idx)
         touched = weights > 0
 
-        if config.sel_blocked:
+        if ffm_pallas:
+            # The Pallas dvs backward: dsel stays tile-resident; only
+            # the per-owner-field gradient set the scatter consumes is
+            # written (stacked [B, F, F·k], sliced per field below).
+            from fm_spark_tpu.ops import pallas_fused
+
+            dvs_stk = pallas_fused.ffm_sel_bwd(
+                rstk, vals_c, dscores.astype(cd), interpret=interp)
+            dvs = [dvs_stk[:, i, :] for i in range(F)]
+        elif config.sel_blocked:
             # d/dsel[b, i, j] = ds_b · sel[b, j, i] (zero diagonal), so
             # per owner i the whole [B, F·k] factor gradient is one
             # recomputed selT_i slice — the [B, F, F, k] dsel tensor is
@@ -849,6 +1036,7 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
     _reject_score_sharded(config, "the single-chip FieldDeepFM body")
     _reject_sel_blocked(config, "the single-chip FieldDeepFM body")
     _reject_deep_sharded(config, "the single-chip FieldDeepFM body")
+    _reject_fused_embed_require(config, "the single-chip FieldDeepFM body")
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -1049,6 +1237,7 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
     _reject_score_sharded(config, "the single-chip flat-table FM step")
     _reject_sel_blocked(config, "the single-chip flat-table FM step")
     _reject_deep_sharded(config, "the single-chip flat-table FM step")
+    _reject_fused_embed_require(config, "the single-chip flat-table FM step")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
 

@@ -174,6 +174,24 @@ class TrainConfig:
     # eliminated. Off by default until the on-chip A/B (bench.py
     # --model ffm sweep) prices it.
     sel_blocked: bool = False
+    # Fused Pallas embedding path (ops/pallas_fused.py; ROADMAP item 4):
+    #  'off'     — the XLA reference path (default).
+    #  'auto'    — use the fused kernel family that serves this
+    #              (spec, config, backend) and fall back to XLA when
+    #              none does — queryably (sparse.fused_embed_plan
+    #              returns the reason; bench/cli surface it), the
+    #              attachment-without-Pallas degrade mode.
+    #  'require' — hard-fail (ops.PallasUnavailable) when no family
+    #              serves, for tests/benches that must price the kernel.
+    # Families: the FieldFM COMPACT backward (g_full built on-chip from
+    # sorted scalar streams + the VMEM-resident urows block, fused with
+    # the segment totals — the per-field [B, w] gradient set never
+    # touches HBM; subsumes gfull_fused + segtotal_pallas for that
+    # stage) and the sel-blocked FieldFFM interaction forward/backward
+    # (tile-resident sel/dsel). fp32 results are bit-exact against the
+    # reference bodies (tests/test_pallas_fused.py); priced per kernel
+    # by bench_kernels.py and through the bench.py sweep legs.
+    fused_embed: str = "off"
 
 
 def _group_reg(config: TrainConfig):
@@ -243,13 +261,18 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
         _reject_score_sharded,
     )
 
-    from fm_spark_tpu.sparse import _reject_sel_blocked
+    from fm_spark_tpu.sparse import (
+        _reject_fused_embed_require,
+        _reject_sel_blocked,
+    )
 
     _reject_host_aux(config, "the dense optax train step")
     _reject_collective_dtype(config, "the dense single-device train step")
     _reject_score_sharded(config, "the dense single-device train step")
     _reject_deep_sharded(config, "the dense single-device train step")
     _reject_sel_blocked(config, "the dense single-device train step")
+    _reject_fused_embed_require(
+        config, "the dense single-device train step")
     optimizer = optimizer or make_optimizer(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)
     add_reg = _group_reg(config)

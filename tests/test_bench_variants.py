@@ -27,6 +27,7 @@ def test_fm_label_config_consistency():
     for label, (pd, cd, layout), cfg in _grid("fm"):
         assert ("gfull" in label) == cfg.gfull_fused, label
         assert ("segtotal" in label) == cfg.segtotal_pallas, label
+        assert ("fusedbwd" in label) == (cfg.fused_embed != "off"), label
         assert ("devaux" in label) == cfg.compact_device, label
         assert ("colT" in label) == (layout == "col"), label
         assert (f"compact{cfg.compact_cap}" in label) == (
@@ -41,19 +42,25 @@ def test_fm_salvage_order_composed_first():
     head, _ = bench.default_variants("fm", 1 << 17)
     cfgs = [c for _, _, c in head]
     # [0] measured winner (floor cap 12288, 1,422,411 on 2026-07-31);
-    # [1] the batch/10-bound cap leg (the formula-derived fallback —
-    # right after the winner so a dying sweep still prices the ladder);
-    # [2] the historical-cap drift leg; [3][4] single-lever legs; [5]
+    # [1] the fused Pallas backward challenger at the same floor cap
+    # (ISSUE 8 — staged right after the incumbent, the round-5 selblk
+    # pattern; 'require' so a no-Pallas attachment skips, never
+    # silently pricing XLA under the fused label);
+    # [2] the batch/10-bound cap leg (the formula-derived fallback);
+    # [3] the historical-cap drift leg; [4][5] single-lever legs; [6]
     # the r3 winner closing the grid.
     assert cfgs[0].gfull_fused and cfgs[0].segtotal_pallas
     assert cfgs[0].compact_cap == 12288
-    assert cfgs[1].gfull_fused and cfgs[1].segtotal_pallas
-    assert cfgs[1].compact_cap == 13312
+    assert cfgs[1].fused_embed == "require"
+    assert cfgs[1].compact_cap == 12288
+    assert not cfgs[1].gfull_fused and not cfgs[1].segtotal_pallas
     assert cfgs[2].gfull_fused and cfgs[2].segtotal_pallas
-    assert cfgs[2].compact_cap == 16384
-    assert cfgs[3].gfull_fused and not cfgs[3].segtotal_pallas
-    assert cfgs[4].segtotal_pallas and not cfgs[4].gfull_fused
-    assert not cfgs[5].gfull_fused and not cfgs[5].segtotal_pallas
+    assert cfgs[2].compact_cap == 13312
+    assert cfgs[3].gfull_fused and cfgs[3].segtotal_pallas
+    assert cfgs[3].compact_cap == 16384
+    assert cfgs[4].gfull_fused and not cfgs[4].segtotal_pallas
+    assert cfgs[5].segtotal_pallas and not cfgs[5].gfull_fused
+    assert not cfgs[6].gfull_fused and not cfgs[6].segtotal_pallas
 
 
 def test_fm_tight_cap_bounds_measured_unique():
@@ -91,6 +98,9 @@ def test_ffm_grid_no_compact():
     for label, _, cfg in _grid("ffm"):
         assert cfg.compact_cap == 0, "compact measured a loser on avazu"
         assert "compact" not in label
+        assert ("selblk" in label) == cfg.sel_blocked, label
+        assert ("selblk-pallas" in label) == (
+            cfg.fused_embed != "off"), label
 
 
 def test_comparable_variant_gate():
@@ -236,3 +246,39 @@ def test_dirty_input_leg_quarantines_exactly_the_injected_lines(tmp_path):
         os.path.join(str(tmp_path), "quarantine_fm", "deadletter.jsonl"))
     assert sum(1 for e in events if e["event"] == "bad_record") == 60
     assert logs and "quarantined" in logs[-1]
+
+
+def test_fused_fallback_payload_never_keep_bests(monkeypatch, capsys):
+    """The parent's MEASURED.json gate (ISSUE 8): a payload stamped
+    fused_fallback — a fused-requested leg that ran the XLA path — must
+    never update the recorded rate, exactly like a degraded one."""
+    import json as _json
+
+    from fm_spark_tpu import measured as measured_lib
+
+    def _boom(*a, **kw):
+        raise AssertionError("fused_fallback payload reached keep-best")
+
+    monkeypatch.setattr(measured_lib, "update_entry", _boom)
+    payload = {
+        "metric": "criteo_fm_rank64_10Mfeat_samples_per_sec_per_chip",
+        "value": 9e9, "unit": "samples/sec/chip",
+        "variant": "bfloat16/dedup_sr/compact12288/cd-bf16/fusedbwd",
+        "device": "TPU v5 lite", "fused_fallback": True,
+    }
+    monkeypatch.setitem(bench._SALVAGE, "line", _json.dumps(payload))
+    monkeypatch.setitem(bench._SALVAGE, "emitted", False)
+    bench._emit_final()  # must print the line but refuse the record
+    out = capsys.readouterr().out
+    assert _json.loads(out.strip().splitlines()[-1]) == payload
+
+    # Control: the same payload WITHOUT the stamp reaches update_entry.
+    called = {}
+    monkeypatch.setattr(
+        measured_lib, "update_entry",
+        lambda entry, **kw: called.setdefault("entry", entry))
+    clean = {k: v for k, v in payload.items() if k != "fused_fallback"}
+    monkeypatch.setitem(bench._SALVAGE, "line", _json.dumps(clean))
+    monkeypatch.setitem(bench._SALVAGE, "emitted", False)
+    bench._emit_final()
+    assert called, "clean payload should have reached keep-best"

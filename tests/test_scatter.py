@@ -122,3 +122,85 @@ def test_fused_step_dedup_matches_scatter_add():
             np.asarray(pa["vw"][f]), np.asarray(pb["vw"][f]),
             rtol=1e-4, atol=1e-6,
         )
+
+
+def test_update_rows_add_matches_scatter_add_on_duplicate_ids():
+    """ISSUE 8 property test: the Pallas unique-row RMW
+    (ops/pallas_fm.update_rows_add), fed the deduped per-segment sums a
+    fused step would feed it, writes EXACTLY the table the plain
+    scatter-add reference produces — on duplicate-heavy batches, the
+    dedup/dedup_sr variants' exact aliasing case. Integer-valued deltas
+    make both paths' sums exact, so equality is bitwise, not tolerance
+    (any aliasing bug — a duplicate id written twice, a dropped
+    segment — shifts a row by >= 1.0)."""
+    from fm_spark_tpu.ops import pallas_fm
+    from fm_spark_tpu.ops.scatter import _dedup
+
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        b = 256
+        n_rows = int(rng.integers(8, 64))
+        w = int(rng.integers(2, 10))
+        table = jnp.asarray(
+            rng.integers(-50, 50, size=(n_rows, w)).astype(np.float32))
+        # Zipf-heavy duplication: many batch lanes alias few rows.
+        ids = jnp.asarray(rng.zipf(1.2, size=b) % n_rows, jnp.int32)
+        delta = jnp.asarray(
+            rng.integers(-8, 8, size=(b, w)).astype(np.float32))
+
+        want = apply_row_updates(table, ids, delta, mode="scatter_add")
+
+        # The fused-step feed: segment-sum duplicates, then one
+        # unique-lane Pallas RMW (bench_kernels' update family).
+        sid, summed, run_start, _order = jax.jit(_dedup)(ids, delta)
+        uids = jnp.where(run_start, sid, 0)
+        valid = run_start.astype(jnp.int32)
+        got = pallas_fm.update_rows_add(
+            jnp.copy(table), uids, valid, summed, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"seed={seed} rows={n_rows} w={w}")
+
+
+def test_compact_apply_totals_matches_compact_apply_write():
+    """The fused backward's write half (compact_apply_totals) against
+    compact_apply fed the same totals through its own segment-sum: the
+    two entrances to _compact_write must land identical tables (dedup)
+    and identical SR draws (dedup_sr), or the fused path would fork the
+    update semantics."""
+    from fm_spark_tpu.ops.scatter import (
+        compact_apply,
+        compact_apply_totals,
+        compact_aux,
+        compact_gather,
+        sr_key,
+    )
+
+    rng = np.random.default_rng(7)
+    b, n_rows, w, cap = 512, 40, 6, 48
+    ids = rng.integers(0, n_rows, size=(b, 1)).astype(np.int32)
+    aux = compact_aux(ids, cap)
+    caux = tuple(jnp.asarray(a[0]) for a in aux)
+    useg, _, _, order, inv = caux
+    table = jnp.asarray(
+        rng.integers(-20, 20, size=(n_rows, w)).astype(np.float32))
+    delta = jnp.asarray(
+        rng.integers(-4, 4, size=(b, w)).astype(np.float32))
+    urows = compact_gather(table, useg)
+
+    # Totals exactly as the fused backward emits them: per-segment sums
+    # of the sorted deltas (integer-valued, so the sum path is exact).
+    sdelta = np.asarray(delta)[np.asarray(order)]
+    seg = np.asarray(inv)[np.asarray(order)]
+    totals = np.zeros((cap, w), np.float32)
+    np.add.at(totals, seg, sdelta)
+    totals = jnp.asarray(totals)
+
+    a = compact_apply(table, delta, caux, "dedup", None, urows)
+    t = compact_apply_totals(table, totals, caux, "dedup", None, urows)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(t))
+
+    key = sr_key(jax.random.key(3), 0, 0)
+    a = compact_apply(table, delta, caux, "dedup_sr", key, urows)
+    t = compact_apply_totals(table, totals, caux, "dedup_sr", key, urows)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(t))

@@ -72,6 +72,16 @@ ALLOWLIST = {
 #: The library-wide bare-print scan root (ISSUE 7).
 LIBRARY_DIR = os.path.join(REPO, "fm_spark_tpu")
 
+#: Kernel modules (ISSUE 8): every Pallas kernel file under ops/. An
+#: attachment without a working Pallas lowering must DEGRADE (the
+#: fused_embed='auto' XLA fallback), not die — so kernel availability
+#: checks raise the structured ops.PallasUnavailable, never ``assert``
+#: (stripped under -O, and an AssertionError is uncatchable-by-contract
+#: for the fallback path) and never a bare ``ValueError`` (the fallback
+#: resolver pins the PallasUnavailable type).
+KERNEL_DIR = os.path.join(REPO, "fm_spark_tpu", "ops")
+KERNEL_PREFIX = "pallas_"
+
 #: Top-level library modules whose stdout IS their interface.
 CLI_EXEMPT = frozenset({"cli.py", "cli_levers.py", "__main__.py"})
 
@@ -175,6 +185,59 @@ def library_print_violations(root: str | None = None) -> list[str]:
     return out
 
 
+def _kernel_fallback_violations_in_tree(tree: ast.AST,
+                                        filename: str) -> list[str]:
+    """Kernel-module rule (ISSUE 8): no ``assert`` statements, and no
+    ``raise ValueError(...)`` — availability/shape constraints raise the
+    structured :class:`fm_spark_tpu.ops.PallasUnavailable` so the
+    ``fused_embed='auto'`` lever can catch-and-degrade."""
+    out = []
+
+    def visit(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        if isinstance(node, ast.Assert):
+            out.append(
+                f"{filename}:{node.lineno} [{func or '<module>'}] "
+                "assert in a Pallas kernel module — raise "
+                "ops.PallasUnavailable so fused_embed='auto' can "
+                "degrade to the XLA path instead of dying"
+            )
+        if isinstance(node, ast.Raise) and isinstance(node.exc, ast.Call):
+            f = node.exc.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name == "ValueError":
+                out.append(
+                    f"{filename}:{node.lineno} [{func or '<module>'}] "
+                    "bare ValueError in a Pallas kernel module — raise "
+                    "ops.PallasUnavailable (the structured fallback "
+                    "signal fused_embed='auto' pins)"
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, None)
+    return out
+
+
+def kernel_fallback_violations(root: str | None = None) -> list[str]:
+    """Structured-fallback violations across every ``pallas_*.py``
+    kernel module under ``root`` (default: ``fm_spark_tpu/ops``)."""
+    root = root or KERNEL_DIR
+    out = []
+    for fname in sorted(os.listdir(root)):
+        if not (fname.startswith(KERNEL_PREFIX)
+                and fname.endswith(".py")):
+            continue
+        path = os.path.join(root, fname)
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        out.extend(_kernel_fallback_violations_in_tree(tree, rel))
+    return out
+
+
 def violations(root: str | None = None) -> list[str]:
     """Violations under ``root`` (a directory); with the default root,
     the shipped surface is checked — every resilience/ module plus
@@ -193,7 +256,8 @@ def violations(root: str | None = None) -> list[str]:
 
 
 def main() -> int:
-    found = violations() + library_print_violations()
+    found = (violations() + library_print_violations()
+             + kernel_fallback_violations())
     for v in found:
         print(v, file=sys.stderr)
     if found:
