@@ -686,6 +686,20 @@ def inner_main(args):
     _log(f"[inner] backend up in {time.perf_counter() - t_start:.1f}s: "
          f"{len(devs)} x {devs[0].device_kind}")
 
+    # Perf provenance (ISSUE 9): every completed leg is appended to the
+    # cross-run ledger (artifacts/obs/ledger.jsonl) with a measurement
+    # fingerprint — lever-config hash, chip kind + count, jax/libtpu
+    # versions, degraded/fused_fallback stamps, and the supervisor-
+    # journal attachment-health verdict — and judged by the noise-aware
+    # sentinel against its (leg, fingerprint) cohort history BEFORE the
+    # record lands. The verdict rides the leg record, the result JSON,
+    # and (via the parent's keep-best gate) the MEASURED.json decision.
+    from fm_spark_tpu.obs.ledger import runtime_versions
+
+    ledger = obs.PerfLedger(obs.default_ledger_path(art_dir))
+    sentinel = obs.Sentinel(ledger)
+    _versions = runtime_versions()
+
     from fm_spark_tpu import models
     from fm_spark_tpu.sparse import (
         make_field_deepfm_sparse_body,
@@ -903,6 +917,10 @@ def inner_main(args):
 
     t_first_result = None  # wall-clock to the FIRST emitted result
     results = []
+    # Per-label sentinel verdict blocks (resumed legs reload theirs
+    # from the sweep artifact) — what emit_best stamps into the
+    # payload's sentinel/all_verdicts fields.
+    leg_verdicts = {}
     # Labels whose fused_embed='auto' resolved to the XLA path (ISSUE
     # 8): the rate is a valid XLA measurement, but its provenance says
     # "fused requested, not served" — stamped into the leg record and
@@ -939,6 +957,15 @@ def inner_main(args):
             # items 1/3/5 read their numbers from (ISSUE 7).
             "telemetry": obs.telemetry_block(),
         }
+        # Sentinel stamps (ISSUE 9): the promoted leg's full verdict
+        # block — the parent's keep-best gate refuses anything but
+        # improved/flat — plus the per-leg verdict map.
+        if best_label in leg_verdicts:
+            payload["sentinel"] = leg_verdicts[best_label]
+        payload["all_verdicts"] = {
+            label: (block or {}).get("verdict")
+            for label, block in leg_verdicts.items()
+        }
         if resumed:
             payload["resumed_legs"] = len(resumed)
         if dirty_stats is not None:
@@ -973,6 +1000,12 @@ def inner_main(args):
                             dt_banked, float(rec.get("loss", 0.0))))
             if rec.get("fused_fallback"):
                 fused_fallback_legs.add(label)
+            if rec.get("sentinel"):
+                # The banked leg was already judged (and ledgered) by
+                # the attempt that measured it — re-observing would
+                # double-count it in its own cohort history.
+                leg_verdicts[label] = dict(rec["sentinel"],
+                                           resumed=True)
             # Banked legs still belong in the telemetry percentiles:
             # obs.configure reset the registry for this attempt, so
             # without replaying the banked per-leg mean the final
@@ -1130,6 +1163,10 @@ def inner_main(args):
         # kill → respawn → auto --resume-sweep of the banked legs.
         outcome = None
         t_leg_wall, t_leg0 = time.time(), time.perf_counter()
+        # Failure delta over THIS leg: the fingerprint's attachment-
+        # health verdict is per-measurement weather, not run-lifetime
+        # state (one early flap must not stamp every later leg flaky).
+        leg_fail0 = sup.total_failures
         while outcome is None:
             try:
                 dt, final_loss = sup.run(measure, op=f"leg:{label}",
@@ -1216,6 +1253,70 @@ def inner_main(args):
         # fencing would change the measurement): percentiles across
         # legs land in the telemetry block.
         obs.histogram("step_time_ms").observe(dt / steps_timed * 1e3)
+        # Device-memory watermark right after the leg, while its tables
+        # are still resident: HBM peak rides the leg record next to the
+        # rate (the registry gauges feed the telemetry block too).
+        mem = obs.device_memory_snapshot(devs) or {}
+        # Fingerprint + sentinel verdict (ISSUE 9): judge this rate
+        # against the cohort history, then append it — best-effort by
+        # the telemetry contract (a broken ledger must not cost the
+        # leg), but a verdict failure is logged, never silent.
+        degraded_now = elastic is not None and elastic.degraded
+        leg_health = ("degraded" if degraded_now else
+                      "flaky" if (sup.total_failures - leg_fail0) > 0
+                      else sup.health_verdict())
+        fingerprint = obs.measurement_fingerprint(
+            variant=label, model=args.model, batch=batch,
+            steps=steps_timed, rank=rank,
+            device_kind=devs[0].device_kind, n_chips=n_chips,
+            jax_version=_versions["jax_version"],
+            libtpu_version=_versions["libtpu_version"],
+            degraded=degraded_now,
+            fused_fallback=label in fused_fallback_legs,
+            attachment_health=leg_health,
+        )
+        try:
+            # Crash window on a RETRIED attempt only (the lookup costs
+            # a ledger scan, so the common fresh path skips it): the
+            # aborted attempt appended this leg's ledger record but
+            # died before _persist_incremental banked it, so the
+            # resume scan re-measured the leg.
+            prior = [r for r in ledger.records(kind="bench_leg",
+                                               leg=METRIC,
+                                               run_id=run_id)
+                     if r.get("variant") == label
+                     ] if args.resume_sweep else []
+            if prior and prior[-1].get("sentinel"):
+                # Judge the RE-MEASURED rate against the recorded
+                # history (which already contains the aborted
+                # attempt's row) WITHOUT appending a duplicate
+                # (run_id, leg, variant) record — the verdict stays
+                # truthful about this value, the history stays
+                # duplicate-free.
+                leg_verdicts[label] = dict(
+                    sentinel.judge(METRIC, round(rate, 1), fingerprint),
+                    reused_ledger_record=True)
+            else:
+                leg_verdicts[label] = sentinel.observe({
+                    "kind": "bench_leg", "leg": METRIC,
+                    "run_id": run_id,
+                    "variant": label, "value": round(rate, 1),
+                    "unit": UNIT, "dt_s": round(dt, 3),
+                    "loss": round(final_loss, 6),
+                    # PJRT's peak_bytes_in_use is the PROCESS-
+                    # cumulative high-water mark at leg end (no reset
+                    # API exists): legs after the sweep's largest
+                    # inherit its peak.
+                    "hbm_peak_bytes": mem.get("peak_bytes_in_use"),
+                    "fingerprint": fingerprint,
+                })
+            _log(f"[inner] [{label}] sentinel: "
+                 f"{leg_verdicts[label]['verdict']} "
+                 f"({leg_verdicts[label]['reason']})")
+        except Exception as e:  # noqa: BLE001 — ledger is best-effort
+            _log(f"[inner] [{label}] ledger/sentinel failed "
+                 f"({type(e).__name__}): "
+                 f"{(str(e).splitlines() or [''])[0][:200]}")
         _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "
              f"(dt={dt:.3f}s loss={final_loss:.4f})")
         # Emit the best-so-far line after EVERY variant: if a later
@@ -1236,7 +1337,17 @@ def inner_main(args):
             "device": devs[0].device_kind,
             "ts": round(time.time(), 3),
             "t_since_start_s": round(time.perf_counter() - t_start, 1),
+            # Provenance fields (ISSUE 9): run_id + fingerprint are
+            # REQUIRED on every leg record (tools/resilience_lint.py
+            # pins these keys), so a sweep artifact line can always be
+            # traced to its run and comparability cohort.
+            "run_id": run_id,
+            "fingerprint": fingerprint,
+            "hbm_peak_bytes": mem.get("peak_bytes_in_use"),
         }
+        if label in leg_verdicts:
+            leg_record["sentinel"] = leg_verdicts[label]
+            leg_record["verdict"] = leg_verdicts[label]["verdict"]
         if elastic is not None and elastic.degraded:
             leg_record["chips"] = n_chips
             leg_record["degraded"] = True
@@ -1276,6 +1387,52 @@ def inner_main(args):
 _SALVAGE = {"line": None, "failures": [], "emitted": False, "proc": None,
             "permanent": False}
 _SALVAGE_LOCK = threading.RLock()
+
+# Parent-side ledger target (set by main): the error path appends a
+# NULL record — a dead round is a first-class attachment_transient
+# data point in the history, not a gap (the BENCH_r03–r05 lesson).
+_LEDGER_PATH = None
+_MODEL_NAME = "fm"
+
+
+def _load_obs_file(name):
+    """Load fm_spark_tpu/obs/<name>.py standalone (ledger/sentinel are
+    deliberately stdlib-only): the light parent gets provenance and the
+    keep-best gate without importing the jax-pulling package."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fm_spark_tpu", "obs", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_bench_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    # Register before exec: dataclass processing looks the module up
+    # in sys.modules.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ledger_error_record():
+    """Append the dead-round null record (best-effort by the final-line
+    contract)."""
+    if _LEDGER_PATH is None:
+        return
+    try:
+        lg = _load_obs_file("ledger")
+        st = _load_obs_file("sentinel")
+        ledger = lg.PerfLedger(_LEDGER_PATH)
+        st.Sentinel(ledger).observe({
+            "kind": "bench_leg", "leg": METRIC,
+            "run_id": _RUN_ID or "unknown",
+            "variant": None, "value": None, "unit": UNIT,
+            "error": "; ".join(_SALVAGE["failures"])[:500]
+            or "no attempt completed",
+            "fingerprint": lg.measurement_fingerprint(
+                variant="(error)", model=_MODEL_NAME,
+                attachment_health="down"),
+        })
+    except Exception as e:
+        _log(f"[parent] error-record ledger append failed: {e!r}")
 
 
 def comparable_variant(variant) -> bool:
@@ -1332,6 +1489,19 @@ def _emit_final():
                         "fused-embed run fell back to the XLA path; "
                         "not a fused-kernel measurement — keeping the "
                         "recorded rate")
+                # Sentinel gate (ISSUE 9): only an improved/flat
+                # verdict against the ledger's cohort history may
+                # promote — a statistically-regressed rate, or one
+                # measured under adverse attachment weather, never
+                # overwrites the recorded capability no matter how the
+                # numeric comparison lands.
+                sb = parsed.get("sentinel")
+                if not _load_obs_file("sentinel").keepbest_allowed(sb):
+                    raise RuntimeError(
+                        f"sentinel verdict {(sb or {}).get('verdict')!r}"
+                        f" ({(sb or {}).get('reason')}); only improved/"
+                        "flat measurements may promote — keeping the "
+                        "recorded rate")
                 # Keep-best: MEASURED.json records the best measured
                 # on-chip capability. A later throttled window (this
                 # attachment streams at 5-10% of nominal HBM on bad
@@ -1367,6 +1537,7 @@ def _emit_final():
             except Exception as e:  # never break the final-line contract
                 _log(f"[parent] MEASURED.json update failed: {e!r}")
         else:
+            _ledger_error_record()
             print(_error_line("; ".join(_SALVAGE["failures"])
                               or "no attempt completed",
                               permanent=_SALVAGE["permanent"]),
@@ -1626,8 +1797,11 @@ def main():
     # Mint the run id HERE so every retried child appends to the same
     # per-run telemetry directory and the parent's own error JSON
     # carries the id of the evidence it left behind.
-    global _RUN_ID
+    global _RUN_ID, _LEDGER_PATH, _MODEL_NAME
     _RUN_ID = args.run_id or _gen_run_id()
+    _MODEL_NAME = args.model
+    _LEDGER_PATH = os.path.join(_artifacts_dir(args), "obs",
+                                "ledger.jsonl")
     # Config errors must fail HERE, not in the child: the parent treats
     # a child death as a retryable attachment flake and would burn the
     # whole --total-deadline re-spawning a guaranteed failure.

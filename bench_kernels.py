@@ -287,9 +287,13 @@ def main():
 
         reason = pf.fm_bwd_supported(cap, w, isz)
         if reason:
-            print(json.dumps({"kernel": "fm_bwd_segment_totals",
-                              "family": "fused_bwd", "skipped": reason}),
-                  flush=True)
+            # Pre-check skips land in rows_out too: an unservable
+            # family must price as a null ledger record, not a gap.
+            row = {"kernel": "fm_bwd_segment_totals",
+                   "family": "fused_bwd", "skipped": reason,
+                   "backend": backend}
+            rows_out.append(row)
+            print(json.dumps(row), flush=True)
         else:
             urows = jnp.asarray(rng.normal(size=(cap, w)) * 0.01, dtype)
             s1s = jnp.asarray(rng.normal(size=(B, w)), cd)
@@ -336,8 +340,10 @@ def main():
         Ff, kf, Bf = args.ffm_fields, args.ffm_rank, args.ffm_batch
         reason = pallas_fused.ffm_sel_supported(Ff, kf, 4)
         if reason:
-            print(json.dumps({"kernel": "ffm_sel", "family": "ffm_sel",
-                              "skipped": reason}), flush=True)
+            row = {"kernel": "ffm_sel", "family": "ffm_sel",
+                   "skipped": reason, "backend": backend}
+            rows_out.append(row)
+            print(json.dumps(row), flush=True)
         else:
             rstk = jnp.asarray(
                 rng.normal(size=(Bf, Ff, Ff * kf)) * 0.01, jnp.float32)
@@ -377,17 +383,18 @@ def main():
     report_dir = args.report_dir
     if report_dir != "none":
         from fm_spark_tpu import obs
+        from fm_spark_tpu.obs.ledger import runtime_versions
 
+        run_id = obs.new_run_id()
         if report_dir is None:
-            report_dir = os.path.join("artifacts", "obs",
-                                      obs.new_run_id())
+            report_dir = os.path.join("artifacts", "obs", run_id)
         os.makedirs(report_dir, exist_ok=True)
         path = os.path.join(report_dir, "kernel_pricing.json")
         with open(path, "w") as f:
             json.dump({
                 "tool": "bench_kernels", "backend": backend,
                 "interpret": interpret, "dtype": args.dtype,
-                "iters": args.iters,
+                "iters": args.iters, "run_id": run_id,
                 "shapes": {"rows": args.rows, "width": w, "batch": B,
                            "cap": cap, "ffm_fields": args.ffm_fields,
                            "ffm_rank": args.ffm_rank,
@@ -395,8 +402,56 @@ def main():
                 "ts": round(time.time(), 3),
                 "kernels": rows_out,
             }, f, indent=1)
-        print(json.dumps({"report": path, "kernels": len(rows_out)}),
-              flush=True)
+        # Every pricing row also lands in the cross-run perf ledger
+        # (ISSUE 9): value = the bytes-model GB/s (higher is better, so
+        # the sentinel's improved/regressed signs apply unchanged);
+        # skipped rows record as nulls, never gaps. Interpret-mode rows
+        # are recorded too — their fingerprint's device_kind ('cpu')
+        # keeps them in their own cohort, away from on-chip history.
+        try:
+            # Sibling-of-the-run-dir convention (artifacts/obs/
+            # ledger.jsonl); normpath so a trailing slash cannot land
+            # the ledger INSIDE the run dir and fork the history.
+            ledger = obs.PerfLedger(os.path.join(
+                os.path.dirname(os.path.normpath(report_dir)) or ".",
+                "ledger.jsonl"))
+            sentinel = obs.Sentinel(ledger)
+            vers = runtime_versions()
+            for row in rows_out:
+                sentinel.observe({
+                    "kind": "kernel_pricing",
+                    "leg": f"kernel/{row['family']}",
+                    "run_id": run_id, "variant": row["kernel"],
+                    "value": row.get("model_gbps"), "unit": "GB/s",
+                    "ms": row.get("ms"),
+                    "bytes_moved_model": row.get("bytes_moved_model"),
+                    "skipped": row.get("skipped"),
+                    "fingerprint": obs.measurement_fingerprint(
+                        variant=row["kernel"],
+                        model=f"kernel/{row['family']}",
+                        batch=row.get("batch"), rank=row.get("rank"),
+                        # The same kernel at a different shape/dtype is
+                        # a different cohort — a bf16 or resized run
+                        # must not be judged against the fp32 band.
+                        extra={k: row[k]
+                               for k in ("dtype", "width", "cap",
+                                         "rows", "fields", "interpret")
+                               if k in row},
+                        device_kind=backend,
+                        jax_version=vers["jax_version"],
+                        libtpu_version=vers["libtpu_version"],
+                        # A capability/shape skip is NOT weather: the
+                        # attachment is fine, there is just no number
+                        # (classifies insufficient_history, and the
+                        # 'skipped' field above carries the reason).
+                        attachment_health="healthy",
+                    ),
+                })
+        except Exception as e:  # noqa: BLE001 — ledger is best-effort
+            print(f"bench_kernels: ledger append failed: {e!r}",
+                  file=sys.stderr)
+        print(json.dumps({"report": path, "kernels": len(rows_out),
+                          "run_id": run_id}), flush=True)
 
 
 if __name__ == "__main__":

@@ -1603,6 +1603,17 @@ def cmd_train(args) -> int:
     if args.model_out:
         models.save_model(args.model_out, spec, params)
         print(json.dumps({"saved": args.model_out}))
+    from fm_spark_tpu import obs as _obs
+
+    if _obs.enabled():
+        # End-of-run device-memory watermark (ISSUE 9) — the final
+        # metrics snapshot (obs.shutdown in main) then carries the HBM
+        # peak/live-buffer gauges — and the run-doctor pointer, so the
+        # run's diagnosis is one copy-paste away.
+        _obs.device_memory_snapshot()
+        print(json.dumps({
+            "run_doctor": f"python tools/run_doctor.py {_obs.run_dir()}",
+        }), flush=True)
     return 0
 
 

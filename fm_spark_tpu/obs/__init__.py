@@ -36,17 +36,32 @@ import threading
 import time
 
 from fm_spark_tpu.obs.flight import FlightRecorder, read_spool
+from fm_spark_tpu.obs.ledger import (
+    PerfLedger,
+    default_ledger_path,
+    measurement_fingerprint,
+)
 from fm_spark_tpu.obs.metrics import MetricsRegistry, registry
+from fm_spark_tpu.obs.sentinel import (
+    Sentinel,
+    SentinelPolicy,
+    keepbest_allowed,
+)
 from fm_spark_tpu.obs.trace import NOOP_SPAN, Span, Tracer
 
 __all__ = [
     "FAULT_KINDS",
     "FlightRecorder",
     "MetricsRegistry",
+    "PerfLedger",
+    "Sentinel",
+    "SentinelPolicy",
     "Span",
     "Tracer",
     "configure",
     "counter",
+    "default_ledger_path",
+    "device_memory_snapshot",
     "emit_span",
     "enabled",
     "event",
@@ -56,6 +71,8 @@ __all__ = [
     "gauge",
     "histogram",
     "install_signal_dump",
+    "keepbest_allowed",
+    "measurement_fingerprint",
     "new_run_id",
     "read_spool",
     "registry",
@@ -265,6 +282,53 @@ def export_snapshot() -> dict | None:
     return registry().export_jsonl(os.path.join(d, METRICS_FILE))
 
 
+def device_memory_snapshot(devices=None) -> dict | None:
+    """Device-memory watermarks into the registry (ISSUE 9): per-device
+    ``memory_stats()`` totals (``bytes_in_use`` and the PJRT
+    ``peak_bytes_in_use`` high-water mark — the HBM peak the ledger
+    records next to every leg's rate) plus the host-visible live-buffer
+    total from ``jax.live_arrays()``. Best-effort and lazy: jax is
+    only *looked up*, never imported — an unconfigured process, or a
+    CPU backend without memory stats, just reports what exists.
+    Returns the snapshot dict (``None`` when jax is not even loaded).
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    reg = registry()
+    out = {"live_buffer_bytes": None, "bytes_in_use": None,
+           "peak_bytes_in_use": None}
+    try:
+        live = sum(int(getattr(a, "nbytes", 0))
+                   for a in jax.live_arrays())
+        out["live_buffer_bytes"] = live
+        reg.gauge("device.live_buffer_bytes").set(live)
+    except Exception:
+        pass
+    try:
+        in_use = peak = 0
+        found = False
+        for d in devices if devices is not None else jax.local_devices():
+            stats = getattr(d, "memory_stats", None)
+            stats = stats() if callable(stats) else None
+            if not stats:
+                continue
+            found = True
+            in_use += int(stats.get("bytes_in_use", 0))
+            peak += int(stats.get("peak_bytes_in_use",
+                                  stats.get("bytes_in_use", 0)))
+        if found:
+            out["bytes_in_use"] = in_use
+            out["peak_bytes_in_use"] = peak
+            reg.gauge("device.bytes_in_use").set(in_use)
+            reg.gauge("device.peak_bytes_in_use").set(peak)
+    except Exception:
+        pass
+    return out
+
+
 def telemetry_block() -> dict:
     """The run's headline telemetry as one JSON-ready block — what
     ``bench.py`` stamps into its result JSON: step-time percentiles
@@ -282,6 +346,13 @@ def telemetry_block() -> dict:
         "ingest_rows_total": reg.counter("ingest.rows_ok_total").value,
         "ingest_quarantined_total":
             reg.counter("ingest.rows_quarantined_total").value,
+        "device_memory": {
+            "live_buffer_bytes": reg.gauge(
+                "device.live_buffer_bytes").value,
+            "bytes_in_use": reg.gauge("device.bytes_in_use").value,
+            "peak_bytes_in_use": reg.gauge(
+                "device.peak_bytes_in_use").value,
+        },
         "fault_events": [
             {k: v for k, v in e.items() if k != "seq"}
             for e in fault_timeline()

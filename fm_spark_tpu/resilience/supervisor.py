@@ -128,6 +128,12 @@ class Supervisor:
         self.breaker_threshold = breaker_threshold
         self._set_state("closed")
         self.consecutive_failures = 0
+        # Cumulative failure count across the supervisor's whole life —
+        # unlike consecutive_failures it survives note_success/reset, so
+        # a caller can DELTA it around one operation to learn whether
+        # that operation saw weather (the per-leg attachment-health
+        # verdict the perf ledger's fingerprints record, ISSUE 9).
+        self.total_failures = 0
         # Identity tracking for the transient-vs-permanent verdict
         # (resilience/elastic.py): a run of IDENTICAL failures (numerals
         # normalized) is the signature of a dead attachment, not a flap.
@@ -154,9 +160,14 @@ class Supervisor:
         # lands, so sampling self.state here would latch stale values.)
         try:
             if event == "failure":
+                self.total_failures += 1
                 obs.counter("resilience.failures_total").add(1)
             elif event == "backoff":
                 obs.counter("resilience.backoffs_total").add(1)
+            elif event == "probe":
+                obs.counter("resilience.probes_total").add(1)
+                if not fields.get("healthy"):
+                    obs.counter("resilience.probe_failures_total").add(1)
             if event in ("circuit_open", "permanent_fault"):
                 obs.flight_dump(event, **{
                     k: v for k, v in fields.items() if k != "reason"})
@@ -199,6 +210,19 @@ class Supervisor:
         run keeps the transient verdict (keep retrying/backing off)."""
         t = self.breaker_threshold if threshold is None else threshold
         return self.identical_failures >= max(t, 1)
+
+    def health_verdict(self) -> str:
+        """The attachment-health verdict this supervisor's journal
+        currently supports — what the perf ledger stamps into a
+        measurement's fingerprint (ISSUE 9): ``down`` when the breaker
+        is open or the failure run classifies permanent, ``flaky``
+        while a failure streak is live, else ``healthy``. Per-operation
+        weather is the caller's delta over :attr:`total_failures`."""
+        if self.state == "open" or self.permanent():
+            return "down"
+        if self.consecutive_failures:
+            return "flaky"
+        return "healthy"
 
     def reset(self, op: str = "op") -> None:
         """Re-arm the breaker after the caller changed the world (an

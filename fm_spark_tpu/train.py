@@ -706,6 +706,12 @@ class FMTrainer:
                     obs.emit_span("train/steps", win_ts, win_dur,
                                   steps=win_steps,
                                   step=self.step_count, loss=loss)
+                    # Device-memory watermark once per log window
+                    # (ISSUE 9): the HBM peak / live-buffer gauges ride
+                    # the metrics snapshots so a run's memory profile
+                    # is recorded next to its step rate. Per-window,
+                    # not per-step — live_arrays() walks every buffer.
+                    obs.device_memory_snapshot()
                     win_ts, win_t0, win_steps = (time.time(),
                                                  time.perf_counter(), 0)
                 steps_since_log = 0

@@ -104,8 +104,28 @@ def test_sweep_survives_init_hang_then_device_loss_and_resumes(tmp_path):
     assert all(st[p] is not None and st[p] > 0
                for p in ("p50", "p95", "p99"))
     assert "ingest_rows_per_sec" in tel
+    assert "device_memory" in tel
     kinds = [e["kind"] for e in tel["fault_events"]]
     assert "failure" in kinds and "backoff" in kinds
+
+    # ISSUE 9 acceptance: the result JSON carries the promoted leg's
+    # sentinel verdict block plus a verdict per completed leg, and the
+    # per-run ledger recorded every leg with the injected-device-loss
+    # weather on the retried one.
+    assert final["sentinel"]["verdict"] in (
+        "improved", "flat", "regressed", "attachment_transient",
+        "insufficient_history")
+    assert set(final["all_verdicts"]) == set(final["all_variants"])
+    ledger_path = art / "obs" / "ledger.jsonl"
+    assert ledger_path.exists()
+    legs = [json.loads(ln) for ln in
+            ledger_path.read_text().splitlines()]
+    legs = [r for r in legs if r.get("run_id") == final["run_id"]]
+    assert len(legs) == final["legs_completed"]
+    # Leg 2 survived a retried device loss: its fingerprint records the
+    # weather; the other legs were clean.
+    healths = [r["fingerprint"]["attachment_health"] for r in legs]
+    assert "flaky" in healths and "healthy" in healths
 
     # ...and obs_report renders a report straight from this run's obs
     # dir: per-leg phase rows, the step-time percentile table, and the
@@ -156,6 +176,9 @@ def test_sweep_survives_init_hang_then_device_loss_and_resumes(tmp_path):
     assert final2["resumed_legs"] == 1
     assert final2["legs_completed"] == n_total
     assert kept["variant"] in final2["all_variants"]
+    # The banked leg's sentinel verdict rides the resume (reloaded from
+    # its sweep record, never re-judged against its own history).
+    assert final2["all_verdicts"][kept["variant"]] == kept["verdict"]
     # Only the remaining legs were re-measured and appended.
     new_records = [json.loads(ln) for ln in
                    sweep_path.read_text().strip().splitlines()]
@@ -291,6 +314,53 @@ def test_elastic_degraded_sweep_completes_on_shrunk_mesh(tmp_path):
     # bound is loose — it only needs to rule out the WRONG denominator
     # (a /8 normalization would miss by a factor of 2).
     assert abs(rec["value"] * 4 * rec["dt_s"] / (2 * 128) - 1) < 0.25
+
+
+def test_retried_leg_never_double_appends_ledger_record(tmp_path):
+    """ISSUE 9 crash window: an attempt can die AFTER the sentinel
+    appended a leg's ledger record but BEFORE _persist_incremental
+    banked it — the retried (--resume-sweep, like every parent
+    respawn) attempt then re-measures the leg. The re-measured rate
+    must be judged WITHOUT appending a duplicate (run_id, variant)
+    row it would then be judged against."""
+    from fm_spark_tpu.obs import ledger as lg
+
+    art = tmp_path / "art"
+    run_id = "20260801-000000-ptest"
+    label = "float32/scatter_add/cd-bf16/b128"
+    metric = "kaggle_fm_rank32_1Mfeat_samples_per_sec_per_chip"
+    led = lg.PerfLedger(str(art / "obs" / "ledger.jsonl"))
+    led.append({
+        "kind": "bench_leg", "leg": metric, "run_id": run_id,
+        "variant": label, "value": 31000.0, "unit": "samples/sec/chip",
+        "sentinel": {"verdict": "insufficient_history",
+                     "reason": "aborted-attempt record",
+                     "n_history": 0, "median": None, "mad": None,
+                     "z": None, "cohort": "exact"},
+        "fingerprint": lg.measurement_fingerprint(
+            variant=label, model="fm_kaggle", batch=128, steps=2),
+    })
+    proc = _run_bench(
+        ["--fast-first", "--model", "fm_kaggle", "--batch", "128",
+         "--steps", "2", "--attempts", "1", "--attempt-timeout", "300",
+         "--total-deadline", "380", "--artifacts-dir", str(art),
+         "--run-id", run_id, "--resume-sweep"],
+        env={}, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    final = _last_json(proc.stdout)
+    rows = [json.loads(ln) for ln in
+            (art / "obs" / "ledger.jsonl").read_text().splitlines()]
+    mine = [r for r in rows if r.get("run_id") == run_id
+            and r.get("variant") == label]
+    assert len(mine) == 1, "duplicate (run_id, variant) ledger record"
+    # The re-measured rate was judged fresh (against a history of just
+    # the aborted attempt's row — insufficient) without re-appending.
+    assert final["all_verdicts"][label] == "insufficient_history"
+    # The OTHER legs were measured fresh and appended normally.
+    others = [r for r in rows if r.get("run_id") == run_id
+              and r.get("variant") != label]
+    assert len(others) == final["legs_completed"] - 1
 
 
 @pytest.mark.slow

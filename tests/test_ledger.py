@@ -1,0 +1,132 @@
+"""Perf-provenance ledger contracts (ISSUE 9): append-only JSONL with
+required provenance fields, torn-tail-tolerant reads, and fingerprint
+cohort keys that split exactly on the comparability-defining fields
+(and NOT on attachment weather)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fm_spark_tpu.obs.ledger import (  # noqa: E402
+    PerfLedger,
+    default_ledger_path,
+    fingerprint_key,
+    measurement_fingerprint,
+)
+
+
+def _fp(**kw):
+    base = dict(variant="v1", model="fm", batch=1024, steps=20,
+                device_kind="TPU v5 lite", n_chips=1,
+                jax_version="0.9.9", libtpu_version="tpu-x")
+    base.update(kw)
+    return measurement_fingerprint(**base)
+
+
+def _rec(value=1.0, leg="legA", run_id="r1", **kw):
+    return {"kind": "bench_leg", "leg": leg, "run_id": run_id,
+            "value": value, "fingerprint": kw.pop("fp", None) or _fp(),
+            **kw}
+
+
+def test_append_and_read_roundtrip(tmp_path):
+    led = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    r1 = led.append(_rec(value=100.0))
+    r2 = led.append(_rec(value=200.0))
+    assert r1["ts"] and r2["ts"]
+    got = led.records()
+    assert [r["value"] for r in got] == [100.0, 200.0]
+    # Append order IS history order.
+    assert got[0]["ts"] <= got[1]["ts"]
+
+
+def test_append_refuses_unattributable_records(tmp_path):
+    led = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    for missing in ("kind", "leg", "run_id", "fingerprint"):
+        rec = _rec()
+        del rec[missing]
+        with pytest.raises(ValueError, match=missing):
+            led.append(rec)
+    # A fingerprint without its cohort key is just as unattributable.
+    rec = _rec()
+    rec["fingerprint"] = {"variant": "v1"}
+    with pytest.raises(ValueError, match="key"):
+        led.append(rec)
+    assert led.records() == []  # nothing half-written
+
+
+def test_records_skips_torn_and_junk_lines(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    led = PerfLedger(str(path))
+    led.append(_rec(value=1.0))
+    with open(path, "a") as f:
+        f.write('{"torn": \n')
+        f.write("[1, 2, 3]\n")  # parseable but not a dict
+    led.append(_rec(value=2.0))
+    assert [r["value"] for r in led.records()] == [1.0, 2.0]
+
+
+def test_records_filters(tmp_path):
+    led = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    fp_a, fp_b = _fp(variant="a"), _fp(variant="b")
+    led.append(_rec(value=1.0, leg="legA", run_id="r1", fp=fp_a))
+    led.append(_rec(value=2.0, leg="legA", run_id="r2", fp=fp_b))
+    led.append(_rec(value=3.0, leg="legB", run_id="r1", fp=fp_a))
+    assert len(led.records(leg="legA")) == 2
+    assert len(led.records(run_id="r1")) == 2
+    assert len(led.records(kind="bench_leg")) == 3
+    assert len(led.records(kind="attachment_probe")) == 0
+    assert [r["value"] for r in led.cohort("legA", fp_a["key"])] == [1.0]
+
+
+def test_missing_file_is_empty_history(tmp_path):
+    assert PerfLedger(str(tmp_path / "nope.jsonl")).records() == []
+
+
+def test_fingerprint_key_splits_on_comparability_fields():
+    base = _fp()
+    # Same inputs -> same key (stable across processes by construction).
+    assert _fp()["key"] == base["key"]
+    # Each comparability-defining field forks the cohort...
+    assert _fp(variant="other")["key"] != base["key"]
+    assert _fp(batch=2048)["key"] != base["key"]
+    assert _fp(device_kind="cpu")["key"] != base["key"]
+    assert _fp(n_chips=8)["key"] != base["key"]
+    assert _fp(jax_version="0.9.8")["key"] != base["key"]
+    assert _fp(degraded=True)["key"] != base["key"]
+    assert _fp(fused_fallback=True)["key"] != base["key"]
+    # ...but attachment WEATHER does not: a flaky-day measurement must
+    # stay comparable with its healthy-day cohort (weather is evidence
+    # for the sentinel, not a cohort splitter).
+    assert _fp(attachment_health="down")["key"] == base["key"]
+
+
+def test_fingerprint_key_matches_module_helper():
+    fp = _fp()
+    assert fingerprint_key(fp) == fp["key"]
+
+
+def test_default_ledger_path_is_the_cross_run_convention(tmp_path):
+    assert default_ledger_path(str(tmp_path)) == str(
+        tmp_path / "obs" / "ledger.jsonl")
+    # Repo default: beside the per-run obs dirs.
+    assert default_ledger_path().endswith(
+        os.path.join("artifacts", "obs", "ledger.jsonl"))
+
+
+def test_append_creates_parent_dirs(tmp_path):
+    led = PerfLedger(str(tmp_path / "a" / "b" / "ledger.jsonl"))
+    led.append(_rec())
+    assert len(led.records()) == 1
+
+
+def test_ledger_record_json_serializable(tmp_path):
+    led = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    rec = led.append(_rec(value=None, error="rc=3"))
+    line = json.loads(open(led.path).read())
+    assert line["value"] is None and line["error"] == "rc=3"
+    assert rec["fingerprint"]["key"] == line["fingerprint"]["key"]

@@ -101,6 +101,101 @@ def test_lint_default_surface_includes_data_stream(tmp_path, monkeypatch):
                                         "resilience")) == []
 
 
+def test_duration_rule_catches_wallclock_subtraction(tmp_path):
+    """ISSUE 9: time.time() inside a subtraction is a wall-clock
+    DURATION — flagged in every form the codebase could write it
+    (module alias, import alias, bare import, either operand,
+    augmented assignment); timestamp uses stay legal."""
+    lint = _load_lint()
+    (tmp_path / "dur.py").write_text(
+        "import time\n"
+        "import time as _time\n"
+        "def measure(t0, t1):\n"
+        "    a = time.time() - t0\n"
+        "    b = t1 - _time.time()\n"
+        "    c = time() - t0\n"          # the from-import form
+        "    t1 -= time.time()\n"
+        "    ok = {'ts': time.time()}\n"          # timestamp: legal
+        "    ok2 = time.perf_counter() - t0\n"    # monotonic: legal
+        "    return a, b, c, ok, ok2\n"
+    )
+    import ast as _ast
+
+    found = lint._duration_violations_in_tree(
+        _ast.parse((tmp_path / "dur.py").read_text()), "dur.py")
+    assert len(found) == 4
+    assert all("perf_counter" in v and "[measure]" in v for v in found)
+
+
+def test_duration_rule_follows_import_aliases(tmp_path):
+    """'import time as t' / 'from time import time as now' must not
+    evade the ban — the rule reads the file's own import aliases."""
+    lint = _load_lint()
+    src = (
+        "import time as t\n"
+        "from time import time as now\n"
+        "def measure(t0):\n"
+        "    a = t.time() - t0\n"
+        "    b = now() - t0\n"
+        "    ok = t.perf_counter() - t0\n"   # monotonic: legal
+        "    return a, b, ok\n"
+    )
+    import ast as _ast
+
+    found = lint._duration_violations_in_tree(_ast.parse(src), "al.py")
+    assert len(found) == 2
+
+
+def test_duration_rule_shipped_library_is_clean():
+    lint = _load_lint()
+    assert lint.duration_time_violations() == []
+
+
+def test_duration_rule_walks_the_library(tmp_path):
+    """The scan actually visits files under an arbitrary root."""
+    lint = _load_lint()
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "m.py").write_text(
+        "import time\ndt = time.time() - 5.0\n")
+    found = lint.duration_time_violations(str(tmp_path))
+    assert len(found) == 1 and "<module>" in found[0]
+
+
+def test_bench_leg_record_rule_shipped_bench_is_clean():
+    lint = _load_lint()
+    assert lint.bench_leg_record_violations() == []
+
+
+def test_bench_leg_record_rule_catches_missing_provenance(tmp_path):
+    lint = _load_lint()
+    bad = tmp_path / "bench.py"
+    bad.write_text(
+        "leg_record = {'variant': label, 'value': 1.0}\n")
+    found = lint.bench_leg_record_violations(str(bad))
+    assert len(found) == 1
+    assert "run_id" in found[0] and "fingerprint" in found[0]
+    # No leg_record literal at all: the contract has no anchor.
+    none = tmp_path / "empty.py"
+    none.write_text("x = 1\n")
+    found = lint.bench_leg_record_violations(str(none))
+    assert len(found) == 1 and "no leg_record" in found[0]
+
+
+def test_new_rules_wired_into_main(monkeypatch, capsys):
+    """main() runs the ISSUE 9 rules — a planted violation in either
+    fails the lint exit status."""
+    lint = _load_lint()
+    monkeypatch.setattr(lint, "duration_time_violations",
+                        lambda root=None: ["dur.py:1 planted"])
+    assert lint.main() == 1
+    monkeypatch.setattr(lint, "duration_time_violations",
+                        lambda root=None: [])
+    monkeypatch.setattr(lint, "bench_leg_record_violations",
+                        lambda path=None: ["bench.py:1 planted"])
+    assert lint.main() == 1
+
+
 @pytest.mark.parametrize("fname", sorted(
     f for f in os.listdir(os.path.join(REPO, "fm_spark_tpu", "resilience"))
     if f.endswith(".py")

@@ -1,0 +1,210 @@
+"""Automated run doctor (ISSUE 9): the E2E acceptance — a REAL bench
+run's obs directory diagnosed by ``tools/run_doctor.py --latest`` via
+subprocess — plus unit coverage of the attribution/finding logic."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_doctor():
+    spec = importlib.util.spec_from_file_location(
+        "run_doctor_tool", os.path.join(REPO, "tools", "run_doctor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------- E2E
+
+
+@pytest.fixture(scope="module")
+def bench_run(tmp_path_factory):
+    """One real (CPU) fast-first sweep: artifacts dir + final payload."""
+    art = tmp_path_factory.mktemp("art")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--fast-first", "--model", "fm_kaggle",
+         "--batch", "128", "--steps", "2",
+         "--attempts", "1", "--attempt-timeout", "300",
+         "--total-deadline", "420", "--artifacts-dir", str(art)],
+        capture_output=True, text=True, cwd=REPO, timeout=460,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    return art, json.loads(lines[-1])
+
+
+def test_doctor_latest_diagnoses_real_bench_run(bench_run):
+    """Acceptance: ``run_doctor.py --latest`` over a real bench run dir
+    produces a phase-attributed diagnosis — compile share, per-leg
+    sentinel verdicts, and a findings section."""
+    art, final = bench_run
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_doctor.py"),
+         "--latest", str(art / "obs")],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert final["run_id"] in out
+    # Phase attribution with a real wall-clock and the compile row.
+    assert "## Where the time went" in out
+    assert "compile+warmup" in out and "execute" in out
+    # Per-leg verdict table: every completed leg's ledger record, with
+    # variant, value, verdict, and weather columns.
+    assert "## Per-leg verdicts" in out
+    assert f"{final['legs_completed']} ledger record(s)" in out
+    for label in final["all_variants"]:
+        assert label[:52] in out
+    for verdict in final["all_verdicts"].values():
+        assert verdict in out
+    assert "healthy" in out
+    assert "## Diagnosis" in out
+
+
+def test_doctor_explicit_dir_and_ledger_flag(bench_run):
+    art, final = bench_run
+    run_dir = art / "obs" / final["run_id"]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_doctor.py"),
+         str(run_dir), "--ledger", str(art / "obs" / "ledger.jsonl")],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert final["run_id"] in proc.stdout
+    assert "## Per-leg verdicts" in proc.stdout
+
+
+def test_bench_leg_records_carry_provenance(bench_run):
+    """Every sweep record and every ledger record from the run carries
+    run_id + fingerprint (the ISSUE 9 leg-record contract, runtime
+    side), and the fingerprint names the cohort fields."""
+    art, final = bench_run
+    sweep = [json.loads(ln) for ln in
+             (art / "sweep_fm_kaggle.jsonl").read_text().splitlines()]
+    assert sweep
+    for rec in sweep:
+        assert rec["run_id"] == final["run_id"]
+        fp = rec["fingerprint"]
+        assert fp["key"] and fp["config_hash"]
+        assert fp["device_kind"] == "cpu"
+        assert fp["attachment_health"] == "healthy"
+        assert rec["verdict"] in ("improved", "flat", "regressed",
+                                  "attachment_transient",
+                                  "insufficient_history")
+    ledger = [json.loads(ln) for ln in
+              (art / "obs" / "ledger.jsonl").read_text().splitlines()]
+    legs = [r for r in ledger if r["kind"] == "bench_leg"]
+    assert len(legs) == len(sweep)
+    assert {r["variant"] for r in legs} == {r["variant"] for r in sweep}
+    # jax version is known in-child — it must ride the fingerprint.
+    assert all(r["fingerprint"]["jax_version"] for r in legs)
+
+
+def test_result_json_carries_sentinel_block(bench_run):
+    """ISSUE 9 acceptance: the bench result JSON carries the promoted
+    leg's sentinel verdict block plus the per-leg verdict map."""
+    art, final = bench_run
+    sb = final["sentinel"]
+    assert sb["verdict"] in ("improved", "flat", "regressed",
+                             "attachment_transient",
+                             "insufficient_history")
+    assert set(final["all_verdicts"]) == set(final["all_variants"])
+
+
+# ---------------------------------------------------------------- unit
+
+
+def _synthetic_run(legs):
+    spans = [
+        {"name": "bench/leg", "label": "a", "t_start": 100.0,
+         "dur_ms": 10_000.0},
+        {"name": "bench/leg", "label": "b", "t_start": 110.0,
+         "dur_ms": 10_000.0},
+        {"name": "resilience/backoff", "t_start": 105.0,
+         "dur_ms": 2_000.0},
+    ]
+    return {"run_id": "synth", "dir": "/x", "spans": spans,
+            "snapshot": {"counters": {}, "gauges": {}},
+            "timeline": [{"kind": "failure", "ts": 105.0}],
+            "dead": [], "dump": None}
+
+
+def test_diagnose_attributes_compile_vs_execute():
+    doctor = _load_doctor()
+    legs = [
+        {"variant": "a", "value": 100.0, "dt_s": 2.0,
+         "sentinel": {"verdict": "flat"}, "fingerprint": {}},
+        {"variant": "b", "value": 90.0, "dt_s": 3.0,
+         "sentinel": {"verdict": "regressed", "reason": "z=-4"},
+         "fingerprint": {}},
+    ]
+    diag = doctor.diagnose(_synthetic_run(legs), legs, [])
+    # Two 10s legs, 5s of timed windows -> 15s compile+warmup, 5s exec.
+    assert diag["phases"]["compile+warmup"] == pytest.approx(15.0)
+    assert diag["phases"]["execute"] == pytest.approx(5.0)
+    assert diag["phases"]["faults/backoff"] == pytest.approx(2.0)
+    assert diag["fault_kinds"] == {"failure": 1}
+    found = doctor.findings(diag, legs)
+    assert any("compile-dominated" in f for f in found)
+    assert any("REGRESSED: b" in f for f in found)
+
+
+def test_findings_flag_weather_and_stamps():
+    doctor = _load_doctor()
+    diag = {"wall_s": 100.0, "fresh_compiles": 0,
+            "phases": {"compile+warmup": 1.0, "execute": 80.0,
+                       "faults/backoff": 15.0, "eval": 0.0,
+                       "other": 4.0},
+            "ingest_busy_s": 0.0, "backoff_s": 15.0,
+            "fault_kinds": {"failure": 3, "circuit_open": 1}}
+    legs = [{"variant": "v", "value": None,
+             "sentinel": {"verdict": "attachment_transient",
+                          "reason": "weather"},
+             "fingerprint": {"degraded": True, "fused_fallback": True}}]
+    found = doctor.findings(diag, legs)
+    assert any("attachment weather" in f and "circuit opened" in f
+               for f in found)
+    assert any("transient (weather, not code)" in f for f in found)
+    assert any("degraded leg" in f for f in found)
+    assert any("fused-embed fallback" in f for f in found)
+
+
+def test_findings_clean_run():
+    doctor = _load_doctor()
+    diag = {"wall_s": 100.0, "fresh_compiles": 0,
+            "phases": {"compile+warmup": 10.0, "execute": 85.0,
+                       "faults/backoff": 0.0, "eval": 2.0,
+                       "other": 3.0},
+            "ingest_busy_s": 0.0, "backoff_s": 0.0, "fault_kinds": {}}
+    found = doctor.findings(diag, [])
+    assert found == [
+        "clean run: no faults, no regressions, 85% of wall-clock "
+        "executing"]
+
+
+def test_findings_flag_ingest_bound():
+    doctor = _load_doctor()
+    diag = {"wall_s": 100.0, "fresh_compiles": 0,
+            "phases": {"compile+warmup": 1.0, "execute": 10.0,
+                       "faults/backoff": 0.0, "eval": 0.0,
+                       "other": 89.0},
+            "ingest_busy_s": 40.0, "backoff_s": 0.0, "fault_kinds": {}}
+    assert any("ingest-bound" in f
+               for f in doctor.findings(diag, []))
+
+
+def test_doctor_cli_errors(tmp_path):
+    doctor = _load_doctor()
+    assert doctor.main(["--latest", str(tmp_path / "none")]) == 1
+    assert doctor.main([str(tmp_path / "nope")]) == 1
+    assert doctor.main([]) == 2

@@ -47,7 +47,9 @@ def test_best_value_parses_max_and_tolerates_junk(tmp_path):
 
 def _make_watch(mod, tmp_path, probe_script, values, deadline=10000.0):
     """A TpuWatch with scripted probe outcomes and per-command bench
-    values; returns (watch, clock, runner_log)."""
+    values; returns (watch, clock, runner_log). The probe ledger is
+    pointed into the tmp dir so tests never touch the repo's real
+    cross-run ledger."""
     clock = FakeClock()
     probes = list(probe_script)
     ran = []
@@ -69,6 +71,8 @@ def _make_watch(mod, tmp_path, probe_script, values, deadline=10000.0):
         sleep=clock.sleep, clock=clock,
         policy=mod.BackoffPolicy(initial=45.0, multiplier=1.5,
                                  max_delay=180.0, jitter=0.0),
+        ledger=mod.PerfLedger(str(tmp_path / "ledger.jsonl")),
+        run_id="watch-test",
     )
     return watch, clock, ran
 
@@ -135,6 +139,46 @@ def test_watch_keeps_best_sweep_and_halts_queue_on_flap(tmp_path):
     assert best == 90.0
     assert mod.best_value(
         os.path.join(str(tmp_path / "out"), "bench_sweep.out")) == 90.0
+
+
+def test_probe_outcomes_journal_into_ledger(tmp_path):
+    """ISSUE 9 satellite: every attachment probe outcome lands in the
+    perf ledger's fingerprint stream — down streaks and the recovery
+    are a first-class ``attachment_probe`` record series, not PERF.md
+    prose."""
+    mod = _load_watch_mod()
+    watch, clock, ran = _make_watch(
+        mod, tmp_path,
+        probe_script=[False, False, True],
+        values=lambda name: 50.0,
+        deadline=600.0,
+    )
+    watch.watch()
+    probes = watch.ledger.records(kind="attachment_probe")
+    assert [p["value"] for p in probes] == [0.0, 0.0, 1.0]
+    assert [p["streak"] for p in probes] == [1, 2, 0]
+    healths = [p["fingerprint"]["attachment_health"] for p in probes]
+    assert healths == ["down", "down", "healthy"]
+    assert all(p["run_id"] == "watch-test" for p in probes)
+    assert all(p["leg"] == "attachment" for p in probes)
+    # Weather is not a cohort splitter: down and healthy probes share
+    # one fingerprint key (the whole series is one comparable stream).
+    assert len({p["fingerprint"]["key"] for p in probes}) == 1
+
+
+def test_broken_ledger_never_kills_the_watch(tmp_path):
+    """The watch outlives an unwritable ledger (best-effort contract)."""
+    mod = _load_watch_mod()
+    watch, clock, ran = _make_watch(
+        mod, tmp_path, probe_script=[True],
+        values=lambda name: 60.0, deadline=500.0)
+
+    class Boom:
+        def append(self, record):
+            raise OSError("disk full")
+
+    watch.ledger = Boom()
+    assert watch.watch() == 60.0
 
 
 def test_wrapper_script_delegates_to_python_watcher():

@@ -87,6 +87,16 @@ def load_run(obs_dir: str) -> dict:
 
     dead = _read_jsonl(os.path.join(obs_dir, "deadletter.jsonl"))
 
+    # Kernel-pricing report (ISSUE 9 satellite): bench_kernels.py
+    # writes kernel_pricing.json into the run dir — surface it instead
+    # of ignoring it.
+    pricing = None
+    try:
+        with open(os.path.join(obs_dir, "kernel_pricing.json")) as f:
+            pricing = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+
     # Fault timeline: flight window + health journals, de-duplicated —
     # the health journal is MIRRORED into the flight ring, so the same
     # transition usually exists in both streams. The key is the FULL
@@ -121,6 +131,7 @@ def load_run(obs_dir: str) -> dict:
         "dump": dump,
         "timeline": timeline,
         "dead": dead,
+        "kernel_pricing": pricing,
     }
 
 
@@ -214,6 +225,30 @@ def render(run: dict) -> str:
                           if r.get("event") == "bad_record")
         for reason, n in reasons.most_common():
             out.append(f"  {n:>6}  {reason}")
+        out.append("")
+
+    pricing = run.get("kernel_pricing")
+    if pricing:
+        kernels = pricing.get("kernels") or []
+        out.append(f"## Kernel pricing ({len(kernels)} row(s), "
+                   f"backend={pricing.get('backend')}"
+                   + (", INTERPRET — timings are emulation overhead"
+                      if pricing.get("interpret") else "") + ")")
+        out.append(f"{'kernel':28} {'family':10} {'ms':>10} "
+                   f"{'model GB/s':>11}  note")
+        for row in kernels:
+            if row.get("skipped"):
+                out.append(f"{row.get('kernel', '?'):28} "
+                           f"{row.get('family', '?'):10} "
+                           f"{'-':>10} {'-':>11}  "
+                           f"skipped: {row['skipped']}"[:120])
+                continue
+            out.append(
+                f"{row.get('kernel', '?'):28} "
+                f"{row.get('family', '?'):10} "
+                f"{_fmt_ms(row.get('ms')):>10} "
+                f"{_fmt_ms(row.get('model_gbps')):>11}  "
+                f"{row.get('note', '')}"[:120])
         out.append("")
 
     dump = run["dump"]

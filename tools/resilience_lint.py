@@ -40,6 +40,19 @@ allowed outside the strict scope. The CLI surface (``cli.py``,
 ``cli_levers.py``, ``__main__.py``) is exempt — a command-line tool's
 stdout IS its interface.
 
+Since ISSUE 9 the lint is also the MEASUREMENT-PROVENANCE lint:
+
+- ``time.time()`` inside a subtraction is banned across
+  ``fm_spark_tpu/`` (:func:`duration_time_violations`): wall-clock is
+  for TIMESTAMPS; a duration computed from it jumps with NTP slews and
+  DST — every measured interval goes through
+  ``time.perf_counter()``/``time.monotonic()`` (the round-2 "timing
+  note" rule, now enforced).
+- ``bench.py``'s per-leg sweep record must carry ``run_id`` and
+  ``fingerprint`` keys (:func:`bench_leg_record_violations`): a leg
+  record that cannot be traced to its run and comparability cohort is
+  exactly the hand-adjudicated number the perf ledger retires.
+
 Usage::
 
     python tools/resilience_lint.py        # exit 1 on violations
@@ -238,6 +251,134 @@ def kernel_fallback_violations(root: str | None = None) -> list[str]:
     return out
 
 
+def _time_aliases(tree: ast.AST) -> tuple[set, set]:
+    """The file's actual names for the time module and for
+    ``time.time`` itself — ``import time as t`` / ``from time import
+    time as now`` must not evade the duration rule. Seeded with the
+    conventional spellings so a bare ``time()`` is always caught."""
+    mods = {"time", "_time"}
+    funcs = {"time"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    funcs.add(a.asname or a.name)
+    return mods, funcs
+
+
+def _is_wallclock_time_call(node: ast.AST, mods: set = frozenset(),
+                            funcs: set = frozenset()) -> bool:
+    """Is ``node`` a ``time.time()`` call under any of the file's
+    aliases (see :func:`_time_aliases`)?"""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in (funcs or {"time"})
+    if isinstance(f, ast.Attribute) and f.attr == "time":
+        return (isinstance(f.value, ast.Name)
+                and f.value.id in (mods or {"time", "_time"}))
+    return False
+
+
+def _duration_violations_in_tree(tree: ast.AST,
+                                 filename: str) -> list[str]:
+    """Provenance rule (ISSUE 9): ``time.time()`` as an operand of a
+    subtraction is a DURATION measured on the wall clock — use
+    ``time.perf_counter()``/``time.monotonic()``. Timestamp uses
+    (record stamps, filenames) stay legal."""
+    out = []
+    mods, funcs = _time_aliases(tree)
+
+    def flag(node, func):
+        out.append(
+            f"{filename}:{node.lineno} [{func or '<module>'}] "
+            "time.time() in a subtraction — durations go through "
+            "time.perf_counter()/time.monotonic(), wall-clock is for "
+            "timestamps only"
+        )
+
+    def visit(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            if (_is_wallclock_time_call(node.left, mods, funcs)
+                    or _is_wallclock_time_call(node.right, mods, funcs)):
+                flag(node, func)
+        if (isinstance(node, ast.AugAssign)
+                and isinstance(node.op, ast.Sub)
+                and _is_wallclock_time_call(node.value, mods, funcs)):
+            flag(node, func)
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(tree, None)
+    return out
+
+
+def duration_time_violations(root: str | None = None) -> list[str]:
+    """Wall-clock-duration violations across every ``.py`` under
+    ``root`` (default: the whole ``fm_spark_tpu`` package)."""
+    root = root or LIBRARY_DIR
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, REPO)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=rel)
+            out.extend(_duration_violations_in_tree(tree, rel))
+    return out
+
+
+#: The per-leg sweep-record keys every bench leg must carry (ISSUE 9).
+LEG_RECORD_REQUIRED_KEYS = ("run_id", "fingerprint")
+
+
+def bench_leg_record_violations(path: str | None = None) -> list[str]:
+    """Provenance rule (ISSUE 9): bench.py's ``leg_record`` dict
+    literal must carry :data:`LEG_RECORD_REQUIRED_KEYS` — the AST half
+    of the runtime check ``PerfLedger.append`` enforces."""
+    path = path or os.path.join(REPO, "bench.py")
+    fname = os.path.basename(path)
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=fname)
+    except OSError as e:
+        return [f"{fname}: unreadable ({e})"]
+    found_literal = False
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "leg_record"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        found_literal = True
+        keys = {k.value for k in node.value.keys
+                if isinstance(k, ast.Constant)}
+        missing = [k for k in LEG_RECORD_REQUIRED_KEYS if k not in keys]
+        if missing:
+            out.append(
+                f"{fname}:{node.lineno} leg_record literal missing "
+                f"provenance key(s) {missing} — every bench leg record "
+                "must carry run_id + fingerprint"
+            )
+    if not found_literal:
+        out.append(
+            f"{fname}: no leg_record dict literal found — the sweep's "
+            "per-leg provenance contract has no anchor to lint"
+        )
+    return out
+
+
 def violations(root: str | None = None) -> list[str]:
     """Violations under ``root`` (a directory); with the default root,
     the shipped surface is checked — every resilience/ module plus
@@ -257,7 +398,9 @@ def violations(root: str | None = None) -> list[str]:
 
 def main() -> int:
     found = (violations() + library_print_violations()
-             + kernel_fallback_violations())
+             + kernel_fallback_violations()
+             + duration_time_violations()
+             + bench_leg_record_violations())
     for v in found:
         print(v, file=sys.stderr)
     if found:

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Automated run doctor: attribute where a run's time went and why.
+
+The diagnostic end of the perf-provenance layer (ISSUE 9). Given a
+per-run telemetry directory (``artifacts/obs/<run_id>/``), the doctor
+turns the run's streams into ONE screen a human can act on:
+
+- **Where the time went** — the compile-vs-execute split (bench legs:
+  leg-span wall minus the ledger's timed window; train runs: the PR-7
+  first-step fence's ``compile_split`` events), ingest busy time (from
+  the rows/sec gauge + row counters), fault/backoff wall (the
+  resilience spans), eval, and the unattributed remainder — each as a
+  share of the observed wall-clock;
+- **Per-leg verdicts** — every ``bench_leg`` ledger record for this
+  run: variant, rate, the sentinel verdict, attachment health, HBM
+  peak, and the degraded/fused_fallback stamps;
+- **Fault timeline** — event-kind counts plus total backoff seconds;
+- **Diagnosis** — the doctor's findings: cold-cache compile domination,
+  attachment weather, ingest-bound execution, degraded/fallback legs,
+  statistically-regressed legs.
+
+The ledger is found beside the run dir by default
+(``<run_dir>/../ledger.jsonl`` — the cross-run convention) or via
+``--ledger``.
+
+Usage::
+
+    python tools/run_doctor.py artifacts/obs/<run_id>/
+    python tools/run_doctor.py --latest [obs_root]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_file(path, modname):
+    """Standalone by-path module load (register in sys.modules BEFORE
+    exec — dataclass processing looks the module up there)."""
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_tool(name):
+    return _load_file(os.path.join(_REPO, "tools", f"{name}.py"),
+                      f"_doctor_{name}")
+
+
+def _span_totals(spans: list[dict]) -> dict:
+    out: dict[str, float] = {}
+    for s in spans:
+        out[s.get("name", "?")] = (out.get(s.get("name", "?"), 0.0)
+                                   + float(s.get("dur_ms") or 0.0) / 1e3)
+    return out
+
+
+def _leg_rows(ledger_path: str, run_id: str) -> list[dict]:
+    """This run's bench_leg ledger records (jax-free ledger load)."""
+    lg = _load_file(os.path.join(_REPO, "fm_spark_tpu", "obs",
+                                 "ledger.py"), "_doctor_ledger")
+    return lg.PerfLedger(ledger_path).records(kind="bench_leg",
+                                              run_id=run_id)
+
+
+def diagnose(run: dict, legs: list[dict],
+             flight_events: list[dict]) -> dict:
+    """The attribution numbers (testable separately from rendering)."""
+    spans = run["spans"]
+    totals = _span_totals(spans)
+    starts = [s["t_start"] for s in spans
+              if s.get("t_start") is not None]
+    ends = [s["t_start"] + float(s.get("dur_ms") or 0.0) / 1e3
+            for s in spans if s.get("t_start") is not None]
+    wall = (max(ends) - min(starts)) if starts else 0.0
+
+    # Bench legs: span wall minus the ledger's timed window is the
+    # compile + warmup (+ retry) share of that leg.
+    timed_by_label = {r.get("variant"): float(r.get("dt_s") or 0.0)
+                      for r in legs}
+    leg_span_s = 0.0
+    leg_timed_s = 0.0
+    for s in spans:
+        if s.get("name") != "bench/leg":
+            continue
+        dur = float(s.get("dur_ms") or 0.0) / 1e3
+        leg_span_s += dur
+        leg_timed_s += min(timed_by_label.get(s.get("label"), 0.0), dur)
+
+    # Train runs: the first-step fence records the compile directly.
+    compile_events = [e for e in flight_events
+                      if e.get("kind") == "compile_split"]
+    fence_compile_s = sum(float(e.get("first_step_ms") or 0.0) / 1e3
+                          for e in compile_events)
+    fresh_compiles = sum(int(e.get("fresh_compiles") or 0)
+                         for e in compile_events)
+
+    compile_s = max(leg_span_s - leg_timed_s, 0.0) + fence_compile_s
+    execute_s = leg_timed_s + totals.get("train/steps", 0.0)
+    fault_s = (totals.get("resilience/backoff", 0.0)
+               + totals.get("resilience/probe", 0.0))
+    eval_s = totals.get("train/eval", 0.0)
+
+    snap = run.get("snapshot") or {}
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    rows_ok = counters.get("ingest.rows_ok_total") or 0.0
+    rate = gauges.get("ingest.rows_per_sec")
+    ingest_s = (rows_ok / rate) if rate else 0.0
+
+    attributed = compile_s + execute_s + fault_s + eval_s
+    other_s = max(wall - attributed, 0.0)
+
+    timeline = run["timeline"]
+    kinds: dict[str, int] = {}
+    for e in timeline:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+
+    return {
+        "wall_s": wall,
+        "phases": {
+            "compile+warmup": compile_s,
+            "execute": execute_s,
+            "faults/backoff": fault_s,
+            "eval": eval_s,
+            "other": other_s,
+        },
+        "ingest_busy_s": ingest_s,
+        "fresh_compiles": fresh_compiles,
+        "fault_kinds": kinds,
+        "backoff_s": totals.get("resilience/backoff", 0.0),
+    }
+
+
+def findings(diag: dict, legs: list[dict]) -> list[str]:
+    """The doctor's opinionated one-liners."""
+    out = []
+    wall = diag["wall_s"] or 1e-9
+    ph = diag["phases"]
+    if ph["compile+warmup"] / wall > 0.30:
+        fresh = (f" ({diag['fresh_compiles']} fresh XLA compiles)"
+                 if diag["fresh_compiles"] else "")
+        out.append(
+            f"compile-dominated: {ph['compile+warmup'] / wall:.0%} of "
+            f"wall-clock in compile/warmup{fresh} — warm the "
+            "persistent cache (--compile-cache)")
+    if ph["faults/backoff"] / wall > 0.10 or diag["fault_kinds"].get(
+            "circuit_open") or diag["fault_kinds"].get("permanent_fault"):
+        out.append(
+            "attachment weather: "
+            f"{diag['fault_kinds'].get('failure', 0)} failure(s), "
+            f"{diag['backoff_s']:.1f}s in backoff"
+            + (", circuit opened"
+               if diag["fault_kinds"].get("circuit_open") else ""))
+    if diag["ingest_busy_s"] > 0.5 * max(ph["execute"], 1e-9) \
+            and diag["ingest_busy_s"] > 1.0:
+        out.append(
+            f"ingest-bound: {diag['ingest_busy_s']:.1f}s of host parse "
+            f"busy time vs {ph['execute']:.1f}s device execute — "
+            "consider --native-ingest / more prefetch")
+    for r in legs:
+        fp = r.get("fingerprint") or {}
+        v = (r.get("sentinel") or {}).get("verdict")
+        if v == "regressed":
+            out.append(
+                f"REGRESSED: {r.get('variant')} at "
+                f"{r.get('value'):,.0f} — "
+                f"{(r.get('sentinel') or {}).get('reason')}")
+        elif v == "attachment_transient":
+            out.append(
+                f"transient (weather, not code): {r.get('variant')} — "
+                f"{(r.get('sentinel') or {}).get('reason')}")
+        if fp.get("degraded"):
+            out.append(f"degraded leg (shrunk mesh): {r.get('variant')}")
+        if fp.get("fused_fallback"):
+            out.append("fused-embed fallback (XLA path measured): "
+                       f"{r.get('variant')}")
+    if not out:
+        out.append("clean run: no faults, no regressions, "
+                   f"{ph['execute'] / wall:.0%} of wall-clock executing")
+    return out
+
+
+def render(run: dict, diag: dict, legs: list[dict]) -> str:
+    out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
+           f"obs dir: {run['dir']}", ""]
+
+    out.append("## Where the time went "
+               f"(observed wall-clock {diag['wall_s']:,.1f} s)")
+    wall = diag["wall_s"] or 1e-9
+    for name, secs in diag["phases"].items():
+        out.append(f"  {name:16} {secs:>10,.2f} s  {secs / wall:>6.1%}")
+    if diag["ingest_busy_s"]:
+        out.append(f"  {'ingest busy':16} {diag['ingest_busy_s']:>10,.2f}"
+                   " s  (host-side, overlaps execute)")
+    out.append("")
+
+    out.append(f"## Per-leg verdicts ({len(legs)} ledger record(s))")
+    if legs:
+        out.append(f"  {'variant':52} {'value':>12} {'verdict':>22} "
+                   f"{'weather':>9} {'hbm_peak':>10}")
+        for r in legs:
+            fp = r.get("fingerprint") or {}
+            v = r.get("value")
+            peak = r.get("hbm_peak_bytes")
+            stamps = "".join(
+                s for s, on in (("/degraded", fp.get("degraded")),
+                                ("/fallback", fp.get("fused_fallback")))
+                if on)
+            out.append(
+                f"  {str(r.get('variant'))[:52]:52} "
+                f"{(f'{v:,.0f}' if isinstance(v, (int, float)) else '-'):>12} "
+                f"{((r.get('sentinel') or {}).get('verdict') or '?') + stamps:>22} "
+                f"{fp.get('attachment_health', '?'):>9} "
+                f"{(f'{peak / 2**30:.2f}G' if peak else '-'):>10}")
+    else:
+        out.append("  (no ledger records for this run — pre-ledger run, "
+                   "or a train-only run)")
+    out.append("")
+
+    if diag["fault_kinds"]:
+        out.append("## Fault timeline (event counts)")
+        for kind in sorted(diag["fault_kinds"]):
+            out.append(f"  {kind:28} {diag['fault_kinds'][kind]:>5}")
+        out.append("")
+
+    out.append("## Diagnosis")
+    for line in findings(diag, legs):
+        out.append(f"  - {line}")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    obs_report = _load_tool("obs_report")
+    ledger_path = None
+    if "--ledger" in args:
+        i = args.index("--ledger")
+        if i + 1 >= len(args):
+            print(__doc__, file=sys.stderr)
+            return 2
+        ledger_path = args[i + 1]
+        del args[i:i + 2]
+    if args and args[0] == "--latest":
+        root = args[1] if len(args) > 1 else os.path.join(
+            _REPO, "artifacts", "obs")
+        obs_dir = obs_report._latest_run_dir(root)
+        if obs_dir is None:
+            print(f"no run directories under {root}", file=sys.stderr)
+            return 1
+    elif len(args) == 1:
+        obs_dir = args[0]
+    else:
+        print(__doc__, file=sys.stderr)
+        return 2
+    if not os.path.isdir(obs_dir):
+        print(f"not a directory: {obs_dir}", file=sys.stderr)
+        return 1
+
+    run = obs_report.load_run(obs_dir)
+    flight_events = obs_report._read_jsonl(
+        os.path.join(obs_dir, "flight.jsonl"))
+    if ledger_path is None:
+        ledger_path = os.path.join(
+            os.path.dirname(os.path.normpath(obs_dir)), "ledger.jsonl")
+    legs = _leg_rows(ledger_path, run["run_id"])
+    diag = diagnose(run, legs, flight_events)
+    sys.stdout.write(render(run, diag, legs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from fm_spark_tpu.obs.ledger import (  # noqa: E402
+    PerfLedger,
+    default_ledger_path,
+    measurement_fingerprint,
+)
 from fm_spark_tpu.resilience import BackoffPolicy, Supervisor  # noqa: E402
 from fm_spark_tpu.utils.logging import EventLog  # noqa: E402
 
@@ -108,7 +113,9 @@ class TpuWatch:
                  runner=None, probe=None, sleep=time.sleep,
                  clock=time.monotonic, journal=None,
                  policy: BackoffPolicy | None = None,
-                 obs_dir: str | None = None):
+                 obs_dir: str | None = None,
+                 ledger: PerfLedger | None = None,
+                 run_id: str = "tpuwatch"):
         self.out = out_dir
         os.makedirs(out_dir, exist_ok=True)
         self.deadline = clock() + deadline_s
@@ -136,6 +143,32 @@ class TpuWatch:
         self.runner = runner or self._run_cmd
         self.best_val = -1.0
         self.down_streak = 0
+        # Attachment weather into the perf ledger (ISSUE 9 satellite):
+        # every probe outcome becomes a first-class
+        # ``attachment_probe`` record in the fingerprint stream, so
+        # "the attachment was flaky that day" is a queryable series
+        # instead of PERF.md prose. Default: the cross-run ledger
+        # beside the obs run dirs.
+        self.run_id = run_id
+        self.ledger = ledger if ledger is not None else PerfLedger(
+            default_ledger_path())
+
+    def _ledger_probe(self, healthy: bool) -> None:
+        """Best-effort probe record (the watch must outlive a broken
+        ledger)."""
+        try:
+            self.ledger.append({
+                "kind": "attachment_probe", "leg": "attachment",
+                "run_id": self.run_id,
+                "value": 1.0 if healthy else 0.0,
+                "unit": "healthy",
+                "streak": 0 if healthy else self.down_streak,
+                "fingerprint": measurement_fingerprint(
+                    variant="attachment_probe",
+                    attachment_health="healthy" if healthy else "down"),
+            })
+        except Exception:
+            pass
 
     # ---------------------------------------------------- external effects
 
@@ -252,6 +285,7 @@ class TpuWatch:
         while self.clock() < self.deadline:
             if self.sup.probe():
                 self.down_streak = 0
+                self._ledger_probe(True)
                 self.sup.note_success("attachment")
                 self.measure_window()
                 # Queue drained → keep-best re-sweeps only: back WAY
@@ -260,6 +294,7 @@ class TpuWatch:
                 self.sleep(1500 if self.queue_drained() else 120)
             else:
                 self.down_streak += 1
+                self._ledger_probe(False)
                 delay = self.policy.delay(self.down_streak,
                                           self.sup._rng)
                 self.journal.emit("down", streak=self.down_streak,
@@ -277,7 +312,8 @@ def main(argv=None) -> int:
     run_id = obs.new_run_id() + "-tpuwatch"
     watch = TpuWatch(
         os.path.join(_REPO, "tpu_watch_out"), deadline,
-        obs_dir=os.path.join(_REPO, "artifacts", "obs", run_id))
+        obs_dir=os.path.join(_REPO, "artifacts", "obs", run_id),
+        run_id=run_id)
     watch.watch()
     return 0
 
