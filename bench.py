@@ -984,6 +984,11 @@ def inner_main(args):
             # (ISSUE 8); the parent's _emit_final gate refuses this
             # stamp exactly like a degraded one.
             payload["fused_fallback"] = True
+        if args.chaos:
+            # A chaos-drill rate measured a run under injected faults
+            # (ISSUE 10) — its own cohort, never the recorded
+            # capability; the parent's _emit_final gate refuses it.
+            payload["chaos"] = True
         print(json.dumps(payload), flush=True)
         return payload
 
@@ -1273,6 +1278,7 @@ def inner_main(args):
             libtpu_version=_versions["libtpu_version"],
             degraded=degraded_now,
             fused_fallback=label in fused_fallback_legs,
+            chaos=args.chaos,
             attachment_health=leg_health,
         )
         try:
@@ -1353,6 +1359,8 @@ def inner_main(args):
             leg_record["degraded"] = True
         if label in fused_fallback_legs:
             leg_record["fused_fallback"] = True
+        if args.chaos:
+            leg_record["chaos"] = True
         _persist_incremental(art_dir, args.model, payload, leg_record)
         # Metrics snapshot after every leg: a later kill still leaves
         # the run's numeric record in <obs_dir>/metrics.jsonl.
@@ -1489,6 +1497,14 @@ def _emit_final():
                         "fused-embed run fell back to the XLA path; "
                         "not a fused-kernel measurement — keeping the "
                         "recorded rate")
+                # A chaos-drill rate ran under an injected fault
+                # schedule (ISSUE 10): a different program in
+                # everything but name — never the keep-best.
+                if parsed.get("chaos"):
+                    raise RuntimeError(
+                        "chaos-drill measurement (run under an active "
+                        "fault schedule); drill legs have their own "
+                        "ledger cohort — keeping the recorded rate")
                 # Sentinel gate (ISSUE 9): only an improved/flat
                 # verdict against the ledger's cohort history may
                 # promote — a statistically-regressed rate, or one
@@ -1709,6 +1725,14 @@ def main():
                     dest="max_shrinks",
                     help="with --elastic: how many times the device "
                          "set may halve before the fault propagates")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos-drill stamping (ISSUE 10): this run is "
+                         "executing under an active fault schedule "
+                         "(FM_SPARK_FAULTS), so every leg's measurement "
+                         "fingerprint carries chaos=true — drill legs "
+                         "form their own ledger cohort and can never "
+                         "join a real perf cohort or pass the keep-best "
+                         "gate into MEASURED.json")
     ap.add_argument("--dirty-input", action="store_true",
                     dest="dirty_input",
                     help="run the hardened-ingest leg before the sweep "
@@ -1838,6 +1862,8 @@ def main():
         argv.append("--fast-first")
     if args.dirty_input:
         argv.append("--dirty-input")
+    if args.chaos:
+        argv.append("--chaos")
     if args.elastic:
         argv += ["--elastic", "--max-shrinks", str(args.max_shrinks)]
     if args.compile_cache is not None:

@@ -47,7 +47,7 @@ from typing import Any
 import orbax.checkpoint as ocp
 
 from fm_spark_tpu import obs
-from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience import faults, watchdog
 
 
 def _tree_checksums(state) -> dict | None:
@@ -272,16 +272,21 @@ class Checkpointer:
                 continue
             # Deterministic crash point for the SIGKILL-mid-save test:
             # data committed, manifest not yet written = a torn save the
-            # chain must never reference.
-            faults.inject("ckpt_commit")
-            with obs.span("checkpoint/verify", step=int(step)):
-                os.makedirs(self._manifest_dir, exist_ok=True)
-                _atomic_write_json(self._manifest_path(step), manifest)
-                prev = self.last_good_step()
-                if prev is None or step > prev:
-                    _atomic_write_json(self._last_good_path,
-                                       {"step": step,
-                                        "ts": round(time.time(), 3)})
+            # chain must never reference. The whole commit window runs
+            # under the ``ckpt_commit`` deadline watchdog (ISSUE 10) so
+            # a hang here — the nastiest place to freeze, mid-torn-save
+            # — becomes a structured HangDetected / bounded exit.
+            with watchdog.phase("ckpt_commit"):
+                faults.inject("ckpt_commit")
+                with obs.span("checkpoint/verify", step=int(step)):
+                    os.makedirs(self._manifest_dir, exist_ok=True)
+                    _atomic_write_json(self._manifest_path(step),
+                                       manifest)
+                    prev = self.last_good_step()
+                    if prev is None or step > prev:
+                        _atomic_write_json(self._last_good_path,
+                                           {"step": step,
+                                            "ts": round(time.time(), 3)})
             self._emit("checkpoint_verified", step=step,
                        last_good=max(step, prev or step))
         self._pending = still

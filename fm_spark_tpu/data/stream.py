@@ -51,7 +51,7 @@ from collections import deque
 import numpy as np
 
 from fm_spark_tpu import obs
-from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience import faults, watchdog
 from fm_spark_tpu.utils.logging import EventLog
 
 __all__ = [
@@ -195,10 +195,15 @@ class ShardReader:
         self._eof = False
 
     def _fill(self) -> None:
-        """Read ONE chunk into the pending-line buffer."""
-        faults.inject("ingest_truncate")
-        with obs.span("ingest/chunk_read", shard=self.shard):
-            chunk = self._fh.read(self.chunk_bytes)
+        """Read ONE chunk into the pending-line buffer. The read (and
+        the fault point that can freeze it) runs under the
+        ``ingest_chunk`` deadline watchdog (ISSUE 10): a hung shard
+        read becomes a structured ``HangDetected`` / bounded exit
+        instead of an eternally stuck ingest."""
+        with watchdog.phase("ingest_chunk"):
+            faults.inject("ingest_truncate")
+            with obs.span("ingest/chunk_read", shard=self.shard):
+                chunk = self._fh.read(self.chunk_bytes)
         if not chunk:
             if self._tail:
                 # Final unterminated line of the shard.

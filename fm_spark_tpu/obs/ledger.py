@@ -89,8 +89,19 @@ def _stable_hash(obj) -> str:
 
 def fingerprint_key(fp: dict) -> str:
     """The cohort key: a stable hash over the comparability-defining
-    fingerprint fields (see :data:`_KEY_FIELDS`)."""
-    return _stable_hash({k: fp.get(k) for k in _KEY_FIELDS})
+    fingerprint fields (see :data:`_KEY_FIELDS`).
+
+    ``chaos`` splits the cohort ONLY when set (ISSUE 10): a leg
+    measured under an active fault schedule ran a different program in
+    everything but name, so chaos-drill legs form their own cohort and
+    can never join — or poison the trailing band of — a real perf
+    cohort. Folded in asymmetrically (absent/falsy contributes nothing
+    to the hash) so every pre-chaos historical key stays byte-stable.
+    """
+    src = {k: fp.get(k) for k in _KEY_FIELDS}
+    if fp.get("chaos"):
+        src["chaos"] = True
+    return _stable_hash(src)
 
 
 def measurement_fingerprint(*, variant: str, model: str | None = None,
@@ -104,6 +115,7 @@ def measurement_fingerprint(*, variant: str, model: str | None = None,
                             libtpu_version: str | None = None,
                             degraded: bool = False,
                             fused_fallback: bool = False,
+                            chaos: bool = False,
                             attachment_health: str = "healthy") -> dict:
     """Build one measurement fingerprint.
 
@@ -114,7 +126,9 @@ def measurement_fingerprint(*, variant: str, model: str | None = None,
     width/cap/dtype, and those must be distinct cohorts); the
     environment fields ride alongside, and ``key`` is the cohort key.
     ``attachment_health`` is the supervisor-journal verdict for THIS
-    measurement (``healthy | flaky | degraded | down``).
+    measurement (``healthy | flaky | degraded | down``). ``chaos``
+    marks a fault-drill measurement (ISSUE 10) — its own cohort, never
+    keep-best eligible.
     """
     ident = {"variant": variant, "model": model, "batch": batch,
              "steps": steps, "rank": rank}
@@ -129,6 +143,7 @@ def measurement_fingerprint(*, variant: str, model: str | None = None,
         "libtpu_version": libtpu_version,
         "degraded": bool(degraded),
         "fused_fallback": bool(fused_fallback),
+        "chaos": bool(chaos),
         "attachment_health": attachment_health,
     }
     fp["key"] = fingerprint_key(fp)

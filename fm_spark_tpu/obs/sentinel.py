@@ -207,10 +207,16 @@ class Sentinel:
         if sum(isinstance(v, (int, float)) for v in vals) \
                 >= self.policy.min_history:
             return vals, "exact"
-        env = (fp.get("device_kind"), fp.get("n_chips"))
+        # Widened = same hardware, same chaos-ness (ISSUE 10): a
+        # fault-drill row must never lend its band to a real cohort
+        # (or vice versa) just because the exact history is thin.
+        env = (fp.get("device_kind"), fp.get("n_chips"),
+               bool(fp.get("chaos")))
         wide = [r for r in rows
                 if ((r.get("fingerprint") or {}).get("device_kind"),
-                    (r.get("fingerprint") or {}).get("n_chips")) == env]
+                    (r.get("fingerprint") or {}).get("n_chips"),
+                    bool((r.get("fingerprint") or {}).get("chaos")))
+                == env]
         return [r.get("value") for r in wide], "leg"
 
     def judge(self, leg: str, value: float | None,

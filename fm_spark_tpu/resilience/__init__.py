@@ -22,6 +22,19 @@ failure handling a tested subsystem:
   :class:`ElasticController` sheds capacity — shrink the mesh 8→4→2→1,
   restore the last good checkpoint under the new sharding, renormalize
   per-chip metrics — instead of burning the deadline re-probing.
+- :mod:`fm_spark_tpu.resilience.watchdog` — per-phase deadline
+  watchdogs (ISSUE 10): the ingest chunk read, the checkpoint commit
+  window, and the train-step window each get a budget, and a hang
+  becomes a structured :class:`~fm_spark_tpu.resilience.watchdog
+  .HangDetected` + flight dump (or a bounded hard exit) instead of a
+  stuck process.
+- :mod:`fm_spark_tpu.resilience.chaos` — the chaos campaign engine
+  (ISSUE 10): seeded multi-fault schedule generation over the
+  ``faults`` registry, a system-wide invariant auditor over short
+  drilled training runs, and automatic schedule minimization
+  (delta-debugging a failing plan down to a minimal reproducible
+  string). Driven by ``tools/chaos_drill.py`` and the tier-1 bounded
+  soak in tests/test_chaos.py.
 
 Consumers: ``bench.py`` (per-leg supervision + ``--resume-sweep``),
 ``FMTrainer.fit`` (device-loss → checkpoint resume with loss
@@ -29,7 +42,7 @@ continuity), and ``tools/tpu_watch.py`` (the supervised attachment
 watcher that replaced the bash poll loop).
 """
 
-from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience import faults, watchdog
 from fm_spark_tpu.resilience.elastic import (
     ElasticController,
     ElasticExhausted,
@@ -49,6 +62,7 @@ from fm_spark_tpu.resilience.supervisor import (
     Supervisor,
     device_probe,
 )
+from fm_spark_tpu.resilience.watchdog import HangDetected
 
 __all__ = [
     "BackoffPolicy",
@@ -57,6 +71,7 @@ __all__ = [
     "ElasticExhausted",
     "FaultInjected",
     "FaultPlan",
+    "HangDetected",
     "InjectedDeviceLoss",
     "RetriesExhausted",
     "Supervisor",
@@ -65,4 +80,5 @@ __all__ = [
     "faults",
     "inject",
     "is_device_loss",
+    "watchdog",
 ]

@@ -1,0 +1,1213 @@
+"""Chaos campaign engine: seeded multi-fault schedules, a system-wide
+invariant auditor, and automatic schedule minimization (ISSUE 10).
+
+The resilience stack (supervisor, elastic/checkpoint chain, exactly-once
+ingest) was only ever exercised by hand-authored SINGLE-fault scenarios,
+but production faults arrive in combinations — a device loss during a
+checkpoint commit while the quarantine breaker's window is nearly full.
+This module is the missing harness layer on top of
+:mod:`fm_spark_tpu.resilience.faults`'s ``KNOWN_POINTS`` registry:
+
+- :class:`ScheduleGenerator` — seeded sampling of multi-rule fault
+  plans (the existing ``point@occurrence=action[:param]`` grammar),
+  with scenario weights biased toward the nastiest interleavings:
+  fault-during-recovery storms, faults inside the ``ckpt_commit``
+  torn-save window, and corruption bursts pressed against the
+  bad-record breaker window. Every schedule is a pure function of its
+  seed — a verdict names the seed, and the seed replays the plan.
+
+- :func:`run_schedule` — one short supervised training drill (the
+  production ``FMTrainer.fit`` + ``StreamBatches`` + ``Checkpointer``
+  + ``Supervisor`` stack, CPU-sized) executed under a schedule, with
+  stubbed sleeps so a campaign costs compute, not wall-clock.
+  :func:`write_worker` / the subprocess runner cover the
+  process-fatal actions (``exit``/``sigterm``/never-returning hangs)
+  plus cross-process occurrence counters via ``FM_SPARK_FAULTS_STATE``.
+
+- :func:`audit` — the invariant auditor, judging from artifacts alone:
+  exactly-once record stream (the drilled tap bit-identical to the
+  clean run's, or to a pure-Python oracle for quarantine schedules),
+  checkpoint-chain integrity (a fresh ``last_good`` walk-back must
+  restore, never a torn state), loss continuity and final-state
+  identity after every recovery, health-journal/flight monotonicity,
+  hang liveness (the :mod:`~fm_spark_tpu.resilience.watchdog`
+  verdicts), breaker-abort discipline, and quarantine accounting.
+
+- :func:`minimize` — delta-debugs a failing schedule down to a minimal
+  reproducible plan string (greedy ddmin over rules; every candidate
+  re-runs the drill, so the minimal plan is *verified* failing).
+
+- :func:`run_campaign` — N seeded schedules under a time budget,
+  producing one machine-readable verdict dict (``tools/chaos_drill.py``
+  writes it to ``artifacts/obs/<run_id>/chaos_verdict.json``;
+  ``tools/run_doctor.py`` renders it). The tier-1 bounded soak in
+  tests/test_chaos.py runs this deterministically every round.
+
+The regression-canary hook (``DrillConfig.break_restore``) deliberately
+breaks the resume path — restore stops rewinding the stream cursor — so
+the suite can prove the auditor CATCHES a broken recovery and the
+minimizer reduces the catch to a 1–2 rule plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import subprocess
+import sys
+import time
+import zlib
+
+from fm_spark_tpu.resilience import faults, watchdog
+from fm_spark_tpu.utils.logging import EventLog, read_events
+
+__all__ = [
+    "DrillConfig",
+    "DrillResult",
+    "Schedule",
+    "ScheduleGenerator",
+    "audit",
+    "build_shards",
+    "golden_run",
+    "minimize",
+    "oracle_tap",
+    "run_campaign",
+    "run_schedule",
+    "write_worker",
+]
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+#: Fault→watchdog phase mapping for hang scenarios.
+_HANG_PHASE = {"ingest_truncate": "ingest_chunk",
+               "ckpt_commit": "ckpt_commit",
+               "train_step": "step_window"}
+
+#: Hang drills: injected sleep vs armed deadline. The margin (6x over
+#: the deadline, and the deadline 10x over a normal CPU step) keeps the
+#: verdict deterministic on a loaded CI host.
+_HANG_SLEEP_S = 0.3
+_HANG_DEADLINE_S = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class DrillConfig:
+    """One drill's workload shape — small enough that a campaign of ~25
+    schedules fits a tier-1 budget, big enough to cross three epochs,
+    several checkpoint commits, and every recovery path."""
+
+    steps: int = 18
+    batch_size: int = 16
+    num_features: int = 128
+    rank: int = 4
+    max_nnz: int = 3
+    n_shards: int = 3
+    rows_per_shard: int = 32
+    chunk_bytes: int = 64
+    save_every: int = 6
+    seed: int = 7
+    learning_rate: float = 0.1
+    guard_window: int = 32
+    guard_min_records: int = 16
+    #: Regression canary (ISSUE 10 acceptance): when True, the drilled
+    #: batch source's ``restore()`` no longer rewinds the stream cursor
+    #: — the exact bug class the exactly-once invariant exists to
+    #: catch. Never set outside canary tests/drills.
+    break_restore: bool = False
+    #: Subprocess drills only: the worker's flight-recorder ring size
+    #: (small so the spool's 2N compaction threshold is reachable
+    #: inside a short drill).
+    flight_capacity: int = 256
+
+    @property
+    def total_rows(self) -> int:
+        return self.n_shards * self.rows_per_shard
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """One seeded multi-fault plan plus the audit contract it carries.
+
+    ``stream_comparable``: no rule consumes records, so the drilled tap
+    must be bit-identical to the clean run's. ``oracle_comparable``:
+    quarantine-only rules with no recovery — the tap must match the
+    pure-Python :func:`oracle_tap`. ``expects`` is the outcome verdict
+    the auditor holds the run to (``completed`` / ``hang_detected`` /
+    ``ingest_aborted``).
+    """
+
+    seed: int
+    scenario: str
+    rules: tuple[str, ...]
+    expects: str = "completed"
+    stream_comparable: bool = True
+    oracle_comparable: bool = False
+    max_bad_frac: float = 1.0
+
+    @property
+    def plan(self) -> str:
+        return ";".join(self.rules)
+
+    def validate(self) -> "Schedule":
+        faults.FaultPlan.from_spec(self.plan)  # eager registry check
+        return self
+
+
+class ScheduleGenerator:
+    """Deterministic seeded sampler over multi-fault scenarios.
+
+    ``schedule(seed)`` is a pure function of the seed: the same seed
+    always yields the same plan, which is what makes a chaos verdict
+    replayable ("seed 17 failed" IS the repro). Weights are biased
+    toward the interleavings the single-fault suites never compose:
+
+    ======================  ==============================================
+    ``commit_loss``          device loss inside the ``ckpt_commit``
+                             torn-save window (± a mid-step loss)
+    ``recovery_storm``       consecutive losses — the second fault lands
+                             DURING recovery of the first (± a probe
+                             fault while the breaker is arming)
+    ``truncate_loss``        device loss on the shard chunk read (± a
+                             mid-step loss): ingest-side recovery
+    ``corrupt_burst``        scattered corruption through quarantine,
+                             below the breaker threshold
+    ``ingest_abort``         a corruption burst pressed into one breaker
+                             window — the run must abort LOUDLY
+    ``hang``                 a finite hang at one guarded phase — the
+                             deadline watchdog must convert it into a
+                             structured ``HangDetected``
+    ``compound``             corruption + device loss + commit-window
+                             loss in one plan
+    ======================  ==============================================
+    """
+
+    _SCENARIOS = (
+        ("commit_loss", 18),
+        ("recovery_storm", 18),
+        ("corrupt_burst", 16),
+        ("truncate_loss", 14),
+        ("hang", 12),
+        ("ingest_abort", 12),
+        ("compound", 10),
+    )
+
+    def __init__(self, cfg: DrillConfig | None = None):
+        self.cfg = cfg or DrillConfig()
+
+    def _pick_scenario(self, rng: random.Random) -> str:
+        total = sum(w for _, w in self._SCENARIOS)
+        roll = rng.random() * total
+        for name, w in self._SCENARIOS:
+            roll -= w
+            if roll < 0:
+                return name
+        return self._SCENARIOS[-1][0]
+
+    def schedule(self, seed: int) -> Schedule:
+        rng = random.Random(int(seed))
+        cfg = self.cfg
+        scenario = self._pick_scenario(rng)
+        mid = max(cfg.steps - 2, 2)
+        if scenario == "commit_loss":
+            rules = [f"ckpt_commit@{rng.randint(1, 2)}=device_loss"]
+            if rng.random() < 0.7:
+                rules.append(
+                    f"train_step@{rng.randint(2, mid)}=device_loss")
+            sched = Schedule(seed, scenario, tuple(rules))
+        elif scenario == "recovery_storm":
+            k = rng.randint(2, mid - 1)
+            rules = [f"train_step@{k}=device_loss",
+                     f"train_step@{k + 1}=device_loss"]
+            if rng.random() < 0.4:
+                rules.append("probe@1=device_loss")
+            sched = Schedule(seed, scenario, tuple(rules))
+        elif scenario == "truncate_loss":
+            rules = [f"ingest_truncate@{rng.randint(2, 10)}=device_loss"]
+            if rng.random() < 0.5:
+                rules.append(
+                    f"train_step@{rng.randint(2, mid)}=device_loss")
+            sched = Schedule(seed, scenario, tuple(rules))
+        elif scenario == "corrupt_burst":
+            n = rng.randint(1, 3)
+            occs = sorted(rng.sample(range(2, 140), n))
+            rules = [f"ingest_corrupt@{o}=error" for o in occs]
+            sched = Schedule(seed, scenario, tuple(rules),
+                             stream_comparable=False,
+                             oracle_comparable=True, max_bad_frac=0.5)
+        elif scenario == "ingest_abort":
+            # The breaker-pressure interleaving: a burst of consecutive
+            # corrupt records inside ONE trailing window, past the
+            # configured rate — silent continuation here would mean
+            # training on a truncated/garbage shard.
+            start = rng.randint(cfg.guard_min_records + 2, 80)
+            n = rng.randint(5, 8)
+            rules = [f"ingest_corrupt@{start + i}=error"
+                     for i in range(n)]
+            sched = Schedule(seed, scenario, tuple(rules),
+                             expects="ingest_aborted",
+                             stream_comparable=False, max_bad_frac=0.1)
+        elif scenario == "hang":
+            point = rng.choice(tuple(_HANG_PHASE))
+            occ = {"ingest_truncate": rng.randint(1, 5),
+                   "ckpt_commit": 1,
+                   "train_step": rng.randint(2, mid)}[point]
+            rules = [f"{point}@{occ}=hang:{_HANG_SLEEP_S}"]
+            sched = Schedule(seed, scenario, tuple(rules),
+                             expects="hang_detected",
+                             stream_comparable=False)
+        else:  # compound
+            rules = [f"ingest_corrupt@{rng.randint(2, 100)}=error",
+                     f"train_step@{rng.randint(2, mid)}=device_loss"]
+            if rng.random() < 0.5:
+                rules.append(
+                    f"ckpt_commit@{rng.randint(1, 2)}=device_loss")
+            if rng.random() < 0.3:
+                rules.append(
+                    f"ingest_corrupt@{rng.randint(101, 200)}=error")
+            sched = Schedule(seed, scenario, tuple(rules),
+                             stream_comparable=False, max_bad_frac=0.5)
+        return sched.validate()
+
+    def sample(self, seeds) -> list[Schedule]:
+        return [self.schedule(s) for s in seeds]
+
+
+# ---------------------------------------------------------------- workload
+
+
+def build_shards(shard_dir: str, cfg: DrillConfig) -> list[str]:
+    """Deterministic libsvm text shards: row ``n`` (global, 0-based)
+    carries first feature id ``n+1`` (1-based in the file), so the
+    drilled tap — the first 0-based id of every admitted row — IS the
+    global record index, and exactly-once is directly readable."""
+    os.makedirs(shard_dir, exist_ok=True)
+    paths = []
+    for s in range(cfg.n_shards):
+        path = os.path.join(shard_dir, f"shard{s}.svm")
+        lines = []
+        for r in range(cfg.rows_per_shard):
+            n = s * cfg.rows_per_shard + r
+            second = cfg.rows_per_shard * cfg.n_shards + 1 + (n % 31)
+            lines.append(f"{n % 2} {n + 1}:1.0 {second}:0.5\n")
+        with open(path, "w") as f:
+            f.write("".join(lines))
+        paths.append(path)
+    return paths
+
+
+class _TapSource:
+    """Batch-source wrapper recording the COMMITTED record stream (the
+    first feature id of every trained row, one line per batch) — the
+    artifact the exactly-once invariant compares.
+
+    The tap length rides the cursor (``tap_len``) and restore truncates
+    the recording: batches emitted after the checkpoint a recovery
+    rewound to were never committed into the final state, so keeping
+    them would make an honest replay read as a duplicate. (Extra cursor
+    keys are ignored by ``StreamBatches.restore`` by design.)
+
+    ``break_restore`` is the regression canary: restore stops rewinding
+    the wrapped source — exactly the resume bug the auditor must
+    catch."""
+
+    def __init__(self, source, break_restore: bool = False):
+        self._source = source
+        self._break = bool(break_restore)
+        self.lines: list[str] = []
+
+    @property
+    def guard(self):
+        return self._source.guard
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        self.lines.append(
+            ",".join(str(int(x)) for x in ids[w > 0][:, 0]))
+        return ids, vals, labels, w
+
+    def state(self):
+        return dict(self._source.state(), tap_len=len(self.lines))
+
+    def restore(self, s):
+        if self._break:
+            return  # canary: the cursor silently stays wherever it was
+        self._source.restore(s)
+        del self.lines[int(s.get("tap_len", 0)):]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+@dataclasses.dataclass
+class DrillResult:
+    """Everything the auditor needs, collected from one drilled run."""
+
+    outcome: str
+    error: str | None
+    steps_done: int
+    loss_history: list
+    params_sums: dict | None
+    tap: list
+    cursor: dict | None
+    counters: dict
+    duration_s: float
+    workdir: str
+    health_path: str
+    deadletter_path: str
+    ckpt_dir: str
+    rcs: tuple = ()
+    resumed_at: tuple = ()
+
+
+def _params_sums(params) -> dict:
+    """Per-leaf crc32 identity of a params tree (the byte-level
+    final-state fingerprint the identity invariant compares)."""
+    import jax
+    import numpy as np
+
+    out = {}
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        out[jax.tree_util.keystr(path)] = (
+            f"{arr.dtype.str}:{arr.shape}:{zlib.crc32(arr.tobytes()):08x}"
+        )
+    return out
+
+
+def _classify_outcome(exc: BaseException) -> str:
+    from fm_spark_tpu.data.stream import IngestAborted
+    from fm_spark_tpu.resilience.supervisor import (
+        CircuitOpen,
+        RetriesExhausted,
+    )
+
+    if isinstance(exc, watchdog.HangDetected):
+        return "hang_detected"
+    if isinstance(exc, IngestAborted):
+        return "ingest_aborted"
+    if isinstance(exc, CircuitOpen):
+        return "circuit_open"
+    if isinstance(exc, RetriesExhausted):
+        return "retries_exhausted"
+    return f"error:{type(exc).__name__}"
+
+
+def run_schedule(schedule: "Schedule | str", cfg: DrillConfig,
+                 workdir: str, shard_paths=None) -> DrillResult:
+    """Run one drill in-process under ``schedule``'s fault plan.
+
+    The drilled stack is the production one: ``ShardReader`` +
+    ``RecordGuard(quarantine)`` + ``StreamBatches`` feeding
+    ``FMTrainer.fit`` with a crash-consistent ``Checkpointer`` and a
+    ``Supervisor`` (stubbed sleep, real probe machinery). Hang
+    schedules additionally arm the deadline watchdog in ``raise`` mode
+    (deterministic, thread-free). Fault state is module-local and
+    cleared on exit, so drills compose with any caller.
+    """
+    import jax
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data.stream import (
+        RecordGuard,
+        ShardReader,
+        StreamBatches,
+        line_parser,
+    )
+    from fm_spark_tpu.resilience.supervisor import BackoffPolicy, Supervisor
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+    from fm_spark_tpu.utils.logging import MetricsLogger
+
+    if isinstance(schedule, str):
+        schedule = Schedule(seed=-1, scenario="adhoc",
+                            rules=tuple(r for r in schedule.split(";")
+                                        if r.strip()))
+    os.makedirs(workdir, exist_ok=True)
+    if shard_paths is None:
+        shard_paths = build_shards(os.path.join(workdir, "shards"), cfg)
+    ck_dir = os.path.join(workdir, "ck")
+    q_dir = os.path.join(workdir, "q")
+    health_path = os.path.join(workdir, "health.jsonl")
+    journal = EventLog(health_path)
+
+    spec = models.FMSpec(num_features=cfg.num_features, rank=cfg.rank,
+                         init_std=0.05)
+    config = TrainConfig(num_steps=cfg.steps, batch_size=cfg.batch_size,
+                         learning_rate=cfg.learning_rate,
+                         lr_schedule="constant", log_every=1,
+                         seed=cfg.seed)
+    guard = RecordGuard("quarantine", quarantine_dir=q_dir,
+                        max_bad_frac=schedule.max_bad_frac,
+                        window=cfg.guard_window,
+                        min_records=cfg.guard_min_records,
+                        journal=journal)
+    source = _TapSource(
+        StreamBatches(ShardReader(shard_paths,
+                                  chunk_bytes=cfg.chunk_bytes),
+                      line_parser("libsvm"), cfg.batch_size,
+                      cfg.max_nnz, guard=guard,
+                      num_features=cfg.num_features),
+        break_restore=cfg.break_restore)
+    ck = Checkpointer(ck_dir, save_every=cfg.save_every,
+                      async_save=False, journal=journal)
+    sup = Supervisor(
+        policy=BackoffPolicy(initial=0.01, jitter=0.0, max_delay=0.05),
+        journal=journal, probe_timeout=10.0, breaker_threshold=8,
+        sleep=lambda s: None)
+
+    trainer = FMTrainer(spec, config)
+    # Drills are quiet: metrics go to a per-drill file, not stdout
+    # (25 schedules x 18 steps of JSON would drown a campaign log).
+    trainer.logger.close()
+    trainer.logger = MetricsLogger(
+        path=os.path.join(workdir, "metrics.jsonl"))
+    trainer.logger._stream = None
+
+    hang_rules = [r for r in schedule.rules if "=hang" in r]
+    if hang_rules:
+        # Warm the jitted step BEFORE arming deadlines: the first call
+        # compiles (hundreds of ms on CPU), which must never read as a
+        # hang. Donated inputs are re-initialized deterministically.
+        import numpy as np
+
+        b, s = cfg.batch_size, cfg.max_nnz
+        trainer._train_step(trainer.params, trainer.opt_state,
+                            np.zeros((b, s), np.int32),
+                            np.zeros((b, s), np.float32),
+                            np.zeros((b,), np.float32),
+                            np.zeros((b,), np.float32))
+        trainer.params = spec.init(jax.random.key(config.seed))
+        trainer.opt_state = trainer.optimizer.init(trainer.params)
+        deadlines = {_HANG_PHASE[r.split("@", 1)[0]]: _HANG_DEADLINE_S
+                     for r in hang_rules}
+        watchdog.configure(deadlines, action="raise", journal=journal)
+
+    t0 = time.perf_counter()
+    outcome, error = "completed", None
+    try:
+        faults.clear()
+        if schedule.plan:
+            faults.activate(schedule.plan)
+        trainer.fit(source, checkpointer=ck, supervisor=sup)
+    except Exception as e:  # noqa: BLE001 — the outcome IS the verdict
+        outcome = _classify_outcome(e)
+        error = f"{type(e).__name__}: {(str(e).splitlines() or [''])[0][:200]}"
+    finally:
+        faults.clear()
+        if hang_rules:
+            watchdog.clear()
+        try:
+            ck.close()
+        except Exception:
+            pass
+        guard.close()
+        journal.close()
+        trainer.logger.close()
+
+    return DrillResult(
+        outcome=outcome, error=error, steps_done=trainer.step_count,
+        loss_history=list(trainer.loss_history),
+        params_sums=(_params_sums(trainer.params)
+                     if outcome == "completed" else None),
+        tap=list(source.lines),
+        cursor=(dict(source.state()) if outcome == "completed" else None),
+        counters=guard.counters(),
+        duration_s=time.perf_counter() - t0,
+        workdir=workdir, health_path=health_path,
+        deadletter_path=os.path.join(
+            q_dir, "deadletter.jsonl"),
+        ckpt_dir=ck_dir,
+    )
+
+
+def golden_run(cfg: DrillConfig, workdir: str,
+               shard_paths=None) -> DrillResult:
+    """The clean (no-fault) reference run every comparable invariant is
+    judged against."""
+    clean = dataclasses.replace(cfg, break_restore=False)
+    return run_schedule(Schedule(seed=-1, scenario="golden", rules=()),
+                        clean, workdir, shard_paths=shard_paths)
+
+
+# ----------------------------------------------------------------- oracle
+
+
+def oracle_tap(schedule: Schedule, cfg: DrillConfig) -> list[str]:
+    """Pure-Python prediction of the admitted record stream for a
+    quarantine-only schedule (no recovery/kill rules): the ``k``-th
+    parse attempt is quarantined iff the plan names occurrence ``k``.
+    Replays ``StreamBatches``'s batch/epoch mechanics exactly —
+    fixed-size batches, the epoch's final partial batch emitted padded
+    — without jax, so the oracle cannot inherit a bug from the code
+    under audit."""
+    bad = set()
+    for rule in schedule.rules:
+        point, _, rest = rule.partition("@")
+        if point == "ingest_corrupt":
+            bad.add(int(rest.split("=", 1)[0]))
+    taps: list[str] = []
+    batch: list[int] = []
+    k = 0
+    while len(taps) < cfg.steps:
+        for n in range(cfg.total_rows):  # one epoch, in stream order
+            k += 1
+            if k in bad:
+                continue
+            batch.append(n)
+            if len(batch) == cfg.batch_size:
+                taps.append(",".join(map(str, batch)))
+                batch = []
+                if len(taps) == cfg.steps:
+                    return taps
+        if batch:  # the epoch's final partial batch, padded at runtime
+            taps.append(",".join(map(str, batch)))
+            batch = []
+    return taps
+
+
+# ---------------------------------------------------------------- auditor
+
+
+def _violation(invariant: str, detail: str) -> dict:
+    return {"invariant": invariant, "detail": detail}
+
+
+def _audit_chain(result: DrillResult, cfg: DrillConfig) -> list[dict]:
+    """The checkpoint chain must restore through ``last_good`` without
+    ever yielding a torn state — checked with a FRESH Checkpointer, the
+    way a real recovery would."""
+    import jax
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.train import TrainConfig, make_optimizer
+
+    out: list[dict] = []
+    if not os.path.isdir(result.ckpt_dir):
+        return out
+    ck = Checkpointer(result.ckpt_dir, save_every=cfg.save_every,
+                      async_save=False)
+    try:
+        if ck.latest_step() is None:
+            return out  # the run died before any commit — nothing owed
+        spec = models.FMSpec(num_features=cfg.num_features,
+                             rank=cfg.rank, init_std=0.05)
+        params = spec.init(jax.random.key(cfg.seed))
+        opt_state = make_optimizer(
+            TrainConfig(num_steps=cfg.steps, batch_size=cfg.batch_size,
+                        learning_rate=cfg.learning_rate,
+                        lr_schedule="constant")).init(params)
+        try:
+            restored = ck.restore(params, opt_state)
+        except Exception as e:  # noqa: BLE001 — a broken chain IS the finding
+            out.append(_violation(
+                "chain_integrity",
+                f"last_good walk-back failed: {type(e).__name__}: "
+                f"{(str(e).splitlines() or [''])[0][:160]}"))
+            return out
+        last_good = ck.last_good_step()
+        if restored is None:
+            out.append(_violation("chain_integrity",
+                                  "steps exist but restore returned None"))
+        elif last_good is not None and restored["step"] < last_good:
+            out.append(_violation(
+                "chain_integrity",
+                f"restored step {restored['step']} behind last_good "
+                f"{last_good} — the pointer vouches for a state the "
+                "chain cannot produce"))
+    finally:
+        try:
+            ck.close()
+        except Exception:
+            pass
+    return out
+
+
+def _audit_journal(result: DrillResult) -> list[dict]:
+    """Every journal line must parse and timestamps must be
+    monotonically non-decreasing (a torn tail is only legal after an
+    uncatchable kill, which the in-process drill never performs)."""
+    out: list[dict] = []
+    try:
+        with open(result.health_path) as f:
+            raw = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return out
+    events = read_events(result.health_path)
+    if len(events) != len(raw):
+        out.append(_violation(
+            "journal_monotonic",
+            f"{len(raw) - len(events)} unparseable journal line(s) in "
+            "an uninterrupted run"))
+    ts = [e.get("ts") for e in events if isinstance(e.get("ts"),
+                                                    (int, float))]
+    if any(b < a for a, b in zip(ts, ts[1:])):
+        out.append(_violation("journal_monotonic",
+                              "journal timestamps went backwards"))
+    return out
+
+
+def audit(schedule: Schedule, result: DrillResult,
+          golden: DrillResult, cfg: DrillConfig) -> list[dict]:
+    """Every violated invariant, as ``{"invariant", "detail"}`` dicts
+    (empty = the schedule is green). Which invariants apply follows
+    from the schedule's contract — see :class:`Schedule`."""
+    v: list[dict] = []
+    events = read_events(result.health_path)
+    kinds = [e.get("event") for e in events]
+
+    if result.outcome != schedule.expects:
+        v.append(_violation(
+            "completion",
+            f"expected outcome {schedule.expects!r}, got "
+            f"{result.outcome!r} ({result.error})"))
+    elif schedule.expects == "completed":
+        if result.steps_done != cfg.steps:
+            v.append(_violation(
+                "completion",
+                f"run ended at step {result.steps_done} of {cfg.steps}"))
+        if any(not (x == x and abs(x) < float("inf"))
+               for x in result.loss_history):
+            v.append(_violation("completion",
+                                "non-finite loss in a completed run"))
+
+    if schedule.stream_comparable and schedule.expects == "completed":
+        if result.tap != golden.tap:
+            first = next((i for i, (a, b) in
+                          enumerate(zip(result.tap, golden.tap))
+                          if a != b), min(len(result.tap),
+                                          len(golden.tap)))
+            v.append(_violation(
+                "exactly_once_stream",
+                f"record stream diverges from the clean run at batch "
+                f"{first} ({len(result.tap)} vs {len(golden.tap)} "
+                "batches) — records replayed or skipped"))
+        if result.loss_history != golden.loss_history:
+            v.append(_violation(
+                "loss_continuity",
+                "loss curve differs from the clean run after recovery"))
+        if (result.params_sums is not None
+                and result.params_sums != golden.params_sums):
+            v.append(_violation(
+                "state_identity",
+                "final params differ byte-wise from the clean run"))
+        if result.cursor is not None and golden.cursor is not None:
+            if result.cursor != golden.cursor:
+                v.append(_violation(
+                    "state_identity",
+                    f"final cursor {result.cursor} != clean "
+                    f"{golden.cursor}"))
+
+    if schedule.oracle_comparable and schedule.expects == "completed":
+        expected = oracle_tap(schedule, cfg)
+        if result.tap != expected:
+            first = next((i for i, (a, b) in
+                          enumerate(zip(result.tap, expected))
+                          if a != b), min(len(result.tap),
+                                          len(expected)))
+            v.append(_violation(
+                "exactly_once_oracle",
+                f"admitted stream diverges from the quarantine oracle "
+                f"at batch {first}"))
+
+    # Quarantine accounting: the guard's counters, the dead-letter
+    # journal, and the checkpointed cursor must tell one story. The
+    # dead-letter journal is APPEND-ONLY across recovery rollbacks
+    # (a record quarantined before a rollback keeps its dead letter
+    # even though the counter honestly rewinds with the cursor), so
+    # the journal bounds the counter from above; without any rollback
+    # they must be equal.
+    dead = read_events(result.deadletter_path)
+    n_dead = sum(1 for e in dead if e.get("event") == "bad_record")
+    rolled_back = any(k in ("failure", "supervisor_reset")
+                      for k in kinds)
+    n_bad = result.counters.get("bad", 0)
+    if (n_bad > n_dead) or (not rolled_back and n_bad != n_dead):
+        v.append(_violation(
+            "quarantine_accounting",
+            f"guard counted {n_bad} bad vs {n_dead} dead-letter "
+            f"record(s) (rolled_back={rolled_back})"))
+    if result.cursor is not None:
+        for key in ("ok", "bad"):
+            if result.cursor.get(key) != result.counters.get(key):
+                v.append(_violation(
+                    "quarantine_accounting",
+                    f"cursor {key}={result.cursor.get(key)} vs guard "
+                    f"{key}={result.counters.get(key)}"))
+
+    if schedule.expects == "hang_detected":
+        if "hang_detected" not in kinds:
+            v.append(_violation(
+                "hang_detection",
+                "no hang_detected journal event — the watchdog verdict "
+                "left no machine-readable trace"))
+    if schedule.expects == "ingest_aborted":
+        aborted = ("ingest_aborted" in kinds
+                   or any(e.get("event") == "ingest_aborted"
+                          for e in dead))
+        if not aborted:
+            v.append(_violation(
+                "abort_detection",
+                "breaker tripped without an ingest_aborted journal "
+                "event"))
+
+    v.extend(_audit_chain(result, cfg))
+    v.extend(_audit_journal(result))
+    return v
+
+
+# -------------------------------------------------------------- minimizer
+
+
+def minimize(rules, fails) -> tuple[str, ...]:
+    """Greedy ddmin over a failing schedule's rules: repeatedly drop
+    any single rule whose removal keeps ``fails(plan)`` true, until no
+    rule can be dropped. Every candidate is re-run, so the returned
+    minimal plan is VERIFIED still-failing — the reproducible repro the
+    verdict publishes with its seed."""
+    cur = list(rules)
+    changed = True
+    while changed and len(cur) > 1:
+        changed = False
+        for i in range(len(cur)):
+            cand = cur[:i] + cur[i + 1:]
+            if fails(";".join(cand)):
+                cur = cand
+                changed = True
+                break
+    return tuple(cur)
+
+
+# --------------------------------------------------------------- campaign
+
+
+class _MinimizeBudgetExhausted(RuntimeError):
+    """The campaign budget ran out mid-ddmin; minimization is aborted
+    (recorded on the failure entry), never silently overrun."""
+
+
+def run_campaign(seeds, cfg: DrillConfig | None = None,
+                 base_dir: str | None = None,
+                 time_budget_s: float | None = None,
+                 per_schedule_timeout_s: float | None = None,
+                 minimize_failures: bool = True,
+                 journal: EventLog | None = None) -> dict:
+    """Run one seeded campaign: golden run, then every seed's schedule,
+    audited; failing schedules are delta-debugged to a minimal plan.
+
+    Bounded: ``time_budget_s`` caps the whole campaign (schedules past
+    the budget are recorded as skipped, never silently dropped), and
+    ``per_schedule_timeout_s`` flags any drill that overran its slice
+    (in-process drills cannot be preempted, so the flag is the audit
+    signal). Returns the machine-readable verdict dict that
+    ``tools/chaos_drill.py`` persists as ``chaos_verdict.json``.
+    """
+    import tempfile
+
+    cfg = cfg or DrillConfig()
+    base_dir = base_dir or tempfile.mkdtemp(prefix="chaos_")
+    os.makedirs(base_dir, exist_ok=True)
+    gen = ScheduleGenerator(cfg)
+    t0 = time.perf_counter()
+
+    def emit(event, **fields):
+        if journal is not None:
+            journal.emit(event, **fields)
+
+    shard_paths = build_shards(os.path.join(base_dir, "shards"), cfg)
+    emit("campaign_start", seeds=list(map(int, seeds)),
+         steps=cfg.steps, canary=cfg.break_restore)
+    golden = golden_run(cfg, os.path.join(base_dir, "golden"),
+                        shard_paths=shard_paths)
+    if golden.outcome != "completed":
+        raise RuntimeError(
+            f"golden (no-fault) drill failed: {golden.error} — the "
+            "workload itself is broken; no schedule verdict is "
+            "meaningful")
+
+    entries: list[dict] = []
+    failures: list[dict] = []
+    budget_exhausted = False
+    for seed in seeds:
+        elapsed = time.perf_counter() - t0
+        if time_budget_s is not None and elapsed > time_budget_s:
+            budget_exhausted = True
+            entries.append({"seed": int(seed), "plan": None,
+                            "scenario": None,
+                            "verdict": "skipped_budget",
+                            "violations": []})
+            continue
+        sched = gen.schedule(seed)
+        workdir = os.path.join(base_dir, f"s{int(seed)}")
+        result = run_schedule(sched, cfg, workdir,
+                              shard_paths=shard_paths)
+        violations = audit(sched, result, golden, cfg)
+        overran = (per_schedule_timeout_s is not None
+                   and result.duration_s > per_schedule_timeout_s)
+        if overran:
+            violations.append(_violation(
+                "schedule_timeout",
+                f"drill took {result.duration_s:.2f}s > "
+                f"{per_schedule_timeout_s:.2f}s slice"))
+        entry = {
+            "seed": int(seed),
+            "scenario": sched.scenario,
+            "plan": sched.plan,
+            "expects": sched.expects,
+            "outcome": result.outcome,
+            "verdict": "green" if not violations else "failed",
+            "violations": violations,
+            "duration_s": round(result.duration_s, 3),
+            "quarantined": result.counters.get("bad", 0),
+        }
+        emit("schedule_verdict", **{k: entry[k] for k in
+                                    ("seed", "scenario", "plan",
+                                     "verdict", "outcome")})
+        if violations:
+            failure = dict(entry)
+            if minimize_failures:
+                rerun_idx = [0]
+
+                def _fails(plan: str, _seed=seed, _sched=sched) -> bool:
+                    # ddmin re-runs are bounded by the SAME campaign
+                    # budget as the schedules themselves — a minimize
+                    # pass must not silently double the advertised
+                    # wall-clock.
+                    if (time_budget_s is not None
+                            and time.perf_counter() - t0
+                            > time_budget_s):
+                        raise _MinimizeBudgetExhausted()
+                    rerun_idx[0] += 1
+                    cand = dataclasses.replace(
+                        _sched, rules=tuple(
+                            r for r in plan.split(";") if r))
+                    r = run_schedule(
+                        cand, cfg,
+                        os.path.join(base_dir,
+                                     f"s{int(_seed)}_min{rerun_idx[0]}"),
+                        shard_paths=shard_paths)
+                    return bool(audit(cand, r, golden, cfg))
+
+                try:
+                    minimal = minimize(sched.rules, _fails)
+                    failure["minimized_plan"] = ";".join(minimal)
+                    failure["minimized_rules"] = len(minimal)
+                    entry["minimized_plan"] = failure["minimized_plan"]
+                except _MinimizeBudgetExhausted:
+                    budget_exhausted = True
+                    failure["minimize_aborted_budget"] = True
+            failures.append(failure)
+        entries.append(entry)
+
+    verdict = {
+        "engine": "chaos-campaign/1",
+        "seeds": [int(s) for s in seeds],
+        "config": {
+            "steps": cfg.steps, "batch_size": cfg.batch_size,
+            "shards": cfg.n_shards,
+            "rows_per_shard": cfg.rows_per_shard,
+            "save_every": cfg.save_every, "canary": cfg.break_restore,
+        },
+        "n_schedules": len(entries),
+        "n_green": sum(e["verdict"] == "green" for e in entries),
+        "n_failed": len(failures),
+        "n_skipped": sum(e["verdict"] == "skipped_budget"
+                         for e in entries),
+        "all_green": (not failures and not budget_exhausted
+                      and bool(entries)),
+        "budget_s": time_budget_s,
+        "budget_exhausted": budget_exhausted,
+        "total_s": round(time.perf_counter() - t0, 3),
+        "schedules": entries,
+        "failures": failures,
+    }
+    emit("campaign_end", all_green=verdict["all_green"],
+         n_failed=verdict["n_failed"], total_s=verdict["total_s"])
+    return verdict
+
+
+# ------------------------------------------------------- subprocess drills
+
+#: Worker script for process-fatal actions (exit / sigterm / real
+#: never-returning hangs / SIGKILL from the parent): the same workload
+#: as :func:`run_schedule` driven as a child process, with the fault
+#: plan arriving via FM_SPARK_FAULTS and cross-process occurrence
+#: counters via FM_SPARK_FAULTS_STATE. Emits one JSON line per step
+#: (the parent's kill trigger) plus ``resumed_at`` / ``done`` markers.
+_WORKER_TEMPLATE = '''\
+import json, os, sys, zlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+(workdir, steps, batch_size, save_every, flight_capacity,
+ max_bad_frac, seed, attempt) = sys.argv[1:9]
+steps, batch_size, seed = int(steps), int(batch_size), int(seed)
+
+import numpy as np
+import jax
+from fm_spark_tpu import models, obs
+from fm_spark_tpu.checkpoint import Checkpointer
+from fm_spark_tpu.data.stream import (RecordGuard, ShardReader,
+                                      StreamBatches, line_parser)
+from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience.supervisor import BackoffPolicy, Supervisor
+from fm_spark_tpu.train import FMTrainer, TrainConfig
+from fm_spark_tpu.utils.logging import EventLog
+
+obs.configure(os.path.join(workdir, "obs"), run_id="chaos-drill",
+              flight_capacity=int(flight_capacity),
+              install_signals=True)
+faults.inject("backend_init")   # the init-window fault point
+
+shard_dir = os.path.join(workdir, "shards")
+paths = sorted(os.path.join(shard_dir, f)
+               for f in os.listdir(shard_dir))
+journal = EventLog(os.path.join(workdir, "health.jsonl"),
+                   mirror_to_flight=True)
+guard = RecordGuard("quarantine",
+                    quarantine_dir=os.path.join(workdir, "q"),
+                    max_bad_frac=float(max_bad_frac), window=32,
+                    min_records=16, journal=journal)
+
+
+class Tap:
+    # Batch-index-prefixed, append-per-batch (SIGKILL-durable) record
+    # tap; the index rides the cursor so a resumed attempt continues
+    # numbering where the checkpoint left off.
+    def __init__(self, source, path):
+        self._source = source
+        self._path = path
+        self._idx = 0
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        with open(self._path, "a") as f:
+            f.write(str(self._idx) + ":" + ",".join(
+                str(int(x)) for x in ids[w > 0][:, 0]))
+            f.write("\\n")
+        self._idx += 1
+        return ids, vals, labels, w
+
+    def state(self):
+        return dict(self._source.state(), tap_len=self._idx)
+
+    def restore(self, s):
+        self._source.restore(s)
+        self._idx = int(s.get("tap_len", 0))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+ck = Checkpointer(os.path.join(workdir, "ck"),
+                  save_every=int(save_every), async_save=False,
+                  journal=journal)
+sup = Supervisor(policy=BackoffPolicy(initial=0.01, jitter=0.0,
+                                      max_delay=0.05),
+                 journal=journal, probe=lambda: True,
+                 breaker_threshold=8, sleep=lambda s: None)
+print(json.dumps({"resumed_at": int(ck.last_good_step() or 0)}),
+      flush=True)
+batches = Tap(
+    StreamBatches(ShardReader(paths, chunk_bytes=64),
+                  line_parser("libsvm"), batch_size, 3, guard=guard,
+                  num_features=128),
+    os.path.join(workdir, f"tap_{attempt}.txt"))
+spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+config = TrainConfig(num_steps=steps, batch_size=batch_size,
+                     learning_rate=0.1, lr_schedule="constant",
+                     log_every=1, seed=seed)
+trainer = FMTrainer(spec, config)
+trainer.fit(batches, checkpointer=ck, supervisor=sup)
+ck.close()
+sums = {}
+for path, leaf in jax.tree_util.tree_flatten_with_path(
+        trainer.params)[0]:
+    arr = np.ascontiguousarray(np.asarray(leaf))
+    sums[jax.tree_util.keystr(path)] = (
+        f"{arr.dtype.str}:{arr.shape}:{zlib.crc32(arr.tobytes()):08x}")
+print(json.dumps({"done": trainer.step_count,
+                  "counters": guard.counters(),
+                  "cursor": batches.state(), "params_sums": sums,
+                  "loss_history": trainer.loss_history}), flush=True)
+obs.shutdown()
+'''
+
+
+def write_worker(workdir: str) -> str:
+    path = os.path.join(workdir, "chaos_worker.py")
+    with open(path, "w") as f:
+        f.write(_WORKER_TEMPLATE)
+    return path
+
+
+def run_schedule_subproc(plan: str, cfg: DrillConfig, workdir: str, *,
+                         attempts: int = 4, timeout_s: float = 120.0,
+                         kill_at_step: int | None = None,
+                         kill_signal: int | None = None,
+                         watchdog_spec: str | None = None,
+                         expected_rcs=(0,)) -> DrillResult:
+    """Drive the worker as a supervised child-process chain: spawn,
+    optionally SIGKILL it at a step (first attempt only), respawn while
+    it dies with an EXPECTED rc, and collect the artifacts for
+    :func:`audit`-style checks. Cross-process fault occurrences ride
+    ``FM_SPARK_FAULTS_STATE`` so "hang the FIRST attempt's read, not
+    every attempt's" stays expressible across respawns.
+
+    rc discipline is itself an invariant: an attempt ending with an rc
+    outside ``expected_rcs`` ∪ {the kill signal, watchdog
+    :data:`~fm_spark_tpu.resilience.watchdog.HANG_EXIT_RC`} fails the
+    drill with outcome ``rc_violation``.
+    """
+    import json as _json  # read-only (json.loads); writes stay EventLog
+
+    import signal as _signal
+
+    os.makedirs(workdir, exist_ok=True)
+    build_shards(os.path.join(workdir, "shards"), cfg)
+    worker = write_worker(workdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FM_SPARK_OBS_DIR="none",
+               PYTHONPATH=_REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               FM_SPARK_FAULTS=plan,
+               FM_SPARK_FAULTS_STATE=os.path.join(workdir,
+                                                  "faults_state.json"))
+    env.pop("FM_SPARK_WATCHDOG", None)
+    env.pop("FM_SPARK_WATCHDOG_ACTION", None)
+    if watchdog_spec:
+        env["FM_SPARK_WATCHDOG"] = watchdog_spec
+        env["FM_SPARK_WATCHDOG_ACTION"] = "exit"
+    kill_sig = (int(kill_signal) if kill_signal is not None
+                else int(_signal.SIGKILL))
+    allowed = set(expected_rcs) | {watchdog.HANG_EXIT_RC,
+                                   -int(_signal.SIGTERM)}
+    if kill_at_step is not None:
+        allowed.add(-kill_sig)
+
+    import threading
+
+    t0 = time.perf_counter()
+    rcs: list[int] = []
+    resumed: list[int] = []
+    done: dict | None = None
+    outcome, error = "incomplete", None
+    for attempt in range(attempts):
+        argv = [sys.executable, worker, workdir, str(cfg.steps),
+                str(cfg.batch_size), str(cfg.save_every),
+                str(cfg.flight_capacity), "1.0", str(cfg.seed),
+                str(attempt)]
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                text=True, cwd=_REPO, env=env)
+        killed = False
+        # The per-attempt timeout must bound a SILENT child too (a
+        # hang at an unbudgeted point emits nothing, and a blocking
+        # readline would wait on it forever): a timer thread kills the
+        # child at the deadline, which unblocks the stdout iteration.
+        timed_out = threading.Event()
+
+        def _deadline_kill(p=proc, flag=timed_out):
+            flag.set()
+            try:
+                p.kill()
+            except OSError:
+                pass
+
+        timer = threading.Timer(timeout_s, _deadline_kill)
+        timer.daemon = True
+        timer.start()
+        try:
+            for line in proc.stdout:
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue
+                if "resumed_at" in rec:
+                    resumed.append(int(rec["resumed_at"]))
+                if "done" in rec:
+                    done = rec
+                if (kill_at_step is not None and not killed
+                        and attempt == 0
+                        and rec.get("step", -1) >= kill_at_step):
+                    os.kill(proc.pid, kill_sig)
+                    killed = True
+            proc.wait(timeout=30)
+        finally:
+            timer.cancel()
+            proc.stdout.close()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        rcs.append(proc.returncode)
+        if timed_out.is_set():
+            outcome = "attempt_timeout"
+            error = f"attempt {attempt} exceeded {timeout_s}s"
+            break
+        # rc discipline applies to EVERY attempt, the completing one
+        # included: a worker that printed its done marker and then
+        # died in teardown still violated the exit contract.
+        if proc.returncode not in allowed:
+            outcome = "rc_violation"
+            error = (f"attempt {attempt} exited rc={proc.returncode}, "
+                     f"allowed {sorted(allowed)}")
+            break
+        if done is not None:
+            outcome = "completed"
+            break
+    if outcome == "incomplete":
+        error = f"no completion in {attempts} attempt(s); rcs={rcs}"
+
+    tap: list[str] = []
+    for attempt in range(attempts):
+        path = os.path.join(workdir, f"tap_{attempt}.txt")
+        if os.path.isfile(path):
+            with open(path) as f:
+                tap.append(f.read())
+    return DrillResult(
+        outcome=outcome, error=error,
+        steps_done=int((done or {}).get("done", 0)),
+        loss_history=list((done or {}).get("loss_history", [])),
+        params_sums=(done or {}).get("params_sums"),
+        tap=tap,  # raw per-attempt tap texts; stitch with stitch_taps()
+        cursor=(done or {}).get("cursor"),
+        counters=dict((done or {}).get("counters", {})),
+        duration_s=time.perf_counter() - t0,
+        workdir=workdir,
+        health_path=os.path.join(workdir, "health.jsonl"),
+        deadletter_path=os.path.join(workdir, "q", "deadletter.jsonl"),
+        ckpt_dir=os.path.join(workdir, "ck"),
+        rcs=tuple(rcs), resumed_at=tuple(resumed),
+    )
+
+
+def stitch_taps(result: DrillResult) -> list[str]:
+    """Reconstruct the EFFECTIVE record stream of a killed-and-resumed
+    drill chain from the batch-index-prefixed per-attempt taps: for
+    each batch index the LAST write wins (a later attempt re-emitting
+    an index means the earlier emission was rolled back with the
+    checkpoint — never committed). The result must be contiguous from
+    batch 0 and bit-identical to the clean run's tap: that is the
+    exactly-once verdict across process deaths. A torn final line (a
+    SIGKILL mid-append) is tolerated exactly once per attempt file."""
+    effective: dict[int, str] = {}
+    for text in result.tap:
+        lines = text.splitlines()
+        for j, line in enumerate(lines):
+            idx, sep, payload = line.partition(":")
+            if not sep or not idx.isdigit():
+                if j == len(lines) - 1:
+                    continue  # torn tail from a kill mid-append
+                raise ValueError(f"malformed tap line {line!r}")
+            effective[int(idx)] = payload
+    if not effective:
+        return []
+    if sorted(effective) != list(range(max(effective) + 1)):
+        raise ValueError(
+            f"tap indices not contiguous: {sorted(effective)[:8]}...")
+    return [effective[i] for i in range(max(effective) + 1)]

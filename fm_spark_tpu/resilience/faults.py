@@ -45,6 +45,7 @@ import signal
 import time
 
 __all__ = [
+    "ACTIONS",
     "ENV_PLAN",
     "ENV_STATE",
     "KNOWN_POINTS",
@@ -79,7 +80,10 @@ KNOWN_POINTS = (
     "ingest_truncate",
 )
 
-_ACTIONS = ("hang", "sleep", "exit", "device_loss", "error", "sigterm")
+#: The action vocabulary (public since ISSUE 10: the chaos schedule
+#: generator samples from it, and the eager-validation error cites it).
+ACTIONS = ("hang", "sleep", "exit", "device_loss", "error", "sigterm")
+_ACTIONS = ACTIONS
 
 
 class FaultInjected(RuntimeError):
@@ -134,7 +138,15 @@ class FaultPlan:
         self.points = {r.point for r in rules}
 
     @classmethod
-    def from_spec(cls, spec: str) -> "FaultPlan":
+    def from_spec(cls, spec: str,
+                  points: "tuple[str, ...] | None" = KNOWN_POINTS
+                  ) -> "FaultPlan":
+        """Parse a plan, validating it EAGERLY (ISSUE 10 satellite): an
+        unknown point or action used to surface only when (never) the
+        point fired — a typo'd plan silently tested nothing. Both are
+        rejected up front with the registry/action set in the error.
+        ``points=None`` disables the registry check (harness-internal
+        plans over synthetic points)."""
         rules = []
         for entry in spec.split(";"):
             entry = entry.strip()
@@ -154,6 +166,13 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown fault action {m['action']!r} "
                     f"(know {_ACTIONS})"
+                )
+            if points is not None and m["point"] not in points:
+                raise ValueError(
+                    f"unknown fault point {m['point']!r} — a rule "
+                    "naming a point nothing injects would silently "
+                    f"never fire (known points: {tuple(points)}; "
+                    f"actions: {_ACTIONS})"
                 )
             rules.append(_Rule(m["point"], int(m["n"]), m["action"],
                                m["param"]))

@@ -26,7 +26,7 @@ import optax
 
 from fm_spark_tpu import obs
 from fm_spark_tpu.ops import losses as losses_lib
-from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience import faults, watchdog
 from fm_spark_tpu.resilience.divergence import DivergenceDetected
 from fm_spark_tpu.utils import metrics as metrics_lib
 from fm_spark_tpu.utils.logging import MetricsLogger
@@ -630,28 +630,45 @@ class FMTrainer:
         # time: per-step host timing would record enqueue latency, not
         # device step time, on an async backend.
         win_ts, win_t0, win_steps = time.time(), time.perf_counter(), 0
+        # Watchdog exemption for the FIRST loop step of every
+        # _fit_loop entry (fresh start AND each post-recovery
+        # re-entry): that step carries the jit compile, whose wall
+        # time is budgeted nowhere near a steady step's — arming the
+        # step_window deadline over it would misclassify a healthy
+        # cold start as a hang. (The obs plane fences the same step
+        # out of its histograms for the same reason.)
+        import contextlib
+
+        first_loop_call = True
         for step_i in range(start, total):
             if preemption_guard is not None and preemption_guard.should_stop:
                 save(force=True)
                 return self.params
-            # Deterministic mid-step device loss for the recovery tests
-            # (resilience/faults.py); a single is-None check when no
-            # fault plan is active.
-            faults.inject("train_step")
-            try:
-                ids, vals, labels, weights = next(it)
-            except StopIteration:
-                raise ValueError(
-                    f"batch iterable exhausted after {step_i} of {total} "
-                    "steps; pass an epoch-cycling iterator (data.Batches) "
-                    "or lower num_steps"
-                ) from None
-            t_step0 = time.perf_counter() if first_step_pending else 0.0
-            self.params, self.opt_state, m = self._train_step(
-                self.params, self.opt_state,
-                jnp.asarray(ids), jnp.asarray(vals),
-                jnp.asarray(labels), jnp.asarray(weights),
-            )
+            # One step's host-observable window — the fault point, the
+            # batch fetch (a stalled producer hangs HERE), and the step
+            # dispatch — runs under the ``step_window`` deadline
+            # watchdog (ISSUE 10); a single is-None/False check each
+            # when no fault plan / watchdog is active.
+            wd_ctx = (contextlib.nullcontext() if first_loop_call
+                      else watchdog.phase("step_window"))
+            first_loop_call = False
+            with wd_ctx:
+                faults.inject("train_step")
+                try:
+                    ids, vals, labels, weights = next(it)
+                except StopIteration:
+                    raise ValueError(
+                        f"batch iterable exhausted after {step_i} of "
+                        f"{total} steps; pass an epoch-cycling iterator "
+                        "(data.Batches) or lower num_steps"
+                    ) from None
+                t_step0 = (time.perf_counter() if first_step_pending
+                           else 0.0)
+                self.params, self.opt_state, m = self._train_step(
+                    self.params, self.opt_state,
+                    jnp.asarray(ids), jnp.asarray(vals),
+                    jnp.asarray(labels), jnp.asarray(weights),
+                )
             if obs_on:
                 if first_step_pending:
                     first_step_pending = False

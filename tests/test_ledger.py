@@ -130,3 +130,19 @@ def test_ledger_record_json_serializable(tmp_path):
     line = json.loads(open(led.path).read())
     assert line["value"] is None and line["error"] == "rc=3"
     assert rec["fingerprint"]["key"] == line["fingerprint"]["key"]
+
+
+def test_chaos_fingerprint_splits_cohort_only_when_set():
+    """ISSUE 10 satellite: chaos-drill legs form their OWN cohort (a
+    run under injected faults is a different program), but the flag is
+    folded into the key asymmetrically so every historical (pre-chaos)
+    cohort key stays byte-stable."""
+    real = _fp()
+    drill = _fp(chaos=True)
+    assert drill["chaos"] is True and real["chaos"] is False
+    assert drill["key"] != real["key"]
+    # Key stability: a fingerprint dict with no chaos field at all (a
+    # pre-ISSUE-10 ledger row) keys identically to chaos=False.
+    legacy = dict(real)
+    del legacy["chaos"]
+    assert fingerprint_key(legacy) == real["key"]

@@ -63,14 +63,32 @@ def test_fault_spec_rejects_malformed_rules(bad):
         FaultPlan.from_spec(bad)
 
 
+def test_fault_spec_rejects_unknown_points_eagerly():
+    """ISSUE 10 satellite: a typo'd point used to be accepted and then
+    silently never fire — now it is rejected at parse/activate time
+    with the registry and action set in the error."""
+    with pytest.raises(ValueError, match=r"unknown fault point"):
+        FaultPlan.from_spec("trian_step@1=device_loss")
+    with pytest.raises(ValueError) as exc:
+        faults.activate("no_such_point@2=error")
+    msg = str(exc.value)
+    for point in faults.KNOWN_POINTS:
+        assert point in msg  # the error lists the whole registry
+    for action in faults.ACTIONS:
+        assert action in msg  # ... and the action vocabulary
+    # Harness-internal plans over synthetic points stay expressible.
+    plan = FaultPlan.from_spec("synthetic_pt@1=error", points=None)
+    assert plan.points == {"synthetic_pt"}
+
+
 def test_inject_fires_at_exact_occurrence_only():
-    faults.activate("p@3=device_loss")
-    faults.inject("p")
-    faults.inject("p")
+    faults.activate("train_step@3=device_loss")
+    faults.inject("train_step")
+    faults.inject("train_step")
     with pytest.raises(InjectedDeviceLoss):
-        faults.inject("p")
-    faults.inject("p")  # occurrence 4: past the rule, quiet again
-    faults.inject("other")  # unrelated point never fires
+        faults.inject("train_step")
+    faults.inject("train_step")  # occurrence 4: past the rule, quiet
+    faults.inject("probe")  # unrelated point never fires
 
 
 def test_inject_noop_without_plan():
@@ -83,20 +101,20 @@ def test_occurrence_counters_survive_process_respawn(tmp_path,
     and 'hang the FIRST init, not every init' must stay expressible."""
     state = tmp_path / "state.json"
     monkeypatch.setenv(faults.ENV_STATE, str(state))
-    faults.activate("init@1=error")
+    faults.activate("backend_init@1=error")
     with pytest.raises(FaultInjected):
-        faults.inject("init")
+        faults.inject("backend_init")
     # "New process": fresh in-memory counters, same state file.
-    faults.activate("init@1=error")
-    faults.inject("init")  # persistent occurrence 2 — no fire
-    assert json.loads(state.read_text())["init"] == 2
+    faults.activate("backend_init@1=error")
+    faults.inject("backend_init")  # persistent occurrence 2 — no fire
+    assert json.loads(state.read_text())["backend_init"] == 2
 
 
 def test_env_plan_loaded_lazily(monkeypatch):
-    monkeypatch.setenv(faults.ENV_PLAN, "envpt@1=device_loss")
+    monkeypatch.setenv(faults.ENV_PLAN, "sweep_leg@1=device_loss")
     faults.clear()  # force the env re-read
     with pytest.raises(InjectedDeviceLoss):
-        faults.inject("envpt")
+        faults.inject("sweep_leg")
 
 
 def test_is_device_loss_classification():

@@ -214,3 +214,34 @@ def test_every_resilience_module_is_covered(fname, tmp_path):
     found = lint.violations(str(tmp_path))
     assert any(v.startswith(f"{fname}:") and "_planted_violation" in v
                for v in found), found
+
+
+def test_fault_point_coverage_clean_on_shipped_registry():
+    """ISSUE 10 satellite: every KNOWN_POINTS entry is exercised by at
+    least one tier-1 test in the shipped tree."""
+    lint = _load_lint()
+    found = lint.fault_point_coverage_violations()
+    assert found == [], "\n".join(found)
+
+
+def test_fault_point_coverage_catches_untested_point(tmp_path):
+    """A new injection point with no test naming it turns the lint red
+    — new fault points can't ship untested."""
+    lint = _load_lint()
+    faults_py = tmp_path / "faults.py"
+    faults_py.write_text(
+        'KNOWN_POINTS = (\n    "train_step",\n    "brand_new_point",\n)\n')
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_x.py").write_text(
+        'def test_a():\n    assert "train_step"\n')
+    found = lint.fault_point_coverage_violations(
+        tests_dir=str(tests_dir), faults_path=str(faults_py))
+    assert len(found) == 1
+    assert "brand_new_point" in found[0]
+    # And a registry nobody can find is itself a violation, not a pass.
+    empty = tmp_path / "empty.py"
+    empty.write_text("x = 1\n")
+    found = lint.fault_point_coverage_violations(
+        tests_dir=str(tests_dir), faults_path=str(empty))
+    assert found and "no KNOWN_POINTS" in found[0]

@@ -208,3 +208,53 @@ def test_doctor_cli_errors(tmp_path):
     assert doctor.main(["--latest", str(tmp_path / "none")]) == 1
     assert doctor.main([str(tmp_path / "nope")]) == 1
     assert doctor.main([]) == 2
+
+
+def test_doctor_renders_chaos_verdict(tmp_path, capsys):
+    """ISSUE 10: a run dir holding a chaos_verdict.json gets a chaos
+    section + diagnosis lines, including the minimized repro plan."""
+    doctor = _load_doctor()
+    run_dir = tmp_path / "r1"
+    run_dir.mkdir()
+    (run_dir / "trace.jsonl").write_text("")
+    verdict = {
+        "run_id": "r1", "mode": "bounded", "n_schedules": 2,
+        "n_green": 1, "n_failed": 1, "n_skipped": 0, "total_s": 3.2,
+        "all_green": False,
+        "schedules": [
+            {"seed": 0, "scenario": "commit_loss",
+             "plan": "ckpt_commit@1=device_loss", "verdict": "green",
+             "outcome": "completed", "violations": []},
+            {"seed": 3, "scenario": "recovery_storm",
+             "plan": "train_step@4=device_loss;probe@1=device_loss",
+             "verdict": "failed", "outcome": "completed",
+             "violations": [{"invariant": "exactly_once_stream",
+                             "detail": "records replayed"}],
+             "minimized_plan": "train_step@4=device_loss"},
+        ],
+        "failures": [
+            {"seed": 3, "scenario": "recovery_storm",
+             "violations": [{"invariant": "exactly_once_stream",
+                             "detail": "records replayed"}],
+             "minimized_plan": "train_step@4=device_loss"},
+        ],
+    }
+    (run_dir / "chaos_verdict.json").write_text(json.dumps(verdict))
+    assert doctor.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "## Chaos verdict" in out
+    assert "exactly_once_stream" in out
+    assert "FM_SPARK_FAULTS='train_step@4=device_loss'" in out
+    assert "CHAOS: seed 3" in out
+
+
+def test_doctor_chaos_findings_green_and_budget():
+    doctor = _load_doctor()
+    assert doctor.chaos_findings(None) == []
+    green = doctor.chaos_findings(
+        {"all_green": True, "n_green": 25, "total_s": 17.0})
+    assert len(green) == 1 and "chaos campaign green" in green[0]
+    over = doctor.chaos_findings(
+        {"all_green": False, "failures": [], "budget_exhausted": True,
+         "n_skipped": 7})
+    assert any("out of budget" in f for f in over)

@@ -420,3 +420,62 @@ def test_emit_final_promotes_improved_verdict(tmp_path, monkeypatch,
     doc = json.loads(dst.read_text())
     assert doc["headline"]["rate_samples_per_sec_per_chip"] \
         == 9_999_999.0
+
+
+def test_sentinel_widened_cohort_excludes_chaos_rows(tmp_path):
+    """ISSUE 10 satellite: chaos-drill rows must never lend their band
+    to a real cohort just because the exact history is thin — nor can
+    a real band judge a chaos leg."""
+    led = PerfLedger(str(tmp_path / "l.jsonl"))
+    # The only leg-wide history is chaos-drill rows at a crippled rate.
+    for i, v in enumerate([100.0, 105.0, 95.0, 102.0, 99.0]):
+        led.append({
+            "kind": "bench_leg", "leg": "legA", "run_id": f"c{i}",
+            "value": v,
+            "fingerprint": measurement_fingerprint(
+                variant="a", model="fm", chaos=True),
+        })
+    fp_real = measurement_fingerprint(variant="brand-new", model="fm")
+    block = Sentinel(led).judge("legA", 1_000_000.0, fp_real)
+    # Widening found nothing comparable: insufficient history, NOT an
+    # "improved" verdict against the chaos band.
+    assert block["verdict"] == "insufficient_history"
+    # And a chaos measurement judges against the chaos band only.
+    fp_chaos = measurement_fingerprint(variant="a", model="fm",
+                                       chaos=True)
+    chaos_block = Sentinel(led).judge("legA", 101.0, fp_chaos)
+    assert chaos_block["cohort"] == "exact"
+    assert chaos_block["verdict"] == "flat"
+
+
+def test_emit_final_gate_refuses_chaos_stamped_payload(tmp_path,
+                                                       monkeypatch,
+                                                       capsys):
+    """ISSUE 10 satellite: a chaos-drill leg — even TPU-stamped,
+    numerically better, sentinel-improved — must never pass the
+    keep-best gate into MEASURED.json."""
+    import fm_spark_tpu.measured as measured
+
+    src = os.path.join(REPO, "MEASURED.json")
+    dst = tmp_path / "MEASURED.json"
+    dst.write_bytes(open(src, "rb").read())
+    monkeypatch.setattr(measured, "MEASURED_PATH", str(dst))
+
+    bench = _load_bench()
+    line = json.dumps({
+        "metric": bench.METRIC, "value": 9_999_999.0,
+        "unit": bench.UNIT, "vs_baseline": 8.0,
+        "variant": "bfloat16/dedup_sr/compact12288/cd-bf16/gfull"
+                   "/segtotal",
+        "device": "TPU v5 lite",
+        "chaos": True,
+        "sentinel": {"verdict": "improved", "reason": "test", "z": 9.0,
+                     "n_history": 6},
+    })
+    before = dst.read_bytes()
+    with bench._SALVAGE_LOCK:
+        bench._SALVAGE.update(line=line, emitted=False)
+    bench._emit_final()
+    assert dst.read_bytes() == before, (
+        "a chaos-stamped payload overwrote MEASURED.json")
+    assert json.loads(capsys.readouterr().out)["value"] == 9_999_999.0

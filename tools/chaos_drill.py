@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Chaos drill runner: seeded multi-fault campaigns with one verdict.
+
+The operational front-end of :mod:`fm_spark_tpu.resilience.chaos`
+(ISSUE 10). Runs N seeded schedules through the invariant auditor,
+delta-debugs any failure down to a minimal reproducible plan string,
+and writes the machine-readable verdict to
+``artifacts/obs/<run_id>/chaos_verdict.json`` (rendered by
+``tools/run_doctor.py``). Exit code 0 iff every schedule was green.
+
+Modes::
+
+    python tools/chaos_drill.py                      # bounded: 25 seeds
+    python tools/chaos_drill.py --seeds 3,17,42      # replay exact seeds
+    python tools/chaos_drill.py --soak               # long mode: 200
+                                                     # seeds + subprocess
+                                                     # kill/hang drills
+                                                     # (nightly / TPU
+                                                     # window)
+    python tools/chaos_drill.py --canary             # prove the auditor
+                                                     # catches a broken
+                                                     # recovery path
+                                                     # (exit 0 iff caught
+                                                     # AND minimized)
+
+The bounded default is exactly what tier-1 runs (tests/test_chaos.py's
+soak), so a green CI round certifies the same invariants this tool
+checks interactively. Every schedule is a pure function of its seed —
+``--seeds <failing-seed>`` replays a verdict's repro, and the verdict's
+``minimized_plan`` can be run directly via ``FM_SPARK_FAULTS``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+VERDICT_FILE = "chaos_verdict.json"
+
+#: The tier-1 bounded campaign: fixed seed list + time budget. Fixed —
+#: not configurable per run — so every CI round drills the SAME plans
+#: and a regression bisects cleanly.
+TIER1_SEEDS = tuple(range(25))
+TIER1_BUDGET_S = 300.0
+TIER1_PER_SCHEDULE_S = 30.0
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_verdict(verdict: dict, obs_root: str,
+                  run_id: str | None = None) -> str:
+    """Persist one campaign verdict under ``<obs_root>/<run_id>/`` —
+    the per-run obs directory convention, so run_doctor/obs_report
+    find it next to any telemetry the drills produced."""
+    from fm_spark_tpu import obs
+
+    run_id = run_id or obs.new_run_id()
+    run_dir = os.path.join(obs_root, run_id)
+    os.makedirs(run_dir, exist_ok=True)
+    verdict["run_id"] = run_id  # in place: callers render the id too
+    path = os.path.join(run_dir, VERDICT_FILE)
+    _atomic_write_json(path, verdict)
+    return path
+
+
+def render(verdict: dict) -> str:
+    out = [f"# chaos campaign — {verdict.get('run_id', '?')}",
+           f"schedules: {verdict['n_schedules']}  "
+           f"green: {verdict['n_green']}  failed: {verdict['n_failed']}"
+           f"  skipped: {verdict.get('n_skipped', 0)}  "
+           f"({verdict['total_s']:.1f}s"
+           + (f" of {verdict['budget_s']:.0f}s budget"
+              if verdict.get("budget_s") else "") + ")", ""]
+    for e in verdict["schedules"]:
+        mark = {"green": "ok ", "failed": "FAIL",
+                "skipped_budget": "skip"}.get(e["verdict"], "?   ")
+        out.append(f"  [{mark}] seed {e['seed']:>4} "
+                   f"{(e.get('scenario') or '-'):14} "
+                   f"{(e.get('outcome') or '-'):16} {e.get('plan') or ''}")
+        for viol in e.get("violations", []):
+            out.append(f"         - {viol['invariant']}: "
+                       f"{viol['detail']}")
+        if e.get("minimized_plan"):
+            out.append(f"         minimized repro: "
+                       f"FM_SPARK_FAULTS='{e['minimized_plan']}' "
+                       f"(seed {e['seed']})")
+    out.append("")
+    out.append("ALL GREEN" if verdict["all_green"]
+               else f"{verdict['n_failed']} FAILING SCHEDULE(S)")
+    return "\n".join(out) + "\n"
+
+
+def _soak_subprocess_drills(cfg, base_dir: str) -> list[dict]:
+    """The process-fatal scenarios the in-process campaign cannot
+    express: SIGKILL mid-run (spool-compaction pressure via a small
+    flight ring), a watchdog-bounded real hang, and an injected init
+    exit — each respawned to completion and held to the exactly-once
+    + rc-discipline invariants."""
+    import dataclasses
+
+    from fm_spark_tpu.resilience import chaos
+
+    sub_cfg = dataclasses.replace(cfg, flight_capacity=4)
+    golden = chaos.golden_run(sub_cfg, os.path.join(base_dir, "golden"))
+    drills = [
+        ("sigkill_midrun", dict(plan="", kill_at_step=9),
+         dict()),
+        ("hang_ingest_watchdog",
+         dict(plan="ingest_truncate@2=hang:300",
+              watchdog_spec="ingest_chunk=1.5"), dict()),
+        ("init_exit_respawn", dict(plan="backend_init@1=exit:3"),
+         dict(expected_rcs=(0, 3))),
+    ]
+    entries = []
+    for name, kw, extra in drills:
+        t0 = time.perf_counter()
+        plan = kw.pop("plan")
+        r = chaos.run_schedule_subproc(
+            plan, sub_cfg,
+            os.path.join(base_dir, f"sub_{name}"), **kw, **extra)
+        violations = []
+        if r.outcome != "completed":
+            violations.append({"invariant": "completion",
+                               "detail": f"{r.outcome}: {r.error}"})
+        else:
+            try:
+                if chaos.stitch_taps(r) != golden.tap:
+                    violations.append({
+                        "invariant": "exactly_once_stream",
+                        "detail": "stitched stream != clean run"})
+            except ValueError as e:
+                violations.append({"invariant": "exactly_once_stream",
+                                   "detail": str(e)})
+            if r.loss_history != golden.loss_history:
+                violations.append({"invariant": "loss_continuity",
+                                   "detail": "loss curve diverged"})
+        entries.append({
+            "seed": None, "scenario": f"subprocess:{name}",
+            "plan": plan, "expects": "completed",
+            "outcome": r.outcome, "rcs": list(r.rcs),
+            "verdict": "green" if not violations else "failed",
+            "violations": violations,
+            "duration_s": round(time.perf_counter() - t0, 3),
+        })
+    return entries
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded chaos campaigns over the resilience stack")
+    ap.add_argument("--seeds", default=None,
+                    help="comma-separated seed list (default: the "
+                         "fixed tier-1 list)")
+    ap.add_argument("--schedules", type=int, default=None,
+                    help="run seeds 0..N-1 instead of the fixed list")
+    ap.add_argument("--soak", action="store_true",
+                    help="long mode (nightly/TPU window): 200 seeds + "
+                         "the subprocess kill/hang/init-exit drills")
+    ap.add_argument("--canary", action="store_true",
+                    help="deliberately break the recovery path "
+                         "(restore stops rewinding the cursor) and "
+                         "exit 0 iff the auditor catches it and the "
+                         "minimizer reduces it to <= 2 rules")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="campaign wall-clock budget in seconds "
+                         f"(default {TIER1_BUDGET_S:.0f}, soak: none)")
+    ap.add_argument("--per-schedule-timeout", type=float,
+                    default=TIER1_PER_SCHEDULE_S,
+                    dest="per_schedule",
+                    help="flag any single drill exceeding this many "
+                         "seconds")
+    ap.add_argument("--no-minimize", action="store_true",
+                    help="skip delta-debugging failing schedules")
+    ap.add_argument("--out", default=os.path.join(_REPO, "artifacts",
+                                                  "obs"),
+                    help="obs root for <run_id>/chaos_verdict.json")
+    ap.add_argument("--work-dir", default=None,
+                    help="drill scratch dir (default: a tempdir)")
+    args = ap.parse_args(argv)
+
+    import dataclasses
+
+    from fm_spark_tpu.resilience import chaos
+
+    if args.seeds:
+        seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    elif args.schedules is not None:
+        seeds = list(range(args.schedules))
+    elif args.soak:
+        seeds = list(range(200))
+    else:
+        seeds = list(TIER1_SEEDS)
+    budget = args.budget
+    if budget is None and not args.soak:
+        budget = TIER1_BUDGET_S
+
+    cfg = chaos.DrillConfig(break_restore=args.canary)
+    base_dir = args.work_dir or tempfile.mkdtemp(prefix="chaos_drill_")
+    # The canary's success criterion IS a minimized repro, so canary
+    # mode always minimizes (--no-minimize would otherwise turn a
+    # caught canary into a false "auditor is blind" verdict).
+    verdict = chaos.run_campaign(
+        seeds, cfg=cfg, base_dir=base_dir, time_budget_s=budget,
+        per_schedule_timeout_s=args.per_schedule,
+        minimize_failures=args.canary or not args.no_minimize)
+    if args.soak:
+        extra = _soak_subprocess_drills(
+            dataclasses.replace(cfg, break_restore=False), base_dir)
+        verdict["schedules"].extend(extra)
+        verdict["n_schedules"] += len(extra)
+        verdict["n_green"] += sum(e["verdict"] == "green"
+                                  for e in extra)
+        fails = [e for e in extra if e["verdict"] != "green"]
+        verdict["failures"].extend(fails)
+        verdict["n_failed"] += len(fails)
+        verdict["all_green"] = (verdict["all_green"] and not fails)
+    verdict["mode"] = ("canary" if args.canary
+                       else "soak" if args.soak else "bounded")
+
+    path = write_verdict(verdict, args.out)
+    sys.stdout.write(render(verdict))
+    print(f"verdict: {path}")
+
+    if args.canary:
+        # Success = the broken recovery path was CAUGHT and minimized
+        # to a <=2-rule reproducible plan (the ISSUE 10 acceptance
+        # criterion); an all-green canary run means the auditor is
+        # blind and must fail loudly.
+        caught = [f for f in verdict["failures"]
+                  if f.get("minimized_plan")
+                  and f.get("minimized_rules", 99) <= 2]
+        if caught:
+            print("canary CAUGHT and minimized: "
+                  f"{caught[0]['minimized_plan']!r}")
+            return 0
+        print("canary NOT caught — the auditor missed a broken "
+              "recovery path", file=sys.stderr)
+        return 1
+    return 0 if verdict["all_green"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

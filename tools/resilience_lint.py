@@ -53,6 +53,12 @@ Since ISSUE 9 the lint is also the MEASUREMENT-PROVENANCE lint:
   record that cannot be traced to its run and comparability cohort is
   exactly the hand-adjudicated number the perf ledger retires.
 
+Since ISSUE 10 the lint is also the FAULT-COVERAGE lint: every entry
+in ``faults.KNOWN_POINTS`` must be exercised by at least one tier-1
+test (:func:`fault_point_coverage_violations`) — a new injection point
+cannot ship untested, because an unexercised recovery path is exactly
+the blind spot the chaos campaign exists to close.
+
 Usage::
 
     python tools/resilience_lint.py        # exit 1 on violations
@@ -342,6 +348,58 @@ def duration_time_violations(root: str | None = None) -> list[str]:
 LEG_RECORD_REQUIRED_KEYS = ("run_id", "fingerprint")
 
 
+def _known_points(faults_path: str) -> list[str]:
+    """AST-extract the ``KNOWN_POINTS`` literal from faults.py — no
+    package import, so the lint stays runnable from a bare checkout."""
+    with open(faults_path) as f:
+        tree = ast.parse(f.read(), filename=os.path.basename(faults_path))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "KNOWN_POINTS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def fault_point_coverage_violations(tests_dir: str | None = None,
+                                    faults_path: str | None = None
+                                    ) -> list[str]:
+    """Fault-registry coverage rule (ISSUE 10 satellite): every
+    ``KNOWN_POINTS`` entry must appear in at least one tier-1 test
+    module — an injection point nobody's test ever names is a recovery
+    path that can rot silently, the exact blind spot the chaos
+    campaign exists to close. (String-level scan: plans are strings,
+    so the point name appearing in a test file IS the exercise
+    anchor.)"""
+    tests_dir = tests_dir or os.path.join(REPO, "tests")
+    faults_path = faults_path or os.path.join(
+        REPO, "fm_spark_tpu", "resilience", "faults.py")
+    points = _known_points(faults_path)
+    if not points:
+        return [f"{os.path.basename(faults_path)}: no KNOWN_POINTS "
+                "literal found — the fault registry has no anchor to "
+                "check coverage against"]
+    texts = []
+    try:
+        for fname in sorted(os.listdir(tests_dir)):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                with open(os.path.join(tests_dir, fname)) as f:
+                    texts.append(f.read())
+    except OSError as e:
+        return [f"tests dir unreadable ({e})"]
+    blob = "\n".join(texts)
+    return [
+        f"fault point {p!r} (KNOWN_POINTS) is exercised by no test "
+        "under tests/ — a new injection point must ship with at least "
+        "one tier-1 test that names it"
+        for p in points if p not in blob
+    ]
+
+
 def bench_leg_record_violations(path: str | None = None) -> list[str]:
     """Provenance rule (ISSUE 9): bench.py's ``leg_record`` dict
     literal must carry :data:`LEG_RECORD_REQUIRED_KEYS` — the AST half
@@ -400,7 +458,8 @@ def main() -> int:
     found = (violations() + library_print_violations()
              + kernel_fallback_violations()
              + duration_time_violations()
-             + bench_leg_record_violations())
+             + bench_leg_record_violations()
+             + fault_point_coverage_violations())
     for v in found:
         print(v, file=sys.stderr)
     if found:

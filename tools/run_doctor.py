@@ -141,6 +141,45 @@ def diagnose(run: dict, legs: list[dict],
     }
 
 
+def load_chaos_verdict(obs_dir: str) -> dict | None:
+    """The run's chaos-campaign verdict (``chaos_verdict.json``,
+    written by tools/chaos_drill.py), if this run dir holds one."""
+    path = os.path.join(obs_dir, "chaos_verdict.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def chaos_findings(chaos: dict | None) -> list[str]:
+    """Chaos-verdict one-liners for the diagnosis section."""
+    if not chaos:
+        return []
+    out = []
+    if chaos.get("all_green"):
+        out.append(
+            f"chaos campaign green: {chaos.get('n_green')} seeded "
+            "schedule(s), every invariant held "
+            f"({chaos.get('total_s', 0):.1f}s)")
+        return out
+    for f in chaos.get("failures", []):
+        inv = ", ".join(sorted({v["invariant"]
+                                for v in f.get("violations", [])}))
+        line = (f"CHAOS: seed {f.get('seed')} "
+                f"({f.get('scenario')}) violated [{inv}]")
+        if f.get("minimized_plan"):
+            line += (f" — minimized repro "
+                     f"FM_SPARK_FAULTS='{f['minimized_plan']}'")
+        out.append(line)
+    if chaos.get("budget_exhausted"):
+        out.append(
+            f"chaos campaign ran out of budget: "
+            f"{chaos.get('n_skipped', 0)} schedule(s) skipped")
+    return out
+
+
 def findings(diag: dict, legs: list[dict]) -> list[str]:
     """The doctor's opinionated one-liners."""
     out = []
@@ -190,7 +229,8 @@ def findings(diag: dict, legs: list[dict]) -> list[str]:
     return out
 
 
-def render(run: dict, diag: dict, legs: list[dict]) -> str:
+def render(run: dict, diag: dict, legs: list[dict],
+           chaos: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -233,8 +273,30 @@ def render(run: dict, diag: dict, legs: list[dict]) -> str:
             out.append(f"  {kind:28} {diag['fault_kinds'][kind]:>5}")
         out.append("")
 
+    if chaos is not None:
+        out.append(
+            f"## Chaos verdict ({chaos.get('mode', '?')} campaign, "
+            f"{chaos.get('n_schedules', 0)} schedule(s))")
+        out.append(
+            f"  green {chaos.get('n_green', 0)}  failed "
+            f"{chaos.get('n_failed', 0)}  skipped "
+            f"{chaos.get('n_skipped', 0)}  "
+            f"({chaos.get('total_s', 0):.1f}s)")
+        for e in chaos.get("schedules", []):
+            if e.get("verdict") == "green":
+                continue
+            out.append(f"  seed {e.get('seed')}: {e.get('verdict')} "
+                       f"[{e.get('scenario')}] {e.get('plan') or ''}")
+            for viol in e.get("violations", []):
+                out.append(f"    - {viol['invariant']}: "
+                           f"{viol['detail']}")
+            if e.get("minimized_plan"):
+                out.append("    minimized repro: FM_SPARK_FAULTS="
+                           f"'{e['minimized_plan']}'")
+        out.append("")
+
     out.append("## Diagnosis")
-    for line in findings(diag, legs):
+    for line in findings(diag, legs) + chaos_findings(chaos):
         out.append(f"  - {line}")
     return "\n".join(out) + "\n"
 
@@ -274,7 +336,8 @@ def main(argv=None) -> int:
             os.path.dirname(os.path.normpath(obs_dir)), "ledger.jsonl")
     legs = _leg_rows(ledger_path, run["run_id"])
     diag = diagnose(run, legs, flight_events)
-    sys.stdout.write(render(run, diag, legs))
+    sys.stdout.write(render(run, diag, legs,
+                            chaos=load_chaos_verdict(obs_dir)))
     return 0
 
 
