@@ -103,6 +103,47 @@ class CheckpointChainBroken(RuntimeError):
     operator's training budget without telling them — surface it."""
 
 
+def _restore_with(mgr, step: int, params_example, opt_state_example):
+    """Restore one committed step through ``mgr`` (shared by the
+    writing :class:`Checkpointer` and the read-only
+    :class:`ChainFollower`); examples pin the pytree structure."""
+    example = {"params": params_example, "opt_state": opt_state_example}
+    with obs.span("checkpoint/restore", step=int(step)):
+        restored = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(example),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+    obs.counter("checkpoint.restores_total").add(1)
+    meta = restored.meta or {}
+    return {
+        "params": restored.state["params"],
+        "opt_state": restored.state["opt_state"],
+        "step": int(step),
+        "pipeline": meta.get("pipeline"),
+        "extra": meta.get("extra"),
+    }
+
+
+def _manifest_matches(result: dict, manifest: dict) -> bool:
+    """Do restored bytes match the manifest recorded at save time?"""
+    checks = manifest.get("checksums")
+    if checks is not None:
+        got = _tree_checksums({"params": result["params"],
+                               "opt_state": result["opt_state"]})
+        if got != checks:
+            return False
+    want_meta = manifest.get("meta_crc")
+    if want_meta is not None:
+        got_meta = _meta_crc({"pipeline": result["pipeline"],
+                              "extra": result["extra"]})
+        if got_meta != want_meta:
+            return False
+    return True
+
+
 class Checkpointer:
     """Orbax-backed training-state checkpointer with a crash-consistent
     verification chain.
@@ -381,40 +422,12 @@ class Checkpointer:
         return saved
 
     def _restore_step(self, step: int, params_example, opt_state_example):
-        example = {"params": params_example, "opt_state": opt_state_example}
-        with obs.span("checkpoint/restore", step=int(step)):
-            restored = self._mgr.restore(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(example),
-                    meta=ocp.args.JsonRestore(),
-                ),
-            )
-        obs.counter("checkpoint.restores_total").add(1)
-        meta = restored.meta or {}
-        return {
-            "params": restored.state["params"],
-            "opt_state": restored.state["opt_state"],
-            "step": int(step),
-            "pipeline": meta.get("pipeline"),
-            "extra": meta.get("extra"),
-        }
+        return _restore_with(self._mgr, step, params_example,
+                             opt_state_example)
 
     def _verified(self, step: int, result: dict, manifest: dict) -> bool:
         """Do the restored bytes match the manifest recorded at save?"""
-        checks = manifest.get("checksums")
-        if checks is not None:
-            got = _tree_checksums({"params": result["params"],
-                                   "opt_state": result["opt_state"]})
-            if got != checks:
-                return False
-        want_meta = manifest.get("meta_crc")
-        if want_meta is not None:
-            got_meta = _meta_crc({"pipeline": result["pipeline"],
-                                  "extra": result["extra"]})
-            if got_meta != want_meta:
-                return False
-        return True
+        return _manifest_matches(result, manifest)
 
     def restore(self, params_example, opt_state_example,
                 step: int | None = None):
@@ -496,6 +509,147 @@ class Checkpointer:
         self._mgr.wait_until_finished()
         self._flush_pending()
         self._mgr.close()
+
+
+class ChainFollower:
+    """Read-only accessor over a checkpoint chain for SERVING followers
+    (ISSUE 12 satellite).
+
+    A serving process that reused :class:`Checkpointer` to poll the
+    trainer's chain would RACE it: ``reopen()``/``restore()`` flush
+    committed-but-pending manifests — a write — and two writers on one
+    chain directory is exactly the torn state the manifest protocol
+    exists to rule out. The follower therefore NEVER mutates the
+    directory: the orbax manager is opened ``read_only``, no manifest
+    or ``last_good`` write path exists on this class, and a step that
+    fails verification is simply skipped (journaled), never repaired.
+
+    Trust model (stricter than :meth:`Checkpointer.restore`): a
+    follower serves ONLY manifest-verified steps. The writer's
+    legacy-directory leniency (pre-chain saves restore unverified) is
+    for resuming one's own training run; a serving fleet must not load
+    a generation nothing ever vouched for. The walk starts from the
+    persisted ``last_good`` pointer and walks BACK through older
+    manifested steps on failure (torn ``last_good``, corrupt bytes,
+    half-GC'd step dirs), returning ``None`` — not raising — when
+    nothing verifies: the serving degraded mode is "keep the old
+    generation", not "die".
+    """
+
+    def __init__(self, directory: str, journal=None):
+        self.directory = os.path.abspath(str(directory))
+        self.journal = journal
+        self._mgr = None
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.emit(event, **fields)
+
+    @property
+    def _manifest_dir(self) -> str:
+        return os.path.join(self.directory, "manifests")
+
+    def last_good_step(self) -> int | None:
+        """The trainer's persisted last VERIFIED step — the atomic
+        publish point this follower polls. ``None`` when absent or
+        torn (an atomic-replace reader never sees a partial write, but
+        a copied/damaged chain can)."""
+        try:
+            with open(os.path.join(self.directory,
+                                   "last_good.json")) as f:
+                step = json.load(f).get("step")
+            return int(step) if step is not None else None
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def _manifest_steps(self) -> list[int]:
+        steps = []
+        try:
+            for fname in os.listdir(self._manifest_dir):
+                if not fname.endswith(".json"):
+                    continue
+                try:
+                    steps.append(int(fname[:-5]))
+                except ValueError:
+                    continue
+        except OSError:
+            pass
+        return steps
+
+    def _read_manifest(self, step: int) -> dict | None:
+        try:
+            with open(os.path.join(self._manifest_dir,
+                                   f"{int(step)}.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _manager(self):
+        if self._mgr is None:
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(read_only=True),
+            )
+        else:
+            # The trainer advances the chain underneath us; re-read the
+            # step list from disk each poll (best-effort — an orbax
+            # without reload() just re-opens next time).
+            try:
+                self._mgr.reload()
+            except Exception:
+                try:
+                    self._mgr.close()
+                except Exception:
+                    pass
+                self._mgr = ocp.CheckpointManager(
+                    self.directory,
+                    options=ocp.CheckpointManagerOptions(read_only=True),
+                )
+        return self._mgr
+
+    def restore(self, params_example, opt_state_example):
+        """Restore the newest manifest-VERIFIED step, walking back past
+        torn/corrupt ones. Returns the same dict as
+        :meth:`Checkpointer.restore`, or ``None`` when no step
+        verifies (including the empty/absent-directory case)."""
+        if not os.path.isdir(self.directory):
+            return None
+        try:
+            committed = set(self._manager().all_steps())
+        except Exception:
+            return None
+        steps = sorted((s for s in self._manifest_steps()
+                        if s in committed), reverse=True)
+        for s in steps:
+            manifest = self._read_manifest(s)
+            if manifest is None:
+                continue
+            try:
+                result = _restore_with(self._manager(), s,
+                                       params_example,
+                                       opt_state_example)
+            except Exception as e:  # noqa: BLE001 — unreadable bytes
+                # are exactly what the walk-back exists for
+                self._emit("checkpoint_unreadable", step=s,
+                           error=f"{type(e).__name__}: "
+                                 f"{(str(e).splitlines() or [''])[0][:200]}")
+                continue
+            if not _manifest_matches(result, manifest):
+                self._emit("checkpoint_corrupt", step=s)
+                continue
+            if s != steps[0]:
+                self._emit("checkpoint_walked_back", from_step=steps[0],
+                           to_step=s)
+            return result
+        return None
+
+    def close(self) -> None:
+        if self._mgr is not None:
+            try:
+                self._mgr.close()
+            except Exception:
+                pass
+            self._mgr = None
 
 
 class PreemptionGuard:

@@ -1683,22 +1683,240 @@ def cmd_eval(args) -> int:
 
 
 def cmd_predict(args) -> int:
-    import jax.numpy as jnp
-
     from fm_spark_tpu import models
+    from fm_spark_tpu.utils import compile_cache
 
+    # Offline batch predict rides the serving engine (ISSUE 12
+    # satellite): the same bucketed AOT executables the online path
+    # dispatches — so --compile-cache/FM_SPARK_COMPILE_CACHE gives a
+    # warm process zero fresh XLA compiles here too. Output is
+    # bit-identical to the pre-engine eager path (padded and unpadded
+    # executions agree exactly; pinned in tests/test_serve.py).
+    if args.compile_cache is not None:
+        compile_cache.enable(args.compile_cache or None)
+    else:
+        compile_cache.enable_from_env()
     spec, params = models.load_model(args.model)
+    engine = None
     out = sys.stdout if args.out in (None, "-") else open(args.out, "w")
     try:
         for bids, bvals, _, w in _batches_for_model(args, spec):
-            preds = np.asarray(
-                spec.predict(params, jnp.asarray(bids), jnp.asarray(bvals))
-            )
+            if engine is None:
+                from fm_spark_tpu.serve import PredictEngine
+
+                # One bucket = the batch size: every iterate_once
+                # batch is already padded to it, so each dispatch is
+                # shape-exact and warmup compiles exactly one program.
+                engine = PredictEngine(
+                    spec, params, nnz=bids.shape[1],
+                    buckets=(args.batch_size,), latency_budget_ms=0.0,
+                )
+                engine.warmup()
+            preds = engine.score(bids, bvals)
             for p in preds[w > 0]:
                 out.write(f"{float(p):.6g}\n")
     finally:
         if out is not sys.stdout:
             out.close()
+    return 0
+
+
+def _serve_opt_example(spec, cfg):
+    """The optimizer-state example a chain follower needs to restore
+    the trainer's checkpoints: ``{}`` for the pure-SGD field families,
+    the dense-head optax state for FieldDeepFM (buildable only with a
+    config naming the optimizer)."""
+    from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
+
+    if not isinstance(spec, FieldDeepFMSpec):
+        return {}
+    if cfg is None:
+        raise SystemExit(
+            "hot reload of a FieldDeepFM chain needs --config (the "
+            "follower must rebuild the optimizer-state structure to "
+            "restore the trainer's checkpoints)"
+        )
+    import jax
+
+    from fm_spark_tpu.train import make_optimizer
+
+    canonical = spec.init(jax.random.key(cfg.seed))
+    return make_optimizer(cfg.train_config()).init(
+        {"w0": canonical["w0"], "mlp": canonical["mlp"]}
+    )
+
+
+def cmd_serve(args) -> int:
+    """Online serving loop (ISSUE 12): the AOT micro-batched engine +
+    hot reload from the checkpoint chain, driven by a bounded request
+    stream (the same dataset plumbing as predict). Emits one summary
+    JSON line: request-latency percentiles, QPS, swap/reload and
+    staleness accounting."""
+    import time as _time
+
+    from fm_spark_tpu import models, obs
+    from fm_spark_tpu.resilience import watchdog
+    from fm_spark_tpu.utils import compile_cache
+    from fm_spark_tpu.utils.logging import EventLog
+
+    if args.compile_cache is not None:
+        compile_cache.enable(args.compile_cache or None)
+    else:
+        compile_cache.enable_from_env()
+
+    _obs_dir = getattr(args, "obs_dir", None)
+    if _obs_dir and _obs_dir.lower() != "none":
+        import os as _os_obs
+
+        _obs_run = obs.new_run_id()
+        obs.configure(_os_obs.path.join(_obs_dir, _obs_run),
+                      run_id=_obs_run, install_signals=True)
+        print(json.dumps({"run_id": _obs_run, "obs_dir": obs.run_dir()}),
+              flush=True)
+
+    if args.slo_ms is not None:
+        # Deadline = the SLO: an overrun becomes a structured
+        # HangDetected + flight dump instead of a silent tail blowup.
+        # An env-configured watchdog (subprocess drills) wins.
+        if not watchdog.active():
+            watchdog.configure({"serve_request": args.slo_ms / 1e3},
+                               action="raise")
+
+    buckets = tuple(sorted({int(b) for b in args.buckets.split(",")
+                            if b}))
+    if not buckets:
+        raise SystemExit(f"--buckets parsed empty from {args.buckets!r}")
+
+    cfg = None
+    if args.config is not None:
+        from fm_spark_tpu import configs as configs_lib
+
+        cfg = configs_lib.get_config(args.config)
+
+    import os as _os
+
+    # The serving journal lands in the run's OWN obs directory, never
+    # in the trainer's chain directory: a serving reader must not
+    # write into (or even create) the chain it follows — the same
+    # contract ChainFollower keeps, and what lets many followers
+    # share one chain without contending on a journal file. With the
+    # obs plane off there is no journal; swaps/failures still show in
+    # the metrics registry and the summary line.
+    journal = None
+    if obs.run_dir():
+        journal = EventLog(
+            _os.path.join(obs.run_dir(), "serve_health.jsonl"),
+            mirror_to_flight=True)
+
+    step0 = 0
+    opt_example = None  # built once; FieldDeepFM's costs a full init
+    if args.model:
+        spec, params = models.load_model(args.model)
+    else:
+        # Serve straight off the trainer's chain: the initial
+        # generation is the newest verified step, read through the
+        # SAME read-only follower the hot-reload path polls.
+        if not (args.checkpoint_dir and cfg is not None):
+            raise SystemExit(
+                "serve needs --model DIR, or --checkpoint-dir with "
+                "--config to follow a training chain"
+            )
+        import jax as _jax_s
+
+        from fm_spark_tpu.checkpoint import ChainFollower
+
+        spec = cfg.spec()
+        init_params = spec.init(_jax_s.random.key(cfg.seed))
+        opt_example = _serve_opt_example(spec, cfg)
+        chain = ChainFollower(args.checkpoint_dir, journal=journal)
+        restored = chain.restore(init_params, opt_example)
+        chain.close()
+        if restored is None:
+            raise SystemExit(
+                f"no verified checkpoint to serve under "
+                f"{args.checkpoint_dir} (the follower trusts only "
+                "manifest-verified steps)"
+            )
+        params, step0 = restored["params"], restored["step"]
+
+    from fm_spark_tpu.serve import PredictEngine, ReloadFollower
+
+    engine = None
+    follower = None
+    out = None
+    if args.out:
+        out = sys.stdout if args.out == "-" else open(args.out, "w")
+    n_requests = 0
+    n_rows = 0
+    t_serve0 = _time.perf_counter()
+    try:
+        for _pass in range(max(args.repeat, 1)):
+            for bids, bvals, _, w in _batches_for_model(args, spec):
+                if engine is None:
+                    engine = PredictEngine(
+                        spec, params, nnz=bids.shape[1], step=step0,
+                        buckets=buckets,
+                        latency_budget_ms=args.latency_budget_ms,
+                        journal=journal,
+                    )
+                    wstats = engine.warmup()
+                    print(json.dumps({
+                        "serving": True, "step": step0,
+                        "buckets": list(buckets),
+                        "warmup_s": wstats["seconds"],
+                        "fresh_compiles": wstats["fresh_compiles"],
+                    }), flush=True)
+                    if args.checkpoint_dir and args.reload_poll_s > 0:
+                        if opt_example is None:
+                            opt_example = _serve_opt_example(spec, cfg)
+                        follower = ReloadFollower(
+                            engine, args.checkpoint_dir,
+                            poll_s=args.reload_poll_s, journal=journal,
+                            opt_state_example=opt_example,
+                        ).start()
+                preds = engine.predict(bids, bvals)
+                if out is not None:
+                    for p in preds[w > 0]:
+                        out.write(f"{float(p):.6g}\n")
+                n_requests += 1
+                n_rows += int((w > 0).sum())
+                if args.max_requests and n_requests >= args.max_requests:
+                    break
+            else:
+                continue
+            break
+    finally:
+        if follower is not None:
+            follower.stop()
+        if engine is not None:
+            engine.close()
+        if out is not None and out is not sys.stdout:
+            out.close()
+    elapsed = _time.perf_counter() - t_serve0
+    req_hist = obs.registry().histogram("serve/request_ms").summary()
+    summary = {
+        "served_requests": n_requests,
+        "served_rows": n_rows,
+        "elapsed_s": round(elapsed, 3),
+        "qps": round(n_requests / elapsed, 2) if elapsed > 0 else None,
+        "request_ms": {k: req_hist[k] for k in
+                       ("count", "mean", "p50", "p95", "p99")},
+        "generation_step": (engine.generation().step
+                            if engine is not None else None),
+        "swaps": follower.reloads if follower is not None else 0,
+        "reload_failures": (follower.failures
+                            if follower is not None else 0),
+        "staleness_steps": int(
+            obs.registry().gauge("serve/staleness_steps").value or 0),
+        "degraded": bool(
+            obs.registry().gauge("serve/degraded").value or 0),
+    }
+    print(json.dumps({"serve_summary": summary}), flush=True)
+    if obs.enabled():
+        obs.export_snapshot()
+        print(json.dumps({
+            "run_doctor": f"python tools/run_doctor.py {obs.run_dir()}",
+        }), flush=True)
     return 0
 
 
@@ -2022,7 +2240,72 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--config", help="config naming the dataset loader")
     add_data_args(pr)
     pr.add_argument("--out", help="output file ('-' = stdout)")
+    pr.add_argument("--compile-cache", nargs="?", const="", default=None,
+                    metavar="DIR", dest="compile_cache",
+                    help="persistent XLA compile cache for the AOT "
+                         "predict executables (bare flag = the "
+                         "repo-local default dir); a warm process "
+                         "deserializes instead of compiling")
     pr.set_defaults(fn=cmd_predict, batch_size=8192)
+
+    sv = sub.add_parser(
+        "serve",
+        help="online serving: AOT micro-batched predict engine with "
+             "hot reload from a checkpoint chain (ISSUE 12)",
+    )
+    sv.add_argument("--model", help="saved model dir (models.io format)")
+    sv.add_argument("--config",
+                    help="config naming the dataset loader / the "
+                         "chain's model family (required with "
+                         "--checkpoint-dir and no --model)")
+    add_data_args(sv)
+    sv.add_argument("--checkpoint-dir", dest="checkpoint_dir",
+                    help="training chain to follow: the initial "
+                         "generation is the newest verified step, and "
+                         "with --reload-poll-s > 0 new last_good "
+                         "publishes hot-swap in")
+    sv.add_argument("--latency-budget-ms", type=float, default=2.0,
+                    dest="latency_budget_ms",
+                    help="how long the coalescer may hold a request "
+                         "waiting for micro-batch peers (0 = dispatch "
+                         "immediately)")
+    sv.add_argument("--buckets", default="1,8,64,512",
+                    help="comma-separated padded-batch buckets; every "
+                         "dispatch pads to one of these shapes, so a "
+                         "warm process never compiles on the request "
+                         "path")
+    sv.add_argument("--reload-poll-s", type=float, default=2.0,
+                    dest="reload_poll_s",
+                    help="how often the follower polls last_good.json "
+                         "(0 = no hot reload)")
+    sv.add_argument("--slo-ms", type=float, default=None, dest="slo_ms",
+                    help="arm the serve_request watchdog phase at this "
+                         "deadline: an overrun becomes a structured "
+                         "HangDetected + flight dump")
+    sv.add_argument("--repeat", type=int, default=1,
+                    help="passes over the request stream (reload drills "
+                         "keep serving while a trainer advances the "
+                         "chain)")
+    sv.add_argument("--max-requests", type=int, default=0,
+                    dest="max_requests",
+                    help="stop after N requests (0 = the full stream)")
+    sv.add_argument("--out",
+                    help="write predictions here ('-' = stdout; "
+                         "default: measured, not dumped)")
+    sv.add_argument("--compile-cache", nargs="?", const="", default=None,
+                    metavar="DIR", dest="compile_cache",
+                    help="persistent XLA compile cache (bare flag = "
+                         "repo-local default): warm serving processes "
+                         "deserialize every bucket executable instead "
+                         "of compiling")
+    import os as _os_sv
+
+    sv.add_argument("--obs-dir", dest="obs_dir",
+                    default=_os_sv.environ.get("FM_SPARK_OBS_DIR",
+                                               "artifacts/obs"),
+                    help="telemetry root (same convention as train); "
+                         "'none' disables")
+    sv.set_defaults(fn=cmd_serve, batch_size=256)
 
     pp = sub.add_parser("preprocess",
                         help="hash raw criteo/avazu text → packed binary")

@@ -31,8 +31,11 @@ _FIELDS = ("rate_samples_per_sec_per_chip", "source", "date")
 # measured values since round 3).
 _REQUIRED = {"headline": _FIELDS, "ffm_avazu": _FIELDS}
 # Entries bench.py MAY write once measured (no carried value exists yet,
-# so their absence is valid).
-_OPTIONAL = {"deepfm_criteo": _FIELDS, "fm_kaggle": _FIELDS}
+# so their absence is valid). "serving" is bench_serve.py's headline
+# (ISSUE 12): scored rows/s/chip through the bucketed AOT request path,
+# promoted through the same sentinel keep-best gate as training legs.
+_OPTIONAL = {"deepfm_criteo": _FIELDS, "fm_kaggle": _FIELDS,
+             "serving": _FIELDS}
 _KNOWN = {**_REQUIRED, **_OPTIONAL}
 
 

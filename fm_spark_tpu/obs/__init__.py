@@ -104,6 +104,7 @@ FAULT_KINDS = frozenset({
     "checkpoint_corrupt", "checkpoint_unverified_skipped",
     "checkpoint_unreadable", "checkpoint_walked_back",
     "backend_init_timeout", "down",
+    "hang_detected", "reload_failed", "serve_batch_failed",
 })
 
 _lock = threading.Lock()

@@ -13,7 +13,12 @@ becomes a query. Three record kinds share one stream:
 - ``kernel_pricing`` — one bench_kernels.py row (measured ms + the
   bytes-model GB/s that is the higher-is-better ``value``);
 - ``attachment_probe`` — one tpu_watch probe outcome, so "attachment
-  weather" has a first-class record stream.
+  weather" has a first-class record stream;
+- ``serve_bench`` — one bench_serve.py ladder rung (ISSUE 12): QPS/chip
+  as the higher-is-better ``value`` with p50/p99 request latency
+  alongside. Serving legs carry their own leg names, so their cohorts
+  never mix with training legs — the sentinel gates serving
+  regressions exactly like training ones, separately.
 
 Every record carries a **measurement fingerprint**
 (:func:`measurement_fingerprint`): the lever-config hash, chip type +

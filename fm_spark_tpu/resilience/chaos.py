@@ -68,12 +68,14 @@ __all__ = [
     "Schedule",
     "ScheduleGenerator",
     "audit",
+    "audit_serve_events",
     "build_shards",
     "golden_run",
     "minimize",
     "oracle_tap",
     "run_campaign",
     "run_schedule",
+    "serve_schedule",
     "write_worker",
 ]
 
@@ -1211,3 +1213,46 @@ def stitch_taps(result: DrillResult) -> list[str]:
         raise ValueError(
             f"tap indices not contiguous: {sorted(effective)[:8]}...")
     return [effective[i] for i in range(max(effective) + 1)]
+
+
+# ------------------------------------------------------- serving (ISSUE 12)
+
+#: The serving-path watchdog phase a hang drill arms (deadline = SLO).
+_SERVE_PHASE = "serve_request"
+
+
+def serve_schedule(seed: int) -> Schedule:
+    """Seeded serving-path fault schedule (ISSUE 12): compositions of
+    trainer-side ``ckpt_commit`` faults (a torn publish window under an
+    active reload follower) and ``serve_reload`` faults (reload
+    failure → degraded serving; ``exit`` = the SIGKILL-mid-reload
+    drill). Same purity contract as :meth:`ScheduleGenerator.schedule`:
+    the plan is a pure function of the seed, so a failing seed IS its
+    repro. The serve drill harness (tests/test_serve.py) runs these
+    against the production engine/follower/checkpointer stack and holds
+    the run to :func:`audit_serve_events`."""
+    rng = random.Random(int(seed))
+    scenario = rng.choice(
+        ("reload_fail", "commit_fault", "reload_storm", "compound"))
+    if scenario == "reload_fail":
+        rules = [f"serve_reload@{rng.randint(1, 2)}=error"]
+    elif scenario == "commit_fault":
+        rules = [f"ckpt_commit@{rng.randint(1, 2)}=error"]
+        if rng.random() < 0.5:
+            rules.append(f"serve_reload@{rng.randint(1, 2)}=error")
+    elif scenario == "reload_storm":
+        rules = ["serve_reload@1=error", "serve_reload@2=error"]
+    else:  # compound: publish fault pressed against a reload failure
+        rules = [f"ckpt_commit@{rng.randint(1, 2)}=error",
+                 f"serve_reload@{rng.randint(1, 3)}=error"]
+    return Schedule(int(seed), f"serve_{scenario}", tuple(rules),
+                    stream_comparable=False).validate()
+
+
+#: Re-export: the auditor lives in the standalone, import-free
+#: :mod:`fm_spark_tpu.resilience.chaos_audit` so jax-light tools
+#: (tools/run_doctor.py) can load it BY PATH without importing the
+#: package; the chaos API keeps its name here.
+from fm_spark_tpu.resilience.chaos_audit import (  # noqa: E402
+    audit_serve_events,
+)

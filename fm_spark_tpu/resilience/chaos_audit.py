@@ -1,0 +1,96 @@
+"""Serving-invariant auditor (ISSUE 12) — standalone and import-free.
+
+Split out of :mod:`fm_spark_tpu.resilience.chaos` (which re-exports it)
+so jax-light tools can load this file BY PATH without importing the
+package: ``tools/run_doctor.py`` audits every serve run it renders, and
+the doctor's import-light contract (PR 9) is exactly why the ledger and
+sentinel live in standalone-loadable modules too.
+"""
+
+from __future__ import annotations
+
+__all__ = ["audit_serve_events"]
+
+
+def _violation(invariant: str, detail: str) -> dict:
+    return {"invariant": invariant, "detail": detail}
+
+
+def audit_serve_events(events: list[dict], *,
+                       final_staleness: int | None = None,
+                       staleness_bound: int = 0,
+                       rc: int | None = None,
+                       allowed_rcs=(0,)) -> list[dict]:
+    """Serving invariants over a run's event stream (ISSUE 12) —
+    flight-ring records (``kind``) and journal records (``event``)
+    both read. Empty list = green. The three contracts:
+
+    - **no_torn_swap** — every observed ``serve_swap`` advances the
+      generation monotonically (step strictly up, ``gen_id`` by
+      exactly one): a regressed or duplicated generation means a
+      request could have seen a mixture of model states;
+    - **staleness_bounded** — after recovery the served generation is
+      within ``staleness_bound`` steps of the chain's published tip
+      (``final_staleness`` from the ``serve/staleness_steps`` gauge);
+    - **rc_discipline** — a drilled serving process ends with an
+      expected rc (0, the watchdog's HANG_EXIT_RC, or the injected
+      exit code — never an unexplained death).
+
+    Degraded mode is additionally held to its journaling contract:
+    every ``reload_failed`` names the step it kept serving.
+    """
+    v: list[dict] = []
+    last_step: int | None = None
+    last_gen: int | None = None
+    seen_swaps: set = set()
+    for e in events:
+        kind = e.get("kind") or e.get("event")
+        if kind == "serve_swap":
+            step, gid = e.get("step"), e.get("gen_id")
+            if step is None:
+                v.append(_violation(
+                    "no_torn_swap",
+                    "serve_swap event missing its generation step"))
+                continue
+            # One swap can reach the stream via two transports (the
+            # journal AND its flight-ring mirror): an event identical
+            # in (step, gen_id, from_step) is the same swap observed
+            # twice, not a duplicated swap. A REAL duplicate (same
+            # gen_id, different step — or vice versa) still trips the
+            # monotonicity checks below.
+            key = (step, gid, e.get("from_step"))
+            if key in seen_swaps:
+                continue
+            seen_swaps.add(key)
+            if last_step is not None and step <= last_step:
+                v.append(_violation(
+                    "no_torn_swap",
+                    f"swap to step {step} after step {last_step} — "
+                    "generations must advance monotonically"))
+            if (gid is not None and last_gen is not None
+                    and gid != last_gen + 1):
+                v.append(_violation(
+                    "no_torn_swap",
+                    f"gen_id jumped {last_gen} -> {gid} — a swap was "
+                    "lost or duplicated"))
+            last_step = step
+            last_gen = gid if gid is not None else last_gen
+        elif kind == "reload_failed":
+            if e.get("served_step") is None and "poll loop" not in str(
+                    e.get("error", "")):
+                v.append(_violation(
+                    "degraded_journaled",
+                    "reload_failed event does not name the generation "
+                    "it kept serving"))
+    if final_staleness is not None and final_staleness > staleness_bound:
+        v.append(_violation(
+            "staleness_bounded",
+            f"served generation {final_staleness} step(s) behind the "
+            f"published chain tip (bound {staleness_bound}) after "
+            "recovery"))
+    if rc is not None and rc not in tuple(allowed_rcs):
+        v.append(_violation(
+            "rc_discipline",
+            f"serving process exited rc={rc}; expected one of "
+            f"{tuple(allowed_rcs)}"))
+    return v

@@ -69,7 +69,11 @@ ENV_STATE = "FM_SPARK_FAULTS_STATE"
 #: fires per chunk read in data/stream.ShardReader (a failing/truncated
 #: shard read), ``ingest_corrupt`` fires per record before parse in
 #: StreamBatches (an injected ``error`` there IS a corrupt record and
-#: takes the active quarantine/strict policy path).
+#: takes the active quarantine/strict policy path). Serving (ISSUE 12):
+#: ``serve_reload`` fires at the start of each hot-reload attempt in
+#: serve/reload.py — an ``error`` there exercises the degraded-serving
+#: path (old generation keeps serving), an ``exit`` is the
+#: SIGKILL-during-reload drill.
 KNOWN_POINTS = (
     "backend_init",
     "sweep_leg",
@@ -78,6 +82,7 @@ KNOWN_POINTS = (
     "ckpt_commit",
     "ingest_corrupt",
     "ingest_truncate",
+    "serve_reload",
 )
 
 #: The action vocabulary (public since ISSUE 10: the chaos schedule

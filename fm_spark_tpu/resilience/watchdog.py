@@ -65,9 +65,12 @@ HANG_EXIT_RC = 87
 
 #: Guarded production phases (the registry the chaos auditor samples
 #: deadlines for): the shard reader's chunk read (data/stream.py), the
-#: checkpoint manifest-commit window (checkpoint.py), and one training
-#: step including its batch fetch (train.py).
-KNOWN_PHASES = ("ingest_chunk", "ckpt_commit", "step_window")
+#: checkpoint manifest-commit window (checkpoint.py), one training
+#: step including its batch fetch (train.py), and one serving
+#: micro-batch execute — deadline = the SLO — in the predict engine
+#: (serve/engine.py, ISSUE 12).
+KNOWN_PHASES = ("ingest_chunk", "ckpt_commit", "step_window",
+                "serve_request")
 
 _ACTIONS = ("raise", "exit")
 

@@ -1,0 +1,35 @@
+"""Low-latency online serving runtime (ISSUE 12).
+
+The request path for the millions-of-users north star, composed from
+pieces other PRs battle-tested:
+
+- :mod:`~fm_spark_tpu.serve.engine` — the AOT micro-batched
+  :class:`PredictEngine`: per-bucket executables compiled once at
+  warmup through the PR-1 persistent compile cache (zero fresh XLA
+  compiles on the request path), a request coalescer under an explicit
+  latency budget, and an atomically swappable model generation;
+- :mod:`~fm_spark_tpu.serve.reload` — the :class:`ReloadFollower`:
+  hot model reload by polling the checkpoint chain's ``last_good``
+  publish point through the read-only
+  :class:`~fm_spark_tpu.checkpoint.ChainFollower`, with degraded mode
+  (keep serving the old generation) and a bounded-staleness gauge;
+- ``bench_serve.py`` (repo root) — the latency/throughput ladder that
+  stamps p50/p99 + QPS/chip into the PR-9 ledger as ``serve_bench``
+  records, sentinel-gated exactly like training legs.
+"""
+
+from fm_spark_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    Generation,
+    PredictEngine,
+    ServeFuture,
+)
+from fm_spark_tpu.serve.reload import ReloadFollower
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Generation",
+    "PredictEngine",
+    "ReloadFollower",
+    "ServeFuture",
+]

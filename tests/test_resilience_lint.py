@@ -245,3 +245,44 @@ def test_fault_point_coverage_catches_untested_point(tmp_path):
     found = lint.fault_point_coverage_violations(
         tests_dir=str(tests_dir), faults_path=str(empty))
     assert found and "no KNOWN_POINTS" in found[0]
+
+
+# ----------------------------------------- watchdog phase coverage (ISSUE 12)
+
+
+def test_watchdog_phase_coverage_clean_on_shipped_registry():
+    """Every KNOWN_PHASES entry — including the new serve_request SLO
+    phase — is exercised by at least one tier-1 test in the tree."""
+    lint = _load_lint()
+    found = lint.watchdog_phase_coverage_violations()
+    assert found == [], "\n".join(found)
+
+
+def test_watchdog_phase_coverage_catches_unarmed_phase(tmp_path):
+    """A guarded phase no test names turns the lint red — deadlines
+    can't ship unexercised, same policy as fault points."""
+    lint = _load_lint()
+    wd = tmp_path / "watchdog.py"
+    wd.write_text(
+        'KNOWN_PHASES = (\n    "step_window",\n    "brand_new_phase",\n)\n')
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_x.py").write_text(
+        'def test_a():\n    assert "step_window"\n')
+    found = lint.watchdog_phase_coverage_violations(
+        tests_dir=str(tests_dir), watchdog_path=str(wd))
+    assert len(found) == 1 and "brand_new_phase" in found[0]
+    empty = tmp_path / "empty.py"
+    empty.write_text("x = 1\n")
+    found = lint.watchdog_phase_coverage_violations(
+        tests_dir=str(tests_dir), watchdog_path=str(empty))
+    assert found and "no KNOWN_PHASES" in found[0]
+
+
+def test_serve_runtime_in_strict_eventlog_scope():
+    """ISSUE 12: the serving runtime's state transitions are held to
+    the EventLog-only rule — the default-scope scan covers serve/."""
+    lint = _load_lint()
+    assert os.path.isdir(lint.SERVE_DIR)
+    # The shipped serve/ modules are clean under the full default scan.
+    assert lint.violations() == []

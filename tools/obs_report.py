@@ -130,9 +130,29 @@ def load_run(obs_dir: str) -> dict:
         (dump or {}).get("metrics"),
         "dump": dump,
         "timeline": timeline,
+        "flight_events": flight_events,
         "dead": dead,
         "kernel_pricing": pricing,
     }
+
+
+def serve_timeline(flight_events: list[dict]) -> list[dict]:
+    """The serving reload/swap timeline from a flight window (ISSUE
+    12): ``serve_*``/``reload_*`` events, payload-deduped — a
+    journaled event and its flight-ring mirror are the same
+    transition. Shared by this report and ``tools/run_doctor.py``."""
+    seen, out = set(), []
+    for e in flight_events:
+        if not str(e.get("kind", "")).startswith(("serve_", "reload_")):
+            continue
+        key = json.dumps({k: v for k, v in e.items()
+                          if k not in ("seq", "ts")},
+                         sort_keys=True, default=str)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    return out
 
 
 def _fmt_ms(v) -> str:
@@ -217,6 +237,23 @@ def render(run: dict) -> str:
     else:
         out.append("(clean run: no fault events)")
     out.append("")
+
+    # Serving reload timeline (ISSUE 12): swaps, reload failures, and
+    # warmup events from the flight window — the hot-reload story the
+    # fault timeline's FAULT_KINDS filter only partially covers.
+    serve_events = serve_timeline(run.get("flight_events", []))
+    if serve_events:
+        out.append(f"## Serving reload timeline "
+                   f"({len(serve_events)} events)")
+        t0 = serve_events[0].get("ts") or 0.0
+        for rec in serve_events:
+            extras = {k: v for k, v in rec.items()
+                      if k not in ("ts", "kind", "seq")}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(
+                extras.items()))
+            out.append(f"  +{(rec.get('ts') or t0) - t0:>9.3f}s "
+                       f"{rec['kind']:24} {detail}"[:200])
+        out.append("")
 
     dead = run["dead"]
     if dead:

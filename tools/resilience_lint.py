@@ -59,6 +59,13 @@ test (:func:`fault_point_coverage_violations`) — a new injection point
 cannot ship untested, because an unexercised recovery path is exactly
 the blind spot the chaos campaign exists to close.
 
+Since ISSUE 12 the serving runtime (``fm_spark_tpu/serve/``,
+:data:`SERVE_DIR`) joins the strict EventLog-only scope, and the
+fault-coverage idea extends to the watchdog:
+every ``watchdog.KNOWN_PHASES`` entry — including the new
+``serve_request`` SLO phase — must appear in at least one tier-1 test
+(:func:`watchdog_phase_coverage_violations`).
+
 Usage::
 
     python tools/resilience_lint.py        # exit 1 on violations
@@ -82,6 +89,14 @@ EXTRA_FILES = (
     os.path.join(REPO, "fm_spark_tpu", "data", "native_stream.py"),
     os.path.join(REPO, "fm_spark_tpu", "native", "__init__.py"),
 )
+
+#: The serving runtime (ISSUE 12) is held to the same EventLog-only
+#: rule as resilience/: its state transitions (generation swaps,
+#: degraded-mode reload failures, batch failures) are exactly the
+#: machine-readable narrative a serving fleet's operator tooling
+#: consumes — a stray print or hand-rolled JSON write there forks the
+#: contract at the highest-QPS spot in the codebase.
+SERVE_DIR = os.path.join(REPO, "fm_spark_tpu", "serve")
 
 #: (filename, enclosing function) pairs exempt from the JSON-write rule.
 ALLOWLIST = {
@@ -400,6 +415,58 @@ def fault_point_coverage_violations(tests_dir: str | None = None,
     ]
 
 
+def _known_phases(watchdog_path: str) -> list[str]:
+    """AST-extract the ``KNOWN_PHASES`` literal from watchdog.py —
+    same no-import policy as :func:`_known_points`."""
+    with open(watchdog_path) as f:
+        tree = ast.parse(f.read(),
+                         filename=os.path.basename(watchdog_path))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "KNOWN_PHASES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def watchdog_phase_coverage_violations(tests_dir: str | None = None,
+                                       watchdog_path: str | None = None
+                                       ) -> list[str]:
+    """Watchdog-phase coverage rule (ISSUE 12 satellite): every
+    ``KNOWN_PHASES`` entry must appear in at least one tier-1 test
+    module — the ``serve_request`` phase (deadline = the serving SLO)
+    joins the registry with this PR, and a guarded phase no test ever
+    arms is a deadline that can rot silently, exactly like an
+    unexercised fault point."""
+    tests_dir = tests_dir or os.path.join(REPO, "tests")
+    watchdog_path = watchdog_path or os.path.join(
+        REPO, "fm_spark_tpu", "resilience", "watchdog.py")
+    phases = _known_phases(watchdog_path)
+    if not phases:
+        return [f"{os.path.basename(watchdog_path)}: no KNOWN_PHASES "
+                "literal found — the watchdog registry has no anchor "
+                "to check coverage against"]
+    texts = []
+    try:
+        for fname in sorted(os.listdir(tests_dir)):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                with open(os.path.join(tests_dir, fname)) as f:
+                    texts.append(f.read())
+    except OSError as e:
+        return [f"tests dir unreadable ({e})"]
+    blob = "\n".join(texts)
+    return [
+        f"watchdog phase {p!r} (KNOWN_PHASES) is exercised by no test "
+        "under tests/ — a guarded phase must ship with at least one "
+        "tier-1 test that names it"
+        for p in phases if p not in blob
+    ]
+
+
 def bench_leg_record_violations(path: str | None = None) -> list[str]:
     """Provenance rule (ISSUE 9): bench.py's ``leg_record`` dict
     literal must carry :data:`LEG_RECORD_REQUIRED_KEYS` — the AST half
@@ -440,7 +507,8 @@ def bench_leg_record_violations(path: str | None = None) -> list[str]:
 def violations(root: str | None = None) -> list[str]:
     """Violations under ``root`` (a directory); with the default root,
     the shipped surface is checked — every resilience/ module plus
-    :data:`EXTRA_FILES` (data/stream.py)."""
+    :data:`EXTRA_FILES` (data/stream.py) and the serving runtime
+    (:data:`SERVE_DIR`, ISSUE 12)."""
     default = root is None
     root = root or RESILIENCE_DIR
     out = []
@@ -451,6 +519,11 @@ def violations(root: str | None = None) -> list[str]:
     if default:
         for path in EXTRA_FILES:
             out.extend(_check_file(path))
+        if os.path.isdir(SERVE_DIR):
+            for fname in sorted(os.listdir(SERVE_DIR)):
+                if fname.endswith(".py"):
+                    out.extend(_check_file(
+                        os.path.join(SERVE_DIR, fname)))
     return out
 
 
@@ -459,7 +532,8 @@ def main() -> int:
              + kernel_fallback_violations()
              + duration_time_violations()
              + bench_leg_record_violations()
-             + fault_point_coverage_violations())
+             + fault_point_coverage_violations()
+             + watchdog_phase_coverage_violations())
     for v in found:
         print(v, file=sys.stderr)
     if found:

@@ -15,9 +15,13 @@ turns the run's streams into ONE screen a human can act on:
   run: variant, rate, the sentinel verdict, attachment health, HBM
   peak, and the degraded/fused_fallback stamps;
 - **Fault timeline** — event-kind counts plus total backoff seconds;
+- **Serving** (ISSUE 12) — request/batch latency percentiles, the
+  ``serve_bench`` ledger rows with their sentinel verdicts, the
+  reload/swap timeline, staleness + degraded-mode state, and the
+  chaos auditor's serving-invariant verdict;
 - **Diagnosis** — the doctor's findings: cold-cache compile domination,
   attachment weather, ingest-bound execution, degraded/fallback legs,
-  statistically-regressed legs.
+  statistically-regressed legs, stale/degraded/regressed serving.
 
 The ledger is found beside the run dir by default
 (``<run_dir>/../ledger.jsonl`` — the cross-run convention) or via
@@ -70,6 +74,90 @@ def _leg_rows(ledger_path: str, run_id: str) -> list[dict]:
                                  "ledger.py"), "_doctor_ledger")
     return lg.PerfLedger(ledger_path).records(kind="bench_leg",
                                               run_id=run_id)
+
+
+def _serve_rows(ledger_path: str, run_id: str) -> list[dict]:
+    """This run's serve_bench ledger records (ISSUE 12)."""
+    lg = _load_file(os.path.join(_REPO, "fm_spark_tpu", "obs",
+                                 "ledger.py"), "_doctor_ledger")
+    return lg.PerfLedger(ledger_path).records(kind="serve_bench",
+                                              run_id=run_id)
+
+
+def serve_diagnose(run: dict, timeline: list[dict],
+                   serve_legs: list[dict]) -> dict | None:
+    """The serving view of a run (ISSUE 12): latency percentiles from
+    the serve histograms, the reload/swap timeline (pre-deduped by
+    ``obs_report.serve_timeline``), staleness and degraded-mode state,
+    and the chaos auditor's serving-invariant verdict over the
+    observed event stream. ``None`` when the run has no serving
+    footprint."""
+    snap = run.get("snapshot") or {}
+    hists = {k: v for k, v in (snap.get("histograms") or {}).items()
+             if k.startswith("serve/")}
+    gauges = snap.get("gauges") or {}
+    counters = snap.get("counters") or {}
+    if not (hists or timeline or serve_legs):
+        return None
+    # Standalone by-path load (fm_spark_tpu/resilience/chaos_audit.py
+    # is import-free by design) — the doctor stays jax-light.
+    audit = _load_file(
+        os.path.join(_REPO, "fm_spark_tpu", "resilience",
+                     "chaos_audit.py"), "_doctor_chaos_audit")
+
+    staleness = gauges.get("serve/staleness_steps")
+    # Staleness here is an OBSERVATION, not an invariant verdict: a
+    # server that exits mid-stream is honestly behind the tip, and
+    # only a drill (which knows recovery completed) may hold a bound
+    # against it — so the doctor reports it as a finding below and
+    # audits the event stream for torn swaps only.
+    violations = audit.audit_serve_events(timeline)
+    return {
+        "histograms": hists,
+        "timeline": timeline,
+        "staleness_steps": staleness,
+        "degraded": bool(gauges.get("serve/degraded") or 0),
+        "swaps": counters.get("serve.swaps_total") or 0,
+        "reload_failures": counters.get(
+            "serve.reload_failures_total") or 0,
+        "requests": counters.get("serve.requests_total") or 0,
+        "batches": counters.get("serve.batches_total") or 0,
+        "violations": violations,
+    }
+
+
+def serve_findings(serve: dict | None, serve_legs: list[dict]
+                   ) -> list[str]:
+    """Serving one-liners for the diagnosis section."""
+    if serve is None:
+        return []
+    out = []
+    for v in serve["violations"]:
+        out.append(f"SERVE INVARIANT VIOLATED — {v['invariant']}: "
+                   f"{v['detail']}")
+    if serve["degraded"]:
+        out.append(
+            "serving DEGRADED: the last reload attempt failed "
+            f"({serve['reload_failures']:.0f} failure(s)) — the old "
+            "generation keeps serving; check the chain")
+    elif serve["staleness_steps"]:
+        out.append(
+            f"serving stale: {serve['staleness_steps']:.0f} step(s) "
+            "behind the published chain tip")
+    for r in serve_legs:
+        v = (r.get("sentinel") or {}).get("verdict")
+        if v == "regressed":
+            out.append(
+                f"SERVING REGRESSED: {r.get('leg')} at "
+                f"{r.get('value'):,.0f} rows/s — "
+                f"{(r.get('sentinel') or {}).get('reason')}")
+    if not out and (serve["requests"] or serve_legs):
+        out.append(
+            f"serving clean: {serve['requests']:.0f} request(s) in "
+            f"{serve['batches']:.0f} micro-batch(es), "
+            f"{serve['swaps']:.0f} hot swap(s), staleness "
+            f"{serve['staleness_steps'] or 0:.0f}")
+    return out
 
 
 def diagnose(run: dict, legs: list[dict],
@@ -230,7 +318,8 @@ def findings(diag: dict, legs: list[dict]) -> list[str]:
 
 
 def render(run: dict, diag: dict, legs: list[dict],
-           chaos: dict | None = None) -> str:
+           chaos: dict | None = None, serve: dict | None = None,
+           serve_legs: list[dict] | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -295,8 +384,50 @@ def render(run: dict, diag: dict, legs: list[dict],
                            f"'{e['minimized_plan']}'")
         out.append("")
 
+    serve_legs = serve_legs or []
+    if serve is not None:
+        out.append("## Serving")
+        if serve["histograms"]:
+            out.append(f"  {'latency':28} {'count':>8} {'mean_ms':>10} "
+                       f"{'p50':>10} {'p95':>10} {'p99':>10}")
+            for name in sorted(serve["histograms"]):
+                s = serve["histograms"][name]
+                out.append(
+                    f"  {name:28} {s.get('count', 0):>8.0f} "
+                    f"{s.get('mean') if s.get('mean') is not None else '-':>10} "
+                    f"{s.get('p50') if s.get('p50') is not None else '-':>10} "
+                    f"{s.get('p95') if s.get('p95') is not None else '-':>10} "
+                    f"{s.get('p99') if s.get('p99') is not None else '-':>10}")
+        if serve_legs:
+            out.append(f"  {'serve leg':24} {'rows/s/chip':>14} "
+                       f"{'p50_ms':>9} {'p99_ms':>9} {'verdict':>22}")
+            for r in serve_legs:
+                v = r.get("value")
+                out.append(
+                    f"  {str(r.get('leg'))[:24]:24} "
+                    f"{(f'{v:,.0f}' if isinstance(v, (int, float)) else '-'):>14} "
+                    f"{r.get('p50_ms', '-'):>9} {r.get('p99_ms', '-'):>9} "
+                    f"{((r.get('sentinel') or {}).get('verdict') or '?'):>22}")
+        if serve["timeline"]:
+            out.append("  reload timeline:")
+            t0 = serve["timeline"][0].get("ts") or 0.0
+            for e in serve["timeline"]:
+                extras = {k: v for k, v in e.items()
+                          if k not in ("ts", "kind", "seq")}
+                detail = " ".join(f"{k}={v}" for k, v in
+                                  sorted(extras.items()))
+                out.append(f"    +{(e.get('ts') or t0) - t0:>8.3f}s "
+                           f"{e.get('kind'):20} {detail}"[:160])
+        out.append(
+            f"  swaps {serve['swaps']:.0f}  reload_failures "
+            f"{serve['reload_failures']:.0f}  staleness "
+            f"{serve['staleness_steps'] or 0:.0f}  degraded "
+            f"{str(serve['degraded']).lower()}")
+        out.append("")
+
     out.append("## Diagnosis")
-    for line in findings(diag, legs) + chaos_findings(chaos):
+    for line in (findings(diag, legs) + chaos_findings(chaos)
+                 + serve_findings(serve, serve_legs)):
         out.append(f"  - {line}")
     return "\n".join(out) + "\n"
 
@@ -335,9 +466,13 @@ def main(argv=None) -> int:
         ledger_path = os.path.join(
             os.path.dirname(os.path.normpath(obs_dir)), "ledger.jsonl")
     legs = _leg_rows(ledger_path, run["run_id"])
+    serve_legs = _serve_rows(ledger_path, run["run_id"])
     diag = diagnose(run, legs, flight_events)
+    serve = serve_diagnose(run, obs_report.serve_timeline(flight_events),
+                           serve_legs)
     sys.stdout.write(render(run, diag, legs,
-                            chaos=load_chaos_verdict(obs_dir)))
+                            chaos=load_chaos_verdict(obs_dir),
+                            serve=serve, serve_legs=serve_legs))
     return 0
 
 
