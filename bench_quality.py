@@ -500,6 +500,54 @@ ORACLES = {
 }
 
 
+def online_smoke():
+    """The continuous-learning quality trajectory (ISSUE 13): run the
+    online protocol on the planted task with a label-flip drift at
+    ``drift_day`` and report the day-over-day eval AUC series, the
+    sentry verdict, and the rollback accounting as one JSON line —
+    the maintained source of PERF.md's round-17 reference trajectory.
+    Passes iff the sentry fires at exactly the first drifted eval day
+    and the post-rollback chain tip is a non-demoted generation."""
+    import tempfile
+
+    jax = _jax()  # noqa: F841 — force the CPU-guarded backend up front
+    from fm_spark_tpu import models, online
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data import synthetic_ctr
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+
+    n_days, drift_day = 8, 5
+    ids, vals, labels = synthetic_ctr(
+        4096, TASK["num_fields"] * TASK["bucket"], TASK["num_fields"],
+        rank=TASK["planted_rank"], seed=TASK["seed"])
+    days = online.flip_labels(
+        online.split_days(ids, vals, labels, n_days), drift_day)
+    spec = models.FMSpec(num_features=TASK["num_fields"] * TASK["bucket"],
+                         rank=TASK["rank"], init_std=0.05)
+    trainer = FMTrainer(spec, TrainConfig(
+        num_steps=0, batch_size=128, learning_rate=TRAIN["lr"],
+        lr_schedule="constant", optimizer="ftrl", log_every=10_000))
+    trainer.logger._stream = None
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d, save_every=10**9, async_save=False)
+        summary = online.run_online(trainer, days, ck,
+                                    sentry=online.drift_guard())
+        stones = ck.tombstoned_steps()
+        ck.close()
+    rolled = [e for e in summary["days"] if e["rolled_back"]]
+    ok = (summary["rollbacks"] >= 1
+          and bool(rolled) and rolled[0]["eval_day"] == drift_day
+          and summary["last_good"] not in stones)
+    print(json.dumps({
+        "online_smoke": True, "drift_day": drift_day,
+        "days": summary["days"], "rollbacks": summary["rollbacks"],
+        "demoted_steps": summary["demoted_steps"],
+        "last_good": summary["last_good"],
+        "all_pass": ok,
+    }))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="fm", choices=list(ORACLES),
@@ -508,7 +556,17 @@ def main():
     ap.add_argument("--variants", nargs="*", default=None,
                     choices=list(VARIANTS))
     ap.add_argument("--skip-oracle", action="store_true")
+    ap.add_argument("--online-smoke", action="store_true",
+                    dest="online_smoke",
+                    help="run the continuous-learning quality "
+                         "trajectory instead of the oracle chains "
+                         "(ISSUE 13): planted drift at day 5 must "
+                         "fire the sentry at exactly that eval day "
+                         "and roll back")
     args = ap.parse_args()
+
+    if args.online_smoke:
+        return online_smoke()
 
     names = args.variants
     if names is None:

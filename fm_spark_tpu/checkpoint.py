@@ -30,6 +30,25 @@ event, never an exception — until the newest verified step restores.
 The divergence guard and the elastic mesh-shrink path both resume
 through exactly this ``last_good`` contract.
 
+Coordinated rollback / demotion (ISSUE 13): continuous learning adds a
+failure mode verification cannot catch — a save whose BYTES are
+perfectly intact but whose MODEL was later judged bad (concept drift on
+the day-over-day eval, a divergence verdict). Such a generation has
+already been published through ``last_good`` and a serving follower may
+be about to load it, so "judged bad" must be a durable, crash-consistent
+chain state, not an in-memory flag: :meth:`Checkpointer.demote` writes
+an atomic TOMBSTONE (``tombstones/<step>.json``, the demotion verdict)
+FIRST and only then republishes ``last_good`` at the newest verified
+non-tombstoned step. Every reader — :meth:`Checkpointer.restore`'s
+walk-back, the read-only :class:`ChainFollower`, and through it the
+serving hot-reload path — treats a tombstone as an unconditional veto,
+so a crash BETWEEN the tombstone write and the pointer republish leaves
+a chain that is still safe: the pointer may vouch for a demoted step,
+but nothing will load it, and the next demotion/flush repairs the
+pointer. Step numbers are never reused after a demotion (the online
+loop continues the step axis past the tombstoned frontier), which keeps
+serving's generation-monotonicity invariant intact.
+
 Final-model export (the reference's ``FMModel.save``) is separate and
 lighter: :mod:`fm_spark_tpu.models.io`.
 """
@@ -95,6 +114,76 @@ def _atomic_write_json(path: str, obj: dict) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def _step_json_names(directory: str) -> list[int]:
+    """Steps named by ``<step>.json`` files in ``directory`` (the
+    manifest and tombstone layout); missing dir = empty."""
+    steps = []
+    try:
+        for fname in os.listdir(directory):
+            if not fname.endswith(".json"):
+                continue
+            try:
+                steps.append(int(fname[:-5]))
+            except ValueError:
+                continue
+    except OSError:
+        pass
+    return steps
+
+
+def _manifest_steps(manifest_dir: str) -> list[int]:
+    return _step_json_names(manifest_dir)
+
+
+class _Tombstones:
+    """The vetoed-step view: ``<step>.json`` singles plus
+    ``range_<floor>_<tip>.json`` range stones (one ATOMIC file vetoing
+    every step in ``(floor, tip]`` — how ``demote_newer_than`` rules
+    out the partial-demotion crash window a per-step loop would have).
+    Membership tests against the INTERVALS — a range spanning a real
+    training day covers ~10⁵⁻⁶ steps, and this view sits on the
+    follower-poll / walk-back / save-flush hot paths, so it must never
+    materialize the span."""
+
+    __slots__ = ("singles", "ranges")
+
+    def __init__(self, singles: set[int], ranges: list[tuple[int, int]]):
+        self.singles = singles
+        self.ranges = ranges
+
+    def __contains__(self, step) -> bool:
+        step = int(step)
+        if step in self.singles:
+            return True
+        return any(floor < step <= tip for floor, tip in self.ranges)
+
+    def __bool__(self) -> bool:
+        return bool(self.singles or self.ranges)
+
+    def frontier(self) -> int:
+        tips = [max(self.singles)] if self.singles else []
+        tips += [tip for _, tip in self.ranges]
+        return max(tips) if tips else 0
+
+
+def _read_tombstones(tombstone_dir: str) -> _Tombstones:
+    singles = set(_step_json_names(tombstone_dir))
+    ranges = []
+    try:
+        names = os.listdir(tombstone_dir)
+    except OSError:
+        names = []
+    for fname in names:
+        if not (fname.startswith("range_") and fname.endswith(".json")):
+            continue
+        parts = fname[len("range_"):-len(".json")].split("_")
+        try:
+            ranges.append((int(parts[0]), int(parts[1])))
+        except (IndexError, ValueError):
+            continue
+    return _Tombstones(singles, ranges)
 
 
 class CheckpointChainBroken(RuntimeError):
@@ -268,6 +357,152 @@ class Checkpointer:
     def _last_good_path(self) -> str:
         return os.path.join(self.directory, "last_good.json")
 
+    @property
+    def _tombstone_dir(self) -> str:
+        return os.path.join(self.directory, "tombstones")
+
+    def _stones(self) -> _Tombstones:
+        """The interval view every hot path tests membership against
+        (re-read from disk — demotion is a cross-process event)."""
+        return _read_tombstones(self._tombstone_dir)
+
+    def tombstoned_steps(self) -> set[int]:
+        """The vetoed steps, EXPANDED — tools/tests/auditor accessor;
+        hot paths use the interval view instead (a range stone can
+        span a whole training day)."""
+        stones = self._stones()
+        out = set(stones.singles)
+        for floor, tip in stones.ranges:
+            out.update(range(floor + 1, tip + 1))
+        return out
+
+    def is_tombstoned(self, step: int) -> bool:
+        return int(step) in self._stones()
+
+    def tombstone_frontier(self) -> int:
+        """The highest demoted step (0 when none): the step axis must
+        continue PAST it — a post-rollback save reusing a demoted step
+        number would resurrect the vetoed generation's slot."""
+        return self._stones().frontier()
+
+    def _n_quarantined(self) -> int:
+        """How many EXISTING saves the tombstones veto (the gauge
+        value): a range stone vetoes every step in its span, but only
+        steps that actually have data/manifests count as quarantined
+        generations."""
+        stones = self._stones()
+        known = set(self._mgr.all_steps()) | set(
+            _manifest_steps(self._manifest_dir))
+        return sum(1 for s in known if s in stones)
+
+    def demote(self, step: int, reason: str = "") -> bool:
+        """Durably demote one committed save: the coordinated-rollback
+        primitive (ISSUE 13).
+
+        Write order is the crash-consistency contract: (1) the
+        tombstone — one atomic JSON naming the step and verdict — then
+        (2) the republished ``last_good`` pointer at the newest
+        verified NON-tombstoned step. A SIGKILL at any point leaves a
+        safe chain: before (1) nothing happened (the caller retries);
+        between (1) and (2) the pointer still vouches for the demoted
+        step, but every reader checks tombstones first, so the
+        generation cannot be restored or hot-loaded, and the next
+        demote/flush repairs the pointer. The ``ckpt_demote`` fault
+        point sits exactly in that window. Returns False (no-op) when
+        the step is already tombstoned.
+        """
+        step = int(step)
+        stones = self._stones()
+        if step in stones:
+            lg = self.last_good_step()
+            if lg is not None and lg in stones:
+                self._republish_last_good()  # crash-window repair
+            return False
+        with obs.span("checkpoint/demote", step=step):
+            os.makedirs(self._tombstone_dir, exist_ok=True)
+            _atomic_write_json(
+                os.path.join(self._tombstone_dir, f"{step}.json"),
+                {"step": step, "reason": str(reason)[:500],
+                 "ts": round(time.time(), 3)})
+            self._emit("generation_demoted", step=step,
+                       reason=str(reason)[:200])
+            obs.counter("checkpoint.demotions_total").add(1)
+            obs.gauge("checkpoint/quarantined_generations").set(
+                self._n_quarantined())
+            # The demotion-crash window: tombstone durable, pointer not
+            # yet republished (drift alarm racing ckpt_commit / a kill
+            # mid-rollback land here).
+            faults.inject("ckpt_demote")
+            self._republish_last_good()
+        return True
+
+    def demote_newer_than(self, step: int, reason: str = "") -> list[int]:
+        """Demote every committed-or-manifested step strictly newer
+        than ``step`` (the pre-drift save) with ONE atomic range
+        tombstone vetoing ``(step, tip]`` — a kill can therefore never
+        leave a partially-demoted suffix where some bad generation is
+        still trusted — then republish the pointer. The ``ckpt_demote``
+        fault point sits between the two writes (the demotion crash
+        window). Returns the newly demoted steps."""
+        floor = int(step)
+        self._mgr.wait_until_finished()
+        self._flush_pending()
+        stones = self._stones()
+        demoted = sorted(
+            s for s in set(self._mgr.all_steps())
+            | set(_manifest_steps(self._manifest_dir))
+            if s > floor and s not in stones)
+        if not demoted:
+            # Recovery idempotence: a re-run after a crash INSIDE the
+            # demotion window finds the tombstone already durable but
+            # possibly a stale pointer still vouching for a vetoed
+            # step — repair it (readers never trusted it, but the
+            # pointer is the publish signal followers poll).
+            lg = self.last_good_step()
+            if lg is not None and lg in stones:
+                self._republish_last_good()
+            return []
+        tip = demoted[-1]
+        with obs.span("checkpoint/demote", floor=floor, tip=tip):
+            os.makedirs(self._tombstone_dir, exist_ok=True)
+            _atomic_write_json(
+                os.path.join(self._tombstone_dir,
+                             f"range_{floor}_{tip}.json"),
+                {"newer_than": floor, "through": tip,
+                 "steps": demoted, "reason": str(reason)[:500],
+                 "ts": round(time.time(), 3)})
+            self._emit("generation_demoted", steps=demoted,
+                       newer_than=floor, reason=str(reason)[:200])
+            obs.counter("checkpoint.demotions_total").add(len(demoted))
+            obs.gauge("checkpoint/quarantined_generations").set(
+                self._n_quarantined())
+            faults.inject("ckpt_demote")
+            self._republish_last_good()
+        return demoted
+
+    def _republish_last_good(self) -> None:
+        """Atomically point ``last_good`` at the newest manifested,
+        committed, non-tombstoned step (the pre-drift save after a
+        demotion); clears the pointer when nothing qualifies."""
+        stones = self._stones()
+        committed = set(self._mgr.all_steps())
+        good = sorted((s for s in _manifest_steps(self._manifest_dir)
+                       if s in committed and s not in stones),
+                      reverse=True)
+        prev = self.last_good_step()
+        if good:
+            _atomic_write_json(self._last_good_path,
+                               {"step": good[0],
+                                "ts": round(time.time(), 3)})
+        else:
+            # Every verified step is demoted: an empty pointer is the
+            # honest state (readers fall back to walk-back/None).
+            _atomic_write_json(self._last_good_path,
+                               {"step": None,
+                                "ts": round(time.time(), 3)})
+        self._emit("last_good_republished", prev=prev,
+                   step=good[0] if good else None)
+
     def _chain_active(self) -> bool:
         """Has THIS directory ever written a manifest? Legacy dirs
         (pre-chain saves) restore without verification; once the chain
@@ -324,6 +559,15 @@ class Checkpointer:
                     _atomic_write_json(self._manifest_path(step),
                                        manifest)
                     prev = self.last_good_step()
+                    if self.is_tombstoned(step):
+                        # A drift alarm demoted this save while its
+                        # commit was in flight (the alarm-during-
+                        # ckpt_commit race): the manifest records the
+                        # verification, but the pointer must never
+                        # vouch for a vetoed generation.
+                        self._emit("checkpoint_verified_demoted",
+                                   step=step)
+                        continue
                     if prev is None or step > prev:
                         _atomic_write_json(self._last_good_path,
                                            {"step": step,
@@ -451,6 +695,13 @@ class Checkpointer:
         but still fails loudly on checksum mismatch.
         """
         if step is not None:
+            if self.is_tombstoned(int(step)):
+                raise CheckpointChainBroken(
+                    f"checkpoint step {step} carries a demotion "
+                    "tombstone (the generation was judged bad after "
+                    "publish); restoring it explicitly would resurrect "
+                    "a vetoed model"
+                )
             result = self._restore_step(int(step), params_example,
                                         opt_state_example)
             manifest = self._read_manifest(int(step))
@@ -467,7 +718,13 @@ class Checkpointer:
             return None
         chain_active = self._chain_active()
         last_good = self.last_good_step()
+        stones = self._stones()
         for s in steps:
+            if s in stones:
+                # Demoted generation: bytes may be pristine — the
+                # MODEL is vetoed (concept drift / divergence verdict).
+                self._emit("checkpoint_demoted_skipped", step=s)
+                continue
             manifest = self._read_manifest(s)
             if manifest is None:
                 if chain_active and (last_good is None or s > last_good):
@@ -534,6 +791,14 @@ class ChainFollower:
     half-GC'd step dirs), returning ``None`` — not raising — when
     nothing verifies: the serving degraded mode is "keep the old
     generation", not "die".
+
+    Tombstones (ISSUE 13) are an unconditional veto: a DEMOTED step —
+    judged bad after publish by the drift sentry or divergence guard —
+    is skipped even when its bytes verify perfectly, and even when a
+    stale ``last_good`` still vouches for it (the demotion crash
+    window). The reload path additionally re-checks
+    :meth:`is_tombstoned` after restore, immediately before the swap,
+    so a demotion landing MID-reload still wins the race.
     """
 
     def __init__(self, directory: str, journal=None):
@@ -563,18 +828,27 @@ class ChainFollower:
             return None
 
     def _manifest_steps(self) -> list[int]:
-        steps = []
-        try:
-            for fname in os.listdir(self._manifest_dir):
-                if not fname.endswith(".json"):
-                    continue
-                try:
-                    steps.append(int(fname[:-5]))
-                except ValueError:
-                    continue
-        except OSError:
-            pass
-        return steps
+        return _manifest_steps(self._manifest_dir)
+
+    def _stones(self) -> _Tombstones:
+        """Interval view, re-read from disk on every call — the
+        trainer demotes underneath a polling follower, and a range
+        stone can span a whole training day (never expanded on the
+        poll path)."""
+        return _read_tombstones(
+            os.path.join(self.directory, "tombstones"))
+
+    def tombstoned_steps(self) -> set[int]:
+        """Demoted steps, EXPANDED (tools/tests/auditor accessor;
+        see :meth:`Checkpointer.tombstoned_steps`)."""
+        stones = self._stones()
+        out = set(stones.singles)
+        for floor, tip in stones.ranges:
+            out.update(range(floor + 1, tip + 1))
+        return out
+
+    def is_tombstoned(self, step: int) -> bool:
+        return int(step) in self._stones()
 
     def _read_manifest(self, step: int) -> dict | None:
         try:
@@ -618,9 +892,15 @@ class ChainFollower:
             committed = set(self._manager().all_steps())
         except Exception:
             return None
+        stones = self._stones()
         steps = sorted((s for s in self._manifest_steps()
                         if s in committed), reverse=True)
         for s in steps:
+            if s in stones:
+                # Vetoed generation (demoted after publish): a serving
+                # follower must never load it, stale pointer or not.
+                self._emit("checkpoint_demoted_skipped", step=s)
+                continue
             manifest = self._read_manifest(s)
             if manifest is None:
                 continue

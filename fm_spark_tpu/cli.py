@@ -1153,6 +1153,136 @@ def _maybe_init_distributed(args) -> None:
         jax.distributed.initialize()
 
 
+def _online_days(args, cfg):
+    """Assemble the time-ordered day list for ``train --online``:
+    ``--synthetic N`` split into ``--online-days`` slices (with the
+    ``--drift-inject`` label-flip drill lever), or ``--data d0,d1,...``
+    — one raw-text shard per day, parsed in memory per dataset kind."""
+    from fm_spark_tpu import data as data_lib
+    from fm_spark_tpu import online
+
+    if args.synthetic:
+        num_features = cfg.num_features if cfg.bucket > 0 else 4096
+        ids, vals, labels = data_lib.synthetic_ctr(
+            args.synthetic, num_features, cfg.num_fields, seed=cfg.seed)
+        days = online.split_days(ids, vals, labels, args.online_days)
+        if args.drift_inject is not None:
+            days = online.flip_labels(days, args.drift_inject)
+        return days, num_features
+    if not args.data or "," not in args.data:
+        raise SystemExit(
+            "--online needs time-ordered days: --data d0,d1,... (one "
+            "shard per day) or --synthetic N with --online-days")
+    if args.drift_inject is not None:
+        raise SystemExit("--drift-inject is the synthetic drill lever; "
+                         "real day shards carry their own drift")
+    paths = [p for p in args.data.split(",") if p]
+    days = []
+    if cfg.dataset in ("criteo", "avazu"):
+        mod = __import__(f"fm_spark_tpu.data.{cfg.dataset}",
+                         fromlist=["parse_lines"])
+        for path in paths:
+            with open(path, "rb") as f:
+                lines = f.read().splitlines()
+            if cfg.dataset == "avazu" and lines and \
+                    lines[0].startswith(b"id,"):
+                lines = lines[1:]
+            guard = _ingest_guard(args, windowed=False)
+            ids, labels = mod.parse_lines(
+                lines, cfg.bucket, per_field=True,
+                on_error=guard.on_error, path=path, start_lineno=1)
+            guard.ok_many(len(labels))
+            guard.check_overall()
+            days.append((ids, np.ones(ids.shape, np.float32),
+                         labels.astype(np.float32)))
+        return days, cfg.num_features
+    if cfg.dataset == "libsvm":
+        from fm_spark_tpu.data import load_libsvm
+
+        num_features = 0
+        for path in paths:
+            guard = _ingest_guard(args, windowed=False)
+            ids, vals, labels = load_libsvm(path,
+                                            on_error=guard.on_error)
+            guard.ok_many(labels.shape[0])
+            guard.check_overall()
+            num_features = max(num_features,
+                               int(ids.max()) + 1 if ids.size else 1)
+            days.append((ids, vals, labels))
+        return days, num_features
+    raise SystemExit(
+        f"--online day shards support criteo/avazu/libsvm text "
+        f"(config {cfg.name!r} is dataset {cfg.dataset!r}); use "
+        "--synthetic N for a config-free run")
+
+
+def _run_online_cmd(args, cfg, tconfig) -> int:
+    """``train --online``: the continuous-learning protocol (ISSUE 13)
+    — see :mod:`fm_spark_tpu.online` for the loop itself."""
+    from fm_spark_tpu import obs, online
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.train import FMTrainer
+    from fm_spark_tpu.utils.logging import EventLog
+
+    if cfg.strategy != "single" or not args.checkpoint_dir:
+        raise SystemExit(
+            "--online requires strategy 'single' and --checkpoint-dir "
+            "(day-granular rollback restores demoted generations from "
+            f"the chain; config {cfg.name!r} resolves to strategy "
+            f"{cfg.strategy!r})")
+    if cfg.task != "classification":
+        raise SystemExit("--online watches eval AUC; config "
+                         f"{cfg.name!r} is task {cfg.task!r}")
+    days, num_features = _online_days(args, cfg)
+    spec = cfg.spec(num_features if cfg.bucket <= 0 else None)
+
+    import os as _os
+
+    _os.makedirs(args.checkpoint_dir, exist_ok=True)
+    journal = EventLog(_os.path.join(args.checkpoint_dir,
+                                     "health.jsonl"),
+                       mirror_to_flight=True)
+    checkpointer = Checkpointer(args.checkpoint_dir,
+                                save_every=args.checkpoint_every,
+                                journal=journal)
+    trainer = FMTrainer(spec, tconfig)
+    sentry = online.drift_guard(
+        drop_factor=args.drift_drop_factor,
+        max_rollbacks=args.drift_max_rollbacks, journal=journal)
+    ledger = leg = fingerprint = run_id = None
+    if args.quality_ledger:
+        from fm_spark_tpu.obs.ledger import (
+            PerfLedger,
+            measurement_fingerprint,
+            runtime_versions,
+        )
+
+        ledger = PerfLedger(args.quality_ledger)
+        leg = f"{online.QUALITY_LEG_PREFIX}{cfg.name}/{tconfig.optimizer}"
+        fingerprint = measurement_fingerprint(
+            variant=leg, model=cfg.model, batch=tconfig.batch_size,
+            rank=cfg.rank,
+            extra={"optimizer": tconfig.optimizer,
+                   "lr": tconfig.learning_rate},
+            device_kind=None, n_chips=1, **runtime_versions())
+        run_id = obs.run_id() or obs.new_run_id()
+    try:
+        summary = online.run_online(
+            trainer, days, checkpointer, sentry=sentry,
+            journal=journal, ledger=ledger, leg=leg,
+            fingerprint=fingerprint, run_id=run_id)
+    finally:
+        checkpointer.close()
+        journal.close()
+    print(json.dumps({"online": summary}))
+    if args.model_out:
+        from fm_spark_tpu import models as models_lib
+
+        models_lib.save_model(args.model_out, spec, trainer.params)
+        print(json.dumps({"saved": args.model_out}))
+    return 0
+
+
 def cmd_train(args) -> int:
     from fm_spark_tpu import configs as configs_lib
     from fm_spark_tpu import models
@@ -1237,6 +1367,13 @@ def cmd_train(args) -> int:
                 f"batch_size={tconfig.batch_size} must be divisible by "
                 f"the process count ({pc})"
             )
+
+    if args.online:
+        # Continuous learning (ISSUE 13): its own day-granular loop —
+        # time-ordered train/eval, drift sentry, coordinated rollback.
+        if pc > 1:
+            raise SystemExit("--online is single-process")
+        return _run_online_cmd(args, cfg, tconfig)
 
     te = None
     te_packed = None
@@ -1724,26 +1861,32 @@ def cmd_predict(args) -> int:
 def _serve_opt_example(spec, cfg):
     """The optimizer-state example a chain follower needs to restore
     the trainer's checkpoints: ``{}`` for the pure-SGD field families,
-    the dense-head optax state for FieldDeepFM (buildable only with a
-    config naming the optimizer)."""
+    the dense-head optax state for FieldDeepFM, and the FULL optax
+    state for single-strategy dense families (an FMTrainer chain — the
+    ``--online`` loop's layout — checkpoints the whole optimizer tree,
+    per-coordinate FTRL/AdaGrad slots included). The two structured
+    cases are buildable only with a config naming the optimizer."""
     from fm_spark_tpu.models.field_deepfm import FieldDeepFMSpec
 
-    if not isinstance(spec, FieldDeepFMSpec):
+    if spec.__class__.__name__.startswith("Field") and not isinstance(
+            spec, FieldDeepFMSpec):
         return {}
     if cfg is None:
         raise SystemExit(
-            "hot reload of a FieldDeepFM chain needs --config (the "
-            "follower must rebuild the optimizer-state structure to "
-            "restore the trainer's checkpoints)"
+            "hot reload of this chain needs --config (the follower "
+            "must rebuild the optimizer-state structure to restore "
+            "the trainer's checkpoints)"
         )
     import jax
 
     from fm_spark_tpu.train import make_optimizer
 
     canonical = spec.init(jax.random.key(cfg.seed))
-    return make_optimizer(cfg.train_config()).init(
-        {"w0": canonical["w0"], "mlp": canonical["mlp"]}
-    )
+    if isinstance(spec, FieldDeepFMSpec):
+        return make_optimizer(cfg.train_config()).init(
+            {"w0": canonical["w0"], "mlp": canonical["mlp"]}
+        )
+    return make_optimizer(cfg.train_config()).init(canonical)
 
 
 def cmd_serve(args) -> int:
@@ -1791,7 +1934,11 @@ def cmd_serve(args) -> int:
     if args.config is not None:
         from fm_spark_tpu import configs as configs_lib
 
-        cfg = configs_lib.get_config(args.config)
+        # --optimizer names the TRAINER's rule for the followed chain
+        # (an --online ftrl chain checkpoints FtrlState; restoring it
+        # needs the matching opt-state structure).
+        cfg = configs_lib.get_config(args.config,
+                                     optimizer=args.optimizer)
 
     import os as _os
 
@@ -2207,6 +2354,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "budget — a numeric blowup costs one "
                         "checkpoint window, not the run. Costs one "
                         "loss fetch per step")
+    t.add_argument("--online", action="store_true",
+                   help="continuous-learning protocol (ISSUE 13; "
+                        "strategy single, requires --checkpoint-dir): "
+                        "train day N, evaluate streamed AUC on the "
+                        "never-seen day N+1, checkpoint per day, and "
+                        "run the concept-drift sentry over the AUC "
+                        "series — a drift verdict DEMOTES the "
+                        "offending day's saves (durable tombstones; "
+                        "last_good republished at the pre-drift save) "
+                        "and rolls the weights back, so a serving "
+                        "follower can never hot-load the bad "
+                        "generation. Days come from --data d0,d1,... "
+                        "(one text shard per day) or --synthetic N "
+                        "with --online-days")
+    t.add_argument("--online-days", type=int, default=8,
+                   dest="online_days",
+                   help="with --online --synthetic: split the "
+                        "synthetic set into this many time-ordered "
+                        "day slices")
+    t.add_argument("--drift-drop-factor", type=float, default=1.15,
+                   dest="drift_drop_factor", metavar="FACTOR",
+                   help="drift sentry threshold: eval AUC below "
+                        "trailing-median / FACTOR is a drift verdict "
+                        "(maximize-mode DivergenceGuard; min-history "
+                        "floor keeps short series from tripping it)")
+    t.add_argument("--drift-max-rollbacks", type=int, default=2,
+                   dest="drift_max_rollbacks",
+                   help="how many drift rollbacks the online run "
+                        "absorbs before the verdict propagates "
+                        "(persistent drift is a data/model problem "
+                        "the operator must see)")
+    t.add_argument("--drift-inject", type=int, default=None,
+                   dest="drift_inject", metavar="DAY",
+                   help="DRILL LEVER: flip the labels of every "
+                        "synthetic day >= DAY (a planted concept "
+                        "drift), to exercise the sentry/rollback path "
+                        "end-to-end — the online analog of the chaos "
+                        "canary")
+    t.add_argument("--quality-ledger", dest="quality_ledger",
+                   default=None, metavar="PATH",
+                   help="append one quality_eval record per online "
+                        "eval day to this perf-ledger JSONL (own "
+                        "sentinel cohorts, isolated from bench legs "
+                        "by leg namespace); default: off")
     import os as _os_parser
 
     t.add_argument("--obs-dir", dest="obs_dir",
@@ -2258,6 +2449,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="config naming the dataset loader / the "
                          "chain's model family (required with "
                          "--checkpoint-dir and no --model)")
+    sv.add_argument("--optimizer", default=None,
+                    help="the TRAINER's optimizer for the followed "
+                         "chain (when it differs from the config's "
+                         "default, e.g. an --online ftrl chain): the "
+                         "follower must rebuild the same opt-state "
+                         "structure to restore the checkpoints")
     add_data_args(sv)
     sv.add_argument("--checkpoint-dir", dest="checkpoint_dir",
                     help="training chain to follow: the initial "

@@ -18,7 +18,14 @@ becomes a query. Three record kinds share one stream:
   as the higher-is-better ``value`` with p50/p99 request latency
   alongside. Serving legs carry their own leg names, so their cohorts
   never mix with training legs — the sentinel gates serving
-  regressions exactly like training ones, separately.
+  regressions exactly like training ones, separately;
+- ``quality_eval`` — one time-ordered eval day of the continuous-
+  learning loop (ISSUE 13; online.py): eval AUC as the
+  higher-is-better ``value``, with the day index, global step, and
+  full metric dict alongside. Quality legs live in their own
+  ``quality/<config>/<optimizer>`` namespace, so model-quality cohorts
+  never share a trailing band with any throughput cohort — an AUC
+  series judged by the same sentinel machinery, separately.
 
 Every record carries a **measurement fingerprint**
 (:func:`measurement_fingerprint`): the lever-config hash, chip type +

@@ -1249,6 +1249,589 @@ def serve_schedule(seed: int) -> Schedule:
                     stream_comparable=False).validate()
 
 
+# ------------------------------------------- continuous learning (ISSUE 13)
+
+#: The drift/rollback failure class joins the chaos surface: seeded
+#: schedules over the ``online_eval`` / ``ckpt_demote`` / ``ckpt_commit``
+#: / ``ingest_corrupt`` points, drilled against the PRODUCTION online
+#: loop (online.run_online + FMTrainer + StreamBatches + Checkpointer)
+#: with a planted label-flip drift, and audited from artifacts alone.
+
+#: Tier-1 drift drill seeds (tools/chaos_drill.py runs the same five).
+DRIFT_TIER1_SEEDS = (0, 1, 2, 3, 4)
+
+_DRIFT_SCENARIOS = ("clean_drift", "eval_fault", "commit_fault",
+                    "demote_fault", "rollback_corruption")
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftDrillConfig:
+    """Online-loop drill shape: enough days for the sentry's
+    ``min_history`` floor to clear before the planted drift day, small
+    enough that five schedules fit the tier-1 budget."""
+
+    days: int = 6
+    rows_per_day: int = 192
+    batch_size: int = 16
+    num_features: int = 128
+    nnz: int = 3
+    rank: int = 4
+    drift_day: int = 4           # labels flip from this day on
+    seed: int = 11
+    learning_rate: float = 0.2
+    drop_factor: float = 1.15
+    min_history: int = 3
+    max_rollbacks: int = 2
+    attempts: int = 4
+
+
+def build_drift_days(cfg: DriftDrillConfig, shard_dir: str):
+    """Deterministic time-ordered day set with a planted concept
+    drift: synthetic planted-FM CTR days whose labels FLIP from
+    ``drift_day`` on. Returns ``(days, shard_paths)`` — in-memory
+    arrays (the eval side) and one libsvm text shard per day (the
+    streaming train side; ids written 1-based per libsvm convention,
+    so the parsed stream round-trips the array ids exactly)."""
+    from fm_spark_tpu import online
+    from fm_spark_tpu.data import synthetic_ctr
+
+    ids, vals, labels = synthetic_ctr(
+        cfg.days * cfg.rows_per_day, cfg.num_features, cfg.nnz,
+        rank=cfg.rank, seed=cfg.seed)
+    days = online.flip_labels(
+        online.split_days(ids, vals, labels, cfg.days), cfg.drift_day)
+    os.makedirs(shard_dir, exist_ok=True)
+    paths = []
+    for k, (di, dv, dl) in enumerate(days):
+        path = os.path.join(shard_dir, f"day{k}.svm")
+        with open(path, "w") as f:
+            for r in range(len(dl)):
+                feats = " ".join(f"{int(di[r, j]) + 1}:{dv[r, j]:g}"
+                                 for j in range(cfg.nnz))
+                f.write(f"{int(dl[r])} {feats}\n")
+        paths.append(path)
+    return days, paths
+
+
+def drift_schedule(seed: int) -> Schedule:
+    """Seeded drift/rollback fault schedule — scenario chosen by
+    ``seed % 5`` so the five tier-1 seeds cover the whole class, rule
+    parameters drawn from the seeded rng; a pure function of the seed
+    like every other schedule here.
+
+    ``clean_drift``          no faults: the rollback protocol itself
+    ``eval_fault``           ``online_eval`` error — the eval pass
+                             dies; the resumed run must REPLAY the
+                             missed eval (durable sentry state), so a
+                             crash can never skip a drift check
+    ``commit_fault``         ``ckpt_commit`` error — a drift-adjacent
+                             save dies in its verify window
+    ``demote_fault``         ``ckpt_demote`` error — the demotion
+                             crashes AFTER the tombstone, BEFORE the
+                             pointer republish (the nastiest window)
+    ``rollback_corruption``  quarantine-policy ingest corruption under
+                             the drifted days — rollback must compose
+                             with dirty ingest accounting
+    """
+    rng = random.Random(int(seed))
+    scenario = _DRIFT_SCENARIOS[int(seed) % len(_DRIFT_SCENARIOS)]
+    if scenario == "clean_drift":
+        rules: tuple = ()
+    elif scenario == "eval_fault":
+        rules = (f"online_eval@{rng.randint(1, 5)}=error",)
+    elif scenario == "commit_fault":
+        rules = (f"ckpt_commit@{rng.randint(2, 6)}=error",)
+    elif scenario == "demote_fault":
+        rules = ("ckpt_demote@1=error",)
+    else:  # rollback_corruption
+        n = rng.randint(2, 4)
+        occs = sorted(rng.sample(range(5, 400), n))
+        rules = tuple(f"ingest_corrupt@{o}=error" for o in occs)
+    return Schedule(int(seed), f"drift_{scenario}", rules,
+                    stream_comparable=(scenario != "rollback_corruption"),
+                    max_bad_frac=0.5).validate()
+
+
+class _DayTap:
+    """Per-day durable batch tap for the online drill: one
+    ``day:index:ids`` line appended per consumed batch (last write
+    wins on re-runs, like the subprocess tap)."""
+
+    def __init__(self, source, day: int, path: str):
+        self._source, self._day, self._path = source, day, path
+        self._idx = 0
+
+    @property
+    def guard(self):
+        return getattr(self._source, "guard", None)
+
+    def next_batch(self):
+        ids, vals, labels, w = self._source.next_batch()
+        with open(self._path, "a") as f:
+            f.write(f"{self._day}:{self._idx}:" + ",".join(
+                str(int(x)) for x in ids[w > 0][:, 0]) + "\n")
+        self._idx += 1
+        return ids, vals, labels, w
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+
+@dataclasses.dataclass
+class DriftResult:
+    """One drilled online run's artifacts for :func:`audit_drift`."""
+
+    outcome: str
+    error: str | None
+    attempts: int
+    summary: dict | None
+    taps: dict
+    params_sums: dict | None
+    tombstones: list
+    last_good: int | None
+    counters: dict
+    workdir: str
+    health_path: str
+    deadletter_path: str
+    ckpt_dir: str
+
+
+def _read_day_taps(path: str) -> dict:
+    """Last-write-wins per-(day, batch) tap reconstruction — a day
+    retrained after a crash replays the same deterministic stream, so
+    the effective map must match the clean run's exactly."""
+    taps: dict = {}
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return taps
+    for line in lines:
+        day, _, rest = line.partition(":")
+        idx, _, payload = rest.partition(":")
+        if not (day.isdigit() and idx.isdigit()):
+            continue
+        taps.setdefault(int(day), {})[int(idx)] = payload
+    return {d: [m[i] for i in sorted(m)] for d, m in taps.items()}
+
+
+def run_drift_schedule(schedule: "Schedule | str",
+                       cfg: DriftDrillConfig, workdir: str,
+                       shard_state=None) -> DriftResult:
+    """Drill the PRODUCTION continuous-learning loop under a fault
+    plan: time-ordered libsvm day shards stream through
+    ``StreamBatches`` + quarantine ``RecordGuard`` into
+    ``online.run_online`` (FMTrainer, crash-consistent Checkpointer,
+    maximize-mode drift sentry), with a planted label-flip drift so
+    EVERY schedule exercises the demotion/rollback path. A fault that
+    kills the run is followed by a fresh-process-style resume (new
+    trainer/checkpointer over the same chain + durable sentry state),
+    up to ``cfg.attempts`` — the in-process analog of the respawn
+    chain, with fault occurrence counters carried across attempts."""
+    import jax  # noqa: F401  (the trainer needs a backend)
+
+    from fm_spark_tpu import models, online
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data.stream import (
+        RecordGuard,
+        ShardReader,
+        StreamBatches,
+        line_parser,
+    )
+    from fm_spark_tpu.train import FMTrainer, TrainConfig
+    from fm_spark_tpu.utils.logging import MetricsLogger
+
+    if isinstance(schedule, str):
+        schedule = Schedule(seed=-1, scenario="adhoc",
+                            rules=tuple(r for r in schedule.split(";")
+                                        if r.strip()))
+    os.makedirs(workdir, exist_ok=True)
+    if shard_state is None:
+        shard_state = build_drift_days(
+            cfg, os.path.join(workdir, "shards"))
+    days, shard_paths = shard_state
+    ck_dir = os.path.join(workdir, "ck")
+    q_dir = os.path.join(workdir, "q")
+    tap_path = os.path.join(workdir, "tap.txt")
+    health_path = os.path.join(workdir, "health.jsonl")
+    journal = EventLog(health_path)
+
+    guards: list = []
+
+    def day_source(k, _default):
+        """Replace the online loop's in-memory day source with the
+        PRODUCTION streaming stack over day ``k``'s text shard —
+        quarantine guard (the ``ingest_corrupt`` surface) + durable
+        per-batch tap."""
+        guard = RecordGuard("quarantine", quarantine_dir=q_dir,
+                            max_bad_frac=schedule.max_bad_frac,
+                            window=64, min_records=32,
+                            journal=journal)
+        guards.append(guard)
+        src = StreamBatches(
+            ShardReader([shard_paths[k]], chunk_bytes=512),
+            line_parser("libsvm"), cfg.batch_size, cfg.nnz,
+            guard=guard, num_features=cfg.num_features)
+        return _DayTap(src, k, tap_path)
+    spec = models.FMSpec(num_features=cfg.num_features, rank=cfg.rank,
+                         init_std=0.05)
+    tconfig = TrainConfig(num_steps=0, batch_size=cfg.batch_size,
+                          learning_rate=cfg.learning_rate,
+                          lr_schedule="constant", optimizer="ftrl",
+                          log_every=10_000, seed=cfg.seed)
+
+    faults.clear()
+    if schedule.plan:
+        faults.activate(schedule.plan)
+    outcome, error, summary = "incomplete", None, None
+    attempts = 0
+    try:
+        for attempt in range(cfg.attempts):
+            attempts = attempt + 1
+            trainer = FMTrainer(spec, tconfig)
+            trainer.logger.close()
+            trainer.logger = MetricsLogger(
+                path=os.path.join(workdir, "metrics.jsonl"))
+            trainer.logger._stream = None
+            ck = Checkpointer(ck_dir, save_every=10**9,
+                              async_save=False, journal=journal)
+            sentry = online.drift_guard(
+                drop_factor=cfg.drop_factor,
+                min_history=cfg.min_history,
+                max_rollbacks=cfg.max_rollbacks, journal=journal)
+            try:
+                summary = online.run_online(
+                    trainer, days, ck, sentry=sentry,
+                    journal=journal, batch_tap=day_source)
+                outcome = "completed"
+            except Exception as e:  # noqa: BLE001 — the outcome IS
+                # the verdict; the next attempt is the recovery
+                outcome = _classify_outcome(e)
+                error = (f"{type(e).__name__}: "
+                         f"{(str(e).splitlines() or [''])[0][:200]}")
+            finally:
+                try:
+                    ck.close()
+                except Exception:
+                    pass
+                trainer.logger.close()
+            if outcome == "completed":
+                break
+    finally:
+        faults.clear()
+        for g in guards:
+            g.close()
+        journal.close()
+
+    total = {"ok": 0, "bad": 0}
+    for g in guards:
+        c = g.counters()
+        total["ok"] += c.get("ok", 0)
+        total["bad"] += c.get("bad", 0)
+    from fm_spark_tpu.checkpoint import ChainFollower
+
+    follower = ChainFollower(ck_dir)
+    tombstones = sorted(follower.tombstoned_steps())
+    last_good = follower.last_good_step()
+    follower.close()
+    return DriftResult(
+        outcome=outcome, error=error, attempts=attempts,
+        summary=summary, taps=_read_day_taps(tap_path),
+        params_sums=(_params_sums(trainer.params)
+                     if outcome == "completed" else None),
+        tombstones=tombstones, last_good=last_good,
+        counters=total, workdir=workdir, health_path=health_path,
+        deadletter_path=os.path.join(q_dir, "deadletter.jsonl"),
+        ckpt_dir=ck_dir,
+    )
+
+
+def audit_drift(schedule: Schedule, result: DriftResult,
+                golden: DriftResult, cfg: DriftDrillConfig) -> list[dict]:
+    """The continuous-learning invariants, judged from artifacts alone
+    (empty list = green):
+
+    - **completion** — the run completes within the attempt budget and
+      every eval day 1..D-1 was judged;
+    - **rollback** — the planted drift fired the sentry and the
+      offending generation was demoted (for stream-comparable
+      schedules, at exactly the first drifted eval day);
+    - **exactly_once_stream** — the effective per-day record stream
+      (last-write-wins across crash re-runs) is bit-identical to the
+      clean drilled run's: records are neither replayed into nor
+      skipped from the committed state, rollbacks included;
+    - **state_identity** — final params byte-identical to the clean
+      run (faults may change WHEN things happened, never the model);
+    - **chain_consistency** — a fresh read-only follower restores a
+      verified, NON-tombstoned step equal to the published
+      ``last_good``; every demoted step is tombstoned; the pointer
+      never vouches for a vetoed generation;
+    - **quarantine_accounting** — corruption schedules: every
+      quarantined record has a dead letter.
+    """
+    v: list[dict] = []
+    if result.outcome != "completed":
+        v.append(_violation(
+            "completion",
+            f"{result.outcome} after {result.attempts} attempt(s): "
+            f"{result.error}"))
+        return v
+    summary = result.summary or {}
+    # Eval coverage spans ATTEMPTS (a killed run's early evals live in
+    # its journal, not the final attempt's summary) — the journal is
+    # the durable record the invariant reads.
+    eval_days = {e.get("eval_day")
+                 for e in read_events(result.health_path)
+                 if e.get("event") == "quality_eval"}
+    eval_days |= {e.get("eval_day") for e in summary.get("days", [])}
+    want = set(range(1, cfg.days))
+    if not want <= eval_days:
+        v.append(_violation(
+            "completion",
+            f"eval days {sorted(want - eval_days)} never judged"))
+    # Rollback evidence spans attempts too: a fault that kills the run
+    # AFTER the rollback leaves the final attempt's summary with
+    # rollbacks=0 while the journal durably records the demotion — the
+    # journal, not the last summary, is what the invariant reads.
+    rollback_events = [e for e in read_events(result.health_path)
+                       if e.get("event") == "online_rollback"]
+    if not (summary.get("rollbacks") or rollback_events):
+        v.append(_violation(
+            "rollback",
+            "planted label-flip drift never fired the sentry"))
+    if schedule.stream_comparable and rollback_events:
+        first_eval = int(rollback_events[0].get("day", -2)) + 1
+        if first_eval != cfg.drift_day:
+            v.append(_violation(
+                "rollback",
+                f"first rollback at eval day {first_eval}, expected "
+                f"the first drifted day {cfg.drift_day}"))
+        if result.taps != golden.taps:
+            bad_days = sorted(d for d in set(result.taps)
+                              | set(golden.taps)
+                              if result.taps.get(d)
+                              != golden.taps.get(d))
+            v.append(_violation(
+                "exactly_once_stream",
+                f"effective record stream diverges from the clean "
+                f"run on day(s) {bad_days[:4]} — records replayed "
+                "or skipped across recovery/rollback"))
+        if (result.params_sums is not None
+                and result.params_sums != golden.params_sums):
+            v.append(_violation(
+                "state_identity",
+                "final params differ byte-wise from the clean run"))
+    if result.last_good is None:
+        v.append(_violation("chain_consistency",
+                            "no last_good published after completion"))
+    elif result.last_good in set(result.tombstones):
+        v.append(_violation(
+            "chain_consistency",
+            f"last_good {result.last_good} is tombstoned — the "
+            "pointer vouches for a vetoed generation"))
+    demoted = set(summary.get("demoted_steps") or [])
+    if not demoted <= set(result.tombstones):
+        v.append(_violation(
+            "chain_consistency",
+            f"demoted steps {sorted(demoted - set(result.tombstones))} "
+            "carry no tombstone"))
+    # A fresh follower must restore exactly the published generation.
+    import jax
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import ChainFollower
+    from fm_spark_tpu.train import TrainConfig, make_optimizer
+
+    spec = models.FMSpec(num_features=cfg.num_features, rank=cfg.rank,
+                         init_std=0.05)
+    params = spec.init(jax.random.key(cfg.seed))
+    opt_ex = make_optimizer(TrainConfig(
+        optimizer="ftrl", learning_rate=cfg.learning_rate)).init(params)
+    follower = ChainFollower(result.ckpt_dir)
+    try:
+        restored = follower.restore(params, opt_ex)
+        if restored is None:
+            v.append(_violation("chain_consistency",
+                                "fresh follower restored nothing"))
+        elif restored["step"] != result.last_good:
+            v.append(_violation(
+                "chain_consistency",
+                f"follower restored step {restored['step']} != "
+                f"last_good {result.last_good}"))
+    finally:
+        follower.close()
+    if not schedule.stream_comparable:
+        dead = read_events(result.deadletter_path)
+        n_dead = sum(1 for e in dead if e.get("event") == "bad_record")
+        if result.counters.get("bad", 0) > n_dead:
+            v.append(_violation(
+                "quarantine_accounting",
+                f"guards counted {result.counters.get('bad')} bad "
+                f"record(s) vs {n_dead} dead letter(s)"))
+        if result.counters.get("bad", 0) == 0 and schedule.rules:
+            v.append(_violation(
+                "quarantine_accounting",
+                "corruption rules active but nothing was quarantined"))
+    v.extend(_audit_journal(result))
+    return v
+
+
+#: Worker for the hard-kill demotion drill: builds nothing, just runs
+#: one demotion over an existing chain — the ``ckpt_demote`` fault
+#: (via FM_SPARK_FAULTS) lands between the tombstone write and the
+#: pointer republish, so an ``exit`` there IS the SIGKILL-mid-demotion
+#: window.
+_DEMOTE_WORKER = '''\
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from fm_spark_tpu.checkpoint import Checkpointer
+ck = Checkpointer(sys.argv[1], save_every=1, async_save=False)
+demoted = ck.demote_newer_than(int(sys.argv[2]),
+                               reason="drill drift verdict")
+ck.close()
+import json
+print(json.dumps({"demoted": demoted}))
+'''
+
+
+def run_demote_kill_drill(workdir: str, *, exit_rc: int = 23) -> dict:
+    """The SIGKILL-at-any-point-during-demotion drill (ISSUE 13
+    acceptance): a subprocess demotes the chain's newest saves and is
+    hard-killed INSIDE the demotion window — after the (atomic, range)
+    tombstone write, before the ``last_good`` republish. The audit
+    then proves, from artifacts alone, that the chain recovered
+    consistent: every reader lands on the PRE-DRIFT save even while
+    the pointer is stale, and the recovery re-run repairs the pointer
+    idempotently. Returns ``{"violations": [...], "rcs": [...]}``."""
+    import numpy as np
+
+    from fm_spark_tpu.checkpoint import ChainFollower, Checkpointer
+
+    os.makedirs(workdir, exist_ok=True)
+    ck_dir = os.path.join(workdir, "ck")
+    ck = Checkpointer(ck_dir, save_every=1, async_save=False)
+    for s in (1, 2, 3):
+        ck.save(s, {"w": np.arange(4, dtype=np.float32) * s}, {},
+                force=True)
+    ck.close()
+    worker = os.path.join(workdir, "demote_worker.py")
+    with open(worker, "w") as f:
+        f.write(_DEMOTE_WORKER)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FM_SPARK_OBS_DIR="none",
+               PYTHONPATH=_REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               FM_SPARK_FAULTS=f"ckpt_demote@1=exit:{exit_rc}")
+    v: list[dict] = []
+    rcs = []
+    proc = subprocess.run([sys.executable, worker, ck_dir, "1"],
+                          cwd=_REPO, env=env, capture_output=True,
+                          timeout=120)
+    rcs.append(proc.returncode)
+    if proc.returncode != exit_rc:
+        v.append(_violation(
+            "rc_discipline",
+            f"demotion worker exited rc={proc.returncode}, expected "
+            f"the injected {exit_rc}"))
+    follower = ChainFollower(ck_dir)
+    try:
+        ex = {"w": np.zeros(4, np.float32)}
+        if follower.tombstoned_steps() != {2, 3}:
+            v.append(_violation(
+                "chain_consistency",
+                f"tombstones {sorted(follower.tombstoned_steps())} "
+                "after the kill; the range stone must veto {2, 3} "
+                "atomically"))
+        restored = follower.restore(ex, {})
+        if restored is None or restored["step"] != 1:
+            v.append(_violation(
+                "chain_consistency",
+                f"reader restored "
+                f"{restored and restored['step']} mid-demotion; must "
+                "land on the pre-drift save 1 even with the pointer "
+                "stale"))
+    finally:
+        follower.close()
+    # Recovery: the re-run must be idempotent AND repair the pointer.
+    env.pop("FM_SPARK_FAULTS")
+    proc2 = subprocess.run([sys.executable, worker, ck_dir, "1"],
+                           cwd=_REPO, env=env, capture_output=True,
+                           timeout=120)
+    rcs.append(proc2.returncode)
+    if proc2.returncode != 0:
+        v.append(_violation(
+            "rc_discipline",
+            f"recovery demotion re-run exited rc={proc2.returncode}: "
+            f"{proc2.stderr.decode()[-200:]}"))
+    ck2 = Checkpointer(ck_dir, save_every=1, async_save=False)
+    try:
+        if ck2.last_good_step() != 1:
+            v.append(_violation(
+                "chain_consistency",
+                f"last_good {ck2.last_good_step()} after recovery; "
+                "the pointer must republish at the pre-drift save 1"))
+    finally:
+        ck2.close()
+    return {"violations": v, "rcs": rcs}
+
+
+def run_drift_campaign(seeds=DRIFT_TIER1_SEEDS,
+                       cfg: DriftDrillConfig | None = None,
+                       base_dir: str | None = None) -> list[dict]:
+    """The drift/rollback half of the chaos campaign: golden drilled
+    run first (the planted drift WITH no faults), then every seed's
+    schedule audited against it. Returns chaos_verdict-style entries
+    (``tools/chaos_drill.py`` merges them into its verdict)."""
+    import tempfile
+
+    cfg = cfg or DriftDrillConfig()
+    base_dir = base_dir or tempfile.mkdtemp(prefix="drift_")
+    os.makedirs(base_dir, exist_ok=True)
+    shard_state = build_drift_days(cfg, os.path.join(base_dir,
+                                                     "shards"))
+    golden = run_drift_schedule(
+        Schedule(seed=-1, scenario="drift_golden", rules=()),
+        cfg, os.path.join(base_dir, "golden"), shard_state=shard_state)
+    if golden.outcome != "completed" or not (
+            golden.summary or {}).get("rollbacks"):
+        raise RuntimeError(
+            f"golden drift drill failed ({golden.outcome}: "
+            f"{golden.error}; rollbacks="
+            f"{(golden.summary or {}).get('rollbacks')}) — the online "
+            "workload itself is broken; no schedule verdict is "
+            "meaningful")
+    entries = []
+    for seed in seeds:
+        sched = drift_schedule(seed)
+        t0 = time.perf_counter()
+        result = run_drift_schedule(
+            sched, cfg, os.path.join(base_dir, f"d{int(seed)}"),
+            shard_state=shard_state)
+        violations = audit_drift(sched, result, golden, cfg)
+        # Rollback/demotion accounting spans ATTEMPTS (the journal),
+        # not just the final attempt's summary — same policy as the
+        # auditor's rollback invariant.
+        journal_rollbacks = sum(
+            1 for e in read_events(result.health_path)
+            if e.get("event") == "online_rollback")
+        entries.append({
+            "seed": int(seed), "scenario": sched.scenario,
+            "plan": sched.plan, "expects": "completed",
+            "outcome": result.outcome,
+            "verdict": "green" if not violations else "failed",
+            "violations": violations,
+            "duration_s": round(time.perf_counter() - t0, 3),
+            "rollbacks": max((result.summary or {}).get("rollbacks")
+                             or 0, journal_rollbacks),
+            "demoted": sorted(set(
+                (result.summary or {}).get("demoted_steps") or [])
+                | set(result.tombstones)),
+        })
+    return entries
+
+
 #: Re-export: the auditor lives in the standalone, import-free
 #: :mod:`fm_spark_tpu.resilience.chaos_audit` so jax-light tools
 #: (tools/run_doctor.py) can load it BY PATH without importing the

@@ -20,15 +20,21 @@ def audit_serve_events(events: list[dict], *,
                        final_staleness: int | None = None,
                        staleness_bound: int = 0,
                        rc: int | None = None,
-                       allowed_rcs=(0,)) -> list[dict]:
+                       allowed_rcs=(0,),
+                       tombstoned_steps=()) -> list[dict]:
     """Serving invariants over a run's event stream (ISSUE 12) —
     flight-ring records (``kind``) and journal records (``event``)
-    both read. Empty list = green. The three contracts:
+    both read. Empty list = green. The contracts:
 
     - **no_torn_swap** — every observed ``serve_swap`` advances the
       generation monotonically (step strictly up, ``gen_id`` by
       exactly one): a regressed or duplicated generation means a
       request could have seen a mixture of model states;
+    - **no_tombstoned_generation** (ISSUE 13) — no swap ever installed
+      a DEMOTED generation: pass the chain's tombstoned step set and
+      any ``serve_swap`` to one of them is a violation — the
+      continuous-learning guarantee that a drift-judged-bad model was
+      never scored with, asserted from artifacts alone;
     - **staleness_bounded** — after recovery the served generation is
       within ``staleness_bound`` steps of the chain's published tip
       (``final_staleness`` from the ``serve/staleness_steps`` gauge);
@@ -40,6 +46,7 @@ def audit_serve_events(events: list[dict], *,
     every ``reload_failed`` names the step it kept serving.
     """
     v: list[dict] = []
+    stones = {int(s) for s in tombstoned_steps}
     last_step: int | None = None
     last_gen: int | None = None
     seen_swaps: set = set()
@@ -62,6 +69,12 @@ def audit_serve_events(events: list[dict], *,
             if key in seen_swaps:
                 continue
             seen_swaps.add(key)
+            if step in stones:
+                v.append(_violation(
+                    "no_tombstoned_generation",
+                    f"swap installed step {step}, which carries a "
+                    "demotion tombstone — a drift-judged-bad "
+                    "generation was served"))
             if last_step is not None and step <= last_step:
                 v.append(_violation(
                     "no_torn_swap",

@@ -27,6 +27,17 @@ run is unsalvageable. The guard makes that cost ONE CHECKPOINT WINDOW:
   spiking at new places is a modeling problem, not a robustness one,
   and propagates after the budget is spent.
 
+Maximize mode (ISSUE 13): the same trailing-median machinery watches a
+HIGHER-IS-BETTER metric — the online protocol's day-over-day eval AUC —
+with ``mode="max"``: detection fires when a finite value DROPS below
+``trailing median / spike_factor`` (the mirror of the loss-spike test;
+``spike_factor`` is sized near 1 for AUC, e.g. 1.1 ≈ a 9% relative
+drop). The ``min_history`` floor applies in both directions, so a short
+eval series — the first days of an online run — can never trip the
+spike/drop test; only non-finite values are unconditional. This is the
+concept-drift sentry: the trainer did not blow up, the WORLD changed
+under it, and the verdict routes into the same rollback budget.
+
 Every decision is journaled through
 :class:`~fm_spark_tpu.utils.logging.EventLog` (``divergence_detected``
 / ``divergence_rollback``) — the lint in tools/resilience_lint.py holds
@@ -60,7 +71,11 @@ class DivergenceGuard:
     """Opt-in training-loop monitor (see module docstring).
 
     ``spike_factor``: a finite loss > factor × trailing-median is a
-    spike. ``window``/``min_history``: trailing-median shape. On
+    spike (``mode="min"``, the default); with ``mode="max"`` (a
+    higher-is-better metric, e.g. eval AUC) a finite value < trailing
+    median ÷ factor is a DROP — the concept-drift direction.
+    ``window``/``min_history``: trailing-median shape; no verdict of
+    either direction before ``min_history`` values are banked. On
     detection :meth:`check` raises; the trainer calls
     :meth:`note_rollback` once per recovery — it returns the truncated
     step target and raises the original detection when the rollback
@@ -69,12 +84,18 @@ class DivergenceGuard:
 
     def __init__(self, spike_factor: float = 10.0, window: int = 16,
                  min_history: int = 3, max_rollbacks: int = 2,
-                 journal=None):
+                 journal=None, mode: str = "min"):
         if spike_factor <= 1.0:
             raise ValueError(
                 f"spike_factor must be > 1, got {spike_factor}"
             )
+        if mode not in ("min", "max"):
+            raise ValueError(
+                f"mode must be 'min' (lower-is-better, loss) or 'max' "
+                f"(higher-is-better, AUC), got {mode!r}"
+            )
         self.spike_factor = float(spike_factor)
+        self.mode = mode
         self.min_history = max(int(min_history), 1)
         self.max_rollbacks = int(max_rollbacks)
         self.journal = journal
@@ -91,6 +112,26 @@ class DivergenceGuard:
         ordered = sorted(self._recent)
         return ordered[len(ordered) // 2]
 
+    def baseline(self) -> float | None:
+        """The current trailing median (None until ``min_history``
+        values are banked) — exposed for the drift-score gauge the
+        online loop publishes alongside each verdict."""
+        return self._baseline()
+
+    def history(self) -> list[float]:
+        """The banked trailing window, oldest first — the durable half
+        of the sentry's state: the online loop persists it in each
+        checkpoint's ``extra`` so a killed-and-resumed run re-seeds
+        the window and its drift verdicts replay exactly."""
+        return list(self._recent)
+
+    def seed_history(self, values) -> None:
+        """Re-seed the trailing window from a checkpoint (see
+        :meth:`history`); replaces whatever was banked."""
+        self._recent.clear()
+        for v in values:
+            self._recent.append(float(v))
+
     def check(self, step: int, loss: float) -> None:
         """Bank a healthy loss, or raise :class:`DivergenceDetected`.
 
@@ -101,16 +142,26 @@ class DivergenceGuard:
         loss = float(loss)
         reason = None
         if not math.isfinite(loss):
-            reason = "non-finite loss"
+            reason = ("non-finite loss" if self.mode == "min"
+                      else "non-finite metric")
         else:
             baseline = self._baseline()
-            if baseline is not None and loss > self.spike_factor * max(
-                    baseline, 1e-12):
+            if baseline is not None and self.mode == "min" and (
+                    loss > self.spike_factor * max(baseline, 1e-12)):
                 reason = (f"loss spike: {loss:.6g} > {self.spike_factor}x "
                           f"trailing median {baseline:.6g}")
+            elif (baseline is not None and self.mode == "max"
+                    and baseline > 0
+                    and loss < baseline / self.spike_factor):
+                # The drift direction: the metric is higher-is-better
+                # and fell past the mirrored factor of its own trailing
+                # median — the world moved, not the optimizer.
+                reason = (f"metric drop: {loss:.6g} < trailing median "
+                          f"{baseline:.6g} / {self.spike_factor}")
         if reason is not None:
             self._emit("divergence_detected", step=step, loss=repr(loss),
-                       reason=reason, rollbacks=self.rollbacks)
+                       reason=reason, rollbacks=self.rollbacks,
+                       mode=self.mode)
             raise DivergenceDetected(step, loss, reason)
         self._recent.append(loss)
 

@@ -73,7 +73,14 @@ ENV_STATE = "FM_SPARK_FAULTS_STATE"
 #: ``serve_reload`` fires at the start of each hot-reload attempt in
 #: serve/reload.py — an ``error`` there exercises the degraded-serving
 #: path (old generation keeps serving), an ``exit`` is the
-#: SIGKILL-during-reload drill.
+#: SIGKILL-during-reload drill. Continuous learning (ISSUE 13):
+#: ``online_eval`` fires at the start of each day's time-ordered eval
+#: pass in online.py (a fault there is a drift-sentry-adjacent failure
+#: — e.g. an alarm racing a checkpoint commit), and ``ckpt_demote``
+#: fires INSIDE checkpoint.Checkpointer's demotion window — after the
+#: durable tombstone write, before the ``last_good`` republish — so an
+#: ``exit`` there is the SIGKILL-mid-demotion drill and an ``error``
+#: exercises the stale-pointer-but-vetoed recovery path.
 KNOWN_POINTS = (
     "backend_init",
     "sweep_leg",
@@ -83,6 +90,8 @@ KNOWN_POINTS = (
     "ingest_corrupt",
     "ingest_truncate",
     "serve_reload",
+    "online_eval",
+    "ckpt_demote",
 )
 
 #: The action vocabulary (public since ISSUE 10: the chaos schedule

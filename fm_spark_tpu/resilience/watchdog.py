@@ -66,11 +66,14 @@ HANG_EXIT_RC = 87
 #: Guarded production phases (the registry the chaos auditor samples
 #: deadlines for): the shard reader's chunk read (data/stream.py), the
 #: checkpoint manifest-commit window (checkpoint.py), one training
-#: step including its batch fetch (train.py), and one serving
-#: micro-batch execute — deadline = the SLO — in the predict engine
-#: (serve/engine.py, ISSUE 12).
+#: step including its batch fetch (train.py), one serving micro-batch
+#: execute — deadline = the SLO — in the predict engine
+#: (serve/engine.py, ISSUE 12), and one day's time-ordered eval pass
+#: in the continuous-learning loop (online.py, ISSUE 13) — a hang
+#: there would silently stall the drift sentry while training keeps
+#: publishing generations.
 KNOWN_PHASES = ("ingest_chunk", "ckpt_commit", "step_window",
-                "serve_request")
+                "serve_request", "online_eval")
 
 _ACTIONS = ("raise", "exit")
 

@@ -96,8 +96,12 @@ class ReloadFollower:
         ``fresh``          serving the newest verified generation
         ``swapped``        a newer generation was loaded + installed
         ``stale_chain``    the chain walked back BELOW the served step
-                           (newest steps all torn/corrupt) — keep
-                           serving what we have
+                           (newest steps all torn/corrupt/demoted) —
+                           keep serving what we have
+        ``demoted``        the restored generation was tombstoned
+                           between restore and swap (a demotion racing
+                           this reload) — refused, old generation
+                           keeps serving
         ``failed``         the reload attempt itself failed — degraded
                            mode, old generation keeps serving
         """
@@ -127,10 +131,22 @@ class ReloadFollower:
                 return "failed"
         if restored is None or restored["step"] <= served:
             # Verified chain tip is not ahead of us (torn newest steps
-            # walked back past the pointer): not a failure, not a swap.
+            # walked back past the pointer, or the tip was DEMOTED —
+            # ISSUE 13's quarantined-tip case): not a failure, not a
+            # swap; the staleness gauge keeps measuring the gap.
             self._fail("no verified step newer than served generation "
-                       "(torn/corrupt chain tip)", last_good, served)
+                       "(torn/corrupt/demoted chain tip)", last_good,
+                       served)
             return "stale_chain"
+        if self.chain.is_tombstoned(restored["step"]):
+            # Demotion raced the reload: the tombstone landed AFTER
+            # restore() walked the chain but before the swap. The
+            # verdict wins — a demoted generation must never be
+            # installed, even loaded-and-verified.
+            obs.counter("serve.demoted_refused_total").add(1)
+            self._fail(f"generation {restored['step']} was demoted "
+                       "mid-reload (tombstone veto)", last_good, served)
+            return "demoted"
         layout = ((restored.get("extra") or {}).get("layout")
                   or "canonical")
         if layout != "canonical":

@@ -40,7 +40,9 @@ class TrainConfig:
     batch_size: int = 1024
     learning_rate: float = 0.1             # stepSize
     lr_schedule: str = "inv_sqrt"          # stepSize/√iter | 'constant'
-    optimizer: str = "sgd"                 # 'sgd' | 'adam' | 'adagrad'
+    optimizer: str = "sgd"                 # 'sgd' | 'adam' | 'adagrad' |
+                                           # 'ftrl' (per-coordinate
+                                           # FTRL-Proximal, optim/)
     reg_bias: float = 0.0                  # regParam triple (r0, r1, r2)
     reg_linear: float = 0.0
     reg_factors: float = 0.0
@@ -201,8 +203,17 @@ def _group_reg(config: TrainConfig):
     ``vw`` tables of FieldFMSpec get a per-COLUMN vector (factor columns →
     reg_factors, the last linear column → reg_linear). Unknown groups are
     an error — silently unregularized parameters are worse than a crash.
+
+    FTRL is the exception (ISSUE 13): its L2 is PROXIMAL, carried by
+    the transform's own closed form (``make_optimizer`` routes the
+    triple into ``optim.ftrl(l2_by_group=...)``) — folding ``λw`` into
+    the gradients here would corrupt the per-coordinate z/n schedule
+    statistics, so this returns the identity for ``optimizer='ftrl'``.
     """
     import numpy as np
+
+    if config.optimizer == "ftrl":
+        return lambda grads, params: grads
 
     known = {
         "w0": config.reg_bias,
@@ -232,6 +243,21 @@ def _group_reg(config: TrainConfig):
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    if config.optimizer == "ftrl":
+        # Per-coordinate FTRL-Proximal (optim/, ISSUE 13): its
+        # (beta + sqrt(n))/alpha term IS the schedule, per coordinate,
+        # so the global lr_schedule deliberately does not apply. The
+        # reg_* triple routes into FTRL's PROXIMAL l2 per group —
+        # never into the gradients (_group_reg is identity for ftrl):
+        # (g + λw)² folded into n would corrupt the schedule itself.
+        from fm_spark_tpu import optim
+
+        return optim.ftrl(
+            alpha=config.learning_rate,
+            l2_by_group={"w0": config.reg_bias,
+                         "w": config.reg_linear,
+                         "v": config.reg_factors,
+                         "mlp": config.reg_factors})
     if config.lr_schedule == "inv_sqrt":
         # iteration is 1-based in the reference: lr_i = stepSize / sqrt(i).
         schedule = lambda count: config.learning_rate / jnp.sqrt(count + 1.0)

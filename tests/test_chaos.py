@@ -461,3 +461,39 @@ def test_chaos_drill_cli_writes_verdict_and_exits_green(tmp_path,
     # Every entry names its seed + plan: the verdict IS the repro.
     for e in verdict["schedules"]:
         assert e["plan"] and isinstance(e["seed"], int)
+
+
+# --------------------------------- drift/rollback drills (ISSUE 13)
+
+
+def test_drift_schedules_deterministic_and_cover_the_class():
+    gen = [chaos.drift_schedule(s) for s in chaos.DRIFT_TIER1_SEEDS]
+    again = [chaos.drift_schedule(s) for s in chaos.DRIFT_TIER1_SEEDS]
+    assert [s.plan for s in gen] == [s.plan for s in again]
+    scenarios = {s.scenario for s in gen}
+    # The five tier-1 seeds cover the whole failure class: the clean
+    # protocol, the eval crash (online_eval), the commit-window crash
+    # (ckpt_commit), the mid-demotion crash (ckpt_demote), and
+    # rollback under quarantine ingest corruption (ingest_corrupt).
+    assert scenarios == {"drift_clean_drift", "drift_eval_fault",
+                         "drift_commit_fault", "drift_demote_fault",
+                         "drift_rollback_corruption"}
+    for s in gen:
+        s.validate()  # every plan parses against the registry
+
+
+def test_tier1_drift_campaign_all_invariants_green(tmp_path):
+    """ISSUE 13 acceptance: the five seeded drift/rollback schedules
+    run the PRODUCTION online loop (label-flip drift, streaming day
+    shards, FTRL, crash-consistent chain) under fault plans, and the
+    artifact auditor proves — for every schedule — completion across
+    respawns, the sentry firing at the first drifted day, demotion
+    tombstones + a never-vetoed last_good, the exactly-once per-day
+    record stream, and byte-identical final params vs the clean run."""
+    entries = chaos.run_drift_campaign(base_dir=str(tmp_path))
+    failing = [(e["seed"], e["scenario"], e["violations"])
+               for e in entries if e["verdict"] != "green"]
+    assert len(entries) == 5
+    assert not failing, failing
+    assert all(e["rollbacks"] >= 1 for e in entries)
+    assert all(e["demoted"] for e in entries)

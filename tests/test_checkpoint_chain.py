@@ -256,3 +256,128 @@ def test_sigkill_mid_save_never_leaves_torn_latest(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(restored["params"]["w"]), np.asarray(params["w"]))
     ck.close()
+
+
+# ------------------------------------------ demotion tombstones (ISSUE 13)
+
+
+def _chain(ckdir, steps=(1, 2, 3)):
+    params = _params()
+    ck = Checkpointer(str(ckdir), save_every=1, async_save=False)
+    for s in steps:
+        ck.save(s, jax.tree_util.tree_map(lambda a: a * s, params),
+                {}, {"epoch": s}, force=True)
+    ck.wait()
+    return ck, params
+
+
+def test_demote_tombstones_and_republishes_last_good(tmp_path):
+    """The coordinated-rollback primitive: a committed, VERIFIED save
+    judged bad after publish gets a durable tombstone and the pointer
+    republishes at the newest good step — bytes intact, model vetoed."""
+    ck, params = _chain(tmp_path / "ck")
+    assert ck.last_good_step() == 3
+    assert ck.demote(3, reason="drift verdict") is True
+    assert ck.last_good_step() == 2
+    assert ck.is_tombstoned(3) and not ck.is_tombstoned(2)
+    restored = ck.restore(params, {})
+    assert restored["step"] == 2
+    # Idempotent: a second demotion of the same step is a no-op.
+    assert ck.demote(3) is False
+    ck.close()
+
+
+def test_demote_newer_than_is_one_atomic_range(tmp_path):
+    """``demote_newer_than`` writes ONE range tombstone — a kill can
+    never leave a partially-demoted suffix where some bad generation
+    is still trusted."""
+    ck, params = _chain(tmp_path / "ck", steps=(1, 2, 3, 4))
+    demoted = ck.demote_newer_than(2, reason="drift day")
+    assert demoted == [3, 4]
+    assert ck.tombstoned_steps() == {3, 4}
+    assert ck.tombstone_frontier() == 4
+    assert ck.last_good_step() == 2
+    stones = os.listdir(str(tmp_path / "ck" / "tombstones"))
+    assert stones == ["range_2_4.json"]  # one atomic veto
+    # Post-rollback saves land PAST the frontier and are trusted.
+    ck.save(5, _params(), {}, None, force=True)
+    ck.wait()
+    assert ck.last_good_step() == 5
+    assert ck.restore(params, {})["step"] == 5
+    ck.close()
+
+
+def test_explicit_restore_of_tombstoned_step_refuses(tmp_path):
+    ck, params = _chain(tmp_path / "ck")
+    ck.demote(3, reason="drift")
+    with pytest.raises(CheckpointChainBroken, match="tombstone"):
+        ck.restore(params, {}, step=3)
+    ck.close()
+
+
+def test_drift_alarm_racing_ckpt_commit_never_publishes(tmp_path):
+    """The alarm-during-commit race: a save whose verify window is
+    still open when its step gets demoted must NOT advance last_good
+    — the tombstone wins even against an in-flight commit."""
+    params = _params()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=1,
+                      async_save=True)
+    ck.save(1, params, {}, None, force=True)
+    ck.wait()
+    assert ck.last_good_step() == 1
+    # Async save 2: data commits, manifest still pending...
+    ck.save(2, params, {}, None, force=True)
+    ck._mgr.wait_until_finished()
+    # ...and the drift verdict lands BEFORE the verify flush.
+    os.makedirs(str(tmp_path / "ck" / "tombstones"), exist_ok=True)
+    with open(str(tmp_path / "ck" / "tombstones" / "2.json"),
+              "w") as f:
+        json.dump({"step": 2, "reason": "drift"}, f)
+    ck.wait()  # flushes the pending manifest
+    assert ck.last_good_step() == 1  # pointer never vouched for 2
+    assert ck.restore(params, {})["step"] == 1
+    ck.close()
+
+
+def test_ckpt_demote_fault_point_fires_in_the_demotion_window(tmp_path):
+    """Registry coverage for ``ckpt_demote``: the fault point sits
+    AFTER the tombstone write, BEFORE the pointer republish — an
+    injected error leaves exactly the mid-demotion state every reader
+    must already survive, and the re-run repairs the pointer."""
+    ck, params = _chain(tmp_path / "ck")
+    faults.activate("ckpt_demote@1=error")
+    with pytest.raises(FaultInjected):
+        ck.demote_newer_than(1, reason="drift")
+    # Tombstone durable, pointer stale — readers veto anyway.
+    assert ck.tombstoned_steps() == {2, 3}
+    assert ck.last_good_step() == 3  # stale
+    assert ck.restore(params, {})["step"] == 1
+    faults.clear()
+    # Recovery re-run: idempotent, repairs the pointer.
+    assert ck.demote_newer_than(1, reason="drift") == []
+    assert ck.last_good_step() == 1
+    ck.close()
+
+
+def test_follower_skips_tombstoned_steps(tmp_path):
+    from fm_spark_tpu.checkpoint import ChainFollower
+
+    ck, params = _chain(tmp_path / "ck")
+    ck.demote_newer_than(1, reason="drift")
+    ck.close()
+    fol = ChainFollower(str(tmp_path / "ck"))
+    assert fol.tombstoned_steps() == {2, 3}
+    restored = fol.restore(params, {})
+    assert restored is not None and restored["step"] == 1
+    fol.close()
+
+
+def test_sigkill_mid_demotion_recovers_to_pre_drift_save(tmp_path):
+    """ISSUE 13 acceptance: SIGKILL at any point during the demotion
+    window recovers to a consistent chain with ``last_good`` at the
+    pre-drift save — the chaos drill asserts it from artifacts alone."""
+    from fm_spark_tpu.resilience import chaos
+
+    r = chaos.run_demote_kill_drill(str(tmp_path / "drill"))
+    assert r["violations"] == [], r["violations"]
+    assert r["rcs"] == [23, 0]

@@ -542,3 +542,53 @@ def test_cli_elastic_shrink_resumes_on_half_mesh(tmp_path):
         assert done["degraded"] is True and done["chips"] == 4
     finally:
         del configs_lib.CONFIGS["elshrink"]
+
+
+def test_guard_maximize_mode_detects_metric_drop():
+    """ISSUE 13 satellite: maximize mode (higher-is-better, eval AUC)
+    fires when a finite value falls below trailing-median / factor —
+    the concept-drift direction — and non-finite is unconditional."""
+    g = DivergenceGuard(spike_factor=1.15, min_history=3, mode="max")
+    for step, auc in enumerate((0.74, 0.75, 0.73, 0.74), 1):
+        g.check(step, auc)  # healthy plateau
+    with pytest.raises(DivergenceDetected, match="metric drop"):
+        g.check(5, 0.55)  # 0.74 / 1.15 = 0.643 > 0.55
+    g2 = DivergenceGuard(spike_factor=1.15, min_history=3, mode="max")
+    g2.check(1, 0.7)
+    with pytest.raises(DivergenceDetected, match="non-finite"):
+        g2.check(2, float("nan"))
+
+
+def test_guard_maximize_min_history_floor_blocks_short_series():
+    """A short eval series can never trip the drop test: the first
+    ``min_history`` values bank unconditionally — in BOTH directions."""
+    g = DivergenceGuard(spike_factor=1.15, min_history=3, mode="max")
+    g.check(1, 0.9)
+    g.check(2, 0.2)   # huge drop, but only 1 value banked: no verdict
+    g.check(3, 0.15)  # still under the floor
+    gmin = DivergenceGuard(spike_factor=2.0, min_history=4, mode="min")
+    gmin.check(1, 1.0)
+    gmin.check(2, 50.0)  # would be a 50x spike with history
+    gmin.check(3, 60.0)
+
+
+def test_guard_maximize_history_roundtrip_and_rollback_budget():
+    g = DivergenceGuard(spike_factor=1.15, min_history=3, mode="max",
+                        max_rollbacks=1)
+    for step, auc in enumerate((0.7, 0.72, 0.71), 1):
+        g.check(step, auc)
+    assert g.history() == [0.7, 0.72, 0.71]
+    g2 = DivergenceGuard(spike_factor=1.15, min_history=3, mode="max",
+                         max_rollbacks=1)
+    g2.seed_history(g.history())  # the durable-resume path
+    with pytest.raises(DivergenceDetected) as exc:
+        g2.check(4, 0.3)
+    assert g2.note_rollback(exc.value, restored_step=2) >= 2
+    assert g2.history() == []  # window cleared for the replay
+    with pytest.raises(DivergenceDetected):  # budget spent
+        g2.note_rollback(exc.value, restored_step=2)
+
+
+def test_guard_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        DivergenceGuard(mode="sideways")

@@ -146,3 +146,27 @@ def test_chaos_fingerprint_splits_cohort_only_when_set():
     legacy = dict(real)
     del legacy["chaos"]
     assert fingerprint_key(legacy) == real["key"]
+
+
+def test_quality_eval_kind_is_cohort_isolated(tmp_path):
+    """ISSUE 13 satellite: quality_eval records (the online loop's
+    day-over-day AUC) live in their own leg namespace AND kind — a
+    kind/leg query for bench or serving cohorts never sees them, and
+    vice versa, so an AUC series can never pollute a throughput
+    trailing band."""
+    led = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    qfp = _fp(variant="quality/demo/ftrl")
+    led.append({"kind": "quality_eval", "leg": "quality/demo/ftrl",
+                "run_id": "r1", "value": 0.71, "day": 1,
+                "fingerprint": qfp})
+    led.append(_rec(value=1_000_000.0))              # bench_leg, legA
+    led.append({"kind": "serve_bench", "leg": "serve_b64",
+                "run_id": "r1", "value": 9000.0, "fingerprint": _fp()})
+    assert [r["value"] for r in led.records(kind="quality_eval")] \
+        == [0.71]
+    assert all(r["kind"] == "bench_leg"
+               for r in led.records(kind="bench_leg"))
+    assert led.records(leg="quality/demo/ftrl", kind="bench_leg") == []
+    # The cohort unit (leg, fingerprint key) holds for quality rows.
+    assert [r["day"] for r in led.cohort("quality/demo/ftrl",
+                                         qfp["key"])] == [1]

@@ -1,0 +1,308 @@
+"""Continuous-learning loop tests (ISSUE 13): the time-ordered
+train/eval protocol, the maximize-mode drift sentry, and the
+coordinated rollback through the checkpoint chain.
+
+The load-bearing contracts:
+
+- a planted label-flip drift fires the sentry at the FIRST drifted
+  eval day, the offending day's save is demoted (durable tombstone,
+  ``last_good`` republished at the pre-drift save) and the weights
+  roll back while the step axis keeps advancing (no step reuse);
+- the sentry's trailing window is DURABLE (saved in each checkpoint's
+  ``extra``) and a killed run replays its missed eval on resume, so a
+  crash can never skip a drift check;
+- ``quality_eval`` ledger records land with their own leg namespace
+  and sentinel cohorts;
+- ``cli train --online --optimizer ftrl`` runs the whole protocol end
+  to end, and a serving follower on the same chain never loads the
+  demoted generation.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models, online
+from fm_spark_tpu.checkpoint import Checkpointer
+from fm_spark_tpu.data import synthetic_ctr
+from fm_spark_tpu.resilience import faults, watchdog
+from fm_spark_tpu.resilience.divergence import DivergenceDetected
+from fm_spark_tpu.train import FMTrainer, TrainConfig
+from fm_spark_tpu.utils.logging import EventLog, read_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    watchdog.clear()
+    yield
+    faults.clear()
+    watchdog.clear()
+
+
+def _days(n_days=8, n=4096, features=256, drift_day=None, seed=3):
+    ids, vals, labels = synthetic_ctr(n, features, 4, seed=seed)
+    days = online.split_days(ids, vals, labels, n_days)
+    if drift_day is not None:
+        days = [(i, v, (1.0 - l).astype(np.float32)
+                 if k >= drift_day else l)
+                for k, (i, v, l) in enumerate(days)]
+    return days
+
+
+def _trainer(features=256, optimizer="ftrl", batch=128):
+    spec = models.FMSpec(num_features=features, rank=4, init_std=0.05)
+    cfg = TrainConfig(num_steps=0, batch_size=batch, learning_rate=0.1,
+                      lr_schedule="constant", optimizer=optimizer,
+                      log_every=10_000)
+    tr = FMTrainer(spec, cfg)
+    tr.logger._stream = None
+    return tr
+
+
+def test_split_days_is_temporal_and_validates():
+    ids, vals, labels = synthetic_ctr(100, 64, 4, seed=0)
+    days = online.split_days(ids, vals, labels, 4)
+    assert sum(len(d[2]) for d in days) == 100
+    assert np.array_equal(np.concatenate([d[0] for d in days]), ids)
+    with pytest.raises(ValueError, match=">= 2 days"):
+        online.split_days(ids, vals, labels, 1)
+
+
+def test_drift_guard_requires_max_mode(tmp_path):
+    from fm_spark_tpu.resilience.divergence import DivergenceGuard
+
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False)
+    with pytest.raises(ValueError, match="max"):
+        online.run_online(tr, _days(), ck,
+                          sentry=DivergenceGuard(mode="min"))
+    ck.close()
+
+
+def test_label_flip_drift_demotes_and_rolls_back(tmp_path):
+    """The headline protocol: AUC collapses at the first drifted eval
+    day, the sentry fires, the drifted day's save is demoted with a
+    durable tombstone, last_good republishes at the pre-drift save,
+    the weights roll back, and the step axis continues past the
+    tombstoned frontier (no step number reuse)."""
+    journal = EventLog(str(tmp_path / "health.jsonl"))
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False, journal=journal)
+    days = _days(drift_day=5)
+    summary = online.run_online(
+        tr, days, ck, sentry=online.drift_guard(journal=journal),
+        journal=journal)
+    assert summary["rollbacks"] == 1
+    assert summary["demoted_steps"]
+    rolled = [d for d in summary["days"] if d["rolled_back"]]
+    assert rolled and rolled[0]["eval_day"] == 5  # first drifted day
+    # Chain state: demoted steps tombstoned, pointer never vouches for
+    # a vetoed step, and the final tip is a fresh post-rollback save.
+    stones = ck.tombstoned_steps()
+    assert set(summary["demoted_steps"]) <= stones
+    assert summary["last_good"] not in stones
+    assert summary["final_step"] > max(stones)
+    evs = [e.get("event") for e in read_events(
+        str(tmp_path / "health.jsonl"))]
+    for wanted in ("divergence_detected", "generation_demoted",
+                   "last_good_republished", "online_rollback",
+                   "quality_eval"):
+        assert wanted in evs
+    ck.close()
+    journal.close()
+
+
+def test_no_drift_means_no_rollback(tmp_path):
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False)
+    summary = online.run_online(tr, _days(n_days=5), ck,
+                                sentry=online.drift_guard())
+    assert summary["rollbacks"] == 0
+    assert ck.tombstoned_steps() == set()
+    assert summary["last_good"] == summary["final_step"]
+    ck.close()
+
+
+def test_kill_between_save_and_eval_replays_the_drift_check(tmp_path):
+    """The crash window that could silently skip a drift verdict: the
+    run dies AFTER the drifted day's save commits, BEFORE its eval
+    runs. The resumed run must REPLAY the missed eval from durable
+    sentry state (the checkpoint's ``extra``) and still fire the
+    sentry — bit-identically to the uninterrupted run."""
+    days = _days(drift_day=5)
+    journal = EventLog(str(tmp_path / "health.jsonl"))
+
+    # Attempt 1: die at the 6th eval (eval day 6 == the drift check
+    # ... occurrence 5 is eval day 5, the first drifted one) — kill
+    # exactly AT the drifted eval, before it can judge.
+    faults.activate("online_eval@5=error")
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False, journal=journal)
+    with pytest.raises(faults.FaultInjected):
+        online.run_online(tr, days, ck,
+                          sentry=online.drift_guard(journal=journal),
+                          journal=journal)
+    ck.close()
+    faults.clear()
+
+    # Attempt 2 (the resume): fresh trainer + checkpointer over the
+    # same chain; the replayed eval must fire the sentry and demote.
+    tr2 = _trainer()
+    ck2 = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                       async_save=False, journal=journal)
+    summary = online.run_online(
+        tr2, days, ck2, sentry=online.drift_guard(journal=journal),
+        journal=journal)
+    assert summary["rollbacks"] == 1
+    rolled = [d for d in summary["days"] if d["rolled_back"]]
+    assert rolled and rolled[0]["eval_day"] == 5
+    assert set(summary["demoted_steps"]) <= ck2.tombstoned_steps()
+    ck2.close()
+    journal.close()
+
+
+def test_online_eval_watchdog_phase_bounds_a_hang(tmp_path):
+    """The ``online_eval`` watchdog phase (KNOWN_PHASES): a hang inside
+    the day-eval pass becomes a structured HangDetected instead of a
+    silently stalled drift sentry."""
+    faults.activate("online_eval@1=hang:0.3")
+    watchdog.configure({"online_eval": 0.05}, action="raise")
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False)
+    with pytest.raises(watchdog.HangDetected, match="online_eval"):
+        online.run_online(tr, _days(n_days=4), ck,
+                          sentry=online.drift_guard())
+    ck.close()
+
+
+def test_rollback_budget_exhaustion_propagates(tmp_path):
+    """Persistent drift is a data/model problem: when the sentry's
+    rollback budget is spent, the verdict PROPAGATES (after demoting —
+    the bad model still must not serve)."""
+    days = _days(drift_day=4, n_days=8)
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False)
+    sentry = online.drift_guard(max_rollbacks=0)
+    with pytest.raises(DivergenceDetected):
+        online.run_online(tr, days, ck, sentry=sentry)
+    # The demotion still happened before the propagation.
+    assert ck.tombstoned_steps()
+    ck.close()
+
+
+def test_quality_eval_ledger_records_and_cohorts(tmp_path):
+    from fm_spark_tpu.obs.ledger import (
+        PerfLedger,
+        measurement_fingerprint,
+    )
+
+    ledger = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    fp = measurement_fingerprint(variant="quality/test/ftrl",
+                                 model="fm", batch=128, n_chips=1)
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False)
+    summary = online.run_online(
+        tr, _days(n_days=5), ck, sentry=online.drift_guard(),
+        ledger=ledger, leg="quality/test/ftrl", fingerprint=fp,
+        run_id="r-test")
+    recs = ledger.records(kind="quality_eval")
+    assert len(recs) == summary["days_trained"]
+    assert all(r["leg"] == "quality/test/ftrl" for r in recs)
+    assert all(isinstance(r.get("value"), float) for r in recs)
+    assert all("sentinel" in r for r in recs)
+    # Cohort isolation: bench-kind queries never see quality rows.
+    assert ledger.records(kind="bench_leg") == []
+    ck.close()
+
+
+def test_online_requires_provenance_fields(tmp_path):
+    from fm_spark_tpu.obs.ledger import PerfLedger
+
+    tr = _trainer()
+    ck = Checkpointer(str(tmp_path / "ck"), save_every=10**9,
+                      async_save=False)
+    with pytest.raises(ValueError, match="provenance"):
+        online.run_online(tr, _days(n_days=4), ck,
+                          ledger=PerfLedger(str(tmp_path / "l.jsonl")))
+    ck.close()
+
+
+def test_cli_online_end_to_end_with_serving_follower(tmp_path, capsys):
+    """ISSUE 13 acceptance: ``cli train --online --optimizer ftrl`` on
+    synthetic time-ordered days — per-day AUC in the ledger as
+    ``quality_eval``, the injected label-flip fires the sentry, the
+    bad generation is demoted — and a serving follower on the same
+    chain is journal-asserted to SKIP the demoted generation and serve
+    the (post-rollback) good tip."""
+    from fm_spark_tpu import cli
+
+    ck_dir = tmp_path / "ck"
+    ledger_path = tmp_path / "ledger.jsonl"
+    rc = cli.main([
+        "train", "--config", "movielens_fm_r8", "--synthetic", "4096",
+        "--online", "--online-days", "8", "--drift-inject", "5",
+        "--optimizer", "ftrl", "--batch-size", "128", "--lr", "0.1",
+        "--steps", "0", "--checkpoint-dir", str(ck_dir),
+        "--quality-ledger", str(ledger_path), "--log-every", "10000",
+        "--test-fraction", "0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(
+        [ln for ln in out.splitlines() if '"online"' in ln][-1]
+    )["online"]
+    assert summary["rollbacks"] >= 1 and summary["demoted_steps"]
+    recs = [json.loads(ln) for ln in open(ledger_path)]
+    assert {r["kind"] for r in recs} == {"quality_eval"}
+    assert all(r["leg"].startswith("quality/") for r in recs)
+
+    # A serving follower over the SAME chain: restores the published
+    # tip, skips every tombstoned generation (journal-asserted), and
+    # the artifact auditor agrees nothing demoted was ever installed.
+    import jax
+
+    from fm_spark_tpu import configs as configs_lib
+    from fm_spark_tpu.resilience.chaos import audit_serve_events
+    from fm_spark_tpu.serve import PredictEngine, ReloadFollower
+    from fm_spark_tpu.train import make_optimizer
+
+    cfg = configs_lib.get_config("movielens_fm_r8", optimizer="ftrl",
+                                 batch_size=128, learning_rate=0.1)
+    spec = models.FMSpec(num_features=4096, rank=8, init_std=0.01)
+    init = spec.init(jax.random.key(cfg.seed))
+    opt_ex = make_optimizer(cfg.train_config()).init(init)
+    journal = EventLog(str(tmp_path / "serve_health.jsonl"))
+    eng = PredictEngine(spec, init, nnz=2, buckets=(8,),
+                        latency_budget_ms=0.0, journal=journal)
+    eng.warmup()
+    fol = ReloadFollower(eng, str(ck_dir), poll_s=0.05,
+                         journal=journal, params_example=init,
+                         opt_state_example=opt_ex)
+    try:
+        assert fol.poll_once() == "swapped"
+        ck = Checkpointer(str(ck_dir), save_every=10**9,
+                          async_save=False)
+        stones = ck.tombstoned_steps()
+        ck.close()
+        assert stones, "drift run left no tombstones"
+        assert eng.generation().step == summary["last_good"]
+        assert eng.generation().step not in stones
+        events = read_events(str(tmp_path / "serve_health.jsonl"))
+        assert audit_serve_events(events,
+                                  tombstoned_steps=stones) == []
+    finally:
+        fol.stop()
+        eng.close()
+        journal.close()

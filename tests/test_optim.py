@@ -1,0 +1,252 @@
+"""Per-coordinate optimizer tests (ISSUE 13): FTRL-Proximal and
+AdaGrad in both forms — the dense optax transformation and the sparse
+dedup/scatter row step — held to one contract:
+
+- **exact laziness** — an untouched coordinate is BIT-unchanged (FTRL's
+  closed form reproduces the stored weight because ``ftrl_init_z``
+  seeds ``z`` from the init; AdaGrad's zero-gradient step is zero), so
+  the sparse step equals the dense transformation on every touched
+  coordinate and leaves the rest alone;
+- **slots ride checkpoints** — an FMTrainer kill-and-resume with FTRL
+  replays bit-identical losses (the z/n slots are opt_state like any
+  other);
+- **no silent fallbacks** — the fused field families keep rejecting
+  adaptive optimizers; the adaptive step rejects the lazy-L2 triple.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fm_spark_tpu import models, optim
+from fm_spark_tpu.train import FMTrainer, TrainConfig, make_optimizer
+
+
+def _fresh(params0):
+    return jax.tree_util.tree_map(jnp.array, params0)
+
+
+def _data(num_features=64, B=32, nnz=3, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, num_features, size=(B, nnz)).astype(np.int32)
+    vals = np.ones((B, nnz), np.float32)
+    labels = (rng.random(B) < 0.5).astype(np.float32)
+    w = np.ones(B, np.float32)
+    return ids, vals, labels, w
+
+
+def test_ftrl_zero_grad_is_a_fixpoint():
+    """The init-preservation contract: with z seeded by ftrl_init_z, a
+    zero gradient leaves every coordinate bit-meaningfully unchanged —
+    without it FTRL zeroes FM factors on first touch and the
+    interaction gradient dies forever."""
+    import optax
+
+    spec = models.FMSpec(num_features=32, rank=4, init_std=0.05)
+    params = spec.init(jax.random.key(0))
+    tx = make_optimizer(TrainConfig(optimizer="ftrl",
+                                    learning_rate=0.1))
+    st = tx.init(params)
+    zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+    upd, _ = tx.update(zero, st, params)
+    p2 = optax.apply_updates(params, upd)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p2[k]),
+                                   np.asarray(params[k]), atol=1e-6)
+
+
+def test_ftrl_l1_shrinks_small_coordinates_to_exact_zero():
+    rows = jnp.ones((4, 2), jnp.float32) * 0.01
+    z = jnp.zeros((4, 2))
+    n = jnp.zeros((4, 2))
+    g = jnp.full((4, 2), 1e-4)
+    new_rows, z2, n2 = optim.ftrl_rows(rows, z, n, g, alpha=0.1,
+                                       beta=1.0, l1=1.0, l2=0.0)
+    assert np.all(np.asarray(new_rows) == 0.0)  # proximal hard zero
+    assert np.all(np.asarray(n2) > 0)
+
+
+@pytest.mark.parametrize("optimizer", ["ftrl", "adagrad"])
+def test_sparse_adaptive_step_matches_dense_on_touched_rows(optimizer):
+    """The sparse step rides the dedup scatter path; per-coordinate
+    totals via segment sums make it equal the dense per-coordinate rule
+    on every touched coordinate, while untouched rows stay bit-frozen
+    (the lazy contract, mirroring the sparse-SGD step's)."""
+    spec = models.FMSpec(num_features=64, rank=4, init_std=0.05,
+                         use_bias=False)
+    params0 = jax.tree_util.tree_map(
+        np.asarray, spec.init(jax.random.key(0)))
+    cfg = TrainConfig(optimizer=optimizer, learning_rate=0.1,
+                      lr_schedule="constant")
+    step = optim.make_sparse_adaptive_step(spec, cfg)
+    slots = optim.init_adaptive_slots(optimizer, spec, _fresh(params0))
+    if optimizer == "ftrl":
+        slots = optim.seed_ftrl_slots(slots, _fresh(params0), 0.1, 1.0)
+    ids, vals, labels, w = _data()
+
+    # Dense per-coordinate reference: for ftrl the optax transform; for
+    # adagrad the same rule applied to the dense analytic gradient.
+    p_s = _fresh(params0)
+    sl = slots
+    if optimizer == "ftrl":
+        import optax
+
+        from fm_spark_tpu.train import make_train_step
+
+        dstep = make_train_step(spec, cfg)
+        p_d = _fresh(params0)
+        o_d = make_optimizer(cfg).init(p_d)
+        for _ in range(5):
+            p_d, o_d, m = dstep(p_d, o_d, jnp.asarray(ids),
+                                jnp.asarray(vals), jnp.asarray(labels),
+                                jnp.asarray(w))
+            p_s, sl, loss = step(p_s, sl, jnp.asarray(ids),
+                                 jnp.asarray(vals), jnp.asarray(labels),
+                                 jnp.asarray(w))
+            np.testing.assert_allclose(float(m["loss"]), float(loss),
+                                       rtol=2e-5)
+        dense = {k: np.asarray(v) for k, v in p_d.items()}
+    else:
+        # numpy float64-ish dense AdaGrad over the analytic FM grad.
+        from fm_spark_tpu.ops import losses as losses_lib
+
+        per_loss = losses_lib.loss_fn(spec.loss)
+
+        def dense_grads(p):
+            def f(pt):
+                scores = spec.scores(pt, jnp.asarray(ids),
+                                     jnp.asarray(vals))
+                per = per_loss(scores, jnp.asarray(labels)) \
+                    * jnp.asarray(w)
+                return jnp.sum(per) / jnp.maximum(jnp.sum(
+                    jnp.asarray(w)), 1.0)
+
+            return jax.grad(f)(p)
+
+        p_d = _fresh(params0)
+        n_acc = {k: np.zeros(np.shape(v), np.float32)
+                 for k, v in params0.items() if k in ("v", "w")}
+        for _ in range(5):
+            g = dense_grads(p_d)
+            newp = dict(p_d)
+            for k in ("v", "w"):
+                gk = np.asarray(g[k], np.float32)
+                n_acc[k] = n_acc[k] + gk * gk
+                stepk = 0.1 * gk / (np.sqrt(n_acc[k])
+                                    + optim.ADAGRAD_EPS)
+                newp[k] = jnp.asarray(np.asarray(p_d[k]) - stepk)
+            p_d = newp
+            p_s, sl, _ = step(p_s, sl, jnp.asarray(ids),
+                              jnp.asarray(vals), jnp.asarray(labels),
+                              jnp.asarray(w))
+        dense = {k: np.asarray(v) for k, v in p_d.items()}
+
+    touched = np.unique(ids)
+    untouched = np.setdiff1d(np.arange(64), touched)
+    for k in ("v", "w"):
+        np.testing.assert_allclose(dense[k][touched],
+                                   np.asarray(p_s[k])[touched],
+                                   atol=3e-5)
+        # Lazy contract: untouched rows bit-identical to the init.
+        assert np.array_equal(np.asarray(p_s[k])[untouched],
+                              params0[k][untouched])
+
+
+def test_duplicate_ids_update_schedule_exactly_once():
+    """A duplicated id within the batch must see its TOTAL gradient
+    once (segment-summed), not two half-updates: adaptive rules are
+    read-modify-write, and double-counting would double the
+    per-coordinate schedule (n would grow twice as fast)."""
+    spec = models.FMSpec(num_features=16, rank=2, init_std=0.05,
+                         use_bias=False, use_linear=False)
+    params0 = jax.tree_util.tree_map(
+        np.asarray, spec.init(jax.random.key(1)))
+    cfg = TrainConfig(optimizer="adagrad", learning_rate=0.1,
+                      lr_schedule="constant")
+    step = optim.make_sparse_adaptive_step(spec, cfg)
+    # Batch of 2 rows activating the SAME id in one column.
+    ids = np.array([[3, 7], [3, 9]], np.int32)
+    vals = np.ones((2, 2), np.float32)
+    labels = np.array([1.0, 0.0], np.float32)
+    w = np.ones(2, np.float32)
+    slots = optim.init_adaptive_slots("adagrad", spec, _fresh(params0))
+    _, sl2, _ = step(_fresh(params0), slots, jnp.asarray(ids),
+                     jnp.asarray(vals), jnp.asarray(labels),
+                     jnp.asarray(w))
+    n3 = np.asarray(sl2["v"]["n"])[3]
+    assert np.all(n3 > 0)
+    # n must be (g_a + g_b)^2 per coordinate — recompute analytically.
+    rows = params0["v"][ids]
+    xv = rows * vals[..., None]
+    s = xv.sum(axis=1)
+    scores = 0.5 * ((s * s).sum(-1) - (xv * xv).sum((1, 2)))
+    p = 1.0 / (1.0 + np.exp(-scores))
+    dsc = (p - labels) / 2.0
+    g_rows = dsc[:, None, None] * vals[..., None] * (s[:, None, :] - xv)
+    g3 = g_rows[0, 0] + g_rows[1, 0]  # both lanes hit id 3
+    np.testing.assert_allclose(n3, g3 * g3, rtol=1e-5)
+
+
+def test_ftrl_slots_ride_checkpoints_bit_identical(tmp_path):
+    """Kill-and-resume continuity with per-coordinate slots: an FTRL
+    FMTrainer checkpointed mid-run resumes with a loss curve
+    bit-identical to the uninterrupted one — the z/n slots are
+    opt_state, so the chain carries them like any other state."""
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.data import Batches, synthetic_ctr
+
+    spec = models.FMSpec(num_features=128, rank=4, init_std=0.05)
+    cfg = TrainConfig(num_steps=12, batch_size=32, learning_rate=0.1,
+                      lr_schedule="constant", optimizer="ftrl",
+                      log_every=1)
+    ids, vals, labels = synthetic_ctr(256, 128, 3, seed=5)
+
+    def run(ck_dir, stop_at=None):
+        tr = FMTrainer(spec, cfg)
+        tr.logger._stream = None
+        ck = Checkpointer(str(ck_dir), save_every=4, async_save=False)
+        b = Batches(ids, vals, labels, 32, seed=1)
+        tr.fit(b, num_steps=stop_at, checkpointer=ck) \
+            if stop_at else tr.fit(b, checkpointer=ck)
+        ck.close()
+        return tr
+
+    golden = run(tmp_path / "g")
+    run(tmp_path / "k", stop_at=6)       # "killed" at step 6
+    resumed = run(tmp_path / "k")        # resumes from the chain
+    assert resumed.loss_history == golden.loss_history
+    for k in golden.params:
+        assert np.array_equal(np.asarray(resumed.params[k]),
+                              np.asarray(golden.params[k]))
+
+
+def test_adaptive_step_rejections():
+    spec = models.FMSpec(num_features=16, rank=2, init_std=0.05)
+    with pytest.raises(ValueError, match="adaptive"):
+        optim.make_sparse_adaptive_step(
+            spec, TrainConfig(optimizer="sgd"))
+    with pytest.raises(ValueError, match="reg"):
+        optim.make_sparse_adaptive_step(
+            spec, TrainConfig(optimizer="ftrl", reg_factors=1e-4))
+    ffm = models.FFMSpec(num_features=16, rank=2, num_fields=2,
+                         init_std=0.05)
+    with pytest.raises(ValueError, match="flat FM"):
+        optim.make_sparse_adaptive_step(
+            ffm, TrainConfig(optimizer="ftrl"))
+    with pytest.raises(ValueError, match="unknown adaptive"):
+        optim.init_adaptive_slots("sgd", spec, {})
+
+
+def test_fused_field_families_still_reject_adaptive_optimizers():
+    """No silent fallback: the fused field bodies are SGD scatter
+    programs; an adaptive optimizer must be refused there, not
+    quietly ignored."""
+    from fm_spark_tpu.sparse import make_field_sparse_sgd_body
+
+    spec = models.FieldFMSpec(num_features=8 * 4, rank=2, num_fields=4,
+                              bucket=8, init_std=0.05)
+    with pytest.raises(ValueError, match="SGD"):
+        make_field_sparse_sgd_body(
+            spec, TrainConfig(optimizer="ftrl"))

@@ -258,3 +258,56 @@ def test_doctor_chaos_findings_green_and_budget():
         {"all_green": False, "failures": [], "budget_exhausted": True,
          "n_skipped": 7})
     assert any("out of budget" in f for f in over)
+
+
+def test_doctor_renders_continuous_learning_section(tmp_path, capsys):
+    """ISSUE 13: a run with an online-learning footprint (quality_eval
+    ledger rows + drift events in the flight ring) gets a Continuous
+    learning section — the AUC series with verdicts, the drift
+    timeline, and a DRIFT ROLLBACK finding."""
+    doctor = _load_doctor()
+    run_dir = tmp_path / "r9"
+    run_dir.mkdir()
+    (run_dir / "trace.jsonl").write_text("")
+    flight = [
+        {"kind": "quality_eval", "ts": 10.0, "seq": 1, "day": 3,
+         "eval_day": 4, "step": 16, "auc": 0.67, "sentinel": "flat"},
+        {"kind": "divergence_detected", "ts": 11.0, "seq": 2,
+         "step": 20, "reason": "metric drop", "mode": "max"},
+        {"kind": "generation_demoted", "ts": 11.1, "seq": 3,
+         "steps": [20], "newer_than": 16},
+        {"kind": "last_good_republished", "ts": 11.2, "seq": 4,
+         "prev": 20, "step": 16},
+        {"kind": "online_rollback", "ts": 11.3, "seq": 5, "day": 4,
+         "demoted": [20], "restored_step": 16},
+    ]
+    (run_dir / "flight.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in flight))
+    (run_dir / "metrics.jsonl").write_text(json.dumps({
+        "gauges": {"online/auc": 0.32, "online/drift_score": 0.52,
+                   "checkpoint/quarantined_generations": 1},
+        "counters": {"online.days_total": 5,
+                     "online.rollbacks_total": 1,
+                     "checkpoint.demotions_total": 1},
+    }) + "\n")
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [
+        {"kind": "quality_eval", "leg": "quality/demo/ftrl",
+         "run_id": "r9", "value": 0.70, "day": 1, "step": 4,
+         "fingerprint": {"key": "k1"},
+         "sentinel": {"verdict": "flat"}},
+        {"kind": "quality_eval", "leg": "quality/demo/ftrl",
+         "run_id": "r9", "value": 0.32, "day": 4, "step": 20,
+         "fingerprint": {"key": "k1"},
+         "sentinel": {"verdict": "regressed",
+                      "reason": "z=-9 below the band"}},
+    ]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert doctor.main([str(run_dir), "--ledger", str(ledger)]) == 0
+    out = capsys.readouterr().out
+    assert "## Continuous learning" in out
+    assert "drift timeline:" in out
+    assert "generation_demoted" in out
+    assert "0.3200" in out and "regressed" in out
+    assert "DRIFT ROLLBACK" in out
+    assert "QUALITY REGRESSED" in out

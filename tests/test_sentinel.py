@@ -479,3 +479,33 @@ def test_emit_final_gate_refuses_chaos_stamped_payload(tmp_path,
     assert dst.read_bytes() == before, (
         "a chaos-stamped payload overwrote MEASURED.json")
     assert json.loads(capsys.readouterr().out)["value"] == 9_999_999.0
+
+
+def test_quality_eval_drifting_auc_series_verdicts(tmp_path):
+    """ISSUE 13 satellite: the sentinel over a quality_eval cohort —
+    a healthy AUC plateau reads flat, the label-flip collapse reads
+    regressed (it is a QUALITY regression, not weather), and the
+    cohort never mixes with bench legs."""
+    led = PerfLedger(str(tmp_path / "l.jsonl"))
+    fp = measurement_fingerprint(variant="quality/demo/ftrl",
+                                 model="fm")
+    plateau = [0.712, 0.708, 0.715, 0.711, 0.709, 0.713]
+    for i, auc in enumerate(plateau):
+        led.append({"kind": "quality_eval", "leg": "quality/demo",
+                    "run_id": f"d{i}", "value": auc,
+                    "fingerprint": fp})
+    s = Sentinel(led)
+    assert s.judge("quality/demo", 0.710, fp)["verdict"] == "flat"
+    drift = s.judge("quality/demo", 0.33, fp)
+    assert drift["verdict"] == "regressed"
+    assert drift["z"] < -3
+    # Same drop under adverse attachment weather would be transient —
+    # but quality evals run on-host; healthy weather keeps it real.
+    fp_flaky = measurement_fingerprint(variant="quality/demo/ftrl",
+                                       model="fm",
+                                       attachment_health="flaky")
+    assert s.judge("quality/demo", 0.33, fp_flaky)["verdict"] \
+        == "attachment_transient"
+    # Cohort isolation: a bench leg's history is invisible here.
+    assert s.judge("bench_legZ", 0.7, fp)["verdict"] \
+        == "insufficient_history"

@@ -358,6 +358,137 @@ def test_reload_fault_degrades_then_converges(tmp_path):
         eng.close()
 
 
+def test_follower_refuses_demoted_tip_and_converges_forward(tmp_path):
+    """ISSUE 13: a generation judged bad AFTER publish (drift verdict
+    → ``demote``: durable tombstone, ``last_good`` republished) must
+    never be hot-loaded — the follower reports the quarantined tip as
+    a degraded poll and keeps serving the prior generation, then
+    converges FORWARD when a newer good save lands."""
+    spec = _spec()
+    chain = tmp_path / "chain"
+    journal_path = tmp_path / "serve_health.jsonl"
+    ck = Checkpointer(str(chain), save_every=1, async_save=False)
+    ck.save(5, _params(spec, scale=2.0), {}, None, force=True)
+    ck.save(9, _params(spec, scale=3.0), {}, None, force=True)
+    ck.wait()
+    # The drift sentry demotes the freshly published tip before any
+    # follower loads it: tombstone durable, pointer republished.
+    assert ck.demote(9, reason="drift verdict") is True
+    assert ck.last_good_step() == 5
+    eng = _engine(spec, _params(spec), buckets=(4,), budget_ms=0.0)
+    fol = ReloadFollower(eng, str(chain), poll_s=0.05,
+                         journal=EventLog(str(journal_path)),
+                         opt_state_example={})
+    try:
+        ids, vals = _batch(spec, 4)
+        # Follower restores the PRE-drift generation, never 9:
+        assert fol.poll_once() == "swapped"
+        assert eng.generation().step == 5
+        assert np.array_equal(
+            eng.score(ids, vals),
+            _direct(spec, _params(spec, scale=2.0), ids, vals))
+        events = read_events(str(journal_path))
+        assert any(e["event"] == "checkpoint_demoted_skipped"
+                   and e["step"] == 9 for e in events)
+        # A newer good save converges serving forward past the veto.
+        ck.save(12, _params(spec, scale=4.0), {}, None, force=True)
+        ck.wait()
+        assert fol.poll_once() == "swapped"
+        assert eng.generation().step == 12
+        # The artifact-only auditor proves no tombstoned generation
+        # was ever installed.
+        events = read_events(str(journal_path))
+        assert chaos.audit_serve_events(
+            events, tombstoned_steps=ck.tombstoned_steps()) == []
+    finally:
+        fol.stop()
+        eng.close()
+        ck.close()
+
+
+def test_demotion_racing_reload_is_refused(tmp_path):
+    """The nastiest interleaving (ISSUE 13): the demotion lands AFTER
+    the follower restored the new generation but BEFORE the swap — the
+    tombstone re-check at the swap boundary must win the race."""
+    spec = _spec()
+    chain = tmp_path / "chain"
+    ck = Checkpointer(str(chain), save_every=1, async_save=False)
+    ck.save(5, _params(spec, scale=2.0), {}, None, force=True)
+    ck.save(9, _params(spec, scale=3.0), {}, None, force=True)
+    ck.wait()
+    journal_path = tmp_path / "serve_health.jsonl"
+    eng = _engine(spec, _params(spec, scale=2.0), buckets=(4,),
+                  budget_ms=0.0)
+    eng.swap_generation(_params(spec, scale=2.0), 5)
+    fol = ReloadFollower(eng, str(chain), poll_s=0.05,
+                         journal=EventLog(str(journal_path)),
+                         opt_state_example={})
+    orig_restore = fol.chain.restore
+
+    def restore_then_demote(*a, **kw):
+        out = orig_restore(*a, **kw)
+        ck.demote(9, reason="drift verdict racing the reload")
+        return out
+
+    fol.chain.restore = restore_then_demote
+    try:
+        assert fol.poll_once() == "demoted"
+        assert eng.generation().step == 5  # never installed 9
+        assert fol.degraded
+        events = read_events(str(journal_path))
+        assert any(e["event"] == "reload_failed"
+                   and "demoted mid-reload" in str(e.get("error"))
+                   for e in events)
+        assert chaos.audit_serve_events(
+            events, tombstoned_steps={9}) == []
+    finally:
+        fol.chain.restore = orig_restore
+        fol.stop()
+        eng.close()
+        ck.close()
+
+
+def test_audit_flags_swap_to_tombstoned_generation():
+    """The no_tombstoned_generation invariant is non-vacuous: a
+    journal showing a swap INTO a demoted step must fail the audit."""
+    events = [{"event": "serve_swap", "step": 9, "gen_id": 1,
+               "from_step": 5}]
+    v = chaos.audit_serve_events(events, tombstoned_steps={9})
+    assert [x["invariant"] for x in v] == ["no_tombstoned_generation"]
+    assert chaos.audit_serve_events(events, tombstoned_steps={7}) == []
+
+
+def test_follower_torn_last_good_is_retried_not_raised(tmp_path):
+    """ISSUE 13 satellite: a torn/empty ``last_good.json`` read (a
+    copied or damaged chain — an atomic-replace reader never sees a
+    partial write, but the file CAN be empty on disk) must surface as
+    'nothing published yet' and heal on the next poll, never raise."""
+    spec = _spec()
+    chain = tmp_path / "chain"
+    ck = Checkpointer(str(chain), save_every=1, async_save=False)
+    ck.save(3, _params(spec, scale=2.0), {}, None, force=True)
+    ck.wait()
+    # Tear the pointer: empty file, then junk bytes.
+    lg = chain / "last_good.json"
+    eng = _engine(spec, _params(spec), buckets=(4,), budget_ms=0.0)
+    fol = ReloadFollower(eng, str(chain), poll_s=0.05,
+                         opt_state_example={})
+    try:
+        for torn in (b"", b'{"st'):
+            lg.write_bytes(torn)
+            assert fol.chain.last_good_step() is None
+            assert fol.poll_once() == "no_checkpoint"
+        # The trainer's next atomic replace heals the pointer; the
+        # very next poll serves it.
+        lg.write_bytes(json.dumps({"step": 3}).encode())
+        assert fol.poll_once() == "swapped"
+        assert eng.generation().step == 3
+    finally:
+        fol.stop()
+        eng.close()
+        ck.close()
+
+
 def _flip_step_bytes(chain_dir, step):
     import glob
 

@@ -89,7 +89,7 @@ def render(verdict: dict) -> str:
     for e in verdict["schedules"]:
         mark = {"green": "ok ", "failed": "FAIL",
                 "skipped_budget": "skip"}.get(e["verdict"], "?   ")
-        out.append(f"  [{mark}] seed {e['seed']:>4} "
+        out.append(f"  [{mark}] seed {str(e.get('seed', '-')):>4} "
                    f"{(e.get('scenario') or '-'):14} "
                    f"{(e.get('outcome') or '-'):16} {e.get('plan') or ''}")
         for viol in e.get("violations", []):
@@ -160,6 +160,33 @@ def _soak_subprocess_drills(cfg, base_dir: str) -> list[dict]:
     return entries
 
 
+def _drift_entries(base_dir: str, soak: bool) -> list[dict]:
+    """The continuous-learning half of the campaign (ISSUE 13): five
+    seeded drift/rollback schedules drilled against the production
+    online loop (planted label-flip drift, demotion tombstones,
+    coordinated rollback), plus — in soak mode — the subprocess
+    SIGKILL-mid-demotion drill."""
+    from fm_spark_tpu.resilience import chaos
+
+    entries = chaos.run_drift_campaign(
+        base_dir=os.path.join(base_dir, "drift"))
+    if soak:
+        t0 = time.perf_counter()
+        r = chaos.run_demote_kill_drill(
+            os.path.join(base_dir, "demote_kill"))
+        entries.append({
+            "seed": None, "scenario": "subprocess:demote_kill",
+            "plan": "ckpt_demote@1=exit:23", "expects": "recovered",
+            "outcome": ("recovered" if not r["violations"]
+                        else "violated"),
+            "rcs": r["rcs"],
+            "verdict": "green" if not r["violations"] else "failed",
+            "violations": r["violations"],
+            "duration_s": round(time.perf_counter() - t0, 3),
+        })
+    return entries
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos campaigns over the resilience stack")
@@ -218,9 +245,18 @@ def main(argv=None) -> int:
         seeds, cfg=cfg, base_dir=base_dir, time_budget_s=budget,
         per_schedule_timeout_s=args.per_schedule,
         minimize_failures=args.canary or not args.no_minimize)
+    extra = []
+    if not args.canary and args.seeds is None and args.schedules is None:
+        # Drift/rollback schedules ride every default bounded and soak
+        # campaign (ISSUE 13); an explicit --seeds/--schedules run is
+        # a targeted replay and drills exactly what it names, and the
+        # canary's broken-restore hook has no business in the online
+        # loop.
+        extra.extend(_drift_entries(base_dir, soak=args.soak))
     if args.soak:
-        extra = _soak_subprocess_drills(
-            dataclasses.replace(cfg, break_restore=False), base_dir)
+        extra.extend(_soak_subprocess_drills(
+            dataclasses.replace(cfg, break_restore=False), base_dir))
+    if extra:
         verdict["schedules"].extend(extra)
         verdict["n_schedules"] += len(extra)
         verdict["n_green"] += sum(e["verdict"] == "green"

@@ -141,9 +141,23 @@ def serve_timeline(flight_events: list[dict]) -> list[dict]:
     12): ``serve_*``/``reload_*`` events, payload-deduped — a
     journaled event and its flight-ring mirror are the same
     transition. Shared by this report and ``tools/run_doctor.py``."""
+    return _dedup_timeline(flight_events, ("serve_", "reload_"))
+
+
+def online_timeline(flight_events: list[dict]) -> list[dict]:
+    """The continuous-learning timeline (ISSUE 13): eval verdicts,
+    drift alarms, demotions, rollbacks, pointer republishes — same
+    dedup contract as :func:`serve_timeline`."""
+    return _dedup_timeline(
+        flight_events,
+        ("quality_eval", "online_", "divergence_",
+         "generation_demoted", "last_good_republished"))
+
+
+def _dedup_timeline(flight_events: list[dict], prefixes) -> list[dict]:
     seen, out = set(), []
     for e in flight_events:
-        if not str(e.get("kind", "")).startswith(("serve_", "reload_")):
+        if not str(e.get("kind", "")).startswith(tuple(prefixes)):
             continue
         key = json.dumps({k: v for k, v in e.items()
                           if k not in ("seq", "ts")},
@@ -247,6 +261,22 @@ def render(run: dict) -> str:
                    f"({len(serve_events)} events)")
         t0 = serve_events[0].get("ts") or 0.0
         for rec in serve_events:
+            extras = {k: v for k, v in rec.items()
+                      if k not in ("ts", "kind", "seq")}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(
+                extras.items()))
+            out.append(f"  +{(rec.get('ts') or t0) - t0:>9.3f}s "
+                       f"{rec['kind']:24} {detail}"[:200])
+        out.append("")
+
+    # Continuous-learning timeline (ISSUE 13): the drift story — eval
+    # verdicts, alarms, demotions, rollbacks — in stream order.
+    drift_events = online_timeline(run.get("flight_events", []))
+    if drift_events:
+        out.append(f"## Continuous-learning timeline "
+                   f"({len(drift_events)} events)")
+        t0 = drift_events[0].get("ts") or 0.0
+        for rec in drift_events:
             extras = {k: v for k, v in rec.items()
                       if k not in ("ts", "kind", "seq")}
             detail = " ".join(f"{k}={v}" for k, v in sorted(

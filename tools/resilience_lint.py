@@ -88,6 +88,10 @@ EXTRA_FILES = (
     os.path.join(REPO, "fm_spark_tpu", "data", "stream.py"),
     os.path.join(REPO, "fm_spark_tpu", "data", "native_stream.py"),
     os.path.join(REPO, "fm_spark_tpu", "native", "__init__.py"),
+    # The continuous-learning loop (ISSUE 13): drift verdicts,
+    # demotions and rollbacks are operator-facing state transitions —
+    # EventLog-only, like the rest of the recovery narrative.
+    os.path.join(REPO, "fm_spark_tpu", "online.py"),
 )
 
 #: The serving runtime (ISSUE 12) is held to the same EventLog-only

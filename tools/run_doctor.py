@@ -19,9 +19,14 @@ turns the run's streams into ONE screen a human can act on:
   ``serve_bench`` ledger rows with their sentinel verdicts, the
   reload/swap timeline, staleness + degraded-mode state, and the
   chaos auditor's serving-invariant verdict;
+- **Continuous learning** (ISSUE 13) — the ``quality_eval`` AUC series
+  with sentinel verdicts, the drift timeline (alarms, demotions,
+  rollbacks, pointer republishes), and the rollback/quarantined-
+  generation counters;
 - **Diagnosis** — the doctor's findings: cold-cache compile domination,
   attachment weather, ingest-bound execution, degraded/fallback legs,
-  statistically-regressed legs, stale/degraded/regressed serving.
+  statistically-regressed legs, stale/degraded/regressed serving,
+  drift rollbacks and quality regressions.
 
 The ledger is found beside the run dir by default
 (``<run_dir>/../ledger.jsonl`` — the cross-run convention) or via
@@ -82,6 +87,79 @@ def _serve_rows(ledger_path: str, run_id: str) -> list[dict]:
                                  "ledger.py"), "_doctor_ledger")
     return lg.PerfLedger(ledger_path).records(kind="serve_bench",
                                               run_id=run_id)
+
+
+def _quality_rows(ledger_path: str, run_id: str) -> list[dict]:
+    """This run's quality_eval ledger records (ISSUE 13): the online
+    loop's day-over-day AUC series."""
+    lg = _load_file(os.path.join(_REPO, "fm_spark_tpu", "obs",
+                                 "ledger.py"), "_doctor_ledger")
+    return lg.PerfLedger(ledger_path).records(kind="quality_eval",
+                                              run_id=run_id)
+
+
+def online_diagnose(run: dict, timeline: list[dict],
+                    quality_rows: list[dict]) -> dict | None:
+    """The continuous-learning view of a run (ISSUE 13): the AUC/
+    drift-score gauges, rollback/demotion counters, and the drift
+    event timeline (pre-deduped by ``obs_report.online_timeline`` —
+    a journaled event and its flight-ring mirror are the same
+    transition). ``None`` when the run has no online footprint."""
+    snap = run.get("snapshot") or {}
+    gauges = snap.get("gauges") or {}
+    counters = snap.get("counters") or {}
+    events = timeline
+    # A genuine ONLINE footprint is required — a plain offline run's
+    # divergence_detected (loss-spike guard) rides the same timeline
+    # helper but must not conjure a Continuous-learning section.
+    has_online = bool(
+        quality_rows or counters.get("online.days_total")
+        or any(str(e.get("kind", "")).startswith(("online_",
+                                                  "quality_eval"))
+               for e in events))
+    if not has_online:
+        return None
+    return {
+        "auc": gauges.get("online/auc"),
+        "drift_score": gauges.get("online/drift_score"),
+        "quarantined": gauges.get(
+            "checkpoint/quarantined_generations") or 0,
+        "days": counters.get("online.days_total") or 0,
+        "rollbacks": counters.get("online.rollbacks_total") or 0,
+        "demotions": counters.get("checkpoint.demotions_total") or 0,
+        "events": events,
+        "quality_rows": quality_rows,
+    }
+
+
+def online_findings(online: dict | None) -> list[str]:
+    """Continuous-learning one-liners for the diagnosis section."""
+    if online is None:
+        return []
+    out = []
+    if online["rollbacks"]:
+        out.append(
+            f"DRIFT ROLLBACK: {online['rollbacks']:.0f} coordinated "
+            f"rollback(s), {online['demotions']:.0f} generation(s) "
+            "demoted — the chain's tombstoned saves will never serve; "
+            "check the eval-day AUC series for when the world moved")
+    elif online["quarantined"]:
+        out.append(
+            f"{online['quarantined']:.0f} quarantined generation(s) "
+            "in the chain (tombstoned by an earlier run)")
+    regressed = [r for r in online["quality_rows"]
+                 if (r.get("sentinel") or {}).get("verdict")
+                 == "regressed"]
+    if regressed:
+        out.append(
+            f"QUALITY REGRESSED: eval AUC {regressed[-1].get('value')}"
+            f" on day {regressed[-1].get('day')} — "
+            f"{(regressed[-1].get('sentinel') or {}).get('reason')}")
+    if not out and online["days"]:
+        out.append(
+            f"online learning clean: {online['days']:.0f} day(s) "
+            f"trained, AUC {online['auc']}, no drift verdicts")
+    return out
 
 
 def serve_diagnose(run: dict, timeline: list[dict],
@@ -319,7 +397,8 @@ def findings(diag: dict, legs: list[dict]) -> list[str]:
 
 def render(run: dict, diag: dict, legs: list[dict],
            chaos: dict | None = None, serve: dict | None = None,
-           serve_legs: list[dict] | None = None) -> str:
+           serve_legs: list[dict] | None = None,
+           online: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -425,9 +504,40 @@ def render(run: dict, diag: dict, legs: list[dict],
             f"{str(serve['degraded']).lower()}")
         out.append("")
 
+    if online is not None:
+        out.append("## Continuous learning")
+        if online["quality_rows"]:
+            out.append(f"  {'eval day':>8} {'step':>8} {'auc':>8} "
+                       f"{'verdict':>22}")
+            for r in online["quality_rows"]:
+                v = r.get("value")
+                out.append(
+                    f"  {str(r.get('day', '-')):>8} "
+                    f"{str(r.get('step', '-')):>8} "
+                    f"{(f'{v:.4f}' if isinstance(v, (int, float)) else '-'):>8} "
+                    f"{((r.get('sentinel') or {}).get('verdict') or '?'):>22}")
+        if online["events"]:
+            out.append("  drift timeline:")
+            t0 = online["events"][0].get("ts") or 0.0
+            for e in online["events"]:
+                extras = {k: v for k, v in e.items()
+                          if k not in ("ts", "kind", "seq")}
+                detail = " ".join(f"{k}={v}" for k, v in
+                                  sorted(extras.items()))
+                out.append(f"    +{(e.get('ts') or t0) - t0:>8.3f}s "
+                           f"{e.get('kind'):22} {detail}"[:160])
+        out.append(
+            f"  days {online['days']:.0f}  rollbacks "
+            f"{online['rollbacks']:.0f}  demoted generations "
+            f"{online['demotions']:.0f}  quarantined "
+            f"{online['quarantined']:.0f}  drift_score "
+            f"{online['drift_score']}")
+        out.append("")
+
     out.append("## Diagnosis")
     for line in (findings(diag, legs) + chaos_findings(chaos)
-                 + serve_findings(serve, serve_legs)):
+                 + serve_findings(serve, serve_legs)
+                 + online_findings(online)):
         out.append(f"  - {line}")
     return "\n".join(out) + "\n"
 
@@ -470,9 +580,12 @@ def main(argv=None) -> int:
     diag = diagnose(run, legs, flight_events)
     serve = serve_diagnose(run, obs_report.serve_timeline(flight_events),
                            serve_legs)
+    online = online_diagnose(run, obs_report.online_timeline(flight_events),
+                             _quality_rows(ledger_path, run["run_id"]))
     sys.stdout.write(render(run, diag, legs,
                             chaos=load_chaos_verdict(obs_dir),
-                            serve=serve, serve_legs=serve_legs))
+                            serve=serve, serve_legs=serve_legs,
+                            online=online))
     return 0
 
 
