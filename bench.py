@@ -626,6 +626,19 @@ def inner_main(args):
     run_id = _RUN_ID = args.run_id or _gen_run_id()
     obs_dir = _obs_run_dir(art_dir, run_id)
     obs.configure(obs_dir, run_id=run_id, install_signals=True)
+    # Live introspection (ISSUE 14): the capture engine arms over this
+    # run dir — a sentinel `regressed` verdict on any leg below fires a
+    # bounded capture bundle while the slow program is still resident —
+    # and --metrics-port serves the live registry while the sweep runs.
+    from fm_spark_tpu.obs import introspect
+
+    introspect.configure(obs_dir, run_id=run_id)
+    if args.metrics_port is not None:
+        from fm_spark_tpu.obs import export as obs_export
+
+        _msrv = obs_export.start_metrics_server(args.metrics_port)
+        print(json.dumps({"metrics_port": _msrv.port,
+                          "metrics_url": _msrv.url}), flush=True)
     journal = EventLog(os.path.join(obs_dir,
                                     f"health_{args.model}.jsonl"),
                        mirror_to_flight=True)
@@ -1281,6 +1294,7 @@ def inner_main(args):
             chaos=args.chaos,
             attachment_health=leg_health,
         )
+        reused_ledger_record = False
         try:
             # Crash window on a RETRIED attempt only (the lookup costs
             # a ledger scan, so the common fresh path skips it): the
@@ -1293,6 +1307,7 @@ def inner_main(args):
                      if r.get("variant") == label
                      ] if args.resume_sweep else []
             if prior and prior[-1].get("sentinel"):
+                reused_ledger_record = True
                 # Judge the RE-MEASURED rate against the recorded
                 # history (which already contains the aborted
                 # attempt's row) WITHOUT appending a duplicate
@@ -1323,6 +1338,41 @@ def inner_main(args):
             _log(f"[inner] [{label}] ledger/sentinel failed "
                  f"({type(e).__name__}): "
                  f"{(str(e).splitlines() or [''])[0][:200]}")
+        # Per-leg cost attribution (ISSUE 14): pair the measured step
+        # time with the leg's bytes-moved model (the same traffic-term
+        # families bench_kernels.py prices per kernel) into a
+        # `cost_attribution` ledger record — the autotuner's evidence
+        # base (ROADMAP item 4) grows on every sweep, not only at
+        # pricing time. value = model-implied GB/s. A resumed leg whose
+        # aborted attempt already ledgered is SKIPPED, same dedup as
+        # the bench_leg record above (the two appends travel together,
+        # so the crash window leaves both or neither) — one record per
+        # (run_id, variant).
+        if not reused_ledger_record:
+            try:
+                pb = 2 if dtypes[0] == "bfloat16" else 4
+                cb = 2 if dtypes[1] == "bfloat16" else 4
+                cost = introspect.step_cost_model(
+                    args.model, batch, rank, cap=config.compact_cap,
+                    param_bytes=pb, compute_bytes=cb)
+                step_s = dt / steps_timed
+                ledger.append({
+                    "kind": "cost_attribution",
+                    "leg": f"cost/{METRIC}",
+                    "run_id": run_id, "variant": label,
+                    "value": round(cost["bytes_total"] / step_s / 1e9,
+                                   3),
+                    "unit": "GB/s(model)",
+                    "step_ms": round(step_s * 1e3, 3),
+                    "bytes_per_step": cost["bytes_total"],
+                    "families": cost["families"],
+                    "assumptions": cost["assumptions"],
+                    "fingerprint": fingerprint,
+                })
+            except Exception as e:  # noqa: BLE001 — best-effort rule
+                _log(f"[inner] [{label}] cost-attribution append "
+                     f"failed ({type(e).__name__}): "
+                     f"{(str(e).splitlines() or [''])[0][:200]}")
         _log(f"[inner] [{label}] {rate:,.0f} samples/sec/chip "
              f"(dt={dt:.3f}s loss={final_loss:.4f})")
         # Emit the best-so-far line after EVERY variant: if a later
@@ -1789,6 +1839,14 @@ def main():
                     help="child-side backend init watchdog: an init that "
                          "has not finished by then never finishes here; "
                          "the child exits early for a cheap retry")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    dest="metrics_port", metavar="PORT",
+                    help="serve the live metrics registry from the "
+                         "measuring child over stdlib HTTP on "
+                         "127.0.0.1:PORT (0 = OS-assigned, echoed as a "
+                         "JSON line): /metrics Prometheus text + "
+                         "/healthz JSON — watch a sweep without "
+                         "touching the process (ISSUE 14)")
     ap.add_argument("--run-id", default=None, dest="run_id",
                     help="telemetry run id (ISSUE 7): every stream this "
                          "run emits lands under <artifacts>/obs/"
@@ -1872,6 +1930,8 @@ def main():
             argv.append(args.compile_cache)
     if args.artifacts_dir:
         argv += ["--artifacts-dir", args.artifacts_dir]
+    if args.metrics_port is not None:
+        argv += ["--metrics-port", str(args.metrics_port)]
     # An outer kill (timeout(1) sends SIGTERM) must still leave a
     # parseable final line: best-so-far result if any child printed one,
     # otherwise the error JSON with the failure log.

@@ -418,6 +418,26 @@ def main():
             sentinel = obs.Sentinel(ledger)
             vers = runtime_versions()
             for row in rows_out:
+                fingerprint = obs.measurement_fingerprint(
+                    variant=row["kernel"],
+                    model=f"kernel/{row['family']}",
+                    batch=row.get("batch"), rank=row.get("rank"),
+                    # The same kernel at a different shape/dtype is
+                    # a different cohort — a bf16 or resized run
+                    # must not be judged against the fp32 band.
+                    extra={k: row[k]
+                           for k in ("dtype", "width", "cap",
+                                     "rows", "fields", "interpret")
+                           if k in row},
+                    device_kind=backend,
+                    jax_version=vers["jax_version"],
+                    libtpu_version=vers["libtpu_version"],
+                    # A capability/shape skip is NOT weather: the
+                    # attachment is fine, there is just no number
+                    # (classifies insufficient_history, and the
+                    # 'skipped' field above carries the reason).
+                    attachment_health="healthy",
+                )
                 sentinel.observe({
                     "kind": "kernel_pricing",
                     "leg": f"kernel/{row['family']}",
@@ -426,27 +446,28 @@ def main():
                     "ms": row.get("ms"),
                     "bytes_moved_model": row.get("bytes_moved_model"),
                     "skipped": row.get("skipped"),
-                    "fingerprint": obs.measurement_fingerprint(
-                        variant=row["kernel"],
-                        model=f"kernel/{row['family']}",
-                        batch=row.get("batch"), rank=row.get("rank"),
-                        # The same kernel at a different shape/dtype is
-                        # a different cohort — a bf16 or resized run
-                        # must not be judged against the fp32 band.
-                        extra={k: row[k]
-                               for k in ("dtype", "width", "cap",
-                                         "rows", "fields", "interpret")
-                               if k in row},
-                        device_kind=backend,
-                        jax_version=vers["jax_version"],
-                        libtpu_version=vers["libtpu_version"],
-                        # A capability/shape skip is NOT weather: the
-                        # attachment is fine, there is just no number
-                        # (classifies insufficient_history, and the
-                        # 'skipped' field above carries the reason).
-                        attachment_health="healthy",
-                    ),
+                    "fingerprint": fingerprint,
                 })
+                if row.get("ms") is not None \
+                        and row.get("bytes_moved_model"):
+                    # Cost attribution (ISSUE 14): the measured-time x
+                    # bytes-model pairing also lands under the ONE
+                    # `cost_attribution` kind the autotuner (and
+                    # run_doctor's cost table) reads, next to bench.py's
+                    # whole-step rows — kernel-grain evidence and
+                    # step-grain evidence in the same stream.
+                    ledger.append({
+                        "kind": "cost_attribution",
+                        "leg": f"cost/kernel/{row['family']}",
+                        "run_id": run_id, "variant": row["kernel"],
+                        "value": row.get("model_gbps"),
+                        "unit": "GB/s(model)",
+                        "step_ms": row.get("ms"),
+                        "bytes_per_step": row.get("bytes_moved_model"),
+                        "families": {row["family"]:
+                                     row.get("bytes_moved_model")},
+                        "fingerprint": fingerprint,
+                    })
         except Exception as e:  # noqa: BLE001 — ledger is best-effort
             print(f"bench_kernels: ledger append failed: {e!r}",
                   file=sys.stderr)

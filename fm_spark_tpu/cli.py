@@ -1283,6 +1283,25 @@ def _run_online_cmd(args, cfg, tconfig) -> int:
     return 0
 
 
+def _start_metrics_endpoint(args) -> None:
+    """``--metrics-port`` (ISSUE 14): serve the live registry over
+    stdlib HTTP (``/metrics`` Prometheus text + ``/healthz`` JSON) so a
+    long-running loop is inspectable without touching the process. The
+    bound port is echoed as a JSON line (port 0 = OS-assigned — how
+    tests and co-located daemons avoid collisions); the server rides a
+    daemon thread and is stopped in ``main``'s finally."""
+    port = getattr(args, "metrics_port", None)
+    if port is None:
+        return
+    from fm_spark_tpu.obs import export as obs_export
+
+    srv = obs_export.start_metrics_server(port)
+    print(json.dumps({"metrics_port": srv.port,
+                      "metrics_url": srv.url,
+                      "endpoints": ["/metrics", "/healthz"]}),
+          flush=True)
+
+
 def cmd_train(args) -> int:
     from fm_spark_tpu import configs as configs_lib
     from fm_spark_tpu import models
@@ -1308,12 +1327,18 @@ def cmd_train(args) -> int:
         import os as _os_obs
 
         from fm_spark_tpu import obs
+        from fm_spark_tpu.obs import introspect as _introspect
 
         _obs_run = obs.new_run_id()
         obs.configure(_os_obs.path.join(_obs_dir, _obs_run),
                       run_id=_obs_run, install_signals=True)
+        # Deep-capture engine (ISSUE 14): anomaly triggers (sentinel
+        # regressions, watchdog near-misses, step-time spikes) arm
+        # bounded capture bundles under this run's obs dir.
+        _introspect.configure(obs.run_dir(), run_id=_obs_run)
         print(json.dumps({"run_id": _obs_run, "obs_dir": obs.run_dir()}),
               flush=True)
+    _start_metrics_endpoint(args)
 
     _maybe_init_distributed(args)
 
@@ -1914,8 +1939,14 @@ def cmd_serve(args) -> int:
         _obs_run = obs.new_run_id()
         obs.configure(_os_obs.path.join(_obs_dir, _obs_run),
                       run_id=_obs_run, install_signals=True)
+        # Deep captures (ISSUE 14): an SLO overrun / sentinel
+        # regression fires a bounded capture bundle into this run dir.
+        from fm_spark_tpu.obs import introspect as _introspect
+
+        _introspect.configure(obs.run_dir(), run_id=_obs_run)
         print(json.dumps({"run_id": _obs_run, "obs_dir": obs.run_dir()}),
               flush=True)
+    _start_metrics_endpoint(args)
 
     if args.slo_ms is not None:
         # Deadline = the SLO: an overrun becomes a structured
@@ -2412,6 +2443,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "harness sets it to 'none' so hundreds of "
                         "in-process train calls don't each open a run "
                         "directory")
+    t.add_argument("--metrics-port", type=int, default=None,
+                   dest="metrics_port", metavar="PORT",
+                   help="serve the live metrics registry over stdlib "
+                        "HTTP on 127.0.0.1:PORT (0 = OS-assigned; the "
+                        "bound port is echoed as a JSON line): "
+                        "/metrics is the Prometheus text dump, "
+                        "/healthz a JSON liveness doc (run_id, "
+                        "generation, staleness, breaker state, last "
+                        "sentinel verdict) — a long-running loop is "
+                        "inspectable without touching the process")
     t.add_argument("--force", action="store_true",
                    help="override safety guardrails (currently: the "
                         "strategy=row >=1M-feature check) with a "
@@ -2502,6 +2543,13 @@ def build_parser() -> argparse.ArgumentParser:
                                                "artifacts/obs"),
                     help="telemetry root (same convention as train); "
                          "'none' disables")
+    sv.add_argument("--metrics-port", type=int, default=None,
+                    dest="metrics_port", metavar="PORT",
+                    help="live-metrics endpoint (same contract as "
+                         "train --metrics-port): /metrics Prometheus "
+                         "text + /healthz JSON with generation/"
+                         "staleness/breaker/last-verdict, served from "
+                         "a daemon thread off the request path")
     sv.set_defaults(fn=cmd_serve, batch_size=256)
 
     pp = sub.add_parser("preprocess",
@@ -2551,8 +2599,13 @@ def main(argv=None) -> int:
         # Clean-run flush for the telemetry plane (no-op when the
         # command never configured it): the final metrics snapshot and
         # flight dump land even when a command exits via SystemExit.
+        # The live endpoint stops first — a scrape racing shutdown must
+        # read a consistent registry, not a half-flushed one — and
+        # obs.shutdown also disarms the capture engine.
         from fm_spark_tpu import obs
+        from fm_spark_tpu.obs import export as _obs_export
 
+        _obs_export.stop_metrics_server()
         obs.shutdown()
 
 

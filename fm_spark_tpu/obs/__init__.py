@@ -35,6 +35,7 @@ import signal as _signal
 import threading
 import time
 
+from fm_spark_tpu.obs import introspect
 from fm_spark_tpu.obs.flight import FlightRecorder, read_spool
 from fm_spark_tpu.obs.ledger import (
     PerfLedger,
@@ -71,6 +72,7 @@ __all__ = [
     "gauge",
     "histogram",
     "install_signal_dump",
+    "introspect",
     "keepbest_allowed",
     "measurement_fingerprint",
     "new_run_id",
@@ -105,6 +107,10 @@ FAULT_KINDS = frozenset({
     "checkpoint_unreadable", "checkpoint_walked_back",
     "backend_init_timeout", "down",
     "hang_detected", "reload_failed", "serve_batch_failed",
+    # ISSUE 14: the live-introspection anomaly events — near-misses and
+    # SLO overruns belong on the same timeline as the faults they
+    # almost were, and a fired capture is the pointer to its evidence.
+    "watchdog_near_miss", "serve_slo_overrun", "capture_fired",
 })
 
 _lock = threading.Lock()
@@ -161,6 +167,9 @@ def shutdown(reason: str | None = "run_end") -> None:
         d = _state["dir"]
         _state.update(dir=None, run_id=None, tracer=None, flight=None,
                       sink=None)
+    # The capture engine is scoped to the run whose directory it writes
+    # into: a new run (configure calls shutdown first) re-arms its own.
+    introspect.clear()
     if flight is None:
         return
     try:
@@ -242,12 +251,17 @@ def event(kind: str, **fields) -> None:
         pass
 
 
-def flight_dump(reason: str, **extra) -> str | None:
-    """Atomically dump the last-N window now (fault endings call this)."""
+def flight_dump(reason: str, path: str | None = None,
+                **extra) -> str | None:
+    """Atomically dump the last-N window now (fault endings call this).
+    ``path`` overrides the default ``flight_dump.json`` target — the
+    introspection capture bundles (ISSUE 14) dump INTO the bundle so a
+    later dump on the default path can never overwrite a capture's
+    flight context."""
     flight = _state["flight"]
     if flight is None:
         return None
-    return flight.dump(reason, extra=extra or None)
+    return flight.dump(reason, path=path, extra=extra or None)
 
 
 def fault_timeline(limit: int = 50) -> list[dict]:

@@ -159,6 +159,13 @@ class Histogram:
                 cum += c
             return float(self.max)
 
+    def bucket_counts(self) -> tuple[tuple, list, int, float]:
+        """One consistent read of the raw per-bucket counts (ascending
+        ``bounds`` + the overflow slot) with count/sum — what the
+        Prometheus histogram exposition is built from."""
+        with self._lock:
+            return self.bounds, list(self._counts), self.count, self.sum
+
     def summary(self) -> dict:
         with self._lock:
             count, total = self.count, self.sum
@@ -212,6 +219,19 @@ class MetricsRegistry:
         return self._get(name, Histogram,
                          lambda: Histogram(name, buckets=buckets))
 
+    def peek(self, name: str) -> float | None:
+        """NON-CREATING read of a counter/gauge value (None when the
+        instrument does not exist, or is a histogram). Read-only
+        consumers — the /healthz endpoint above all — must never
+        create instruments as a scrape side effect: a phantom
+        None-valued gauge would pollute every later snapshot of a run
+        that never touched that subsystem."""
+        with self._lock:
+            item = self._items.get(name)
+        if isinstance(item, (Counter, Gauge)):
+            return item.value
+        return None
+
     def reset(self) -> None:
         """Drop every instrument (a new run's clean slate; tests)."""
         with self._lock:
@@ -245,37 +265,72 @@ class MetricsRegistry:
             pass
         return snap
 
-    def prometheus_text(self, prefix: str = "fm_spark") -> str:
-        """Prometheus exposition-format dump (counters/gauges as-is,
-        histograms as summaries with quantile labels)."""
+    def prometheus_text(self, prefix: str = "fm_spark",
+                        labels: dict | None = None) -> str:
+        """Prometheus exposition-format dump: counters/gauges as-is,
+        histograms in NATIVE histogram format — cumulative
+        ``_bucket{le="..."}`` lines (one per bound, plus the mandatory
+        ``+Inf``) with ``_sum``/``_count``. The live ``/metrics``
+        endpoint (ISSUE 14, :mod:`fm_spark_tpu.obs.export`) serves this
+        to real scrapers, so the bucket lines are the real exposition
+        contract, not a summary approximation. ``labels`` (e.g.
+        ``{"run_id": ...}``) attach to every sample; values are escaped
+        per the exposition rules (backslash, double-quote, newline)."""
 
         def clean(name: str) -> str:
             safe = "".join(c if c.isalnum() or c == "_" else "_"
                            for c in name)
             return f"{prefix}_{safe}" if prefix else safe
 
-        snap = self.snapshot()
+        def esc(v) -> str:
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        def lab(extra: dict | None = None) -> str:
+            items = dict(labels or {})
+            if extra:
+                items.update(extra)
+            if not items:
+                return ""
+            return ("{" + ",".join(f'{k}="{esc(v)}"'
+                                   for k, v in items.items()) + "}")
+
+        def num(v: float) -> str:
+            # Full-precision sample values: '%g' keeps 6 significant
+            # digits, which quantizes a large counter so hard that
+            # rate() over consecutive scrapes reads zero — integers
+            # render as integers, floats shortest-round-trip.
+            f = float(v)
+            return str(int(f)) if f.is_integer() else repr(f)
+
+        with self._lock:
+            items = dict(self._items)
         lines = []
-        for name, v in snap["counters"].items():
+        for name in sorted(items):
+            item = items[name]
             m = clean(name)
-            lines.append(f"# TYPE {m} counter")
-            lines.append(f"{m} {v:g}")
-        for name, v in snap["gauges"].items():
-            if v is None:
-                continue
-            m = clean(name)
-            lines.append(f"# TYPE {m} gauge")
-            lines.append(f"{m} {v:g}")
-        for name, s in snap["histograms"].items():
-            if not s["count"]:
-                continue
-            m = clean(name)
-            lines.append(f"# TYPE {m} summary")
-            for q in ("p50", "p95", "p99"):
-                lines.append(
-                    f'{m}{{quantile="0.{q[1:]}"}} {s[q]:g}')
-            lines.append(f"{m}_sum {s['sum']:g}")
-            lines.append(f"{m}_count {s['count']}")
+            if isinstance(item, Counter):
+                lines.append(f"# TYPE {m} counter")
+                lines.append(f"{m}{lab()} {num(item.value)}")
+            elif isinstance(item, Gauge):
+                v = item.value
+                if v is None:
+                    continue
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m}{lab()} {num(v)}")
+            elif isinstance(item, Histogram):
+                bounds, counts, count, total = item.bucket_counts()
+                if not count:
+                    continue
+                lines.append(f"# TYPE {m} histogram")
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        f'{m}_bucket{lab({"le": f"{b:g}"})} {cum}')
+                lines.append(f'{m}_bucket{lab({"le": "+Inf"})} {count}')
+                lines.append(f"{m}_sum{lab()} {num(total)}")
+                lines.append(f"{m}_count{lab()} {count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -236,10 +236,37 @@ class Sentinel:
     def observe(self, record: dict) -> dict:
         """Judge ``record`` against prior history, stamp the verdict
         block into it as ``sentinel``, append it to the ledger, and
-        return the verdict block."""
+        return the verdict block.
+
+        Live-introspection hooks (ISSUE 14), both best-effort and
+        stdlib-only: the verdict is published to the ``/healthz``
+        endpoint's status, and a ``regressed`` verdict — the moment the
+        anomalous program is still resident — fires a rate-limited deep
+        capture (no-ops when the engine is unarmed)."""
         block = self.judge(record["leg"], record.get("value"),
                            record.get("fingerprint"))
         record = dict(record)
         record["sentinel"] = block
         self.ledger.append(record)
+        try:
+            import sys as _sys
+
+            # Hooks only when the package is ALREADY loaded: this
+            # module is also exec'd standalone by path (bench.py's
+            # parent, tools/) exactly so the light process never
+            # imports the package — the hook must not be the import
+            # that drags jax in.
+            if "fm_spark_tpu.obs" in _sys.modules:
+                from fm_spark_tpu.obs import export as _export
+                from fm_spark_tpu.obs import introspect as _introspect
+
+                _export.note_sentinel_verdict(record.get("leg"), block)
+                if block.get("verdict") == "regressed":
+                    _introspect.fire(
+                        "sentinel_regressed", leg=record.get("leg"),
+                        variant=record.get("variant"),
+                        value=record.get("value"), z=block.get("z"),
+                        reason=block.get("reason"))
+        except Exception:
+            pass
         return block

@@ -41,12 +41,14 @@ import threading
 import time
 
 from fm_spark_tpu import obs
+from fm_spark_tpu.obs.introspect import NEAR_MISS_FRACTION
 
 __all__ = [
     "ENV_ACTION",
     "ENV_SPEC",
     "HANG_EXIT_RC",
     "KNOWN_PHASES",
+    "NEAR_MISS_FRACTION",
     "HangDetected",
     "WatchdogTable",
     "active",
@@ -62,6 +64,12 @@ ENV_ACTION = "FM_SPARK_WATCHDOG_ACTION"
 #: fault injector can produce, so a supervising parent can tell "hang
 #: detected and bounded" from "crashed for an unexplained reason".
 HANG_EXIT_RC = 87
+
+#: Minimum seconds between two near-miss flight dumps of the same phase
+#: when NO capture engine is armed (armed, the engine's own rate
+#: limiter gates the heavy evidence): a steady-state phase living at
+#: 85% of its deadline must never fsync a full dump per occurrence.
+NEAR_MISS_DUMP_INTERVAL_S = 30.0
 
 #: Guarded production phases (the registry the chaos auditor samples
 #: deadlines for): the shard reader's chunk read (data/stream.py), the
@@ -164,6 +172,14 @@ class _PhaseGuard:
                                       elapsed)
             if self._table.action == "raise" and exc_type is None:
                 raise HangDetected(self.phase, self.deadline_s, elapsed)
+        elif elapsed > NEAR_MISS_FRACTION * self.deadline_s:
+            # Near-miss (ISSUE 14): the phase survived but spent >80%
+            # of its budget — the last observable moment BEFORE a hang
+            # verdict, so this is where the deep capture arms (an
+            # actual overrun either raises out or hard-exits; by then
+            # the evidence window is closing, not open).
+            self._table._note_near_miss(self.phase, self.deadline_s,
+                                        elapsed)
         return False
 
 
@@ -200,6 +216,8 @@ class WatchdogTable:
         self._monitor: threading.Thread | None = None
         self._stop = threading.Event()
         self.hangs_detected = 0
+        self.near_misses = 0
+        self._last_near_dump: dict[str, float] = {}
 
     # ----------------------------------------------------------- arming
 
@@ -246,6 +264,56 @@ class WatchdogTable:
             obs.event("hang_detected", **fields)
             obs.counter("resilience.hangs_detected_total").add(1)
             obs.flight_dump("hang_detected", **fields)
+        except Exception:
+            pass
+
+    def _note_near_miss(self, name: str, limit: float,
+                        elapsed: float) -> None:
+        """A phase finished past :data:`NEAR_MISS_FRACTION` of its
+        deadline (ISSUE 14): count it, arm a rate-limited deep capture
+        while the near-hanging program is still resident, and journal
+        + flight-dump the context (the satellite — a capture always
+        has its flight window). The HEAVY evidence (journal line,
+        fsync'd dump) is rate-limited — a steady-state phase at 85% of
+        its deadline near-misses every occurrence, and the watchdog
+        must observe that, not fsync per step: with a capture engine
+        armed, its limiter decides (a suppressed fire suppresses the
+        dump); unarmed, a per-phase monotonic throttle does."""
+        self.near_misses += 1
+        fields = dict(phase=name, deadline_s=round(limit, 3),
+                      elapsed_s=round(elapsed, 3),
+                      frac=round(elapsed / limit, 3))
+        try:
+            obs.counter("resilience.near_misses_total").add(1)
+        except Exception:
+            pass
+        armed = False
+        bundle = None
+        try:
+            from fm_spark_tpu.obs import introspect
+
+            armed = introspect.active()
+            if armed:
+                bundle = introspect.fire("watchdog_near_miss", **fields)
+        except Exception:
+            pass
+        if armed and bundle is None:
+            return  # the engine's rate limiter suppressed this one
+        if not armed:
+            now = time.monotonic()
+            last = self._last_near_dump.get(name)
+            if last is not None and \
+                    now - last < NEAR_MISS_DUMP_INTERVAL_S:
+                return
+            self._last_near_dump[name] = now
+        if self.journal is not None:
+            try:
+                self.journal.emit("watchdog_near_miss", **fields)
+            except Exception:
+                pass
+        try:
+            obs.event("watchdog_near_miss", **fields)
+            obs.flight_dump("watchdog_near_miss", **fields)
         except Exception:
             pass
 

@@ -152,11 +152,16 @@ class PredictEngine:
             lambda p, i, v: self.spec.predict(p, i, v))
         self._compiled: dict[int, object] = {}
         self._gen = Generation(jax.device_put(params), step, gen_id=0)
+        # The live /healthz endpoint (ISSUE 14) reads this gauge; a
+        # fresh engine that never swaps must still report what it
+        # serves, not None.
+        obs.gauge("serve/generation_step").set(self._gen.step)
         self._queue: queue.Queue = queue.Queue()
         self._carry: _Request | None = None
         self._worker: threading.Thread | None = None
         self._worker_lock = threading.Lock()
         self._closed = False
+        self._last_slo_dump: float | None = None
 
     # -------------------------------------------------------- generations
 
@@ -388,6 +393,41 @@ class PredictEngine:
                 # caller must be answered (exactly once), even by the
                 # failure; HangDetected and injected faults land here.
                 obs.counter("serve.batch_failures_total").add(1)
+                if isinstance(e, watchdog.HangDetected):
+                    # SLO overrun (ISSUE 14): the serve_request phase
+                    # blew its deadline. Arm a rate-limited deep
+                    # capture while the slow program is resident, and
+                    # dump the flight window (the capture-context
+                    # satellite) — heavy evidence rate-limited like
+                    # the watchdog near-miss: a sustained SLO breach
+                    # at load overruns every micro-batch, and the
+                    # worker must answer callers, not fsync per batch.
+                    overrun = dict(phase=e.phase,
+                                   deadline_s=round(e.deadline_s, 3),
+                                   elapsed_s=round(e.elapsed_s, 3),
+                                   rows=int(ids.shape[0]),
+                                   gen_step=gen.step)
+                    obs.counter("serve.slo_overruns_total").add(1)
+                    armed = False
+                    bundle = None
+                    try:
+                        from fm_spark_tpu.obs import introspect
+
+                        armed = introspect.active()
+                        if armed:
+                            bundle = introspect.fire(
+                                "serve_slo_overrun", **overrun)
+                    except Exception:
+                        pass
+                    now = time.monotonic()
+                    throttled = (self._last_slo_dump is not None
+                                 and now - self._last_slo_dump
+                                 < watchdog.NEAR_MISS_DUMP_INTERVAL_S)
+                    if ((armed and bundle is not None)
+                            or (not armed and not throttled)):
+                        self._last_slo_dump = now
+                        obs.event("serve_slo_overrun", **overrun)
+                        obs.flight_dump("serve_slo_overrun", **overrun)
                 obs.event("serve_batch_failed",
                           error=f"{type(e).__name__}: "
                                 f"{(str(e).splitlines() or [''])[0][:200]}",

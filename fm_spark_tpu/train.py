@@ -741,7 +741,13 @@ class FMTrainer:
                     # the window mean is honest device step time.
                     win_dur = time.perf_counter() - win_t0
                     if win_steps:
-                        hist_step.observe(win_dur * 1e3 / win_steps)
+                        win_mean_ms = win_dur * 1e3 / win_steps
+                        hist_step.observe(win_mean_ms)
+                        # Live introspection (ISSUE 14): a window mean
+                        # past the trailing p99 fires a rate-limited
+                        # deep capture while the slow program is still
+                        # resident; one None check when unarmed.
+                        obs.introspect.observe_step_time(win_mean_ms)
                     # steps=win_steps, not steps_since_log: the first
                     # window's timer restarts after the compile step,
                     # so the span must count only the steps its

@@ -118,10 +118,15 @@ def test_sweep_survives_init_hang_then_device_loss_and_resumes(tmp_path):
     assert set(final["all_verdicts"]) == set(final["all_variants"])
     ledger_path = art / "obs" / "ledger.jsonl"
     assert ledger_path.exists()
-    legs = [json.loads(ln) for ln in
+    rows = [json.loads(ln) for ln in
             ledger_path.read_text().splitlines()]
-    legs = [r for r in legs if r.get("run_id") == final["run_id"]]
+    rows = [r for r in rows if r.get("run_id") == final["run_id"]]
+    legs = [r for r in rows if r.get("kind") == "bench_leg"]
     assert len(legs) == final["legs_completed"]
+    # ISSUE 14: every completed leg ALSO landed one cost_attribution
+    # record (measured step time x bytes-moved model).
+    cost = [r for r in rows if r.get("kind") == "cost_attribution"]
+    assert len(cost) == final["legs_completed"]
     # Leg 2 survived a retried device loss: its fingerprint records the
     # weather; the other legs were clean.
     healths = [r["fingerprint"]["attachment_health"] for r in legs]
@@ -352,14 +357,22 @@ def test_retried_leg_never_double_appends_ledger_record(tmp_path):
     rows = [json.loads(ln) for ln in
             (art / "obs" / "ledger.jsonl").read_text().splitlines()]
     mine = [r for r in rows if r.get("run_id") == run_id
-            and r.get("variant") == label]
+            and r.get("variant") == label
+            and r.get("kind") == "bench_leg"]
     assert len(mine) == 1, "duplicate (run_id, variant) ledger record"
+    # The cost_attribution append rides the same dedup (ISSUE 14): a
+    # resumed leg never lands a second cost record either.
+    cost_mine = [r for r in rows if r.get("run_id") == run_id
+                 and r.get("variant") == label
+                 and r.get("kind") == "cost_attribution"]
+    assert len(cost_mine) <= 1, "duplicate cost_attribution record"
     # The re-measured rate was judged fresh (against a history of just
     # the aborted attempt's row — insufficient) without re-appending.
     assert final["all_verdicts"][label] == "insufficient_history"
     # The OTHER legs were measured fresh and appended normally.
     others = [r for r in rows if r.get("run_id") == run_id
-              and r.get("variant") != label]
+              and r.get("variant") != label
+              and r.get("kind") == "bench_leg"]
     assert len(others) == final["legs_completed"] - 1
 
 

@@ -1,0 +1,511 @@
+"""Live introspection plane (ISSUE 14): the trigger-fired deep-capture
+engine, the step-spike detector, the per-step cost model, and the
+scrapeable live-metrics endpoint.
+
+The load-bearing contracts:
+
+- **bundle anatomy** — a fired capture leaves
+  ``captures/<trigger>_<seq>/`` with an atomic ``capture.json``
+  manifest, a metrics snapshot, and the flight window (the satellite:
+  a capture always has its flight context); a torn bundle (no
+  manifest) is skipped by every reader;
+- **rate limiting** — ``max_per_trigger`` and ``min_interval_s`` bound
+  the capture set; suppressed fires are counted, never silent;
+- **trigger coverage** — every registry entry fires here or in
+  test_serve/test_obs_overhead: ``sentinel_regressed`` (the sentinel
+  hook + the subprocess drill), ``watchdog_near_miss`` (a phase past
+  80% of its deadline), ``serve_slo_overrun`` (the subprocess serve
+  drill), ``step_time_spike`` (the trailing-p99 detector);
+- **exactly-one drills** — a synthetic sentinel regression and a serve
+  SLO overrun each produce EXACTLY ONE rate-limited bundle in
+  subprocess drills (the tier-1 acceptance);
+- **the endpoint** — ``/metrics`` serves the Prometheus text dump
+  (native histogram buckets, run_id labels) and ``/healthz`` the JSON
+  liveness doc, over a real HTTP round-trip.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from fm_spark_tpu import obs  # noqa: E402
+from fm_spark_tpu.obs import export, introspect  # noqa: E402
+from fm_spark_tpu.obs.introspect import (  # noqa: E402
+    CaptureEngine,
+    StepSpikeDetector,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    introspect.clear()
+    export.stop_metrics_server()
+    yield
+    obs.shutdown(reason=None)
+    introspect.clear()
+    export.stop_metrics_server()
+
+
+# ------------------------------------------------------- capture engine
+
+
+def test_capture_bundle_anatomy(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.configure(run_dir, run_id="cap1")
+    introspect.configure(run_dir, run_id="cap1", profile=False)
+    obs.event("tick", i=7)
+    bundle = introspect.fire("watchdog_near_miss", phase="ckpt_commit",
+                             frac=0.91)
+    assert bundle is not None
+    names = sorted(os.listdir(bundle))
+    assert names == ["capture.json", "flight.json", "metrics.json"]
+    with open(os.path.join(bundle, "capture.json")) as f:
+        manifest = json.load(f)
+    assert manifest["trigger"] == "watchdog_near_miss"
+    assert manifest["run_id"] == "cap1"
+    assert manifest["context"] == {"phase": "ckpt_commit", "frac": 0.91}
+    assert manifest["profiler"] == {"status": "disabled"}
+    # The flight context rode along (the ISSUE 14 satellite).
+    with open(os.path.join(bundle, "flight.json")) as f:
+        flight = json.load(f)
+    assert any(e.get("kind") == "tick" for e in flight["events"])
+    # The fire itself is on the flight timeline + counters.
+    assert obs.registry().counter("introspect.captures_total").value == 1
+    assert any(e["kind"] == "capture_fired"
+               for e in obs.fault_timeline())
+
+
+def test_rate_limit_max_per_trigger_and_interval(tmp_path):
+    eng = CaptureEngine(str(tmp_path), max_per_trigger=2,
+                        min_interval_s=0.0, profile=False)
+    assert eng.fire("step_time_spike", step_ms=9.0) is not None
+    assert eng.fire("step_time_spike", step_ms=9.0) is not None
+    # Third of the same trigger: suppressed (max_per_trigger).
+    assert eng.fire("step_time_spike", step_ms=9.0) is None
+    assert eng.suppressed == 1
+    # A DIFFERENT trigger still fires — limits are per trigger.
+    assert eng.fire("sentinel_regressed", leg="x") is not None
+
+    clock = {"t": 100.0}
+    eng2 = CaptureEngine(str(tmp_path / "b"), max_per_trigger=5,
+                         min_interval_s=30.0, profile=False,
+                         _monotonic=lambda: clock["t"])
+    assert eng2.fire("step_time_spike") is not None
+    clock["t"] += 10.0  # inside the interval: suppressed
+    assert eng2.fire("step_time_spike") is None
+    clock["t"] += 30.0  # past it: fires
+    assert eng2.fire("step_time_spike") is not None
+    assert eng2.suppressed == 1
+
+
+def test_unknown_trigger_rejected_eagerly(tmp_path):
+    eng = CaptureEngine(str(tmp_path), profile=False)
+    with pytest.raises(ValueError, match="unknown introspection"):
+        eng.fire("totally_made_up")
+
+
+def test_disabled_fire_is_noop_and_module_fire_never_raises():
+    introspect.clear()
+    assert introspect.fire("sentinel_regressed", leg="x") is None
+    assert introspect.observe_step_time(1.0) is None
+    assert not introspect.active()
+
+
+def test_list_captures_skips_torn_bundle(tmp_path):
+    eng = CaptureEngine(str(tmp_path), min_interval_s=0.0,
+                        profile=False)
+    good = eng.fire("sentinel_regressed", leg="a")
+    # A torn bundle: directory exists, manifest never landed (a crash
+    # between mkdir and the atomic manifest replace).
+    torn = tmp_path / "captures" / "step_time_spike_001"
+    torn.mkdir(parents=True)
+    (torn / "metrics.json").write_text("{}")
+    found = introspect.list_captures(str(tmp_path))
+    assert [m["dir"] for m in found] == [good]
+
+
+def test_profiler_arm_path_with_loaded_jax(tmp_path):
+    """With jax loaded, the capture arms a BOUNDED profiler trace (a
+    daemon timer stops it). Tolerant of a backend that refuses to
+    profile — the manifest then records the failure, never raises."""
+    import jax  # noqa: F401 — ensure it is in sys.modules
+
+    eng = CaptureEngine(str(tmp_path), profile=True, trace_s=0.05)
+    bundle = eng.fire("step_time_spike", step_ms=1.0)
+    assert bundle is not None
+    with open(os.path.join(bundle, "capture.json")) as f:
+        status = json.load(f)["profiler"]["status"]
+    assert status == "armed" or status.startswith("failed:")
+    # Bounded: the timer releases the profiler either way.
+    deadline = time.monotonic() + 10.0
+    while eng._profiler_busy and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not eng._profiler_busy
+
+
+# --------------------------------------------------- step-spike trigger
+
+
+def test_spike_detector_trailing_p99():
+    det = StepSpikeDetector(window=16, factor=3.0, min_history=4)
+    for _ in range(3):
+        assert not det.observe(10.0)  # under min_history: never fires
+    for _ in range(5):
+        assert not det.observe(10.0)
+    assert not det.observe(25.0)      # 2.5x: inside the band
+    assert det.observe(100.0)         # 10x the trailing p99: spike
+    # The spike entered the window (a level shift becomes the new
+    # normal instead of firing forever).
+    assert 100.0 in det._vals
+
+
+def test_observe_step_time_fires_step_time_spike(tmp_path):
+    introspect.configure(str(tmp_path), profile=False,
+                         min_interval_s=0.0, spike_min_history=4)
+    for _ in range(6):
+        assert introspect.observe_step_time(10.0) is None
+    bundle = introspect.observe_step_time(500.0)
+    assert bundle is not None and "step_time_spike" in bundle
+    with open(os.path.join(bundle, "capture.json")) as f:
+        ctx = json.load(f)["context"]
+    assert ctx["step_ms"] == 500.0
+    assert ctx["trailing_p99_ms"] == 10.0
+
+
+# ------------------------------------------------ sentinel regression
+
+
+def _regression_ledger(path):
+    from fm_spark_tpu.obs.ledger import PerfLedger, measurement_fingerprint
+    from fm_spark_tpu.obs.sentinel import Sentinel
+
+    fp = measurement_fingerprint(variant="v", model="fm", batch=64,
+                                 device_kind="cpu", n_chips=1)
+    ledger = PerfLedger(path)
+    sentinel = Sentinel(ledger)
+    for v in (1000.0, 1010.0, 990.0, 1005.0, 995.0):
+        sentinel.observe({"kind": "bench_leg", "leg": "t", "run_id": "r",
+                          "variant": "v", "value": v,
+                          "fingerprint": fp})
+    return sentinel, fp
+
+
+def test_sentinel_regressed_fires_capture_and_healthz_status(tmp_path):
+    introspect.configure(str(tmp_path), profile=False,
+                         min_interval_s=0.0)
+    sentinel, fp = _regression_ledger(str(tmp_path / "ledger.jsonl"))
+    block = sentinel.observe({"kind": "bench_leg", "leg": "t",
+                              "run_id": "r", "variant": "v",
+                              "value": 400.0, "fingerprint": fp})
+    assert block["verdict"] == "regressed"
+    found = introspect.list_captures(str(tmp_path))
+    assert [m["trigger"] for m in found] == ["sentinel_regressed"]
+    assert found[0]["context"]["leg"] == "t"
+    # The /healthz status carries the last verdict (any kind).
+    assert export.status()["last_sentinel"]["verdict"] == "regressed"
+    assert export.status()["last_sentinel"]["leg"] == "t"
+
+
+_SENTINEL_DRILL = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from fm_spark_tpu.obs import introspect
+from fm_spark_tpu.obs.ledger import PerfLedger, measurement_fingerprint
+from fm_spark_tpu.obs.sentinel import Sentinel
+
+run_dir = {run_dir!r}
+introspect.configure(run_dir, run_id="drill", max_per_trigger=1,
+                     min_interval_s=0.0, profile=False)
+fp = measurement_fingerprint(variant="v", model="fm", batch=64,
+                             device_kind="cpu", n_chips=1)
+sentinel = Sentinel(PerfLedger(os.path.join(run_dir, "ledger.jsonl")))
+for v in (1000.0, 1010.0, 990.0, 1005.0, 995.0):
+    sentinel.observe({{"kind": "bench_leg", "leg": "t", "run_id": "r",
+                       "variant": "v", "value": v, "fingerprint": fp}})
+# TWO synthetic regressions: the rate limiter must keep exactly one
+# bundle.
+for v in (400.0, 380.0):
+    block = sentinel.observe({{"kind": "bench_leg", "leg": "t",
+                               "run_id": "r", "variant": "v",
+                               "value": v, "fingerprint": fp}})
+    assert block["verdict"] == "regressed", block
+bundles = introspect.list_captures(run_dir)
+print("BUNDLES", len(bundles), bundles[0]["trigger"])
+"""
+
+
+def test_subprocess_sentinel_regression_exactly_one_bundle(tmp_path):
+    """The tier-1 acceptance drill: a synthetic sentinel regression in
+    a subprocess produces EXACTLY ONE rate-limited capture bundle."""
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _SENTINEL_DRILL.format(repo=REPO, run_dir=run_dir)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "BUNDLES 1 sentinel_regressed" in proc.stdout
+    found = introspect.list_captures(run_dir)
+    assert len(found) == 1
+    assert found[0]["trigger"] == "sentinel_regressed"
+
+
+def test_profiler_skipped_when_jax_not_loaded(tmp_path, monkeypatch):
+    """A jax-free process (the bench parent's shape) still gets a
+    metrics+flight bundle, with the profiler skip RECORDED — the
+    lookup goes through sys.modules, never an import."""
+    import sys as _sys
+
+    monkeypatch.setitem(_sys.modules, "jax", None)
+    eng = CaptureEngine(str(tmp_path), profile=True)
+    bundle = eng.fire("sentinel_regressed", leg="x")
+    with open(os.path.join(bundle, "capture.json")) as f:
+        assert (json.load(f)["profiler"]["status"]
+                == "skipped: jax not loaded")
+
+
+_SERVE_SLO_DRILL = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from fm_spark_tpu import models, obs
+from fm_spark_tpu.obs import introspect
+from fm_spark_tpu.resilience import watchdog
+from fm_spark_tpu.serve import PredictEngine
+import jax, numpy as np
+
+run_dir = {run_dir!r}
+obs.configure(run_dir, run_id="slo", install_signals=False)
+introspect.configure(run_dir, run_id="slo", max_per_trigger=1,
+                     min_interval_s=0.0, profile=False)
+spec = models.FieldFMSpec(num_features=4 * 64, rank=4, num_fields=4,
+                          bucket=64, init_std=0.1)
+params = spec.init(jax.random.key(0))
+eng = PredictEngine(spec, params, buckets=(1,), latency_budget_ms=0.0)
+eng.warmup()
+real = eng._compiled[1]
+def slow(p, i, v):
+    time.sleep(0.08)
+    return real(p, i, v)
+eng._compiled[1] = slow
+watchdog.configure({{"serve_request": 0.01}}, action="raise")
+ids = np.zeros((1, 4), np.int32)
+vals = np.ones((1, 4), np.float32)
+overruns = 0
+for _ in range(2):           # TWO overruns -> exactly ONE bundle
+    try:
+        eng.submit(ids, vals).result(30)
+    except watchdog.HangDetected:
+        overruns += 1
+eng.close()
+bundles = introspect.list_captures(run_dir)
+dump = json.load(open(os.path.join(run_dir, "flight_dump.json")))
+print(json.dumps({{
+    "overruns": overruns, "bundles": len(bundles),
+    "trigger": bundles[0]["trigger"],
+    "slo_counter": obs.registry().counter(
+        "serve.slo_overruns_total").value,
+    "dump_reason": dump["reason"],
+}}))
+"""
+
+
+def test_subprocess_serve_slo_overrun_exactly_one_bundle(tmp_path):
+    """The serving half of the acceptance drill: two serve SLO
+    overruns (the serve_request watchdog armed at the SLO) produce
+    exactly one rate-limited ``serve_slo_overrun`` bundle, and the
+    flight dump (the capture-context satellite) landed."""
+    run_dir = str(tmp_path / "run")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _SERVE_SLO_DRILL.format(repo=REPO, run_dir=run_dir)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["overruns"] == 2
+    assert out["bundles"] == 1
+    assert out["trigger"] == "serve_slo_overrun"
+    assert out["slo_counter"] == 2
+    # The default-path dump's final reason is the SECOND overrun's
+    # watchdog verdict (hang_detected dumps are never throttled — a
+    # blown deadline is a fault, not a near-miss); the suppressed
+    # serve-side dump did not overwrite it, and the accepted capture
+    # holds its own flight.json copy regardless.
+    assert out["dump_reason"] == "hang_detected"
+    bundle_flight = os.path.join(run_dir, "captures",
+                                 "serve_slo_overrun_001", "flight.json")
+    assert os.path.exists(bundle_flight)
+
+
+# -------------------------------------------------- watchdog near-miss
+
+
+def test_watchdog_near_miss_fires_capture_and_flight_dump(tmp_path):
+    from fm_spark_tpu.resilience import watchdog
+
+    run_dir = str(tmp_path / "run")
+    obs.configure(run_dir, run_id="nm", install_signals=False)
+    introspect.configure(run_dir, run_id="nm", profile=False,
+                         min_interval_s=0.0)
+    # Wide margins: the sleep must land in (80%, 100%] of the deadline
+    # even with scheduler overshoot on a loaded CI core.
+    table = watchdog.configure({"ckpt_commit": 0.5}, action="raise")
+    try:
+        with watchdog.phase("ckpt_commit"):
+            time.sleep(0.42)   # ~84% of the deadline: a near-miss
+        assert table.near_misses == 1
+        assert table.hangs_detected == 0
+        found = introspect.list_captures(run_dir)
+        assert [m["trigger"] for m in found] == ["watchdog_near_miss"]
+        ctx = found[0]["context"]
+        assert ctx["phase"] == "ckpt_commit"
+        assert 0.8 < ctx["frac"] <= 1.0
+        # Flight dump on a near-miss (the ISSUE 14 satellite).
+        with open(os.path.join(run_dir, "flight_dump.json")) as f:
+            assert json.load(f)["reason"] == "watchdog_near_miss"
+        assert any(e["kind"] == "watchdog_near_miss"
+                   for e in obs.fault_timeline())
+        # A fast phase is NOT a near-miss.
+        with watchdog.phase("ckpt_commit"):
+            pass
+        assert table.near_misses == 1
+    finally:
+        watchdog.clear()
+
+
+def test_near_miss_heavy_evidence_throttled_when_unarmed(tmp_path):
+    """Without a capture engine, back-to-back near-misses of the same
+    phase are COUNTED each time but journal+dump at most once per
+    throttle interval — a phase living at 85% of its deadline must
+    never fsync per occurrence."""
+    from fm_spark_tpu.resilience import watchdog
+
+    class _Journal:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, event, **fields):
+            self.events.append(event)
+
+    introspect.clear()
+    journal = _Journal()
+    table = watchdog.configure({"ckpt_commit": 0.4}, action="raise",
+                               journal=journal)
+    try:
+        for _ in range(3):
+            with watchdog.phase("ckpt_commit"):
+                time.sleep(0.34)   # ~85% of the deadline each time
+        assert table.near_misses == 3
+        assert journal.events.count("watchdog_near_miss") == 1
+    finally:
+        watchdog.clear()
+
+
+# ------------------------------------------------------ cost attribution
+
+
+def test_step_cost_model_families_and_shapes():
+    fm = introspect.step_cost_model("fm", batch=1024, rank=64)
+    assert set(fm["families"]) == {"gather", "interact", "update",
+                                   "segsum"}
+    assert fm["bytes_total"] == sum(fm["families"].values())
+    assert fm["families"]["segsum"] == 0          # no compact cap
+    assert fm["assumptions"]["fields"] == 39
+
+    compact = introspect.step_cost_model("fm", batch=131072, rank=64,
+                                         cap=16384)
+    # The compact lever's whole point: the update term shrinks from
+    # B lanes to cap lanes per field.
+    assert compact["families"]["update"] \
+        < introspect.step_cost_model("fm", batch=131072,
+                                     rank=64)["families"]["update"]
+    assert compact["families"]["segsum"] > 0
+
+    ffm = introspect.step_cost_model("ffm", batch=1024, rank=16)
+    # FFM's field-aware sel set dominates: F x larger than FM's
+    # elementwise interaction at the same shape.
+    assert ffm["families"]["interact"] > \
+        introspect.step_cost_model("fm", batch=1024, rank=16,
+                                   fields=23)["families"]["interact"]
+    assert ffm["assumptions"]["fields"] == 23
+
+    bf16 = introspect.step_cost_model("fm", batch=1024, rank=64,
+                                      param_bytes=2)
+    assert bf16["families"]["gather"] < fm["families"]["gather"]
+
+
+# ------------------------------------------------------- live endpoint
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def test_metrics_endpoint_round_trip(tmp_path):
+    obs.configure(str(tmp_path / "run"), run_id="ep1",
+                  install_signals=False)
+    reg = obs.registry()
+    reg.counter("ingest.rows_ok_total").add(3)
+    reg.histogram("step_time_ms", buckets=(10.0, 100.0)).observe(42.0)
+    reg.gauge("serve/staleness_steps").set(2)
+    srv = export.start_metrics_server(0)
+    try:
+        status, ctype, text = _get(f"{srv.url}/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        # Native histogram exposition, run_id-labelled samples.
+        assert ('fm_spark_ingest_rows_ok_total{run_id="ep1"} 3'
+                in text)
+        assert ('fm_spark_step_time_ms_bucket{run_id="ep1",le="100"} 1'
+                in text)
+        assert ('fm_spark_step_time_ms_bucket{run_id="ep1",le="+Inf"} 1'
+                in text)
+
+        status, ctype, body = _get(f"{srv.url}/healthz")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["status"] == "ok"
+        assert doc["run_id"] == "ep1"
+        assert doc["staleness_steps"] == 2
+        assert doc["captures"] == 0
+        # A scrape is READ-ONLY: the gauges /healthz asked about but
+        # this process never set must not be conjured into the
+        # registry (they would pollute every later snapshot).
+        snap = reg.snapshot()
+        assert "serve/generation_step" not in snap["gauges"]
+        assert "online/auc" not in snap["gauges"]
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/nope")
+        assert ei.value.code == 404
+    finally:
+        export.stop_metrics_server()
+    # Stopped: the port no longer answers.
+    with pytest.raises(Exception):
+        _get(f"{srv.url}/healthz", timeout=2)
+
+
+def test_start_metrics_server_replaces_previous():
+    a = export.start_metrics_server(0)
+    b = export.start_metrics_server(0)
+    try:
+        assert a.port != b.port or a is not b
+        status, _, _ = _get(f"{b.url}/healthz")
+        assert status == 200
+        with pytest.raises(Exception):
+            _get(f"{a.url}/healthz", timeout=2)
+    finally:
+        export.stop_metrics_server()

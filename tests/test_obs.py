@@ -178,8 +178,62 @@ def test_snapshot_and_prometheus_text():
     text = reg.prometheus_text()
     assert "# TYPE fm_spark_ingest_rows_ok_total counter" in text
     assert "fm_spark_train_n_chips 4" in text
-    assert 'fm_spark_step_time_ms{quantile="0.50"}' in text
+    # Native Prometheus HISTOGRAM exposition (ISSUE 14 — the live
+    # /metrics endpoint serves real scrapers): cumulative le buckets,
+    # the mandatory +Inf, _sum and _count.
+    assert "# TYPE fm_spark_step_time_ms histogram" in text
+    assert 'fm_spark_step_time_ms_bucket{le="10"} 0' in text
+    assert 'fm_spark_step_time_ms_bucket{le="100"} 1' in text
+    assert 'fm_spark_step_time_ms_bucket{le="+Inf"} 1' in text
+    assert "fm_spark_step_time_ms_sum 42" in text
     assert "fm_spark_step_time_ms_count 1" in text
+
+
+def test_prometheus_histogram_buckets_are_cumulative_and_ordered():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", buckets=(1.0, 5.0, 10.0))
+    for v in (0.5, 0.7, 3.0, 7.0, 50.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("fm_spark_lat_ms_bucket")]
+    # One line per bound + the +Inf catch-all, cumulative counts.
+    assert lines == [
+        'fm_spark_lat_ms_bucket{le="1"} 2',
+        'fm_spark_lat_ms_bucket{le="5"} 3',
+        'fm_spark_lat_ms_bucket{le="10"} 4',
+        'fm_spark_lat_ms_bucket{le="+Inf"} 5',
+    ]
+    assert "fm_spark_lat_ms_count 5" in text
+
+
+def test_prometheus_labels_attach_and_escape():
+    """Label escaping per the exposition rules (ISSUE 14 satellite):
+    backslash, double-quote and newline in a label VALUE must be
+    escaped, and caller labels compose with the histogram's own
+    ``le``."""
+    reg = MetricsRegistry()
+    reg.counter("c").add(1)
+    reg.histogram("h_ms", buckets=(1.0,)).observe(0.5)
+    text = reg.prometheus_text(
+        labels={"run_id": 'r"1\\x\ny', "host": "a"})
+    assert 'fm_spark_c{run_id="r\\"1\\\\x\\ny",host="a"} 1' in text
+    assert ('fm_spark_h_ms_bucket{run_id="r\\"1\\\\x\\ny",host="a",'
+            'le="1"} 1') in text
+    # No labels -> bare sample names, no empty {}.
+    assert "fm_spark_c 1" in reg.prometheus_text()
+
+
+def test_prometheus_large_counter_keeps_full_precision():
+    """'%g' would quantize a 9-digit counter to 6 significant digits,
+    making small increments invisible to rate() between scrapes — the
+    live endpoint serves full-precision values."""
+    reg = MetricsRegistry()
+    reg.counter("rows_total").add(123_456_789)
+    reg.gauge("g").set(123_456_789.25)
+    text = reg.prometheus_text()
+    assert "fm_spark_rows_total 123456789" in text
+    assert "fm_spark_g 123456789.25" in text
 
 
 def test_export_jsonl_appends_parseable_snapshots(tmp_path):
@@ -486,6 +540,44 @@ def test_obs_report_latest_picks_newest_run(tmp_path, capsys):
     assert "obs/new" in capsys.readouterr().out.replace(os.sep, "/")
 
 
+def test_obs_report_run_id_selector(tmp_path, capsys):
+    """ISSUE 14 satellite: ``--run-id`` picks a run by NAME — the
+    mtime-based --latest is wrong while a serve daemon keeps its run
+    dir hot (the OLD run the operator wants to read is not the newest
+    directory)."""
+    root = tmp_path / "obs"
+    for name, ts in (("wanted", 100.0), ("hot-daemon", 200.0)):
+        d = root / name
+        d.mkdir(parents=True)
+        os.utime(d, (ts, ts))
+    report = _load_report()
+    assert report.main(["--run-id", "wanted", str(root)]) == 0
+    assert "obs/wanted" in capsys.readouterr().out.replace(os.sep, "/")
+    assert report.main(["--run-id", "absent", str(root)]) == 1
+    assert "absent" in capsys.readouterr().err
+
+
+def test_obs_report_renders_deep_captures(tmp_path, capsys):
+    """ISSUE 14: capture bundles under <run>/captures/ get a Deep
+    captures section — trigger, profiler status, context, bundle
+    path."""
+    d = tmp_path / "run"
+    d.mkdir()
+    bundle = d / "captures" / "sentinel_regressed_001"
+    bundle.mkdir(parents=True)
+    (bundle / "capture.json").write_text(json.dumps({
+        "trigger": "sentinel_regressed", "seq": 1, "run_id": "x",
+        "ts": 1.0, "context": {"leg": "t", "z": -8.1},
+        "profiler": {"status": "skipped: jax not loaded"},
+    }))
+    report = _load_report()
+    assert report.main([str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "## Deep captures (1 bundle(s))" in out
+    assert "sentinel_regressed" in out and "z=-8.1" in out
+    assert "profiler=skipped: jax not loaded" in out
+
+
 def test_obs_report_renders_kernel_pricing(tmp_path, capsys):
     """ISSUE 9 satellite: a run dir carrying bench_kernels.py's
     kernel_pricing.json gets a pricing table in the report — measured
@@ -539,13 +631,22 @@ def test_bench_kernels_prices_into_run_dir_and_ledger(tmp_path, capsys):
     ledger = [json.loads(ln) for ln in
               (tmp_path / "obs" / "ledger.jsonl").read_text()
               .splitlines()]
-    assert len(ledger) == 2
-    for rec in ledger:
-        assert rec["kind"] == "kernel_pricing"
+    pricing = [r for r in ledger if r["kind"] == "kernel_pricing"]
+    assert len(pricing) == 2
+    for rec in pricing:
         assert rec["leg"] == "kernel/gather"
         assert rec["run_id"] == doc["run_id"]
         assert rec["value"] > 0 and rec["unit"] == "GB/s"
         assert rec["fingerprint"]["device_kind"] == "cpu"
+    # ISSUE 14: each priced row ALSO lands a cost_attribution record
+    # (measured ms x bytes model) in the one kind the autotuner reads.
+    cost = [r for r in ledger if r["kind"] == "cost_attribution"]
+    assert len(cost) == 2
+    for rec in cost:
+        assert rec["leg"] == "cost/kernel/gather"
+        assert rec["step_ms"] > 0 and rec["bytes_per_step"] > 0
+        assert rec["unit"] == "GB/s(model)"
+    assert len(ledger) == 4
     report = _load_report()
     assert report.main([str(run_dir)]) == 0
     out = capsys.readouterr().out

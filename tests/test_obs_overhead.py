@@ -55,7 +55,15 @@ def _loop_bare(steps: int, step_s: float) -> float:
 def _loop_instrumented(steps: int, step_s: float) -> float:
     """The library's disabled-path instrumentation pattern per step:
     one unconditional ``with obs.span(...)`` (the stream/checkpoint
-    idiom) plus the latched-flag check (the trainer idiom)."""
+    idiom), the latched-flag check (the trainer idiom), and the
+    ISSUE 14 introspection hooks — ``observe_step_time`` (the
+    trainer's window feed) and ``fire`` (the sentinel/watchdog/serve
+    hook) — both one module-global None check when no capture engine
+    is armed. (The live endpoint, obs/export.py, is pull-model: an
+    un-scraped process runs NO export code on any hot path, so there
+    is nothing of it to time here.)"""
+    from fm_spark_tpu.obs import introspect
+
     obs_on = obs.enabled()
     hist = obs.histogram("overhead_test_ms") if obs_on else None
     t0 = time.perf_counter()
@@ -64,13 +72,19 @@ def _loop_instrumented(steps: int, step_s: float) -> float:
             _spin(step_s)
         if obs_on:
             hist.observe(0.0)
+        introspect.observe_step_time(step_s * 1e3)
+        introspect.fire("step_time_spike")
     return time.perf_counter() - t0
 
 
 @pytest.mark.parametrize("steps,step_s", [(200, 0.0005)])
 def test_disabled_tracing_overhead_under_1pct(steps, step_s):
+    from fm_spark_tpu.obs import introspect
+
     obs.shutdown(reason=None)  # the disabled path is the unconfigured one
+    introspect.clear()         # ...and the unarmed capture engine
     assert not obs.enabled()
+    assert not introspect.active()
     # Warm both loops (bytecode/alloc effects), then take the best of 3
     # — min is the right statistic for a noise-floor comparison.
     _loop_bare(20, step_s)

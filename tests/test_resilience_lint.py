@@ -286,3 +286,47 @@ def test_serve_runtime_in_strict_eventlog_scope():
     assert os.path.isdir(lint.SERVE_DIR)
     # The shipped serve/ modules are clean under the full default scan.
     assert lint.violations() == []
+
+
+# ------------------------------------ introspection triggers (ISSUE 14)
+
+
+def test_introspect_trigger_coverage_clean_on_shipped_registry():
+    """Every TRIGGERS entry in obs/introspect.py — sentinel_regressed,
+    watchdog_near_miss, serve_slo_overrun, step_time_spike — is fired
+    by at least one tier-1 test in the tree."""
+    lint = _load_lint()
+    found = lint.introspect_trigger_coverage_violations()
+    assert found == [], "\n".join(found)
+
+
+def test_introspect_trigger_coverage_catches_untested_trigger(tmp_path):
+    """A capture trigger no test fires turns the lint red — deep-
+    profiling paths can't ship unexercised, same policy as fault
+    points and watchdog phases."""
+    lint = _load_lint()
+    intro = tmp_path / "introspect.py"
+    intro.write_text(
+        'TRIGGERS = (\n    "step_time_spike",\n'
+        '    "brand_new_trigger",\n)\n')
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_x.py").write_text(
+        'def test_a():\n    assert "step_time_spike"\n')
+    found = lint.introspect_trigger_coverage_violations(
+        tests_dir=str(tests_dir), introspect_path=str(intro))
+    assert len(found) == 1 and "brand_new_trigger" in found[0]
+    empty = tmp_path / "empty.py"
+    empty.write_text("x = 1\n")
+    found = lint.introspect_trigger_coverage_violations(
+        tests_dir=str(tests_dir), introspect_path=str(empty))
+    assert found and "no TRIGGERS" in found[0]
+
+
+def test_introspect_trigger_rule_wired_into_main(monkeypatch):
+    """main() runs the ISSUE 14 rule — a planted violation fails the
+    lint exit status."""
+    lint = _load_lint()
+    monkeypatch.setattr(lint, "introspect_trigger_coverage_violations",
+                        lambda **kw: ["introspect.py:1 planted"])
+    assert lint.main() == 1

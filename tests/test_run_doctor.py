@@ -121,6 +121,58 @@ def test_result_json_carries_sentinel_block(bench_run):
     assert set(final["all_verdicts"]) == set(final["all_variants"])
 
 
+def test_cost_attribution_lands_per_leg_and_renders(bench_run):
+    """ISSUE 14 acceptance: every completed bench leg lands ONE
+    cost_attribution ledger record (measured step time x bytes-moved
+    model), and the doctor renders the cost table."""
+    art, final = bench_run
+    ledger = [json.loads(ln) for ln in
+              (art / "obs" / "ledger.jsonl").read_text().splitlines()]
+    legs = [r for r in ledger if r["kind"] == "bench_leg"]
+    cost = [r for r in ledger if r["kind"] == "cost_attribution"]
+    assert len(cost) == len(legs) >= 1
+    assert ({r["variant"] for r in cost}
+            == {r["variant"] for r in legs})
+    for rec in cost:
+        assert rec["run_id"] == final["run_id"]
+        assert rec["value"] > 0 and rec["unit"] == "GB/s(model)"
+        assert rec["step_ms"] > 0
+        assert rec["bytes_per_step"] == sum(rec["families"].values())
+        assert set(rec["families"]) == {"gather", "interact",
+                                        "update", "segsum"}
+        assert rec["fingerprint"]["key"]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_doctor.py"),
+         "--latest", str(art / "obs")],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "## Cost attribution" in proc.stdout
+    assert "GB/s(model)" in proc.stdout
+
+
+def test_doctor_run_id_selector(bench_run):
+    """ISSUE 14 satellite: ``--run-id`` selects a run by NAME (the
+    mtime-based --latest pick is wrong while a daemon keeps its run
+    dir hot), and a bogus id is a loud error, never a fallback."""
+    art, final = bench_run
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_doctor.py"),
+         "--run-id", final["run_id"], str(art / "obs")],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert final["run_id"] in proc.stdout
+    assert "## Per-leg verdicts" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_doctor.py"),
+         "--run-id", "no-such-run", str(art / "obs")],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "no-such-run" in proc.stderr
+
+
 # ---------------------------------------------------------------- unit
 
 
@@ -246,6 +298,32 @@ def test_doctor_renders_chaos_verdict(tmp_path, capsys):
     assert "exactly_once_stream" in out
     assert "FM_SPARK_FAULTS='train_step@4=device_loss'" in out
     assert "CHAOS: seed 3" in out
+
+
+def test_doctor_renders_deep_captures(tmp_path, capsys):
+    """ISSUE 14: a run dir holding capture bundles gets a Deep
+    captures section plus a DEEP CAPTURE diagnosis pointer per bundle;
+    a torn bundle (no manifest) is skipped, never fatal."""
+    doctor = _load_doctor()
+    run_dir = tmp_path / "r14"
+    run_dir.mkdir()
+    (run_dir / "trace.jsonl").write_text("")
+    bundle = run_dir / "captures" / "serve_slo_overrun_001"
+    bundle.mkdir(parents=True)
+    (bundle / "capture.json").write_text(json.dumps({
+        "trigger": "serve_slo_overrun", "seq": 1, "run_id": "r14",
+        "ts": 5.0, "context": {"deadline_s": 0.01, "elapsed_s": 0.09},
+        "profiler": {"status": "armed", "trace_s": 0.5},
+    }))
+    torn = run_dir / "captures" / "step_time_spike_001"
+    torn.mkdir()
+    (torn / "metrics.json").write_text("{}")
+    assert doctor.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "## Deep captures (1 bundle(s))" in out
+    assert "serve_slo_overrun" in out and "profiler=armed" in out
+    assert "DEEP CAPTURE [serve_slo_overrun]" in out
+    assert "step_time_spike" not in out
 
 
 def test_doctor_chaos_findings_green_and_budget():

@@ -909,5 +909,66 @@ def test_cli_serve_smoke_from_model(tmp_path, capsys):
     assert not summary["degraded"]
 
 
+def test_cli_serve_metrics_port_live_round_trip(tmp_path):
+    """ISSUE 14 acceptance: while a ``cli serve`` loop is LIVE,
+    ``--metrics-port`` serves valid Prometheus text on /metrics and a
+    JSON liveness doc on /healthz — a curl-level HTTP round-trip from
+    another process, no touching the daemon."""
+    import urllib.request
+
+    spec = _spec()
+    models.save_model(str(tmp_path / "m"), spec, _params(spec))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fm_spark_tpu", "serve",
+         "--model", str(tmp_path / "m"),
+         "--synthetic", "256", "--batch-size", "8",
+         "--buckets", "1,8", "--latency-budget-ms", "0",
+         "--reload-poll-s", "0", "--repeat", "1000000",
+         "--obs-dir", "none", "--metrics-port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        port = None
+        serving = False
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if "metrics_port" in doc:
+                port = doc["metrics_port"]
+            if doc.get("serving"):
+                serving = True
+                break
+        assert port, "no metrics_port line from cli serve"
+        assert serving, proc.stderr.read()[-2000:]
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=15) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        # Valid exposition text with the live serving gauges: the
+        # engine published its generation before the first request.
+        assert "# TYPE fm_spark_serve_generation_step gauge" in text
+        assert "fm_spark_serve_generation_step 0" in text
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=15) as r:
+            hz = json.loads(r.read())
+        assert hz["status"] == "ok"
+        assert hz["generation_step"] == 0
+        assert not hz["degraded"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def test_default_buckets_sane():
     assert DEFAULT_BUCKETS == (1, 8, 64, 512)

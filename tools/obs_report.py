@@ -26,6 +26,12 @@ Usage::
     python tools/obs_report.py artifacts/obs/<run_id>/
     python tools/obs_report.py --latest            # newest run under
                                                    # artifacts/obs/
+    python tools/obs_report.py --run-id <id>       # explicit run-id
+                                                   # selector (ISSUE 14:
+                                                   # mtime-based --latest
+                                                   # is wrong while a
+                                                   # serve daemon keeps
+                                                   # its run dir hot)
 """
 
 from __future__ import annotations
@@ -40,6 +46,7 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from fm_spark_tpu.obs import FAULT_KINDS, TRACE_FILE  # noqa: E402
+from fm_spark_tpu.obs.introspect import list_captures  # noqa: E402
 
 
 def _read_jsonl(path: str) -> list[dict]:
@@ -133,6 +140,9 @@ def load_run(obs_dir: str) -> dict:
         "flight_events": flight_events,
         "dead": dead,
         "kernel_pricing": pricing,
+        # Deep-capture bundles (ISSUE 14): every valid manifest under
+        # <run>/captures/ — trigger, context, profiler status.
+        "captures": list_captures(obs_dir),
     }
 
 
@@ -152,6 +162,25 @@ def online_timeline(flight_events: list[dict]) -> list[dict]:
         flight_events,
         ("quality_eval", "online_", "divergence_",
          "generation_demoted", "last_good_republished"))
+
+
+def render_captures(captures: list[dict]) -> list[str]:
+    """The 'Deep captures' section body (ISSUE 14) — trigger, profiler
+    status, context, bundle path per valid manifest. Shared by this
+    report and ``tools/run_doctor.py`` (same sharing contract as
+    :func:`serve_timeline`), so the format can never drift between the
+    two tools."""
+    out = [f"## Deep captures ({len(captures)} bundle(s))"]
+    for m in captures:
+        ctx = " ".join(f"{k}={v}" for k, v in sorted(
+            (m.get("context") or {}).items()))
+        prof = (m.get("profiler") or {}).get("status", "?")
+        out.append(f"  {m.get('trigger', '?'):22} "
+                   f"#{m.get('seq', '?')}  profiler={prof}  "
+                   f"{ctx}"[:200])
+        out.append(f"    -> {m.get('dir')}")
+    out.append("")
+    return out
 
 
 def _dedup_timeline(flight_events: list[dict], prefixes) -> list[dict]:
@@ -318,6 +347,10 @@ def render(run: dict) -> str:
                 f"{row.get('note', '')}"[:120])
         out.append("")
 
+    captures = run.get("captures") or []
+    if captures:
+        out.extend(render_captures(captures))
+
     dump = run["dump"]
     if dump:
         out.append(f"last flight dump: reason={dump.get('reason')!r} "
@@ -334,20 +367,50 @@ def _latest_run_dir(root: str) -> str | None:
     return max(runs, key=os.path.getmtime) if runs else None
 
 
-def main(argv=None) -> int:
-    args = list(sys.argv[1:] if argv is None else argv)
+def _run_dir_by_id(root: str, run_id: str) -> str | None:
+    """Explicit run-id selection (ISSUE 14 satellite): `--latest`'s
+    mtime pick is wrong when a serve daemon keeps its run dir hot —
+    the run you want to inspect is named, not newest."""
+    path = os.path.join(root, run_id)
+    return path if os.path.isdir(path) else None
+
+
+def select_run_dir(args: list, default_root: str) -> "str | int":
+    """Shared --latest / --run-id / positional-dir selection for this
+    report and tools/run_doctor.py. Returns the run dir, or an int
+    exit code: not-found complaints are printed here (tool-agnostic),
+    but a USAGE error (2) returns silently — each caller prints its
+    OWN usage doc, never this module's."""
+    if args and args[0] == "--run-id":
+        if len(args) < 2:
+            return 2
+        root = args[2] if len(args) > 2 else default_root
+        obs_dir = _run_dir_by_id(root, args[1])
+        if obs_dir is None:
+            print(f"no run directory {args[1]!r} under {root}",
+                  file=sys.stderr)
+            return 1
+        return obs_dir
     if args and args[0] == "--latest":
-        root = args[1] if len(args) > 1 else os.path.join(
-            _REPO, "artifacts", "obs")
+        root = args[1] if len(args) > 1 else default_root
         obs_dir = _latest_run_dir(root)
         if obs_dir is None:
             print(f"no run directories under {root}", file=sys.stderr)
             return 1
-    elif len(args) == 1:
-        obs_dir = args[0]
-    else:
-        print(__doc__, file=sys.stderr)
-        return 2
+        return obs_dir
+    if len(args) == 1:
+        return args[0]
+    return 2
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    obs_dir = select_run_dir(args, os.path.join(_REPO, "artifacts",
+                                                "obs"))
+    if isinstance(obs_dir, int):
+        if obs_dir == 2:
+            print(__doc__, file=sys.stderr)
+        return obs_dir
     if not os.path.isdir(obs_dir):
         print(f"not a directory: {obs_dir}", file=sys.stderr)
         return 1

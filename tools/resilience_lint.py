@@ -66,6 +66,13 @@ every ``watchdog.KNOWN_PHASES`` entry — including the new
 ``serve_request`` SLO phase — must appear in at least one tier-1 test
 (:func:`watchdog_phase_coverage_violations`).
 
+Since ISSUE 14 the same coverage idea extends to the introspection
+plane: every capture trigger registered in
+``obs/introspect.py::TRIGGERS`` must appear in at least one tier-1
+test (:func:`introspect_trigger_coverage_violations`) — a trigger no
+test ever fires is a capture path that can rot silently, exactly like
+an unexercised fault point.
+
 Usage::
 
     python tools/resilience_lint.py        # exit 1 on violations
@@ -471,6 +478,57 @@ def watchdog_phase_coverage_violations(tests_dir: str | None = None,
     ]
 
 
+def _known_triggers(introspect_path: str) -> list[str]:
+    """AST-extract the ``TRIGGERS`` literal from obs/introspect.py —
+    same no-import policy as :func:`_known_points`."""
+    with open(introspect_path) as f:
+        tree = ast.parse(f.read(),
+                         filename=os.path.basename(introspect_path))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "TRIGGERS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def introspect_trigger_coverage_violations(
+        tests_dir: str | None = None,
+        introspect_path: str | None = None) -> list[str]:
+    """Introspection-trigger coverage rule (ISSUE 14 satellite): every
+    ``TRIGGERS`` entry in obs/introspect.py must appear in at least one
+    tier-1 test module — a capture trigger nobody's test ever fires is
+    a deep-profiling path that can rot silently, the exact blind spot
+    the fault-point and watchdog-phase rules already close."""
+    tests_dir = tests_dir or os.path.join(REPO, "tests")
+    introspect_path = introspect_path or os.path.join(
+        REPO, "fm_spark_tpu", "obs", "introspect.py")
+    triggers = _known_triggers(introspect_path)
+    if not triggers:
+        return [f"{os.path.basename(introspect_path)}: no TRIGGERS "
+                "literal found — the introspection registry has no "
+                "anchor to check coverage against"]
+    texts = []
+    try:
+        for fname in sorted(os.listdir(tests_dir)):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                with open(os.path.join(tests_dir, fname)) as f:
+                    texts.append(f.read())
+    except OSError as e:
+        return [f"tests dir unreadable ({e})"]
+    blob = "\n".join(texts)
+    return [
+        f"introspection trigger {t!r} (TRIGGERS) is exercised by no "
+        "test under tests/ — a capture trigger must ship with at "
+        "least one tier-1 test that fires it"
+        for t in triggers if t not in blob
+    ]
+
+
 def bench_leg_record_violations(path: str | None = None) -> list[str]:
     """Provenance rule (ISSUE 9): bench.py's ``leg_record`` dict
     literal must carry :data:`LEG_RECORD_REQUIRED_KEYS` — the AST half
@@ -537,7 +595,8 @@ def main() -> int:
              + duration_time_violations()
              + bench_leg_record_violations()
              + fault_point_coverage_violations()
-             + watchdog_phase_coverage_violations())
+             + watchdog_phase_coverage_violations()
+             + introspect_trigger_coverage_violations())
     for v in found:
         print(v, file=sys.stderr)
     if found:

@@ -36,6 +36,15 @@ Usage::
 
     python tools/run_doctor.py artifacts/obs/<run_id>/
     python tools/run_doctor.py --latest [obs_root]
+    python tools/run_doctor.py --run-id <id> [obs_root]
+
+``--run-id`` (ISSUE 14 satellite) selects a run by name — ``--latest``
+picks by mtime, which is wrong while a serve daemon keeps its own run
+directory hot. The doctor also renders the run's **deep-capture
+bundles** (``captures/<trigger>_<seq>/`` — trigger-fired profiler
+traces + metrics/flight snapshots) and its **cost-attribution table**
+(``cost_attribution`` ledger rows: measured step time x bytes-moved
+model = model-implied GB/s, the autotuner's lever-ranking evidence).
 """
 
 from __future__ import annotations
@@ -60,9 +69,15 @@ def _load_file(path, modname):
     return mod
 
 
+_TOOL_CACHE: dict = {}
+
+
 def _load_tool(name):
-    return _load_file(os.path.join(_REPO, "tools", f"{name}.py"),
-                      f"_doctor_{name}")
+    if name not in _TOOL_CACHE:
+        _TOOL_CACHE[name] = _load_file(
+            os.path.join(_REPO, "tools", f"{name}.py"),
+            f"_doctor_{name}")
+    return _TOOL_CACHE[name]
 
 
 def _span_totals(spans: list[dict]) -> dict:
@@ -95,6 +110,15 @@ def _quality_rows(ledger_path: str, run_id: str) -> list[dict]:
     lg = _load_file(os.path.join(_REPO, "fm_spark_tpu", "obs",
                                  "ledger.py"), "_doctor_ledger")
     return lg.PerfLedger(ledger_path).records(kind="quality_eval",
+                                              run_id=run_id)
+
+
+def _cost_rows(ledger_path: str, run_id: str) -> list[dict]:
+    """This run's cost_attribution ledger records (ISSUE 14): measured
+    step time paired with the bytes-moved model per leg/kernel."""
+    lg = _load_file(os.path.join(_REPO, "fm_spark_tpu", "obs",
+                                 "ledger.py"), "_doctor_ledger")
+    return lg.PerfLedger(ledger_path).records(kind="cost_attribution",
                                               run_id=run_id)
 
 
@@ -395,10 +419,25 @@ def findings(diag: dict, legs: list[dict]) -> list[str]:
     return out
 
 
+def capture_findings(captures: list[dict]) -> list[str]:
+    """Deep-capture one-liners (ISSUE 14): a fired capture is evidence
+    the operator should open, so each bundle gets a pointer."""
+    out = []
+    for m in captures or []:
+        ctx = m.get("context") or {}
+        detail = ctx.get("reason") or " ".join(
+            f"{k}={v}" for k, v in sorted(ctx.items()))
+        out.append(
+            f"DEEP CAPTURE [{m.get('trigger')}]: {str(detail)[:120]} "
+            f"— evidence at {m.get('dir')}")
+    return out
+
+
 def render(run: dict, diag: dict, legs: list[dict],
            chaos: dict | None = None, serve: dict | None = None,
            serve_legs: list[dict] | None = None,
-           online: dict | None = None) -> str:
+           online: dict | None = None,
+           cost_rows: list[dict] | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -434,6 +473,30 @@ def render(run: dict, diag: dict, legs: list[dict],
         out.append("  (no ledger records for this run — pre-ledger run, "
                    "or a train-only run)")
     out.append("")
+
+    cost_rows = cost_rows or []
+    if cost_rows:
+        out.append(f"## Cost attribution ({len(cost_rows)} record(s): "
+                   "measured step time x bytes-moved model)")
+        out.append(f"  {'variant':52} {'GB/s(model)':>12} "
+                   f"{'step_ms':>10} {'bytes/step':>12}")
+        for r in cost_rows:
+            v = r.get("value")
+            ms = r.get("step_ms")
+            bts = r.get("bytes_per_step")
+            v_s = f"{v:,.1f}" if isinstance(v, (int, float)) else "-"
+            ms_s = f"{ms:,.2f}" if isinstance(ms, (int, float)) else "-"
+            b_s = (f"{bts / 2**20:,.1f}M"
+                   if isinstance(bts, (int, float)) else "-")
+            out.append(f"  {str(r.get('variant'))[:52]:52} "
+                       f"{v_s:>12} {ms_s:>10} {b_s:>12}")
+        out.append("")
+
+    captures = run.get("captures") or []
+    if captures:
+        # One shared renderer (obs_report.render_captures) — the
+        # section format can never drift between the two tools.
+        out.extend(_load_tool("obs_report").render_captures(captures))
 
     if diag["fault_kinds"]:
         out.append("## Fault timeline (event counts)")
@@ -537,7 +600,8 @@ def render(run: dict, diag: dict, legs: list[dict],
     out.append("## Diagnosis")
     for line in (findings(diag, legs) + chaos_findings(chaos)
                  + serve_findings(serve, serve_legs)
-                 + online_findings(online)):
+                 + online_findings(online)
+                 + capture_findings(run.get("captures"))):
         out.append(f"  - {line}")
     return "\n".join(out) + "\n"
 
@@ -553,18 +617,13 @@ def main(argv=None) -> int:
             return 2
         ledger_path = args[i + 1]
         del args[i:i + 2]
-    if args and args[0] == "--latest":
-        root = args[1] if len(args) > 1 else os.path.join(
-            _REPO, "artifacts", "obs")
-        obs_dir = obs_report._latest_run_dir(root)
-        if obs_dir is None:
-            print(f"no run directories under {root}", file=sys.stderr)
-            return 1
-    elif len(args) == 1:
-        obs_dir = args[0]
-    else:
-        print(__doc__, file=sys.stderr)
-        return 2
+    # Shared --latest / --run-id / positional selection (ISSUE 14).
+    obs_dir = obs_report.select_run_dir(
+        args, os.path.join(_REPO, "artifacts", "obs"))
+    if isinstance(obs_dir, int):
+        if obs_dir == 2:
+            print(__doc__, file=sys.stderr)
+        return obs_dir
     if not os.path.isdir(obs_dir):
         print(f"not a directory: {obs_dir}", file=sys.stderr)
         return 1
@@ -585,7 +644,9 @@ def main(argv=None) -> int:
     sys.stdout.write(render(run, diag, legs,
                             chaos=load_chaos_verdict(obs_dir),
                             serve=serve, serve_legs=serve_legs,
-                            online=online))
+                            online=online,
+                            cost_rows=_cost_rows(ledger_path,
+                                                 run["run_id"])))
     return 0
 
 
