@@ -1,0 +1,54 @@
+"""fmlint — the repo's pluggable static-analysis framework (ISSUE 15).
+
+Importing this package registers every shipped rule; see
+:mod:`fm_spark_tpu.analysis.core` for the framework (rule registry,
+findings, inline suppressions with required reasons, the committed
+baseline, JSON reports into ``artifacts/obs/<run_id>/``),
+:mod:`.rules_obs` for the rules migrated from ``tools/
+resilience_lint.py``, :mod:`.rules_threads` for the thread-safety /
+lock-discipline pass, and :mod:`.rules_jax` for the JAX host-sync /
+tracer-hazard pass. ``tools/fmlint.py`` is the CLI; the old
+``tools/resilience_lint.py`` survives as a compatibility shim.
+
+Stdlib-only on purpose: the CLI loads this package by file path so a
+bare checkout (no jax) can lint itself.
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_FILE,
+    Context,
+    Finding,
+    RULES,
+    Rule,
+    SUPPRESSION_RULE,
+    all_rules,
+    analyze,
+    compare_to_baseline,
+    counts_of,
+    load_baseline,
+    rule,
+    run_rules,
+    write_baseline,
+    write_baseline_counts,
+    write_report,
+)
+from . import rules_jax, rules_obs, rules_threads  # noqa: F401
+
+__all__ = [
+    "BASELINE_FILE",
+    "Context",
+    "Finding",
+    "RULES",
+    "Rule",
+    "SUPPRESSION_RULE",
+    "all_rules",
+    "analyze",
+    "compare_to_baseline",
+    "counts_of",
+    "load_baseline",
+    "rule",
+    "run_rules",
+    "write_baseline",
+    "write_baseline_counts",
+    "write_report",
+]

@@ -1,0 +1,291 @@
+"""fmlint JAX hazard pass: host syncs, jit side effects, unfenced timing.
+
+The throughput headline (1,422,411 samples/s/chip, MEASURED.json)
+depends on the step loop staying ASYNC: the jitted step returns at
+dispatch time and the device pipelines ahead of the host. One stray
+``float(loss)`` per step serializes host and device and the headline
+dies silently — nothing errors, the number just halves. Three rules:
+
+``jax-host-sync``
+    Inside ``for``/``while`` loop bodies of the hot-path files
+    (:data:`HOT_FILES` — train.py, sparse.py, parallel/,
+    serve/engine.py), flag the device→host synchronization spellings:
+    ``float(...)``/``int(subscript)`` of a non-constant,
+    ``.item()``, ``.block_until_ready()``/``jax.block_until_ready``,
+    ``jax.device_get``, and ``np.asarray``/``np.array`` (``jnp.*`` is
+    device-side and exempt). The DELIBERATE fences — the per-window
+    loss fetch that IS the measurement boundary (PR 7), the first-step
+    compile fence — carry reasoned suppressions; anything else is a
+    stray sync on the hot path. Comprehensions don't count as loops
+    (a post-loop summary comprehension is not the step loop).
+
+``jax-jit-side-effect``
+    Python-side effects inside functions handed to ``jax.jit`` /
+    ``pmap`` / ``shard_map`` run at TRACE time (once, or worse,
+    per-retrace) — not per step: ``print``, journal ``.emit(...)``,
+    and ``obs.*`` registry calls inside jitted bodies are bugs in
+    every direction and are flagged package-wide.
+
+``jax-unfenced-timing``
+    The PR-7 rule, now enforced: a timing window (two or more
+    ``perf_counter``/``monotonic``/``time.time`` calls) inside a hot
+    loop body that also dispatches step work must contain a fence
+    between the first and last timing call — otherwise it measures
+    enqueue latency, not device time, on an async backend.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from .core import Finding, call_name, rule, walk_with_func
+
+#: The hot-path surface (repo-relative; fnmatch patterns): every file
+#: whose loop bodies the async-dispatch discipline protects.
+HOT_FILES = (
+    "fm_spark_tpu/train.py",
+    "fm_spark_tpu/sparse.py",
+    "fm_spark_tpu/online.py",
+    "fm_spark_tpu/parallel/*.py",
+    "fm_spark_tpu/serve/engine.py",
+)
+
+#: Callables that force a device→host sync (dotted-name terminals).
+FENCE_ATTR_CALLS = frozenset({"item", "block_until_ready"})
+FENCE_DOTTED = frozenset({"jax.block_until_ready", "jax.device_get",
+                          "np.asarray", "np.array", "numpy.asarray",
+                          "numpy.array"})
+
+TIMING_CALLS = frozenset({"time.perf_counter", "time.monotonic",
+                          "time.time", "perf_counter", "monotonic"})
+
+#: Side-effect spellings banned inside jitted bodies.
+JIT_BANNED_PREFIXES = ("obs.",)
+JIT_BANNED_CALLS = frozenset({"print"})
+JIT_BANNED_ATTRS = frozenset({"emit"})
+
+#: What counts as "dispatching step work" for the timing rule: a call
+#: whose terminal name mentions a step, or a compiled-executable call.
+DISPATCH_MARKERS = ("step", "compiled")
+
+
+def hot_files(ctx):
+    out = []
+    seen = set()
+    for sf in ctx.package_files():
+        for pat in HOT_FILES:
+            if fnmatch.fnmatch(sf.rel, pat) and sf.rel not in seen:
+                seen.add(sf.rel)
+                out.append(sf)
+    return out
+
+
+def _is_sync_call(node: ast.Call) -> str | None:
+    """The host-sync spelling this call is, or None."""
+    name = call_name(node)
+    if name in FENCE_DOTTED:
+        return name
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in FENCE_ATTR_CALLS
+            and not name.startswith(("jnp.", "jax.numpy."))):
+        return f".{node.func.attr}()"
+    if name == "float" and node.args and not isinstance(
+            node.args[0], ast.Constant):
+        return "float(...)"
+    if (name == "int" and node.args
+            and isinstance(node.args[0], ast.Subscript)):
+        return "int(...)"
+    return None
+
+
+def _is_fence(node: ast.Call) -> bool:
+    return _is_sync_call(node) is not None
+
+
+def _is_timing(node: ast.Call) -> bool:
+    return call_name(node) in TIMING_CALLS
+
+
+def _is_dispatch(node: ast.Call) -> bool:
+    term = call_name(node).rsplit(".", 1)[-1].lower()
+    return any(m in term for m in DISPATCH_MARKERS)
+
+
+def _loops_with_func(tree):
+    """Yield ``(loop_node, enclosing_function)`` for every for/while."""
+    for node, func in walk_with_func(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            yield node, func
+
+
+def _walk_no_comprehensions(node):
+    """Walk a loop body without descending into comprehensions or
+    nested function defs (their bodies are not the loop's hot path —
+    a generator consumed later is not a per-iteration sync)."""
+    yield node
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp, ast.FunctionDef,
+                         ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_no_comprehensions(child)
+
+
+@rule("jax-host-sync",
+      "no device→host sync (float/int/.item/block_until_ready/"
+      "np.asarray/device_get) inside hot-path loop bodies — the step "
+      "loop must stay async; deliberate fences carry a reasoned "
+      "suppression (ISSUE 15)")
+def jax_host_sync(ctx):
+    out = []
+    for sf in hot_files(ctx):
+        tree = sf.tree
+        if tree is None:
+            continue
+        seen_lines = set()
+        for loop, func in _loops_with_func(tree):
+            for stmt in loop.body + getattr(loop, "orelse", []):
+                for node in _walk_no_comprehensions(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    spelling = _is_sync_call(node)
+                    if spelling is None:
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in seen_lines:   # nested loops: flag once
+                        continue
+                    seen_lines.add(key)
+                    out.append(Finding(
+                        "jax-host-sync", sf.rel, node.lineno,
+                        f"host sync {spelling} inside a hot-path loop "
+                        "body — the step loop must stay async "
+                        "(dispatch, don't fetch); if this IS the "
+                        "fence, say so in a suppression reason",
+                        func or ""))
+    return out
+
+
+def _jitted_bodies(tree):
+    """(body root, display name) for every function this module hands
+    to jax.jit/pmap/shard_map: decorated defs, jit(f) over local defs,
+    and inline jit(lambda ...)."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    compilers = ("jit", "pmap", "shard_map")
+
+    def is_compiler(call_or_name) -> bool:
+        if isinstance(call_or_name, ast.Call):
+            name = call_name(call_or_name)
+        elif isinstance(call_or_name, (ast.Name, ast.Attribute)):
+            c = ast.Call(func=call_or_name, args=[], keywords=[])
+            name = call_name(c)
+        else:
+            return False
+        term = name.rsplit(".", 1)[-1]
+        return term in compilers
+
+    out = []
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                target = deco
+                if (isinstance(deco, ast.Call)
+                        and call_name(deco).rsplit(".", 1)[-1]
+                        == "partial" and deco.args):
+                    target = deco.args[0]
+                if is_compiler(target):
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        out.append((node, node.name))
+        elif isinstance(node, ast.Call) and is_compiler(node):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    d = defs[arg.id]
+                    if id(d) not in seen:
+                        seen.add(id(d))
+                        out.append((d, d.name))
+                elif isinstance(arg, ast.Lambda):
+                    out.append((arg, "<lambda>"))
+    return out
+
+
+@rule("jax-jit-side-effect",
+      "no print / journal .emit / obs.* registry calls inside "
+      "functions handed to jax.jit/pmap/shard_map — trace-time "
+      "side effects fire once (or per retrace), never per step "
+      "(ISSUE 15)")
+def jax_jit_side_effect(ctx):
+    out = []
+    for sf in ctx.package_files():
+        tree = sf.tree
+        if tree is None:
+            continue
+        for body, name in _jitted_bodies(tree):
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                bad = None
+                if cname in JIT_BANNED_CALLS:
+                    bad = cname
+                elif cname.startswith(JIT_BANNED_PREFIXES):
+                    bad = cname
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in JIT_BANNED_ATTRS):
+                    bad = f".{node.func.attr}()"
+                if bad is not None:
+                    out.append(Finding(
+                        "jax-jit-side-effect", sf.rel, node.lineno,
+                        f"Python side effect {bad} inside jitted "
+                        f"function {name!r} runs at trace time, not "
+                        "per step — hoist it out of the compiled "
+                        "body", name))
+    return out
+
+
+@rule("jax-unfenced-timing",
+      "a timing window around dispatched step work in a hot loop must "
+      "contain a fence (block_until_ready/float/.item/np.asarray) "
+      "between its timing calls — else it measures enqueue latency, "
+      "not device time (the PR-7 rule, enforced; ISSUE 15)")
+def jax_unfenced_timing(ctx):
+    out = []
+    for sf in hot_files(ctx):
+        tree = sf.tree
+        if tree is None:
+            continue
+        flagged = set()
+        for loop, func in _loops_with_func(tree):
+            timing, fences, dispatches = [], [], []
+            for stmt in loop.body + getattr(loop, "orelse", []):
+                for node in _walk_no_comprehensions(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if _is_timing(node):
+                        timing.append(node.lineno)
+                    elif _is_fence(node):
+                        fences.append(node.lineno)
+                    elif _is_dispatch(node):
+                        dispatches.append(node.lineno)
+            if len(timing) < 2 or not dispatches:
+                continue
+            lo, hi = min(timing), max(timing)
+            if any(lo <= f <= hi for f in fences):
+                continue
+            if any(lo <= d <= hi for d in dispatches):
+                key = (sf.rel, hi)
+                if key not in flagged:
+                    flagged.add(key)
+                    out.append(Finding(
+                        "jax-unfenced-timing", sf.rel, hi,
+                        "timing window around a step dispatch with no "
+                        "fence between the timing calls — on an async "
+                        "backend this measures enqueue, not the step; "
+                        "fence at the window boundary "
+                        "(jax.block_until_ready / the loss fetch)",
+                        func or ""))
+    return out

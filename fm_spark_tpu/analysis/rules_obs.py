@@ -1,0 +1,353 @@
+"""fmlint rules migrated from the ``tools/resilience_lint.py`` monolith.
+
+Every rule the monolith hand-rolled (ISSUEs 4–14) now registers through
+the :func:`fm_spark_tpu.analysis.core.rule` decorator; the monolith
+survives only as a thin compatibility shim over this registry. The
+rules (ids are what ``# fmlint: disable=`` names):
+
+``eventlog-only``        strict scope: no print/json.dump/sys.std* in
+                         resilience/, serve/, the ingest stream modules
+``bare-print``           library-wide: no bare ``print()`` outside CLI
+``pallas-fallback``      kernel modules raise PallasUnavailable, never
+                         assert / bare ValueError
+``wallclock-duration``   durations use perf_counter/monotonic, never
+                         ``time.time()`` in a subtraction
+``leg-provenance``       bench.py's leg_record carries run_id+fingerprint
+``registry-coverage``    every fault point / watchdog phase / introspect
+                         trigger appears in at least one tier-1 test
+``parse-error``          every scanned source must parse
+
+Plus the framework's own meta-rule, ``suppression-hygiene``: a
+``# fmlint: disable=`` comment with no ``-- reason`` does not suppress
+and is itself a finding, as is one naming a rule that does not exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, call_name, parse_errors, rule, walk_with_func
+
+# --------------------------------------------------------------- scope config
+
+#: The strict EventLog-only surface: resilience/ and serve/ entirely,
+#: plus the ingest-stream modules whose quarantine/abort transitions
+#: carry the same machine-readability contract (ISSUEs 5/6/13).
+STRICT_DIRS = ("fm_spark_tpu/resilience", "fm_spark_tpu/serve")
+STRICT_EXTRA_FILES = (
+    "fm_spark_tpu/data/stream.py",
+    "fm_spark_tpu/data/native_stream.py",
+    "fm_spark_tpu/native/__init__.py",
+    "fm_spark_tpu/online.py",
+)
+
+#: (basename, enclosing function) pairs exempt from the JSON-write rule
+#: — faults.py::_next_count persists cross-process occurrence COUNTERS,
+#: bookkeeping the injection harness needs before a journal can exist.
+EVENTLOG_ALLOWLIST = {("faults.py", "_next_count")}
+
+#: Top-level library modules whose stdout IS their interface.
+CLI_EXEMPT = frozenset({"cli.py", "cli_levers.py", "__main__.py"})
+
+KERNEL_DIR = "fm_spark_tpu/ops"
+KERNEL_PREFIX = "pallas_"
+
+LEG_RECORD_REQUIRED_KEYS = ("run_id", "fingerprint")
+
+#: (registry kind, module holding it, literal name) — the coverage
+#: rule's anchors: a registered point/phase/trigger no tier-1 test
+#: names is a recovery/capture path that can rot silently.
+COVERAGE_REGISTRIES = (
+    ("fault point", "fm_spark_tpu/resilience/faults.py", "KNOWN_POINTS"),
+    ("watchdog phase", "fm_spark_tpu/resilience/watchdog.py",
+     "KNOWN_PHASES"),
+    ("introspection trigger", "fm_spark_tpu/obs/introspect.py",
+     "TRIGGERS"),
+)
+
+
+def _strict_files(ctx):
+    out = []
+    for d in STRICT_DIRS:
+        out.extend(ctx.files_under(d, recursive=False))
+    for rel in STRICT_EXTRA_FILES:
+        sf = ctx.file(rel)
+        if sf is not None:
+            out.append(sf)
+    return out
+
+
+# --------------------------------------------------------------------- rules
+
+@rule("parse-error",
+      "every scanned source file must parse — a broken file is a "
+      "finding, never a silently shrunk scan")
+def parse_error_rule(ctx):
+    return parse_errors(ctx.package_files() + ctx.root_files())
+
+
+@rule("eventlog-only",
+      "resilience/serve/ingest state transitions go through "
+      "utils/logging.EventLog — no print, no ad-hoc json.dump, no "
+      "sys.stdout/stderr writes (ISSUE 4/5/12)")
+def eventlog_only(ctx):
+    out = []
+    for sf in _strict_files(ctx):
+        tree = sf.tree
+        if tree is None:
+            continue
+        base = os.path.basename(sf.rel)
+        for node, func in walk_with_func(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "print":
+                out.append(Finding(
+                    "eventlog-only", sf.rel, node.lineno,
+                    "bare print() — emit a journal event "
+                    "(utils/logging.EventLog) instead", func or ""))
+            elif name in ("json.dump", "json.dumps"):
+                if (base, func) not in EVENTLOG_ALLOWLIST:
+                    out.append(Finding(
+                        "eventlog-only", sf.rel, node.lineno,
+                        f"ad-hoc JSON write ({name}) — state "
+                        "transitions go through EventLog, not "
+                        "hand-rolled JSON", func or ""))
+            elif name in ("sys.stdout.write", "sys.stderr.write"):
+                out.append(Finding(
+                    "eventlog-only", sf.rel, node.lineno,
+                    f"direct {name} — emit a journal event instead",
+                    func or ""))
+    return out
+
+
+@rule("bare-print",
+      "no bare print() anywhere in library code — numbers go to the "
+      "metrics registry, narrative to EventLog/spans; CLI modules "
+      "exempt (ISSUE 7)")
+def bare_print(ctx):
+    out = []
+    for sf in ctx.package_files():
+        base = os.path.basename(sf.rel)
+        if (base in CLI_EXEMPT
+                and os.path.dirname(sf.rel) == "fm_spark_tpu"):
+            continue
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node, func in walk_with_func(tree):
+            if (isinstance(node, ast.Call)
+                    and call_name(node) == "print"
+                    and not any(kw.arg == "file"
+                                for kw in node.keywords)):
+                out.append(Finding(
+                    "bare-print", sf.rel, node.lineno,
+                    "bare print() in library code — use MetricsLogger/"
+                    "EventLog/obs APIs (fm_spark_tpu.obs) instead",
+                    func or ""))
+    return out
+
+
+@rule("pallas-fallback",
+      "Pallas kernel modules raise ops.PallasUnavailable — never "
+      "assert, never bare ValueError — so fused_embed='auto' can "
+      "degrade to the XLA path (ISSUE 8)")
+def pallas_fallback(ctx):
+    out = []
+    for sf in ctx.files_under(KERNEL_DIR, recursive=False):
+        if not os.path.basename(sf.rel).startswith(KERNEL_PREFIX):
+            continue
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node, func in walk_with_func(tree):
+            if isinstance(node, ast.Assert):
+                out.append(Finding(
+                    "pallas-fallback", sf.rel, node.lineno,
+                    "assert in a Pallas kernel module — raise "
+                    "ops.PallasUnavailable so fused_embed='auto' can "
+                    "degrade to the XLA path instead of dying",
+                    func or ""))
+            elif (isinstance(node, ast.Raise)
+                  and isinstance(node.exc, ast.Call)):
+                f = node.exc.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else "")
+                if name == "ValueError":
+                    out.append(Finding(
+                        "pallas-fallback", sf.rel, node.lineno,
+                        "bare ValueError in a Pallas kernel module — "
+                        "raise ops.PallasUnavailable (the structured "
+                        "fallback signal fused_embed='auto' pins)",
+                        func or ""))
+    return out
+
+
+def _time_aliases(tree: ast.AST) -> tuple[set, set]:
+    """The file's actual names for the time module and ``time.time``
+    itself — ``import time as t`` / ``from time import time as now``
+    must not evade the duration rule."""
+    mods = {"time", "_time"}
+    funcs = {"time"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    funcs.add(a.asname or a.name)
+    return mods, funcs
+
+
+def _is_wallclock_call(node, mods, funcs) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in funcs
+    if isinstance(f, ast.Attribute) and f.attr == "time":
+        return isinstance(f.value, ast.Name) and f.value.id in mods
+    return False
+
+
+@rule("wallclock-duration",
+      "time.time() inside a subtraction is a wall-clock DURATION — "
+      "measured intervals go through time.perf_counter()/"
+      "time.monotonic(); wall-clock is for timestamps (ISSUE 9)")
+def wallclock_duration(ctx):
+    out = []
+    for sf in ctx.package_files():
+        tree = sf.tree
+        if tree is None:
+            continue
+        mods, funcs = _time_aliases(tree)
+        for node, func in walk_with_func(tree):
+            hit = None
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and (_is_wallclock_call(node.left, mods, funcs)
+                         or _is_wallclock_call(node.right, mods,
+                                               funcs))):
+                hit = node
+            elif (isinstance(node, ast.AugAssign)
+                  and isinstance(node.op, ast.Sub)
+                  and _is_wallclock_call(node.value, mods, funcs)):
+                hit = node
+            if hit is not None:
+                out.append(Finding(
+                    "wallclock-duration", sf.rel, hit.lineno,
+                    "time.time() in a subtraction — durations go "
+                    "through time.perf_counter()/time.monotonic(), "
+                    "wall-clock is for timestamps only", func or ""))
+    return out
+
+
+@rule("leg-provenance",
+      "bench.py's per-leg sweep record must carry run_id + fingerprint "
+      "— a leg untraceable to its run/cohort is the hand-adjudicated "
+      "number the perf ledger retires (ISSUE 9)")
+def leg_provenance(ctx):
+    sf = ctx.file("bench.py")
+    if sf is None or sf.tree is None:
+        return [Finding(
+            "leg-provenance", "bench.py", 1,
+            "bench.py missing or unparseable — the sweep's per-leg "
+            "provenance contract has no anchor to lint")]
+    out = []
+    found = False
+    for node, func in walk_with_func(sf.tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "leg_record"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        found = True
+        keys = {k.value for k in node.value.keys
+                if isinstance(k, ast.Constant)}
+        missing = [k for k in LEG_RECORD_REQUIRED_KEYS if k not in keys]
+        if missing:
+            out.append(Finding(
+                "leg-provenance", sf.rel, node.lineno,
+                f"leg_record literal missing provenance key(s) "
+                f"{missing} — every bench leg record must carry "
+                "run_id + fingerprint", func or ""))
+    if not found:
+        out.append(Finding(
+            "leg-provenance", sf.rel, 1,
+            "no leg_record dict literal found — the sweep's per-leg "
+            "provenance contract has no anchor to lint"))
+    return out
+
+
+def _literal_entries(sf, literal: str) -> tuple[list[str], int] | None:
+    """(string entries, line) of a module-level tuple/list assignment
+    named ``literal``, AST-extracted — no package import, so the lint
+    runs from a bare checkout."""
+    if sf is None or sf.tree is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == literal
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return ([e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)], node.lineno)
+    return None
+
+
+@rule("registry-coverage",
+      "every fault point (KNOWN_POINTS), watchdog phase "
+      "(KNOWN_PHASES), and introspection trigger (TRIGGERS) must "
+      "appear in at least one tier-1 test — an unexercised recovery/"
+      "capture path rots silently (ISSUE 10/12/14)")
+def registry_coverage(ctx):
+    out = []
+    blob = ctx.tests_blob()
+    for kind, rel, literal in COVERAGE_REGISTRIES:
+        sf = ctx.file(rel)
+        got = _literal_entries(sf, literal)
+        if got is None or not got[0]:
+            out.append(Finding(
+                "registry-coverage", rel, 1,
+                f"no {literal} literal found — the {kind} registry "
+                "has no anchor to check coverage against"))
+            continue
+        entries, line = got
+        for entry in entries:
+            if entry not in blob:
+                out.append(Finding(
+                    "registry-coverage", rel, line,
+                    f"{kind} {entry!r} ({literal}) is exercised by no "
+                    "test under tests/ — a new entry must ship with "
+                    "at least one tier-1 test that names it"))
+    return out
+
+
+@rule("suppression-hygiene",
+      "every `# fmlint: disable=<rule>` needs `-- <reason>` and must "
+      "name a registered rule — bare or misspelled disables are "
+      "findings, never silencers (ISSUE 15)")
+def suppression_hygiene(ctx):
+    from .core import RULES
+
+    out = []
+    for sf in ctx.package_files() + ctx.root_files():
+        for line, sup in sf.suppressions().items():
+            if sup.reason is None:
+                out.append(Finding(
+                    "suppression-hygiene", sf.rel, line,
+                    "bare suppression: `# fmlint: disable=` without "
+                    "`-- <reason>` suppresses nothing — state why the "
+                    "convention bends here"))
+            for rid in sup.rules:
+                if rid not in RULES:
+                    out.append(Finding(
+                        "suppression-hygiene", sf.rel, line,
+                        f"suppression names unknown rule {rid!r} — "
+                        "check the rule glossary (README 'Static "
+                        "analysis')"))
+    return out

@@ -170,6 +170,17 @@ def shutdown(reason: str | None = "run_end") -> None:
     # The capture engine is scoped to the run whose directory it writes
     # into: a new run (configure calls shutdown first) re-arms its own.
     introspect.clear()
+    if reason is not None:
+        # A REAL shutdown (not configure()'s reason=None replace) is a
+        # thread-lifecycle boundary (ISSUE 15): the live-metrics
+        # endpoint's serve_forever thread must not outlive the run it
+        # narrates.
+        try:
+            from fm_spark_tpu.obs import export as _export
+
+            _export.stop_metrics_server()
+        except Exception:
+            pass
     if flight is None:
         return
     try:

@@ -252,7 +252,12 @@ class WatchdogTable:
 
     def _note_overrun(self, name: str, limit: float,
                       elapsed: float) -> None:
-        self.hangs_detected += 1
+        # Under the table lock: the exit-mode monitor thread and a
+        # raise-mode phase exit (caller thread) can both note overruns
+        # — an unlocked += here drops counts (fmlint
+        # thread-lock-discipline, ISSUE 15).
+        with self._lock:
+            self.hangs_detected += 1
         fields = dict(phase=name, deadline_s=round(limit, 3),
                       elapsed_s=round(elapsed, 3), action=self.action)
         if self.journal is not None:
@@ -279,7 +284,10 @@ class WatchdogTable:
         must observe that, not fsync per step: with a capture engine
         armed, its limiter decides (a suppressed fire suppresses the
         dump); unarmed, a per-phase monotonic throttle does."""
-        self.near_misses += 1
+        # Same locking as _note_overrun: any thread exiting a guarded
+        # phase (serve worker, main loop) lands here concurrently.
+        with self._lock:
+            self.near_misses += 1
         fields = dict(phase=name, deadline_s=round(limit, 3),
                       elapsed_s=round(elapsed, 3),
                       frac=round(elapsed / limit, 3))
@@ -301,11 +309,12 @@ class WatchdogTable:
             return  # the engine's rate limiter suppressed this one
         if not armed:
             now = time.monotonic()
-            last = self._last_near_dump.get(name)
-            if last is not None and \
-                    now - last < NEAR_MISS_DUMP_INTERVAL_S:
-                return
-            self._last_near_dump[name] = now
+            with self._lock:
+                last = self._last_near_dump.get(name)
+                if last is not None and \
+                        now - last < NEAR_MISS_DUMP_INTERVAL_S:
+                    return
+                self._last_near_dump[name] = now
         if self.journal is not None:
             try:
                 self.journal.emit("watchdog_near_miss", **fields)
@@ -339,6 +348,13 @@ class WatchdogTable:
         self._stop.set()
         with self._lock:
             self._armed.clear()
+            monitor = self._monitor
+            self._monitor = None
+        if monitor is not None:
+            # Joined on the shutdown path (ISSUE 15 thread-lifecycle
+            # audit): daemon or not, a monitor left spinning between
+            # configure() cycles leaks one poll thread per table.
+            monitor.join(timeout=5.0)
 
 
 # Module state, faults.py-style: None = env not looked at yet; False =

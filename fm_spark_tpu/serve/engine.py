@@ -182,7 +182,7 @@ class PredictEngine:
         old = self._gen
         gen = Generation(self._jax.device_put(params), step,
                          gen_id=old.gen_id + 1)
-        self._gen = gen  # THE swap: one atomic reference store
+        self._gen = gen  # fmlint: disable=thread-lock-discipline -- THE swap: one atomic reference store; worker reads the reference once per batch (no-torn-swap contract, chaos-audited)
         obs.counter("serve.swaps_total").add(1)
         obs.gauge("serve/generation_step").set(gen.step)
         obs.event("serve_swap", step=gen.step, gen_id=gen.gen_id,
@@ -228,7 +228,7 @@ class PredictEngine:
                     jax.ShapeDtypeStruct((b, self.nnz),
                                          self._vals_dtype),
                 )
-                self._compiled[b] = lowered.compile()
+                self._compiled[b] = lowered.compile()  # fmlint: disable=thread-lock-discipline -- warmup() runs before serving starts; bucket entries are add-only and never mutated after
         stats1 = compile_cache.cache_stats()
         out = {
             "seconds": round(time.perf_counter() - t0, 4),
@@ -345,7 +345,7 @@ class PredictEngine:
         """Block for the first request, then accumulate under the
         latency budget / until bucket-max; ``None`` = stop."""
         first = self._carry
-        self._carry = None
+        self._carry = None  # fmlint: disable=thread-lock-discipline -- coalescer-thread-local carry: only the single worker thread (_run/_gather) ever touches it
         if first is None:
             first = self._queue.get()
         if first is _STOP:
@@ -368,7 +368,7 @@ class PredictEngine:
                 self._queue.put(_STOP)
                 break
             if rows + nxt.n > cap:
-                self._carry = nxt  # heads the next batch
+                self._carry = nxt  # fmlint: disable=thread-lock-discipline -- heads the next batch; coalescer-thread-local (single worker thread)
                 break
             batch.append(nxt)
             rows += nxt.n
@@ -382,7 +382,7 @@ class PredictEngine:
             # ONE generation read per micro-batch: every row in this
             # dispatch — and every response split from it — scores on
             # the same params (the no-torn-swap contract).
-            gen = self._gen
+            gen = self._gen  # fmlint: disable=thread-lock-discipline -- single atomic reference read per micro-batch IS the protocol (no-torn-swap contract)
             ids = (batch[0].ids if len(batch) == 1 else
                    np.concatenate([r.ids for r in batch]))
             vals = (batch[0].vals if len(batch) == 1 else
@@ -405,7 +405,7 @@ class PredictEngine:
                     overrun = dict(phase=e.phase,
                                    deadline_s=round(e.deadline_s, 3),
                                    elapsed_s=round(e.elapsed_s, 3),
-                                   rows=int(ids.shape[0]),
+                                   rows=int(ids.shape[0]),  # fmlint: disable=jax-host-sync -- ids is a host np.ndarray (coalesced request rows), not a traced value
                                    gen_step=gen.step)
                     obs.counter("serve.slo_overruns_total").add(1)
                     armed = False
@@ -431,7 +431,7 @@ class PredictEngine:
                 obs.event("serve_batch_failed",
                           error=f"{type(e).__name__}: "
                                 f"{(str(e).splitlines() or [''])[0][:200]}",
-                          rows=int(ids.shape[0]), gen_step=gen.step)
+                          rows=int(ids.shape[0]), gen_step=gen.step)  # fmlint: disable=jax-host-sync -- ids is a host np.ndarray; failure path, not the dispatch loop
                 if self.journal is not None:
                     self.journal.emit(
                         "serve_batch_failed",

@@ -58,6 +58,11 @@ class ReloadFollower:
                              else opt_state_example)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Outcome counters are written by the poll thread and read by
+        # callers (cli serve's summary, tests): guarded — an unlocked
+        # += from a direct poll_once() call racing the loop drops
+        # counts (fmlint thread-lock-discipline, ISSUE 15).
+        self._counter_lock = threading.Lock()
         self.reloads = 0
         self.failures = 0
 
@@ -79,7 +84,8 @@ class ReloadFollower:
               served: int) -> None:
         """The degraded-mode transition, in one place: count, raise
         the gauge, journal — the old generation keeps serving."""
-        self.failures += 1
+        with self._counter_lock:
+            self.failures += 1
         obs.counter("serve.reload_failures_total").add(1)
         obs.gauge("serve/degraded").set(1)
         self._emit("reload_failed", target_step=int(target_step),
@@ -156,7 +162,8 @@ class ReloadFollower:
             return "failed"
         self.engine.swap_generation(restored["params"],
                                     restored["step"])
-        self.reloads += 1
+        with self._counter_lock:
+            self.reloads += 1
         obs.counter("serve.reloads_total").add(1)
         obs.gauge("serve/degraded").set(0)
         self._set_staleness(self.chain.last_good_step(),

@@ -702,7 +702,7 @@ class FMTrainer:
                     # split wants the real first-step wall time, and
                     # one d2h on the compile step is free next to the
                     # compile itself.
-                    jax.block_until_ready(m)
+                    jax.block_until_ready(m)  # fmlint: disable=jax-host-sync -- deliberate first-step-only fence: the compile-vs-execute split needs real first-step wall time
                     dt_ms = (time.perf_counter() - t_step0) * 1e3
                     from fm_spark_tpu.utils import compile_cache
 
@@ -725,15 +725,15 @@ class FMTrainer:
                 # One device→host sync per step — the opt-in price of
                 # catching the blowup BEFORE its state can be logged,
                 # evaluated, or reach a checkpoint snapshot below.
-                divergence_guard.check(self.step_count, float(m["loss"]))
+                divergence_guard.check(self.step_count, float(m["loss"]))  # fmlint: disable=jax-host-sync -- opt-in per-step sync: the guard must see the loss before it can checkpoint/log
             if self.step_count % log_every == 0 or step_i == total - 1:
-                loss = float(m["loss"])
+                loss = float(m["loss"])  # fmlint: disable=jax-host-sync -- the PR-7 window fence: the log-boundary loss fetch IS the measurement boundary
                 self.loss_history.append(loss)
                 self.logger.log(
                     self.step_count,
                     samples=steps_since_log * len(labels),
                     loss=loss,
-                    grad_norm=float(m["grad_norm"]),
+                    grad_norm=float(m["grad_norm"]),  # fmlint: disable=jax-host-sync -- log-boundary fetch, already behind the window fence above
                 )
                 if obs_on:
                     # float(m["loss"]) above was the d2h fence: every

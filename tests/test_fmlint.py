@@ -1,0 +1,636 @@
+"""fmlint framework + rule suite (ISSUE 15).
+
+Covers: the registry/driver, inline suppressions (reason REQUIRED),
+the baseline add/burn-down round trip, a synthetic positive AND
+negative fixture for EVERY registered rule (the meta-test applies the
+PR-10 fault-coverage pattern to the linter itself: a rule with no
+firing fixture is a rule that can rot silently), the shipped-repo
+zero-unbaselined gate (the tier-1 wiring), and subprocess drills that
+prove the thread-safety and JAX-hazard passes catch seeded synthetic
+violations through the real CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fm_spark_tpu import analysis
+from fm_spark_tpu.analysis import core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FMLINT = os.path.join(REPO, "tools", "fmlint.py")
+
+
+def write_tree(root, files: dict):
+    """Materialize ``{relpath: source}`` under ``root``."""
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def run_rule(root, rule_id):
+    ctx = core.Context(str(root))
+    found, suppressed = core.run_rules(ctx, rules=[rule_id])
+    return found, suppressed
+
+
+# ----------------------------------------------------------------- fixtures
+#
+# One (positive, negative) fixture pair per registered rule. The
+# meta-test below asserts this table covers the registry EXACTLY, so a
+# new rule cannot ship without a firing fixture.
+
+FIXTURES = {
+    "parse-error": {
+        "positive": {"fm_spark_tpu/broken.py": "def f(:\n"},
+        "negative": {"fm_spark_tpu/fine.py": "def f():\n    return 1\n"},
+        "expect": 1,
+    },
+    "eventlog-only": {
+        "positive": {"fm_spark_tpu/resilience/bad.py": """\
+            import json, sys
+            def transition(state):
+                print('circuit open')
+                sys.stderr.write('backing off\\n')
+                with open('events.json', 'w') as f:
+                    json.dump({'event': 'backoff'}, f)
+                return json.dumps(state)
+        """},
+        "negative": {"fm_spark_tpu/resilience/good.py": """\
+            def transition(journal, state):
+                journal.emit('backoff', state=state)
+        """},
+        "expect": 4,
+    },
+    "bare-print": {
+        "positive": {"fm_spark_tpu/mod.py": """\
+            def f():
+                print('narration')
+        """},
+        "negative": {
+            "fm_spark_tpu/mod.py": """\
+                import sys
+                def f(stream):
+                    print('directed', file=stream)
+            """,
+            # CLI stdout IS the interface — exempt.
+            "fm_spark_tpu/cli.py": "print('usage: ...')\n",
+        },
+        "expect": 1,
+    },
+    "pallas-fallback": {
+        "positive": {"fm_spark_tpu/ops/pallas_bad.py": """\
+            def kernel(x):
+                assert x.ndim == 2
+                raise ValueError('bad shape')
+        """},
+        "negative": {
+            "fm_spark_tpu/ops/pallas_good.py": """\
+                from fm_spark_tpu.ops import PallasUnavailable
+                def kernel(x):
+                    raise PallasUnavailable('no TPU lowering')
+            """,
+            # Non-kernel module in ops/: asserts stay legal.
+            "fm_spark_tpu/ops/util.py": "def f(x):\n    assert x\n",
+        },
+        "expect": 2,
+    },
+    "wallclock-duration": {
+        "positive": {"fm_spark_tpu/dur.py": """\
+            import time
+            import time as t
+            from time import time as now
+            def measure(t0, t1):
+                a = time.time() - t0
+                b = t1 - t.time()
+                c = now() - t0
+                t1 -= time.time()
+                return a, b, c
+        """},
+        "negative": {"fm_spark_tpu/dur.py": """\
+            import time
+            def measure(t0):
+                ok = {'ts': time.time()}       # timestamp: legal
+                ok2 = time.perf_counter() - t0  # monotonic: legal
+                return ok, ok2
+        """},
+        "expect": 4,
+    },
+    "leg-provenance": {
+        "positive": {"bench.py":
+                     "leg_record = {'variant': 'x', 'value': 1.0}\n"},
+        "negative": {"bench.py": """\
+            leg_record = {'variant': 'x', 'value': 1.0,
+                          'run_id': rid, 'fingerprint': fp}
+        """},
+        "expect": 1,
+    },
+    "registry-coverage": {
+        "positive": {
+            "fm_spark_tpu/resilience/faults.py":
+                'KNOWN_POINTS = ("train_step", "brand_new_point")\n',
+            "fm_spark_tpu/resilience/watchdog.py":
+                'KNOWN_PHASES = ("step_window",)\n',
+            "fm_spark_tpu/obs/introspect.py":
+                'TRIGGERS = ("step_time_spike",)\n',
+            "tests/test_x.py": """\
+                def test_a():
+                    assert "train_step" and "step_window"
+                    assert "step_time_spike"
+            """,
+        },
+        "negative": {
+            "fm_spark_tpu/resilience/faults.py":
+                'KNOWN_POINTS = ("train_step",)\n',
+            "fm_spark_tpu/resilience/watchdog.py":
+                'KNOWN_PHASES = ("step_window",)\n',
+            "fm_spark_tpu/obs/introspect.py":
+                'TRIGGERS = ("step_time_spike",)\n',
+            "tests/test_x.py": """\
+                def test_a():
+                    assert "train_step" and "step_window"
+                    assert "step_time_spike"
+            """,
+        },
+        "expect": 1,
+    },
+    "suppression-hygiene": {
+        "positive": {"fm_spark_tpu/mod.py": """\
+            def f():
+                x = 1  # fmlint: disable=bare-print
+                y = 2  # fmlint: disable=no-such-rule -- because
+                return x + y
+        """},
+        "negative": {"fm_spark_tpu/mod.py": """\
+            def f():
+                print('x')  # fmlint: disable=bare-print -- demo reason
+        """},
+        "expect": 2,
+    },
+    "thread-lock-discipline": {
+        "positive": {"fm_spark_tpu/worker.py": """\
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._t = None
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+                def _run(self):
+                    while True:
+                        self._count += 1     # unlocked thread write
+                def read(self):
+                    return self._count       # unlocked cross-domain read
+        """},
+        "negative": {"fm_spark_tpu/worker.py": """\
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._q = __import__('queue').Queue()
+                    self._t = None
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._t.start()
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self._count += 1
+                        self._q.put(1)       # Queue: inherently safe
+                def read(self):
+                    with self._lock:
+                        return self._count
+        """},
+        "expect": 2,
+    },
+    "thread-lifecycle": {
+        # os.path.join / "".join in scope must NOT count as a thread
+        # join (the rule would be near-vacuous on real code otherwise).
+        "positive": {"fm_spark_tpu/spawn.py": """\
+            import os
+            import threading
+            class Spawner:
+                def start(self):
+                    self._log = os.path.join('/tmp', 'x.log')
+                    self._csv = ",".join(["a", "b"])
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                def _run(self):
+                    pass
+        """},
+        "negative": {"fm_spark_tpu/spawn.py": """\
+            import threading
+            class Daemonized:
+                def start(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+                def _run(self):
+                    pass
+            class Joined:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                def close(self):
+                    self._t.join(timeout=5)
+                def _run(self):
+                    pass
+            def probe():
+                t = threading.Timer(1.0, lambda: None)
+                t.daemon = True
+                t.start()
+        """},
+        "expect": 1,
+    },
+    "jax-host-sync": {
+        # Only HOT_FILES are scanned — the fixture plants a fake
+        # train.py; the same code in another module is the negative.
+        "positive": {"fm_spark_tpu/train.py": """\
+            import numpy as np
+            def fit(step, batches):
+                for b in batches:
+                    out = step(b)
+                    loss = float(out['loss'])
+                    arr = np.asarray(out['grad'])
+                    s = out['metric'].item()
+                    out['x'].block_until_ready()
+                return loss, arr, s
+        """},
+        "negative": {
+            "fm_spark_tpu/train.py": """\
+                import jax.numpy as jnp
+                def fit(step, batches):
+                    for b in batches:
+                        out = step(jnp.asarray(b))   # device-side: legal
+                    return {k: float(v) for k, v in out.items()}
+            """,
+            # Same syncs OFF the hot-file list: legal.
+            "fm_spark_tpu/report.py": """\
+                import numpy as np
+                def summarize(rows):
+                    for r in rows:
+                        x = float(r['v'])
+                    return x
+            """,
+        },
+        "expect": 4,
+    },
+    "jax-jit-side-effect": {
+        "positive": {"fm_spark_tpu/steps.py": """\
+            import jax
+            @jax.jit
+            def step(x):
+                print('tracing')
+                return x * 2
+            def inner(x):
+                journal.emit('oops', x=1)
+                return x
+            compiled = jax.jit(inner)
+        """},
+        "negative": {"fm_spark_tpu/steps.py": """\
+            import jax
+            @jax.jit
+            def step(x):
+                return x * 2
+            def outer(x):
+                print('host side, not jitted')
+                return step(x)
+        """},
+        "expect": 2,
+    },
+    "jax-unfenced-timing": {
+        "positive": {"fm_spark_tpu/train.py": """\
+            import time
+            def fit(step_fn, batches):
+                for b in batches:
+                    t0 = time.perf_counter()
+                    out = step_fn(b)
+                    dt = time.perf_counter() - t0
+                return out, dt
+        """},
+        "negative": {"fm_spark_tpu/train.py": """\
+            import time, jax
+            def fit(step_fn, batches):
+                for b in batches:
+                    t0 = time.perf_counter()
+                    out = step_fn(b)
+                    jax.block_until_ready(out)
+                    dt = time.perf_counter() - t0
+                return out, dt
+        """},
+        "expect": 1,
+    },
+}
+
+
+# ---------------------------------------------------------------- framework
+
+def test_registry_has_rules_and_glossary():
+    rules = analysis.all_rules()
+    assert len(rules) >= 12
+    for r in rules:
+        assert r.doc, f"rule {r.id} has no glossary doc"
+    # The six monolith rules all migrated.
+    migrated = {"eventlog-only", "bare-print", "pallas-fallback",
+                "wallclock-duration", "leg-provenance",
+                "registry-coverage"}
+    assert migrated <= {r.id for r in rules}
+
+
+def test_rule_decorator_rejects_duplicates_and_bad_ids():
+    with pytest.raises(ValueError, match="kebab-case"):
+        core.rule("Bad_Id", "x")(lambda ctx: [])
+    with pytest.raises(ValueError, match="duplicate"):
+        core.rule("bare-print", "x")(lambda ctx: [])
+
+
+def test_finding_render_and_location():
+    f = core.Finding("bare-print", "fm_spark_tpu/m.py", 3, "msg", "f")
+    assert f.location == "fm_spark_tpu/m.py:3"
+    assert f.render() == "fm_spark_tpu/m.py:3 [f] bare-print: msg"
+    assert f.to_dict()["rule"] == "bare-print"
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_fires_on_positive_fixture(rule_id, tmp_path):
+    fx = FIXTURES[rule_id]
+    write_tree(tmp_path, fx["positive"])
+    found, _ = run_rule(tmp_path, rule_id)
+    assert len(found) == fx["expect"], \
+        f"{rule_id}: {[f.render() for f in found]}"
+    assert all(f.rule == rule_id for f in found)
+    assert all(f.line >= 1 and f.path for f in found)
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_quiet_on_negative_fixture(rule_id, tmp_path):
+    fx = FIXTURES[rule_id]
+    write_tree(tmp_path, fx["negative"])
+    found, _ = run_rule(tmp_path, rule_id)
+    assert found == [], f"{rule_id}: {[f.render() for f in found]}"
+
+
+def test_every_registered_rule_has_a_firing_fixture():
+    """The PR-10 fault-coverage pattern applied to the linter itself:
+    the fixture table must cover the registry EXACTLY — a rule with no
+    positive fixture is a rule whose detection can rot silently."""
+    assert set(FIXTURES) == {r.id for r in analysis.all_rules()}
+
+
+# ------------------------------------------------------------- suppressions
+
+def test_reasoned_suppression_suppresses_and_is_recorded(tmp_path):
+    write_tree(tmp_path, {"fm_spark_tpu/m.py": """\
+        def f():
+            print('x')  # fmlint: disable=bare-print -- CLI-adjacent demo path, narration is the contract here
+    """})
+    ctx = core.Context(str(tmp_path))
+    found, suppressed = core.run_rules(
+        ctx, rules=["bare-print", "suppression-hygiene"])
+    assert found == []
+    assert len(suppressed) == 1
+    f, reason = suppressed[0]
+    assert f.rule == "bare-print" and "narration" in reason
+
+
+def test_bare_suppression_does_not_suppress_and_is_a_finding(tmp_path):
+    write_tree(tmp_path, {"fm_spark_tpu/m.py": """\
+        def f():
+            print('x')  # fmlint: disable=bare-print
+    """})
+    found, suppressed = core.run_rules(
+        core.Context(str(tmp_path)),
+        rules=["bare-print", "suppression-hygiene"])
+    assert suppressed == []
+    rules = sorted(f.rule for f in found)
+    assert rules == ["bare-print", "suppression-hygiene"]
+
+
+def test_suppression_only_silences_the_named_rule(tmp_path):
+    # A wallclock violation suppressed under the WRONG rule id stays.
+    write_tree(tmp_path, {"fm_spark_tpu/m.py": """\
+        import time
+        def f(t0):
+            return time.time() - t0  # fmlint: disable=bare-print -- wrong rule named
+    """})
+    found, suppressed = core.run_rules(
+        core.Context(str(tmp_path)), rules=["wallclock-duration"])
+    assert len(found) == 1 and suppressed == []
+
+
+def test_suppression_hygiene_is_never_suppressible(tmp_path):
+    write_tree(tmp_path, {"fm_spark_tpu/m.py": (
+        "x = 1  # fmlint: disable=suppression-hygiene,no-such -- sneaky\n"
+    )})
+    found, suppressed = core.run_rules(
+        core.Context(str(tmp_path)), rules=["suppression-hygiene"])
+    assert len(found) == 1 and suppressed == []
+    assert "no-such" in found[0].message
+
+
+# ----------------------------------------------------------------- baseline
+
+def _one_violation_repo(tmp_path):
+    return write_tree(tmp_path, {"fm_spark_tpu/m.py": (
+        "def f():\n    print('x')\n")})
+
+
+def test_baseline_round_trip_and_burn_down(tmp_path):
+    repo = _one_violation_repo(tmp_path)
+    bl = str(tmp_path / "baseline.json")
+    rules = ["bare-print"]
+    # 1. Fresh repo, empty baseline: the finding is NEW -> not ok.
+    rep = core.analyze(repo, baseline_path=bl, rules=rules)
+    assert not rep["ok"] and len(rep["new"]) == 1
+    # 2. Absorb it.
+    ctx = core.Context(repo)
+    findings, _ = core.run_rules(ctx, rules=rules)
+    core.write_baseline(bl, findings)
+    doc = json.load(open(bl))
+    assert doc["counts"]["bare-print"]["fm_spark_tpu/m.py"] == 1
+    # 3. Same repo now passes, finding tracked as baselined.
+    rep = core.analyze(repo, baseline_path=bl, rules=rules)
+    assert rep["ok"] and rep["baselined_total"] == 1
+    assert rep["new"] == [] and rep["burned_down"] == []
+    # 4. A SECOND finding in the same file exceeds the cell -> fails.
+    (tmp_path / "fm_spark_tpu" / "m.py").write_text(
+        "def f():\n    print('x')\n    print('y')\n")
+    rep = core.analyze(repo, baseline_path=bl, rules=rules)
+    assert not rep["ok"] and len(rep["new"]) == 2  # whole cell listed
+    # 5. Fixing ALL of them reports burn-down, still ok.
+    (tmp_path / "fm_spark_tpu" / "m.py").write_text(
+        "def f():\n    return 1\n")
+    rep = core.analyze(repo, baseline_path=bl, rules=rules)
+    assert rep["ok"] and rep["new"] == []
+    assert rep["burned_down"] == [{
+        "rule": "bare-print", "path": "fm_spark_tpu/m.py",
+        "baseline": 1, "current": 0}]
+
+
+def test_missing_baseline_means_empty(tmp_path):
+    assert core.load_baseline(str(tmp_path / "nope.json")) == {}
+    (tmp_path / "junk.json").write_text("{not json")
+    assert core.load_baseline(str(tmp_path / "junk.json")) == {}
+
+
+def test_baseline_never_hides_a_new_rule_file_cell(tmp_path):
+    repo = _one_violation_repo(tmp_path)
+    bl = str(tmp_path / "baseline.json")
+    # Baseline holds a DIFFERENT file's debt: this file still fails.
+    json.dump({"version": 1, "counts": {
+        "bare-print": {"fm_spark_tpu/other.py": 3}}}, open(bl, "w"))
+    rep = core.analyze(repo, baseline_path=bl, rules=["bare-print"])
+    assert not rep["ok"] and len(rep["new"]) == 1
+
+
+# ------------------------------------------------------------------- report
+
+def test_report_shape_and_write(tmp_path):
+    repo = _one_violation_repo(tmp_path)
+    rep = core.analyze(repo, rules=["bare-print"], run_id="r-test")
+    assert rep["tool"] == "fmlint" and rep["run_id"] == "r-test"
+    assert rep["counts"] == {"bare-print": {"fm_spark_tpu/m.py": 1}}
+    assert "bare-print" in rep["rules"]  # glossary rides the report
+    out = core.write_report(rep, str(tmp_path / "obs" / "r-test"))
+    assert out and os.path.basename(out) == "fmlint.json"
+    loaded = json.load(open(out))
+    assert loaded["total_findings"] == 1 and not loaded["ok"]
+
+
+# --------------------------------------------------- the tier-1 repo gate
+
+def test_shipped_repo_has_zero_unbaselined_findings():
+    """THE gate (acceptance criterion): the full rule set over the real
+    repo, against the committed baseline — an unbaselined finding
+    anywhere turns tier-1 red."""
+    rep = core.analyze(REPO)
+    lines = "\n".join(
+        f"{f['path']}:{f['line']} {f['rule']}: {f['message']}"
+        for f in rep["new"])
+    assert rep["ok"], f"unbaselined fmlint findings:\n{lines}"
+
+
+def test_shipped_suppressions_all_carry_reasons():
+    rep = core.analyze(REPO)
+    assert rep["suppressed"], "expected the documented lock-free/" \
+        "fence suppressions to be visible in the report"
+    for s in rep["suppressed"]:
+        assert s["reason"].strip()
+
+
+# ------------------------------------------------------------ CLI (tier-1)
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, FMLINT, *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_clean_on_shipped_repo(tmp_path):
+    p = _run_cli("--out", str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    rep = json.load(open(tmp_path / "fmlint.json"))
+    assert rep["ok"] and rep["new"] == []
+    assert rep["run_id"].startswith("fmlint-")
+
+
+def test_cli_list_rules():
+    p = _run_cli("--list-rules")
+    assert p.returncode == 0
+    for r in analysis.all_rules():
+        assert r.id in p.stdout
+
+
+def test_cli_unknown_rule_is_usage_error():
+    p = _run_cli("--rules", "no-such-rule")
+    assert p.returncode == 2
+
+
+def test_cli_catches_seeded_thread_safety_violation(tmp_path):
+    """Acceptance criterion: the thread-safety pass demonstrably
+    catches a seeded synthetic violation through the real CLI in a
+    subprocess."""
+    repo = write_tree(tmp_path, FIXTURES["thread-lock-discipline"]
+                      ["positive"])
+    p = _run_cli("--repo", repo, "--rules", "thread-lock-discipline",
+                 "--no-report")
+    assert p.returncode == 1
+    assert "thread-lock-discipline" in p.stderr
+    assert "_count" in p.stderr
+
+
+def test_cli_catches_seeded_jax_hazard_violation(tmp_path):
+    """Acceptance criterion, JAX half: a seeded host sync in a step
+    loop fails the CLI run."""
+    repo = write_tree(
+        tmp_path, FIXTURES["jax-host-sync"]["positive"])
+    p = _run_cli("--repo", repo, "--rules",
+                 "jax-host-sync,jax-unfenced-timing", "--no-report")
+    assert p.returncode == 1
+    assert "jax-host-sync" in p.stderr
+
+
+def test_cli_write_baseline_round_trip(tmp_path):
+    repo = write_tree(tmp_path, {"fm_spark_tpu/m.py":
+                                 "def f():\n    print('x')\n"})
+    bl = str(tmp_path / "fmlint_baseline.json")
+    p = _run_cli("--repo", repo, "--rules", "bare-print",
+                 "--baseline", bl, "--no-report")
+    assert p.returncode == 1
+    p = _run_cli("--repo", repo, "--rules", "bare-print",
+                 "--baseline", bl, "--write-baseline")
+    assert p.returncode == 0, p.stderr
+    p = _run_cli("--repo", repo, "--rules", "bare-print",
+                 "--baseline", bl, "--no-report")
+    assert p.returncode == 0
+    assert "1 baselined" in p.stderr
+
+
+def test_cli_write_baseline_with_rules_subset_merges(tmp_path):
+    """--write-baseline under a --rules subset rewrites ONLY the
+    selected rules' cells — a targeted run must never erase another
+    rule's baselined debt (post-review regression)."""
+    repo = write_tree(tmp_path, {"fm_spark_tpu/m.py": (
+        "def f():\n    print('x')\n")})
+    bl = str(tmp_path / "fmlint_baseline.json")
+    json.dump({"version": 1, "counts": {
+        "jax-host-sync": {"fm_spark_tpu/train.py": 4}}}, open(bl, "w"))
+    p = _run_cli("--repo", repo, "--rules", "bare-print",
+                 "--baseline", bl, "--write-baseline")
+    assert p.returncode == 0, p.stderr
+    counts = json.load(open(bl))["counts"]
+    assert counts["jax-host-sync"] == {"fm_spark_tpu/train.py": 4}
+    assert counts["bare-print"] == {"fm_spark_tpu/m.py": 1}
+    # Paying the selected rule's debt down and re-absorbing drops its
+    # cells but still leaves the unselected rule's ledger intact.
+    (tmp_path / "fm_spark_tpu" / "m.py").write_text("x = 1\n")
+    p = _run_cli("--repo", repo, "--rules", "bare-print",
+                 "--baseline", bl, "--write-baseline")
+    assert p.returncode == 0, p.stderr
+    counts = json.load(open(bl))["counts"]
+    assert counts == {"jax-host-sync": {"fm_spark_tpu/train.py": 4}}
+
+
+def test_cli_report_lands_in_obs_layout(tmp_path):
+    """Default report path is artifacts/obs/<run_id>/fmlint.json —
+    exercised against a synthetic repo so the real artifacts/ tree
+    stays untouched by tests."""
+    repo = write_tree(tmp_path, {"fm_spark_tpu/ok.py": "x = 1\n"})
+    p = _run_cli("--repo", repo, "--rules", "bare-print",
+                 "--run-id", "r-fmlint-test")
+    assert p.returncode == 0
+    path = (tmp_path / "artifacts" / "obs" / "r-fmlint-test"
+            / "fmlint.json")
+    assert path.is_file()
+    assert json.load(open(path))["run_id"] == "r-fmlint-test"

@@ -1,332 +1,145 @@
-"""Tier-1 wiring for tools/resilience_lint.py (ISSUE 4 satellite):
-every resilience/ state transition goes through utils/logging.EventLog
-— no bare print, no ad-hoc JSON writes. The lint module owns the rules;
-this suite (a) holds the shipped subsystem to them and (b) pins the
-lint's own detection so a future refactor can't quietly lobotomize it.
+"""Tier-1 wiring for the tools/resilience_lint.py COMPATIBILITY SHIM
+(ISSUE 15): the six monolith rules now live in the fmlint registry
+(fm_spark_tpu/analysis/, exercised per-rule in tests/test_fmlint.py);
+this suite holds the shipped tree to them THROUGH the shim's historic
+entry points, pins the shim's delegation, and keeps the
+planted-violation coverage property for every resilience/serve module
+(an exclusion bug must turn the suite red, not silently shrink the
+scan).
 """
 
 import importlib.util
 import os
+import sys
 
 import pytest
+
+from fm_spark_tpu.analysis import core
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_lint():
+def _load_shim():
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
     spec = importlib.util.spec_from_file_location(
         "resilience_lint_tool",
-        os.path.join(REPO, "tools", "resilience_lint.py"))
+        os.path.join(tools, "resilience_lint.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-def test_resilience_package_is_clean():
-    lint = _load_lint()
-    found = lint.violations()
+SHIM_FUNCS = (
+    "violations",
+    "library_print_violations",
+    "kernel_fallback_violations",
+    "duration_time_violations",
+    "bench_leg_record_violations",
+    "fault_point_coverage_violations",
+    "watchdog_phase_coverage_violations",
+    "introspect_trigger_coverage_violations",
+)
+
+
+@pytest.mark.parametrize("fname", SHIM_FUNCS)
+def test_shipped_tree_clean_through_every_shim_entry_point(fname):
+    shim = _load_shim()
+    found = getattr(shim, fname)()
     assert found == [], "\n".join(found)
 
 
-def test_lint_catches_bare_print_and_adhoc_json(tmp_path):
-    lint = _load_lint()
-    (tmp_path / "bad.py").write_text(
-        "import json, sys\n"
-        "def transition(state):\n"
-        "    print('circuit open')\n"
-        "    sys.stderr.write('backing off\\n')\n"
-        "    with open('events.json', 'w') as f:\n"
-        "        json.dump({'event': 'backoff'}, f)\n"
-        "    return json.dumps(state)\n"
-    )
-    found = lint.violations(str(tmp_path))
-    assert len(found) == 4
-    assert any("bare print" in v for v in found)
-    assert any("json.dump)" in v for v in found)
-    assert any("json.dumps)" in v for v in found)
-    assert any("sys.stderr.write" in v for v in found)
-    # Every violation names file, line, and enclosing function.
-    assert all(v.startswith("bad.py:") and "[transition]" in v
-               for v in found)
+def test_shim_main_is_the_full_fmlint_gate():
+    shim = _load_shim()
+    assert shim.main() == 0
 
 
-def test_lint_allowlist_is_scoped_to_the_named_function(tmp_path):
-    lint = _load_lint()
-    # Same call in a DIFFERENT function of the allowlisted file: flagged.
-    (tmp_path / "faults.py").write_text(
-        "import json\n"
-        "def _next_count(point):\n"
-        "    return json.dumps({point: 1})\n"   # allowlisted
-        "def other(point):\n"
-        "    return json.dumps({point: 1})\n"   # not allowlisted
-    )
-    found = lint.violations(str(tmp_path))
-    assert len(found) == 1
-    assert "[other]" in found[0]
+def test_shim_rejects_legacy_scope_overrides():
+    """The shim scans the shipped repo only: the historical per-call
+    root/path/tests_dir overrides now fail LOUDLY instead of silently
+    returning whole-repo results to a fixture-scanning caller
+    (post-review hardening)."""
+    shim = _load_shim()
+    with pytest.raises(TypeError, match="no longer honors"):
+        shim.violations("/tmp/somewhere")
+    with pytest.raises(TypeError, match="no longer honors"):
+        shim.fault_point_coverage_violations(tests_dir="/tmp/t")
 
 
-def test_lint_cli_exit_status(tmp_path, capsys, monkeypatch):
-    lint = _load_lint()
-    assert lint.main() == 0  # the shipped package is clean
-    monkeypatch.setattr(lint, "RESILIENCE_DIR", str(tmp_path))
-    (tmp_path / "m.py").write_text("print('x')\n")
-    monkeypatch.setattr(
-        lint, "violations",
-        lambda root=str(tmp_path): lint._violations_in_tree(
-            __import__("ast").parse("print('x')"), "m.py"))
-    assert lint.main() == 1
-
-
-def test_lint_default_surface_includes_data_stream(tmp_path, monkeypatch):
-    """ISSUE 5: data/stream.py's quarantine/abort transitions carry the
-    same EventLog-only contract, so the DEFAULT lint surface must scan
-    it — a planted violation in a swapped-in copy is flagged, proving
-    the extra-files hook actually runs (not just lists)."""
-    lint = _load_lint()
+def test_shim_exports_historical_constants():
+    shim = _load_shim()
+    assert os.path.isdir(shim.RESILIENCE_DIR)
+    assert os.path.isdir(shim.SERVE_DIR)
     assert any(p.endswith(os.path.join("data", "stream.py"))
-               for p in lint.EXTRA_FILES)
-    src = lint.EXTRA_FILES[0]
+               for p in shim.EXTRA_FILES)
+
+
+def test_shim_renders_historical_string_format(tmp_path):
+    """Violation strings keep the ``path:line [func] message`` shape
+    old consumers parsed — checked against a planted violation run
+    through the registry rule the shim delegates to."""
+    (tmp_path / "fm_spark_tpu" / "resilience").mkdir(parents=True)
+    (tmp_path / "fm_spark_tpu" / "resilience" / "bad.py").write_text(
+        "def transition(s):\n    print('open')\n")
+    found, _ = core.run_rules(core.Context(str(tmp_path)),
+                              rules=["eventlog-only"])
+    rendered = [f"{f.path}:{f.line} [{f.func or '<module>'}] "
+                f"{f.message}" for f in found]
+    assert len(rendered) == 1
+    assert rendered[0].startswith(
+        "fm_spark_tpu/resilience/bad.py:2 [transition] ")
+    assert "bare print" in rendered[0]
+
+
+def _planted_copy(tmp_path, rel):
+    """Copy a shipped module into a synthetic repo at the same
+    relative path, with a violation appended."""
+    src = os.path.join(REPO, rel)
     with open(src) as f:
         body = f.read()
-    planted = tmp_path / "stream.py"
-    planted.write_text(
-        body + "\n\ndef _planted_violation():\n    print('x')\n")
-    monkeypatch.setattr(lint, "EXTRA_FILES", (str(planted),))
-    found = lint.violations()
-    assert any(v.startswith("stream.py:") and "_planted_violation" in v
-               for v in found), found
-    # An explicit-root call (the tmp-dir test idiom) stays scoped to
-    # that root — extra files are a default-surface property.
-    assert lint.violations(os.path.join(REPO, "fm_spark_tpu",
-                                        "resilience")) == []
+    dst = tmp_path
+    for part in rel.split("/"):
+        dst = dst / part
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(body + "\n\ndef _planted_violation():\n"
+                   "    print('x')\n")
 
 
-def test_duration_rule_catches_wallclock_subtraction(tmp_path):
-    """ISSUE 9: time.time() inside a subtraction is a wall-clock
-    DURATION — flagged in every form the codebase could write it
-    (module alias, import alias, bare import, either operand,
-    augmented assignment); timestamp uses stay legal."""
-    lint = _load_lint()
-    (tmp_path / "dur.py").write_text(
-        "import time\n"
-        "import time as _time\n"
-        "def measure(t0, t1):\n"
-        "    a = time.time() - t0\n"
-        "    b = t1 - _time.time()\n"
-        "    c = time() - t0\n"          # the from-import form
-        "    t1 -= time.time()\n"
-        "    ok = {'ts': time.time()}\n"          # timestamp: legal
-        "    ok2 = time.perf_counter() - t0\n"    # monotonic: legal
-        "    return a, b, c, ok, ok2\n"
-    )
-    import ast as _ast
-
-    found = lint._duration_violations_in_tree(
-        _ast.parse((tmp_path / "dur.py").read_text()), "dur.py")
-    assert len(found) == 4
-    assert all("perf_counter" in v and "[measure]" in v for v in found)
+def _strict_scope_modules():
+    out = []
+    for d in ("fm_spark_tpu/resilience", "fm_spark_tpu/serve"):
+        for fname in sorted(os.listdir(os.path.join(REPO, d))):
+            if fname.endswith(".py"):
+                out.append(f"{d}/{fname}")
+    out += ["fm_spark_tpu/data/stream.py",
+            "fm_spark_tpu/data/native_stream.py",
+            "fm_spark_tpu/native/__init__.py",
+            "fm_spark_tpu/online.py"]
+    return out
 
 
-def test_duration_rule_follows_import_aliases(tmp_path):
-    """'import time as t' / 'from time import time as now' must not
-    evade the ban — the rule reads the file's own import aliases."""
-    lint = _load_lint()
-    src = (
-        "import time as t\n"
-        "from time import time as now\n"
-        "def measure(t0):\n"
-        "    a = t.time() - t0\n"
-        "    b = now() - t0\n"
-        "    ok = t.perf_counter() - t0\n"   # monotonic: legal
-        "    return a, b, ok\n"
-    )
-    import ast as _ast
-
-    found = lint._duration_violations_in_tree(_ast.parse(src), "al.py")
-    assert len(found) == 2
+@pytest.mark.parametrize("rel", _strict_scope_modules())
+def test_every_strict_scope_module_is_actually_scanned(rel, tmp_path):
+    """The eventlog-only rule VISITS every module of the strict scope:
+    a planted violation appended to a copy of each shipped file is
+    flagged — so a future scope regression turns the suite red instead
+    of silently shrinking coverage."""
+    _planted_copy(tmp_path, rel)
+    found, _ = core.run_rules(core.Context(str(tmp_path)),
+                              rules=["eventlog-only"])
+    assert any(f.path == rel and f.func == "_planted_violation"
+               for f in found), [f.render() for f in found]
 
 
-def test_duration_rule_shipped_library_is_clean():
-    lint = _load_lint()
-    assert lint.duration_time_violations() == []
+def test_registry_coverage_rule_sees_the_real_registries():
+    """The three coverage anchors (KNOWN_POINTS / KNOWN_PHASES /
+    TRIGGERS) all parse out of the shipped modules — if a refactor
+    moves or renames a literal, this fails before the rule silently
+    checks nothing."""
+    ctx = core.Context(REPO)
+    from fm_spark_tpu.analysis import rules_obs
 
-
-def test_duration_rule_walks_the_library(tmp_path):
-    """The scan actually visits files under an arbitrary root."""
-    lint = _load_lint()
-    sub = tmp_path / "pkg"
-    sub.mkdir()
-    (sub / "m.py").write_text(
-        "import time\ndt = time.time() - 5.0\n")
-    found = lint.duration_time_violations(str(tmp_path))
-    assert len(found) == 1 and "<module>" in found[0]
-
-
-def test_bench_leg_record_rule_shipped_bench_is_clean():
-    lint = _load_lint()
-    assert lint.bench_leg_record_violations() == []
-
-
-def test_bench_leg_record_rule_catches_missing_provenance(tmp_path):
-    lint = _load_lint()
-    bad = tmp_path / "bench.py"
-    bad.write_text(
-        "leg_record = {'variant': label, 'value': 1.0}\n")
-    found = lint.bench_leg_record_violations(str(bad))
-    assert len(found) == 1
-    assert "run_id" in found[0] and "fingerprint" in found[0]
-    # No leg_record literal at all: the contract has no anchor.
-    none = tmp_path / "empty.py"
-    none.write_text("x = 1\n")
-    found = lint.bench_leg_record_violations(str(none))
-    assert len(found) == 1 and "no leg_record" in found[0]
-
-
-def test_new_rules_wired_into_main(monkeypatch, capsys):
-    """main() runs the ISSUE 9 rules — a planted violation in either
-    fails the lint exit status."""
-    lint = _load_lint()
-    monkeypatch.setattr(lint, "duration_time_violations",
-                        lambda root=None: ["dur.py:1 planted"])
-    assert lint.main() == 1
-    monkeypatch.setattr(lint, "duration_time_violations",
-                        lambda root=None: [])
-    monkeypatch.setattr(lint, "bench_leg_record_violations",
-                        lambda path=None: ["bench.py:1 planted"])
-    assert lint.main() == 1
-
-
-@pytest.mark.parametrize("fname", sorted(
-    f for f in os.listdir(os.path.join(REPO, "fm_spark_tpu", "resilience"))
-    if f.endswith(".py")
-))
-def test_every_resilience_module_is_covered(fname, tmp_path):
-    """The lint actually VISITS every module of the real package: a
-    planted violation appended to a copy of each shipped file is
-    flagged — so an exclusion bug (or a skipped file) turns the suite
-    red instead of silently shrinking coverage."""
-    lint = _load_lint()
-    src = os.path.join(lint.RESILIENCE_DIR, fname)
-    with open(src) as f:
-        body = f.read()
-    (tmp_path / fname).write_text(
-        body + "\n\ndef _planted_violation():\n    print('x')\n")
-    found = lint.violations(str(tmp_path))
-    assert any(v.startswith(f"{fname}:") and "_planted_violation" in v
-               for v in found), found
-
-
-def test_fault_point_coverage_clean_on_shipped_registry():
-    """ISSUE 10 satellite: every KNOWN_POINTS entry is exercised by at
-    least one tier-1 test in the shipped tree."""
-    lint = _load_lint()
-    found = lint.fault_point_coverage_violations()
-    assert found == [], "\n".join(found)
-
-
-def test_fault_point_coverage_catches_untested_point(tmp_path):
-    """A new injection point with no test naming it turns the lint red
-    — new fault points can't ship untested."""
-    lint = _load_lint()
-    faults_py = tmp_path / "faults.py"
-    faults_py.write_text(
-        'KNOWN_POINTS = (\n    "train_step",\n    "brand_new_point",\n)\n')
-    tests_dir = tmp_path / "tests"
-    tests_dir.mkdir()
-    (tests_dir / "test_x.py").write_text(
-        'def test_a():\n    assert "train_step"\n')
-    found = lint.fault_point_coverage_violations(
-        tests_dir=str(tests_dir), faults_path=str(faults_py))
-    assert len(found) == 1
-    assert "brand_new_point" in found[0]
-    # And a registry nobody can find is itself a violation, not a pass.
-    empty = tmp_path / "empty.py"
-    empty.write_text("x = 1\n")
-    found = lint.fault_point_coverage_violations(
-        tests_dir=str(tests_dir), faults_path=str(empty))
-    assert found and "no KNOWN_POINTS" in found[0]
-
-
-# ----------------------------------------- watchdog phase coverage (ISSUE 12)
-
-
-def test_watchdog_phase_coverage_clean_on_shipped_registry():
-    """Every KNOWN_PHASES entry — including the new serve_request SLO
-    phase — is exercised by at least one tier-1 test in the tree."""
-    lint = _load_lint()
-    found = lint.watchdog_phase_coverage_violations()
-    assert found == [], "\n".join(found)
-
-
-def test_watchdog_phase_coverage_catches_unarmed_phase(tmp_path):
-    """A guarded phase no test names turns the lint red — deadlines
-    can't ship unexercised, same policy as fault points."""
-    lint = _load_lint()
-    wd = tmp_path / "watchdog.py"
-    wd.write_text(
-        'KNOWN_PHASES = (\n    "step_window",\n    "brand_new_phase",\n)\n')
-    tests_dir = tmp_path / "tests"
-    tests_dir.mkdir()
-    (tests_dir / "test_x.py").write_text(
-        'def test_a():\n    assert "step_window"\n')
-    found = lint.watchdog_phase_coverage_violations(
-        tests_dir=str(tests_dir), watchdog_path=str(wd))
-    assert len(found) == 1 and "brand_new_phase" in found[0]
-    empty = tmp_path / "empty.py"
-    empty.write_text("x = 1\n")
-    found = lint.watchdog_phase_coverage_violations(
-        tests_dir=str(tests_dir), watchdog_path=str(empty))
-    assert found and "no KNOWN_PHASES" in found[0]
-
-
-def test_serve_runtime_in_strict_eventlog_scope():
-    """ISSUE 12: the serving runtime's state transitions are held to
-    the EventLog-only rule — the default-scope scan covers serve/."""
-    lint = _load_lint()
-    assert os.path.isdir(lint.SERVE_DIR)
-    # The shipped serve/ modules are clean under the full default scan.
-    assert lint.violations() == []
-
-
-# ------------------------------------ introspection triggers (ISSUE 14)
-
-
-def test_introspect_trigger_coverage_clean_on_shipped_registry():
-    """Every TRIGGERS entry in obs/introspect.py — sentinel_regressed,
-    watchdog_near_miss, serve_slo_overrun, step_time_spike — is fired
-    by at least one tier-1 test in the tree."""
-    lint = _load_lint()
-    found = lint.introspect_trigger_coverage_violations()
-    assert found == [], "\n".join(found)
-
-
-def test_introspect_trigger_coverage_catches_untested_trigger(tmp_path):
-    """A capture trigger no test fires turns the lint red — deep-
-    profiling paths can't ship unexercised, same policy as fault
-    points and watchdog phases."""
-    lint = _load_lint()
-    intro = tmp_path / "introspect.py"
-    intro.write_text(
-        'TRIGGERS = (\n    "step_time_spike",\n'
-        '    "brand_new_trigger",\n)\n')
-    tests_dir = tmp_path / "tests"
-    tests_dir.mkdir()
-    (tests_dir / "test_x.py").write_text(
-        'def test_a():\n    assert "step_time_spike"\n')
-    found = lint.introspect_trigger_coverage_violations(
-        tests_dir=str(tests_dir), introspect_path=str(intro))
-    assert len(found) == 1 and "brand_new_trigger" in found[0]
-    empty = tmp_path / "empty.py"
-    empty.write_text("x = 1\n")
-    found = lint.introspect_trigger_coverage_violations(
-        tests_dir=str(tests_dir), introspect_path=str(empty))
-    assert found and "no TRIGGERS" in found[0]
-
-
-def test_introspect_trigger_rule_wired_into_main(monkeypatch):
-    """main() runs the ISSUE 14 rule — a planted violation fails the
-    lint exit status."""
-    lint = _load_lint()
-    monkeypatch.setattr(lint, "introspect_trigger_coverage_violations",
-                        lambda **kw: ["introspect.py:1 planted"])
-    assert lint.main() == 1
+    for kind, rel, literal in rules_obs.COVERAGE_REGISTRIES:
+        got = rules_obs._literal_entries(ctx.file(rel), literal)
+        assert got and got[0], f"{literal} not found in {rel}"

@@ -389,3 +389,44 @@ def test_doctor_renders_continuous_learning_section(tmp_path, capsys):
     assert "0.3200" in out and "regressed" in out
     assert "DRIFT ROLLBACK" in out
     assert "QUALITY REGRESSED" in out
+
+
+def test_doctor_renders_static_analysis_section(tmp_path, capsys):
+    """ISSUE 15: a run dir holding an fmlint.json report gets a Static
+    analysis section + diagnosis lines — unbaselined findings render
+    as loudly as a regressed leg."""
+    doctor = _load_doctor()
+    run_dir = tmp_path / "r1"
+    run_dir.mkdir()
+    (run_dir / "trace.jsonl").write_text("")
+    rep = {
+        "version": 1, "tool": "fmlint", "run_id": "r1", "ok": False,
+        "rules": {"bare-print": "no bare print",
+                  "jax-host-sync": "no host syncs in step loops"},
+        "counts": {"jax-host-sync": {"fm_spark_tpu/train.py": 1}},
+        "total_findings": 1,
+        "new": [{"rule": "jax-host-sync",
+                 "path": "fm_spark_tpu/train.py", "line": 7,
+                 "message": "host sync float(...) inside a hot-path "
+                            "loop body", "func": "fit"}],
+        "baselined_total": 0,
+        "burned_down": [{"rule": "bare-print",
+                         "path": "fm_spark_tpu/x.py",
+                         "baseline": 2, "current": 0}],
+        "suppressed": [],
+    }
+    (run_dir / "fmlint.json").write_text(json.dumps(rep))
+    assert doctor.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "## Static analysis" in out and "FAILING" in out
+    assert "jax-host-sync" in out
+    assert "STATIC ANALYSIS: 1 unbaselined finding(s)" in out
+    assert "burn-down" in out
+    # A clean report renders quietly green.
+    rep.update(ok=True, new=[], counts={}, total_findings=0,
+               burned_down=[], baselined_total=3)
+    (run_dir / "fmlint.json").write_text(json.dumps(rep))
+    assert doctor.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out
+    assert "3 baselined finding(s) still burning down" in out

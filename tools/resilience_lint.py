@@ -1,609 +1,126 @@
-"""AST lint: resilience/ state transitions go through EventLog, period.
+"""Compatibility shim over the fmlint registry (ISSUE 15).
 
-The resilience subsystem's whole value is that a degraded round leaves a
-MACHINE-READABLE account of what happened (utils/logging.EventLog —
-JSONL, schema'd by ``event``). That property dies the day someone adds a
-``print(...)`` or hand-rolls a JSON write inside a recovery path: the
-transition becomes stderr prose (or a second, uncoordinated artifact
-format) that no tool can consume, and nothing turns red. Same failure
-shape as the shadowed-test bug (tests/test_no_shadowed_tests.py): a
-silent convention, enforced by nobody.
-
-This lint IS the enforcement, wired into tier-1 via
-tests/test_resilience_lint.py. It AST-parses every module under
-``fm_spark_tpu/resilience/`` — plus the hardened-ingest modules
-``fm_spark_tpu/data/stream.py`` (ISSUE 5) and the native chunk path
-``fm_spark_tpu/data/native_stream.py`` / ``fm_spark_tpu/native/
-__init__.py`` (ISSUE 6), whose quarantine/abort state transitions
-(dead-letter records, the rate-breaker abort) carry the same
-machine-readability contract — and flags:
-
-- any ``print(...)`` call (state narration belongs in the journal);
-- any ``json.dump``/``json.dumps`` call (an ad-hoc JSON write bypassing
-  EventLog's schema/atomicity/best-effort contract);
-- any ``sys.stdout``/``sys.stderr`` write.
-
-Allowlist: ``faults.py::_next_count`` persists cross-process occurrence
-COUNTERS (bookkeeping the injection harness needs before a journal can
-even exist) — it is not a state transition. Anything else wanting an
-exemption should probably be an EventLog event instead.
-
-Since ISSUE 7 the lint is also the OBSERVABILITY lint: beyond the
-strict EventLog-only scope above, every library module under
-``fm_spark_tpu/`` is scanned for *bare* ``print()`` — a print with no
-``file=`` destination, i.e. stdout narration that bypasses the
-telemetry plane. Numbers belong in the metrics registry
-(:mod:`fm_spark_tpu.obs.metrics` / ``MetricsLogger``), narrative in
-``EventLog``/spans. A ``print(..., file=...)`` is a *directed*
-transport (MetricsLogger's own JSONL stream writes that way) and is
-allowed outside the strict scope. The CLI surface (``cli.py``,
-``cli_levers.py``, ``__main__.py``) is exempt — a command-line tool's
-stdout IS its interface.
-
-Since ISSUE 9 the lint is also the MEASUREMENT-PROVENANCE lint:
-
-- ``time.time()`` inside a subtraction is banned across
-  ``fm_spark_tpu/`` (:func:`duration_time_violations`): wall-clock is
-  for TIMESTAMPS; a duration computed from it jumps with NTP slews and
-  DST — every measured interval goes through
-  ``time.perf_counter()``/``time.monotonic()`` (the round-2 "timing
-  note" rule, now enforced).
-- ``bench.py``'s per-leg sweep record must carry ``run_id`` and
-  ``fingerprint`` keys (:func:`bench_leg_record_violations`): a leg
-  record that cannot be traced to its run and comparability cohort is
-  exactly the hand-adjudicated number the perf ledger retires.
-
-Since ISSUE 10 the lint is also the FAULT-COVERAGE lint: every entry
-in ``faults.KNOWN_POINTS`` must be exercised by at least one tier-1
-test (:func:`fault_point_coverage_violations`) — a new injection point
-cannot ship untested, because an unexercised recovery path is exactly
-the blind spot the chaos campaign exists to close.
-
-Since ISSUE 12 the serving runtime (``fm_spark_tpu/serve/``,
-:data:`SERVE_DIR`) joins the strict EventLog-only scope, and the
-fault-coverage idea extends to the watchdog:
-every ``watchdog.KNOWN_PHASES`` entry — including the new
-``serve_request`` SLO phase — must appear in at least one tier-1 test
-(:func:`watchdog_phase_coverage_violations`).
-
-Since ISSUE 14 the same coverage idea extends to the introspection
-plane: every capture trigger registered in
-``obs/introspect.py::TRIGGERS`` must appear in at least one tier-1
-test (:func:`introspect_trigger_coverage_violations`) — a trigger no
-test ever fires is a capture path that can rot silently, exactly like
-an unexercised fault point.
+The six hand-rolled AST checks that lived here (ISSUEs 4–14: the
+EventLog-only scope, the library-wide bare-print ban, the Pallas
+structured-fallback rule, the wall-clock-duration ban, the bench
+leg-record provenance keys, and the fault/phase/trigger coverage
+rules) are now REGISTERED RULES in :mod:`fm_spark_tpu.analysis` —
+see ``tools/fmlint.py`` for the CLI, inline suppressions, and the
+committed baseline. This module keeps the old entry points alive for
+anything still importing them; each delegates to the registry and
+renders findings in the historical ``path:line [func] message``
+string form.
 
 Usage::
 
     python tools/resilience_lint.py        # exit 1 on violations
 """
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fmlint import load_analysis  # noqa: E402
+
+_analysis = load_analysis(REPO)
+
+#: Historical names, re-exported for old callers.
 RESILIENCE_DIR = os.path.join(REPO, "fm_spark_tpu", "resilience")
-
-#: Modules OUTSIDE resilience/ held to the same EventLog-only rule:
-#: data/stream.py journals quarantine/abort transitions (ISSUE 5);
-#: data/native_stream.py replays the same guard policy from the native
-#: chunk parse and native/__init__.py is its binding layer (ISSUE 6) —
-#: a stray print/JSON write in either would fork the dead-letter
-#: contract the moment ingest goes native.
-EXTRA_FILES = (
-    os.path.join(REPO, "fm_spark_tpu", "data", "stream.py"),
-    os.path.join(REPO, "fm_spark_tpu", "data", "native_stream.py"),
-    os.path.join(REPO, "fm_spark_tpu", "native", "__init__.py"),
-    # The continuous-learning loop (ISSUE 13): drift verdicts,
-    # demotions and rollbacks are operator-facing state transitions —
-    # EventLog-only, like the rest of the recovery narrative.
-    os.path.join(REPO, "fm_spark_tpu", "online.py"),
-)
-
-#: The serving runtime (ISSUE 12) is held to the same EventLog-only
-#: rule as resilience/: its state transitions (generation swaps,
-#: degraded-mode reload failures, batch failures) are exactly the
-#: machine-readable narrative a serving fleet's operator tooling
-#: consumes — a stray print or hand-rolled JSON write there forks the
-#: contract at the highest-QPS spot in the codebase.
 SERVE_DIR = os.path.join(REPO, "fm_spark_tpu", "serve")
-
-#: (filename, enclosing function) pairs exempt from the JSON-write rule.
-ALLOWLIST = {
-    ("faults.py", "_next_count"),
-}
-
-#: The library-wide bare-print scan root (ISSUE 7).
 LIBRARY_DIR = os.path.join(REPO, "fm_spark_tpu")
-
-#: Kernel modules (ISSUE 8): every Pallas kernel file under ops/. An
-#: attachment without a working Pallas lowering must DEGRADE (the
-#: fused_embed='auto' XLA fallback), not die — so kernel availability
-#: checks raise the structured ops.PallasUnavailable, never ``assert``
-#: (stripped under -O, and an AssertionError is uncatchable-by-contract
-#: for the fallback path) and never a bare ``ValueError`` (the fallback
-#: resolver pins the PallasUnavailable type).
-KERNEL_DIR = os.path.join(REPO, "fm_spark_tpu", "ops")
-KERNEL_PREFIX = "pallas_"
-
-#: Top-level library modules whose stdout IS their interface.
-CLI_EXEMPT = frozenset({"cli.py", "cli_levers.py", "__main__.py"})
+EXTRA_FILES = tuple(
+    os.path.join(REPO, *rel.split("/"))
+    for rel in _analysis.rules_obs.STRICT_EXTRA_FILES)
 
 
-def _call_name(node: ast.Call) -> str:
-    """Dotted name of the called object, best-effort ('' if dynamic)."""
-    parts = []
-    f = node.func
-    while isinstance(f, ast.Attribute):
-        parts.append(f.attr)
-        f = f.value
-    if isinstance(f, ast.Name):
-        parts.append(f.id)
-    return ".".join(reversed(parts))
+def _render(findings) -> list[str]:
+    return [f"{f.path}:{f.line} [{f.func or '<module>'}] {f.message}"
+            for f in findings]
 
 
-def _violations_in_tree(tree: ast.AST, filename: str) -> list[str]:
-    out = []
-    # Parent-function context: walk with an explicit stack so each Call
-    # knows its enclosing def (the allowlist granularity).
-    def visit(node, func):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            func = node.name
-        if isinstance(node, ast.Call):
-            name = _call_name(node)
-            if name == "print":
-                out.append(
-                    f"{filename}:{node.lineno} [{func or '<module>'}] "
-                    "bare print() — emit a journal event "
-                    "(utils/logging.EventLog) instead"
-                )
-            elif name in ("json.dump", "json.dumps"):
-                if (filename, func) not in ALLOWLIST:
-                    out.append(
-                        f"{filename}:{node.lineno} [{func or '<module>'}] "
-                        f"ad-hoc JSON write ({name}) — state transitions "
-                        "go through EventLog, not hand-rolled JSON"
-                    )
-            elif name in ("sys.stdout.write", "sys.stderr.write"):
-                out.append(
-                    f"{filename}:{node.lineno} [{func or '<module>'}] "
-                    f"direct {name} — emit a journal event instead"
-                )
-        for child in ast.iter_child_nodes(node):
-            visit(child, func)
-
-    visit(tree, None)
-    return out
+def _reject_overrides(**kw) -> None:
+    """The shim scans THE SHIPPED REPO through the registry's own
+    scope. The old per-call root/path overrides cannot be honored here
+    — silently returning whole-repo results to a caller who passed a
+    fixture dir would make their check vacuously pass/fail — so a
+    non-None override is a loud error pointing at the replacement
+    (``analysis.Context(repo)`` + ``run_rules``)."""
+    bad = {k: v for k, v in kw.items() if v is not None}
+    if bad:
+        raise TypeError(
+            f"resilience_lint is a shim over the fmlint registry and "
+            f"no longer honors {sorted(bad)} — scan a custom root via "
+            "fm_spark_tpu.analysis: run_rules(Context(repo), "
+            "rules=[...]) (see tests/test_fmlint.py)")
 
 
-def _check_file(path: str) -> list[str]:
-    fname = os.path.basename(path)
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=fname)
-    return _violations_in_tree(tree, fname)
+def _run(rule_id: str) -> list[str]:
+    found, _suppressed = _analysis.run_rules(
+        _analysis.Context(REPO), rules=[rule_id])
+    return _render(found)
 
 
-def _bare_prints_in_tree(tree: ast.AST, filename: str) -> list[str]:
-    """Library-wide rule (ISSUE 7): ``print()`` with no ``file=``
-    destination is stdout narration — route it through the obs plane
-    (EventLog / MetricsLogger / obs spans) instead."""
-    out = []
-
-    def visit(node, func):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            func = node.name
-        if (isinstance(node, ast.Call) and _call_name(node) == "print"
-                and not any(kw.arg == "file" for kw in node.keywords)):
-            out.append(
-                f"{filename}:{node.lineno} [{func or '<module>'}] "
-                "bare print() in library code — use MetricsLogger/"
-                "EventLog/obs APIs (fm_spark_tpu.obs) instead"
-            )
-        for child in ast.iter_child_nodes(node):
-            visit(child, func)
-
-    visit(tree, None)
-    return out
+def violations(root=None) -> list[str]:
+    """The strict EventLog-only scope over the shipped tree."""
+    _reject_overrides(root=root)
+    return _run("eventlog-only")
 
 
-def library_print_violations(root: str | None = None) -> list[str]:
-    """Bare-print violations across every ``.py`` under ``root``
-    (default: the whole ``fm_spark_tpu`` package), CLI modules exempt.
-    Filenames are reported repo-relative so two modules sharing a
-    basename stay distinguishable."""
-    root = root or LIBRARY_DIR
-    out = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, REPO)
-            if (fname in CLI_EXEMPT
-                    and os.path.dirname(rel) == "fm_spark_tpu"):
-                continue
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=rel)
-            out.extend(_bare_prints_in_tree(tree, rel))
-    return out
+def library_print_violations(root=None) -> list[str]:
+    _reject_overrides(root=root)
+    return _run("bare-print")
 
 
-def _kernel_fallback_violations_in_tree(tree: ast.AST,
-                                        filename: str) -> list[str]:
-    """Kernel-module rule (ISSUE 8): no ``assert`` statements, and no
-    ``raise ValueError(...)`` — availability/shape constraints raise the
-    structured :class:`fm_spark_tpu.ops.PallasUnavailable` so the
-    ``fused_embed='auto'`` lever can catch-and-degrade."""
-    out = []
-
-    def visit(node, func):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            func = node.name
-        if isinstance(node, ast.Assert):
-            out.append(
-                f"{filename}:{node.lineno} [{func or '<module>'}] "
-                "assert in a Pallas kernel module — raise "
-                "ops.PallasUnavailable so fused_embed='auto' can "
-                "degrade to the XLA path instead of dying"
-            )
-        if isinstance(node, ast.Raise) and isinstance(node.exc, ast.Call):
-            f = node.exc.func
-            name = f.id if isinstance(f, ast.Name) else (
-                f.attr if isinstance(f, ast.Attribute) else "")
-            if name == "ValueError":
-                out.append(
-                    f"{filename}:{node.lineno} [{func or '<module>'}] "
-                    "bare ValueError in a Pallas kernel module — raise "
-                    "ops.PallasUnavailable (the structured fallback "
-                    "signal fused_embed='auto' pins)"
-                )
-        for child in ast.iter_child_nodes(node):
-            visit(child, func)
-
-    visit(tree, None)
-    return out
+def kernel_fallback_violations(root=None) -> list[str]:
+    _reject_overrides(root=root)
+    return _run("pallas-fallback")
 
 
-def kernel_fallback_violations(root: str | None = None) -> list[str]:
-    """Structured-fallback violations across every ``pallas_*.py``
-    kernel module under ``root`` (default: ``fm_spark_tpu/ops``)."""
-    root = root or KERNEL_DIR
-    out = []
-    for fname in sorted(os.listdir(root)):
-        if not (fname.startswith(KERNEL_PREFIX)
-                and fname.endswith(".py")):
-            continue
-        path = os.path.join(root, fname)
-        rel = os.path.relpath(path, REPO)
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=rel)
-        out.extend(_kernel_fallback_violations_in_tree(tree, rel))
-    return out
+def duration_time_violations(root=None) -> list[str]:
+    _reject_overrides(root=root)
+    return _run("wallclock-duration")
 
 
-def _time_aliases(tree: ast.AST) -> tuple[set, set]:
-    """The file's actual names for the time module and for
-    ``time.time`` itself — ``import time as t`` / ``from time import
-    time as now`` must not evade the duration rule. Seeded with the
-    conventional spellings so a bare ``time()`` is always caught."""
-    mods = {"time", "_time"}
-    funcs = {"time"}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    mods.add(a.asname or a.name)
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "time":
-                    funcs.add(a.asname or a.name)
-    return mods, funcs
+def bench_leg_record_violations(path=None) -> list[str]:
+    _reject_overrides(path=path)
+    return _run("leg-provenance")
 
 
-def _is_wallclock_time_call(node: ast.AST, mods: set = frozenset(),
-                            funcs: set = frozenset()) -> bool:
-    """Is ``node`` a ``time.time()`` call under any of the file's
-    aliases (see :func:`_time_aliases`)?"""
-    if not isinstance(node, ast.Call):
-        return False
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id in (funcs or {"time"})
-    if isinstance(f, ast.Attribute) and f.attr == "time":
-        return (isinstance(f.value, ast.Name)
-                and f.value.id in (mods or {"time", "_time"}))
-    return False
+def _coverage(kind_prefix: str) -> list[str]:
+    found, _ = _analysis.run_rules(_analysis.Context(REPO),
+                                   rules=["registry-coverage"])
+    return _render([f for f in found
+                    if kind_prefix in f.message])
 
 
-def _duration_violations_in_tree(tree: ast.AST,
-                                 filename: str) -> list[str]:
-    """Provenance rule (ISSUE 9): ``time.time()`` as an operand of a
-    subtraction is a DURATION measured on the wall clock — use
-    ``time.perf_counter()``/``time.monotonic()``. Timestamp uses
-    (record stamps, filenames) stay legal."""
-    out = []
-    mods, funcs = _time_aliases(tree)
-
-    def flag(node, func):
-        out.append(
-            f"{filename}:{node.lineno} [{func or '<module>'}] "
-            "time.time() in a subtraction — durations go through "
-            "time.perf_counter()/time.monotonic(), wall-clock is for "
-            "timestamps only"
-        )
-
-    def visit(node, func):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            func = node.name
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
-            if (_is_wallclock_time_call(node.left, mods, funcs)
-                    or _is_wallclock_time_call(node.right, mods, funcs)):
-                flag(node, func)
-        if (isinstance(node, ast.AugAssign)
-                and isinstance(node.op, ast.Sub)
-                and _is_wallclock_time_call(node.value, mods, funcs)):
-            flag(node, func)
-        for child in ast.iter_child_nodes(node):
-            visit(child, func)
-
-    visit(tree, None)
-    return out
+def fault_point_coverage_violations(tests_dir=None,
+                                    faults_path=None) -> list[str]:
+    _reject_overrides(tests_dir=tests_dir, faults_path=faults_path)
+    return _coverage("fault point")
 
 
-def duration_time_violations(root: str | None = None) -> list[str]:
-    """Wall-clock-duration violations across every ``.py`` under
-    ``root`` (default: the whole ``fm_spark_tpu`` package)."""
-    root = root or LIBRARY_DIR
-    out = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, REPO)
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=rel)
-            out.extend(_duration_violations_in_tree(tree, rel))
-    return out
+def watchdog_phase_coverage_violations(tests_dir=None,
+                                       watchdog_path=None) -> list[str]:
+    _reject_overrides(tests_dir=tests_dir, watchdog_path=watchdog_path)
+    return _coverage("watchdog phase")
 
 
-#: The per-leg sweep-record keys every bench leg must carry (ISSUE 9).
-LEG_RECORD_REQUIRED_KEYS = ("run_id", "fingerprint")
-
-
-def _known_points(faults_path: str) -> list[str]:
-    """AST-extract the ``KNOWN_POINTS`` literal from faults.py — no
-    package import, so the lint stays runnable from a bare checkout."""
-    with open(faults_path) as f:
-        tree = ast.parse(f.read(), filename=os.path.basename(faults_path))
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name)
-                        and t.id == "KNOWN_POINTS"
-                        for t in node.targets)
-                and isinstance(node.value, (ast.Tuple, ast.List))):
-            return [e.value for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)]
-    return []
-
-
-def fault_point_coverage_violations(tests_dir: str | None = None,
-                                    faults_path: str | None = None
-                                    ) -> list[str]:
-    """Fault-registry coverage rule (ISSUE 10 satellite): every
-    ``KNOWN_POINTS`` entry must appear in at least one tier-1 test
-    module — an injection point nobody's test ever names is a recovery
-    path that can rot silently, the exact blind spot the chaos
-    campaign exists to close. (String-level scan: plans are strings,
-    so the point name appearing in a test file IS the exercise
-    anchor.)"""
-    tests_dir = tests_dir or os.path.join(REPO, "tests")
-    faults_path = faults_path or os.path.join(
-        REPO, "fm_spark_tpu", "resilience", "faults.py")
-    points = _known_points(faults_path)
-    if not points:
-        return [f"{os.path.basename(faults_path)}: no KNOWN_POINTS "
-                "literal found — the fault registry has no anchor to "
-                "check coverage against"]
-    texts = []
-    try:
-        for fname in sorted(os.listdir(tests_dir)):
-            if fname.startswith("test_") and fname.endswith(".py"):
-                with open(os.path.join(tests_dir, fname)) as f:
-                    texts.append(f.read())
-    except OSError as e:
-        return [f"tests dir unreadable ({e})"]
-    blob = "\n".join(texts)
-    return [
-        f"fault point {p!r} (KNOWN_POINTS) is exercised by no test "
-        "under tests/ — a new injection point must ship with at least "
-        "one tier-1 test that names it"
-        for p in points if p not in blob
-    ]
-
-
-def _known_phases(watchdog_path: str) -> list[str]:
-    """AST-extract the ``KNOWN_PHASES`` literal from watchdog.py —
-    same no-import policy as :func:`_known_points`."""
-    with open(watchdog_path) as f:
-        tree = ast.parse(f.read(),
-                         filename=os.path.basename(watchdog_path))
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name)
-                        and t.id == "KNOWN_PHASES"
-                        for t in node.targets)
-                and isinstance(node.value, (ast.Tuple, ast.List))):
-            return [e.value for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)]
-    return []
-
-
-def watchdog_phase_coverage_violations(tests_dir: str | None = None,
-                                       watchdog_path: str | None = None
-                                       ) -> list[str]:
-    """Watchdog-phase coverage rule (ISSUE 12 satellite): every
-    ``KNOWN_PHASES`` entry must appear in at least one tier-1 test
-    module — the ``serve_request`` phase (deadline = the serving SLO)
-    joins the registry with this PR, and a guarded phase no test ever
-    arms is a deadline that can rot silently, exactly like an
-    unexercised fault point."""
-    tests_dir = tests_dir or os.path.join(REPO, "tests")
-    watchdog_path = watchdog_path or os.path.join(
-        REPO, "fm_spark_tpu", "resilience", "watchdog.py")
-    phases = _known_phases(watchdog_path)
-    if not phases:
-        return [f"{os.path.basename(watchdog_path)}: no KNOWN_PHASES "
-                "literal found — the watchdog registry has no anchor "
-                "to check coverage against"]
-    texts = []
-    try:
-        for fname in sorted(os.listdir(tests_dir)):
-            if fname.startswith("test_") and fname.endswith(".py"):
-                with open(os.path.join(tests_dir, fname)) as f:
-                    texts.append(f.read())
-    except OSError as e:
-        return [f"tests dir unreadable ({e})"]
-    blob = "\n".join(texts)
-    return [
-        f"watchdog phase {p!r} (KNOWN_PHASES) is exercised by no test "
-        "under tests/ — a guarded phase must ship with at least one "
-        "tier-1 test that names it"
-        for p in phases if p not in blob
-    ]
-
-
-def _known_triggers(introspect_path: str) -> list[str]:
-    """AST-extract the ``TRIGGERS`` literal from obs/introspect.py —
-    same no-import policy as :func:`_known_points`."""
-    with open(introspect_path) as f:
-        tree = ast.parse(f.read(),
-                         filename=os.path.basename(introspect_path))
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name)
-                        and t.id == "TRIGGERS"
-                        for t in node.targets)
-                and isinstance(node.value, (ast.Tuple, ast.List))):
-            return [e.value for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)]
-    return []
-
-
-def introspect_trigger_coverage_violations(
-        tests_dir: str | None = None,
-        introspect_path: str | None = None) -> list[str]:
-    """Introspection-trigger coverage rule (ISSUE 14 satellite): every
-    ``TRIGGERS`` entry in obs/introspect.py must appear in at least one
-    tier-1 test module — a capture trigger nobody's test ever fires is
-    a deep-profiling path that can rot silently, the exact blind spot
-    the fault-point and watchdog-phase rules already close."""
-    tests_dir = tests_dir or os.path.join(REPO, "tests")
-    introspect_path = introspect_path or os.path.join(
-        REPO, "fm_spark_tpu", "obs", "introspect.py")
-    triggers = _known_triggers(introspect_path)
-    if not triggers:
-        return [f"{os.path.basename(introspect_path)}: no TRIGGERS "
-                "literal found — the introspection registry has no "
-                "anchor to check coverage against"]
-    texts = []
-    try:
-        for fname in sorted(os.listdir(tests_dir)):
-            if fname.startswith("test_") and fname.endswith(".py"):
-                with open(os.path.join(tests_dir, fname)) as f:
-                    texts.append(f.read())
-    except OSError as e:
-        return [f"tests dir unreadable ({e})"]
-    blob = "\n".join(texts)
-    return [
-        f"introspection trigger {t!r} (TRIGGERS) is exercised by no "
-        "test under tests/ — a capture trigger must ship with at "
-        "least one tier-1 test that fires it"
-        for t in triggers if t not in blob
-    ]
-
-
-def bench_leg_record_violations(path: str | None = None) -> list[str]:
-    """Provenance rule (ISSUE 9): bench.py's ``leg_record`` dict
-    literal must carry :data:`LEG_RECORD_REQUIRED_KEYS` — the AST half
-    of the runtime check ``PerfLedger.append`` enforces."""
-    path = path or os.path.join(REPO, "bench.py")
-    fname = os.path.basename(path)
-    try:
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=fname)
-    except OSError as e:
-        return [f"{fname}: unreadable ({e})"]
-    found_literal = False
-    out = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name) and t.id == "leg_record"
-                        for t in node.targets)
-                and isinstance(node.value, ast.Dict)):
-            continue
-        found_literal = True
-        keys = {k.value for k in node.value.keys
-                if isinstance(k, ast.Constant)}
-        missing = [k for k in LEG_RECORD_REQUIRED_KEYS if k not in keys]
-        if missing:
-            out.append(
-                f"{fname}:{node.lineno} leg_record literal missing "
-                f"provenance key(s) {missing} — every bench leg record "
-                "must carry run_id + fingerprint"
-            )
-    if not found_literal:
-        out.append(
-            f"{fname}: no leg_record dict literal found — the sweep's "
-            "per-leg provenance contract has no anchor to lint"
-        )
-    return out
-
-
-def violations(root: str | None = None) -> list[str]:
-    """Violations under ``root`` (a directory); with the default root,
-    the shipped surface is checked — every resilience/ module plus
-    :data:`EXTRA_FILES` (data/stream.py) and the serving runtime
-    (:data:`SERVE_DIR`, ISSUE 12)."""
-    default = root is None
-    root = root or RESILIENCE_DIR
-    out = []
-    for fname in sorted(os.listdir(root)):
-        if not fname.endswith(".py"):
-            continue
-        out.extend(_check_file(os.path.join(root, fname)))
-    if default:
-        for path in EXTRA_FILES:
-            out.extend(_check_file(path))
-        if os.path.isdir(SERVE_DIR):
-            for fname in sorted(os.listdir(SERVE_DIR)):
-                if fname.endswith(".py"):
-                    out.extend(_check_file(
-                        os.path.join(SERVE_DIR, fname)))
-    return out
+def introspect_trigger_coverage_violations(tests_dir=None,
+                                           introspect_path=None
+                                           ) -> list[str]:
+    _reject_overrides(tests_dir=tests_dir,
+                      introspect_path=introspect_path)
+    return _coverage("introspection trigger")
 
 
 def main() -> int:
-    found = (violations() + library_print_violations()
-             + kernel_fallback_violations()
-             + duration_time_violations()
-             + bench_leg_record_violations()
-             + fault_point_coverage_violations()
-             + watchdog_phase_coverage_violations()
-             + introspect_trigger_coverage_violations())
-    for v in found:
-        print(v, file=sys.stderr)
-    if found:
-        print(f"{len(found)} observability-logging violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
+    """Full fmlint run (all rules, baseline applied) — the historical
+    exit-status contract: 0 clean, 1 on violations."""
+    import fmlint
+
+    return fmlint.main(["--no-report", "--quiet"])
 
 
 if __name__ == "__main__":

@@ -23,6 +23,11 @@ turns the run's streams into ONE screen a human can act on:
   with sentinel verdicts, the drift timeline (alarms, demotions,
   rollbacks, pointer republishes), and the rollback/quarantined-
   generation counters;
+- **Static analysis** (ISSUE 15) — the run's ``fmlint.json`` report
+  (written by ``tools/fmlint.py`` into the same run dir): per-rule
+  finding counts, unbaselined (build-failing) findings, reasoned
+  suppressions, and the baseline burn-down — analysis regressions
+  render next to perf ones;
 - **Diagnosis** — the doctor's findings: cold-cache compile domination,
   attachment weather, ingest-bound execution, degraded/fallback legs,
   statistically-regressed legs, stale/degraded/regressed serving,
@@ -370,6 +375,72 @@ def chaos_findings(chaos: dict | None) -> list[str]:
     return out
 
 
+def load_fmlint_report(obs_dir: str) -> dict | None:
+    """The run's static-analysis report (``fmlint.json``, written by
+    tools/fmlint.py — ISSUE 15), if this run dir holds one."""
+    path = os.path.join(obs_dir, "fmlint.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def fmlint_findings(rep: dict | None) -> list[str]:
+    """Static-analysis one-liners for the diagnosis section — analysis
+    regressions render next to perf ones (ISSUE 15)."""
+    if not rep:
+        return []
+    out = []
+    new = rep.get("new") or []
+    if new:
+        out.append(
+            f"STATIC ANALYSIS: {len(new)} unbaselined finding(s) — "
+            "the build is red until fixed, suppressed with a reason, "
+            "or baselined")
+        for f in new[:5]:
+            out.append(f"  fmlint {f.get('rule')}: {f.get('path')}:"
+                       f"{f.get('line')} {f.get('message', '')[:90]}")
+    elif rep.get("baselined_total"):
+        out.append(
+            f"fmlint: clean vs baseline, {rep['baselined_total']} "
+            "baselined finding(s) still burning down")
+    else:
+        out.append("fmlint: clean — zero findings beyond reasoned "
+                   "suppressions")
+    if rep.get("burned_down"):
+        out.append(
+            f"fmlint baseline burn-down: {len(rep['burned_down'])} "
+            "(rule, file) cell(s) below budget — run tools/fmlint.py "
+            "--write-baseline to lock the progress in")
+    return out
+
+
+def render_fmlint(rep: dict | None) -> list[str]:
+    """The Static-analysis section lines ('' terminated), or []."""
+    if not rep:
+        return []
+    counts = rep.get("counts") or {}
+    out = [f"## Static analysis (fmlint — "
+           f"{len(rep.get('rules') or {})} rule(s), "
+           f"{'OK' if rep.get('ok') else 'FAILING'})"]
+    total = rep.get("total_findings", 0)
+    out.append(f"  findings {total}  new {len(rep.get('new') or [])}  "
+               f"baselined {rep.get('baselined_total', 0)}  "
+               f"suppressed {len(rep.get('suppressed') or [])}  "
+               f"burned-down {len(rep.get('burned_down') or [])}")
+    for rule_id in sorted(counts):
+        files = counts[rule_id]
+        out.append(f"  {rule_id:24} {sum(files.values()):>4}  "
+                   f"in {len(files)} file(s)")
+    for f in (rep.get("new") or [])[:10]:
+        out.append(f"  NEW {f.get('path')}:{f.get('line')} "
+                   f"[{f.get('rule')}] {f.get('message', '')[:80]}")
+    out.append("")
+    return out
+
+
 def findings(diag: dict, legs: list[dict]) -> list[str]:
     """The doctor's opinionated one-liners."""
     out = []
@@ -437,7 +508,8 @@ def render(run: dict, diag: dict, legs: list[dict],
            chaos: dict | None = None, serve: dict | None = None,
            serve_legs: list[dict] | None = None,
            online: dict | None = None,
-           cost_rows: list[dict] | None = None) -> str:
+           cost_rows: list[dict] | None = None,
+           fmlint_rep: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -597,11 +669,14 @@ def render(run: dict, diag: dict, legs: list[dict],
             f"{online['drift_score']}")
         out.append("")
 
+    out.extend(render_fmlint(fmlint_rep))
+
     out.append("## Diagnosis")
     for line in (findings(diag, legs) + chaos_findings(chaos)
                  + serve_findings(serve, serve_legs)
                  + online_findings(online)
-                 + capture_findings(run.get("captures"))):
+                 + capture_findings(run.get("captures"))
+                 + fmlint_findings(fmlint_rep)):
         out.append(f"  - {line}")
     return "\n".join(out) + "\n"
 
@@ -646,7 +721,8 @@ def main(argv=None) -> int:
                             serve=serve, serve_legs=serve_legs,
                             online=online,
                             cost_rows=_cost_rows(ledger_path,
-                                                 run["run_id"])))
+                                                 run["run_id"]),
+                            fmlint_rep=load_fmlint_report(obs_dir)))
     return 0
 
 
