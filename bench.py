@@ -797,7 +797,8 @@ def inner_main(args):
                       or args.compact_cap
                       or args.compact_device or args.gfull_fused
                       or args.segtotal_pallas
-                      or args.fused_embed != "off")
+                      or args.fused_embed != "off"
+                      or args.embed_tier != "off")
     shape_explicit = (args.rank is not None or args.batch != 1 << 17
                       or args.steps != 20)
     # --fast-first keeps the tiered variant sweep even at a non-default
@@ -816,6 +817,8 @@ def inner_main(args):
         + ("/gfull" if args.gfull_fused else "")
         + ("/segtotal" if args.segtotal_pallas else "")
         + (f"/fused-{args.fused_embed}" if args.fused_embed != "off"
+           else "")
+        + (f"/tier-{args.embed_tier}" if args.embed_tier != "off"
            else ""),
         (args.param_dtype, None, None),
         TrainConfig(learning_rate=0.05, lr_schedule="constant",
@@ -825,7 +828,9 @@ def inner_main(args):
                     compact_device=args.compact_device,
                     gfull_fused=args.gfull_fused,
                     segtotal_pallas=args.segtotal_pallas,
-                    fused_embed=args.fused_embed),
+                    fused_embed=args.fused_embed,
+                    embed_tier=args.embed_tier, hot_rows=args.hot_rows,
+                    embed_bucket_rows=args.embed_bucket_rows),
     )]
     if not explicit:
         head, tail = default_variants(args.model, batch)
@@ -1745,6 +1750,21 @@ def main():
                          "back to XLA — the leg is then stamped "
                          "fused_fallback and never keep-bests into "
                          "MEASURED.json")
+    ap.add_argument("--embed-tier", default="off",
+                    choices=["off", "auto", "require"],
+                    dest="embed_tier",
+                    help="tiered embedding store lever (ISSUE 16) for "
+                         "the measured config: the in-HBM sweep legs "
+                         "reject 'require' loudly (the tiered path is "
+                         "priced by its OWN ladder, bench_embed.py, "
+                         "into the embed_bench ledger kind — never "
+                         "compared against in-HBM legs)")
+    ap.add_argument("--hot-rows", type=int, default=0, dest="hot_rows",
+                    help="HBM hot-tier rows for --embed-tier (see "
+                         "bench_embed.py for the tiered ladder itself)")
+    ap.add_argument("--embed-bucket-rows", type=int, default=512,
+                    dest="embed_bucket_rows",
+                    help="rows per hot-tier bucket for --embed-tier")
     ap.add_argument("--fast-first", action="store_true",
                     dest="fast_first",
                     help="tiered sweep (warm-start): measure the "
@@ -1916,6 +1936,10 @@ def main():
         argv.append("--segtotal-pallas")
     if args.fused_embed != "off":
         argv += ["--fused-embed", args.fused_embed]
+    if args.embed_tier != "off":
+        argv += ["--embed-tier", args.embed_tier,
+                 "--hot-rows", str(args.hot_rows),
+                 "--embed-bucket-rows", str(args.embed_bucket_rows)]
     if args.fast_first:
         argv.append("--fast-first")
     if args.dirty_input:

@@ -1676,6 +1676,35 @@ def cmd_train(args) -> int:
             "--ckpt-sharded requires strategy 'field_sparse' and "
             "--checkpoint-dir"
         )
+    embed_mode = None
+    if tconfig.embed_tier != "off":
+        # ONE decision point (embed.tier_plan), same contract as the
+        # fused_embed lever: 'require' turns a None verdict into a hard
+        # failure carrying the reason; 'auto' falls back SAYING so.
+        from fm_spark_tpu import embed as _embed
+
+        embed_mode, embed_reason = _embed.tier_plan(spec, tconfig, strategy)
+        if embed_mode is None:
+            if tconfig.embed_tier == "require":
+                raise SystemExit(
+                    f"--embed-tier require cannot be served: "
+                    f"{embed_reason}")
+            print(
+                f"embed-tier auto: in-HBM fallback ({embed_reason})",
+                file=sys.stderr)
+        else:
+            if supervisor is not None or elastic is not None or \
+                    divergence_guard is not None:
+                raise SystemExit(
+                    "--embed-tier is exclusive with --supervise/"
+                    "--elastic/--divergence-guard: the tiered trainer "
+                    "runs its own fit loop (residency state does not "
+                    "survive a device rebuild)")
+            if tconfig.eval_every > 0:
+                raise SystemExit(
+                    "--embed-tier does not run periodic in-fit eval "
+                    "(eval_every > 0): held-out metrics come from the "
+                    "merged view once at end of fit")
     from fm_spark_tpu.data import iterate_once as _iter_once
 
     if te is not None:
@@ -1688,7 +1717,15 @@ def cmd_train(args) -> int:
     else:
         eval_source = None
     with profile_ctx:
-        if strategy == "single":
+        if strategy == "single" and embed_mode == "tiered":
+            from fm_spark_tpu.embed import TieredTrainer
+
+            trainer = TieredTrainer(spec, tconfig)
+            params = trainer.fit(
+                batches, checkpointer=checkpointer,
+                prefetch=args.prefetch,
+            )
+        elif strategy == "single":
             trainer = FMTrainer(spec, tconfig)
             trainer.fit(
                 batches, checkpointer=checkpointer,
@@ -1743,7 +1780,11 @@ def cmd_train(args) -> int:
         }))
 
     metrics = None
-    if strategy == "single" and eval_source is not None:
+    if strategy == "single" and embed_mode == "tiered":
+        # The tiered trainer evaluates through its merged full-axis view.
+        if eval_source is not None:
+            metrics = evaluate_params(spec, params, eval_source())
+    elif strategy == "single" and eval_source is not None:
         # fit() already evaluated the final model when eval_every > 0 —
         # don't re-stream the held-out set.
         metrics = trainer.last_eval or trainer.evaluate(eval_source())

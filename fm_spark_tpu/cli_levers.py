@@ -98,6 +98,32 @@ def _v_sel_blocked(tc, ctx):
         )
 
 
+def _v_hot_rows_need_tier(tc):
+    if tc.hot_rows > 0 and tc.embed_tier == "off":
+        # Capacity without the lever would be a silent no-op: the
+        # in-HBM trainers never consult hot_rows.
+        return "--hot-rows has no effect without --embed-tier auto|require"
+    if tc.embed_tier != "off" and tc.hot_rows > 0 and \
+            tc.hot_rows % tc.embed_bucket_rows:
+        return (
+            f"--hot-rows {tc.hot_rows} must be a multiple of "
+            f"--embed-bucket-rows {tc.embed_bucket_rows} (the hot tier "
+            "is managed in whole buckets)"
+        )
+
+
+def _v_embed_tier(tc, ctx):
+    # 'require' off the single-attachment strategy dies later in the
+    # factories with a less situated message (the residency protocol is
+    # single-attachment); 'auto' is always legal — queryable fallback.
+    if tc.embed_tier == "require" and ctx["sharded"]:
+        return (
+            f"--embed-tier require is served by the SINGLE-CHIP tiered "
+            f"flat-FM trainer (found {ctx['n']} devices); use 'auto' "
+            "for fallback-to-in-HBM semantics on a sharded run"
+        )
+
+
 def _v_fused_embed(tc, ctx):
     # 'require' on a sharded run dies later in the factory with a less
     # situated message; 'auto' is always legal (queryable XLA fallback).
@@ -188,6 +214,27 @@ _LEVERS = (
            "kernel)",
            choices=("off", "auto", "require"),
            validate=_v_fused_embed),
+    _Lever("--embed-tier", "embed_tier", "choice",
+           "tiered embedding store (fm_spark_tpu/embed): hot-bucket "
+           "HBM cache of --hot-rows rows over host cold storage, "
+           "async batch-keyed bucket prefetch, LRU-by-batch eviction "
+           "with dirty write-back — bit-identical to the in-HBM flat "
+           "FM path. 'auto' tiers when the tiered trainer serves this "
+           "(flat FM, single strategy, sgd/ftrl/adagrad) and falls "
+           "back with a stderr notice (embed.tier_plan's reason); "
+           "'require' hard-fails instead of falling back",
+           choices=("off", "auto", "require"),
+           validate=_v_embed_tier),
+    _Lever("--hot-rows", "hot_rows", "int",
+           "HBM hot-tier capacity in rows for --embed-tier (multiple "
+           "of --embed-bucket-rows; must cover one batch's touched-"
+           "bucket working set, and be < num-features — otherwise "
+           "there is nothing to tier)",
+           validate_any=_v_hot_rows_need_tier),
+    _Lever("--embed-bucket-rows", "embed_bucket_rows", "int",
+           "rows per hot-tier bucket (the residency/eviction/prefetch "
+           "unit; default 512). Smaller buckets = finer eviction, more "
+           "transfers; must divide --hot-rows and num-features"),
 )
 
 

@@ -1,0 +1,488 @@
+"""Two-tier parameter store: HBM hot-bucket cache over host cold rows.
+
+ROADMAP item 2's memory hierarchy (ISSUE 16). Every table today is
+HBM-resident end to end, which caps the feature axis at ~10M rows; the
+CTR workloads the paper targets run 100M–1B+. The tiered store keeps a
+fixed-capacity HOT tier on the device — ``hot_rows`` rows, managed as
+buckets of ``bucket_rows`` contiguous rows each, evicted LRU-by-batch —
+in front of a host-memory COLD tier holding the full feature axis.
+
+Layout contract (what makes the device step UNCHANGED):
+
+- A *bucket* is the residency unit: global rows ``[b·R, (b+1)·R)`` for
+  bucket ``b`` and ``R = bucket_rows``. Global id ``g`` lives in bucket
+  ``g // R`` at offset ``g % R``.
+- The hot tier is an ordinary ``[hot_rows, ...]`` table per plane
+  (``v``, ``w``, the FTRL/AdaGrad slot tables — ALL planes share ONE
+  residency map, so the optimizer schedule tiers with its params).
+  Bucket-in-slot ``s`` occupies hot rows ``[s·R, (s+1)·R)``.
+- :meth:`TieredStore.begin_batch` translates a batch's global ids to
+  hot-local ids. The train step then runs the stock flat-FM
+  gather/scatter body (sparse.make_sparse_sgd_step /
+  optim.make_sparse_adaptive_step) against the hot tables with local
+  ids — scores and updates depend only on gathered row VALUES, and a
+  stable relabeling preserves the duplicate-lane structure, so the
+  tiered step is BITWISE the untiered step (tests/test_embed_tier.py).
+
+Consistency protocol (the crash/chaos surface):
+
+- Updates write through to the hot tier only; a resident bucket touched
+  by a batch is marked DIRTY. Eviction flushes dirty hot rows back to
+  their cold block (the ``embed_evict`` fault point fires per flush)
+  and bumps the bucket's VERSION.
+- The async prefetcher (prefetch.py) stages ``device_put`` buffers for
+  batch N+1's missing buckets, recording the version it read. A staged
+  buffer whose version is stale by install time (the bucket was
+  evicted+flushed in between) is discarded and re-read — a stale
+  install would silently resurrect pre-flush values.
+- :meth:`TieredStore.merged_planes` materializes the cold view with
+  every dirty resident bucket flushed in, WITHOUT touching the live
+  cold arrays or versions — the checkpointable merged view is a pure
+  function of (cold, hot, dirty mask), so save/restore round-trips it
+  bitwise whatever the residency state was at save time.
+
+Misses that do block (a needed bucket neither resident nor staged) are
+COUNTED and timed, never hidden: ``embed/hit_rate``,
+``embed/evictions``, and ``embed/stall_ms`` land in the metrics
+registry (scraped by ``/metrics``; rendered by tools/run_doctor.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from fm_spark_tpu import obs
+from fm_spark_tpu.resilience import faults
+
+__all__ = ["ColdStore", "TieredStore"]
+
+
+class ColdStore:
+    """Host-memory cold tier: named row-planes over one global row axis.
+
+    Two materialization modes share the bucket read/write API:
+
+    - :meth:`dense` wraps fully materialized ndarrays (the differential
+      / checkpoint mode — ``merged`` views and bitwise parity against
+      an untiered run need the whole axis on host);
+    - :meth:`lazy` materializes a bucket only on first touch via a
+      deterministic ``init_fn(plane, bucket, shape, dtype)`` — the
+      100M/1B bench rungs, where host RSS must track the TOUCHED row
+      set, not the feature axis.
+    """
+
+    def __init__(self, planes: dict, bucket_rows: int, n_rows: int,
+                 init_fn=None):
+        if bucket_rows <= 0:
+            raise ValueError(f"bucket_rows must be > 0, got {bucket_rows}")
+        if n_rows % bucket_rows:
+            raise ValueError(
+                f"n_rows={n_rows} must divide by bucket_rows="
+                f"{bucket_rows} (bucket = contiguous row block)")
+        self.bucket_rows = int(bucket_rows)
+        self.n_rows = int(n_rows)
+        self.n_buckets = self.n_rows // self.bucket_rows
+        self._init_fn = init_fn
+        # plane -> full ndarray (dense) | plane -> {bucket: ndarray} (lazy)
+        self._planes = planes
+        self._lazy = init_fn is not None
+        # plane metadata is fixed either way: (row_shape, dtype).
+        if self._lazy:
+            self._meta = dict(planes)  # {plane: (row_shape, dtype)}
+            self._planes = {p: {} for p in planes}
+        else:
+            self._meta = {
+                p: (tuple(a.shape[1:]), a.dtype)
+                for p, a in planes.items()
+            }
+            for p, a in planes.items():
+                if a.shape[0] != self.n_rows:
+                    raise ValueError(
+                        f"plane {p!r} has {a.shape[0]} rows, store has "
+                        f"{self.n_rows}")
+
+    @classmethod
+    def dense(cls, planes: dict, bucket_rows: int) -> "ColdStore":
+        """Materialized cold tier from full host arrays (one per plane,
+        identical leading row count)."""
+        n_rows = next(iter(planes.values())).shape[0]
+        return cls(dict(planes), bucket_rows, n_rows)
+
+    @classmethod
+    def lazy(cls, meta: dict, bucket_rows: int, n_rows: int,
+             init_fn) -> "ColdStore":
+        """Demand-materialized cold tier. ``meta`` maps plane name →
+        ``(row_shape, dtype)``; ``init_fn(plane, bucket, shape, dtype)``
+        must be DETERMINISTIC per (plane, bucket) — a re-read after an
+        eviction-free crash must reproduce the same rows."""
+        return cls(dict(meta), bucket_rows, n_rows, init_fn=init_fn)
+
+    @property
+    def is_lazy(self) -> bool:
+        return self._lazy
+
+    @property
+    def plane_names(self) -> tuple:
+        return tuple(sorted(self._meta))
+
+    def row_shape(self, plane: str) -> tuple:
+        return self._meta[plane][0]
+
+    def dtype(self, plane: str):
+        return self._meta[plane][1]
+
+    def _slice(self, b: int) -> slice:
+        return slice(b * self.bucket_rows, (b + 1) * self.bucket_rows)
+
+    def read_bucket(self, plane: str, b: int) -> np.ndarray:
+        """A COPY of bucket ``b``'s rows (callers hand it to device_put
+        or mutate it freely; the store's own bytes never alias out)."""
+        if self._lazy:
+            blocks = self._planes[plane]
+            if b not in blocks:
+                shape, dtype = self._meta[plane]
+                blocks[b] = np.ascontiguousarray(
+                    self._init_fn(plane, int(b),
+                                  (self.bucket_rows, *shape), dtype))
+            return blocks[b].copy()
+        # .copy(), not ascontiguousarray: a contiguous slice would come
+        # back as a VIEW and alias the store's bytes out to callers.
+        return self._planes[plane][self._slice(b)].copy()
+
+    def write_bucket(self, plane: str, b: int, values: np.ndarray) -> None:
+        """Install an eviction flush (or restore) into bucket ``b``."""
+        values = np.asarray(values)
+        if self._lazy:
+            self._planes[plane][int(b)] = values.copy()
+        else:
+            self._planes[plane][self._slice(b)] = values
+
+    def dense_plane(self, plane: str) -> np.ndarray:
+        """The full materialized plane (dense mode only — the merged
+        checkpoint view; a lazy 1B-row plane must never materialize)."""
+        if self._lazy:
+            raise ValueError(
+                "dense_plane() is the checkpoint/merged view of a DENSE "
+                "cold store; lazy stores bound host RSS by never "
+                "materializing the full axis")
+        return self._planes[plane]
+
+    def host_bytes(self) -> int:
+        """Materialized cold bytes — the bench ladder's host-RSS model
+        term (lazy mode: only touched buckets count)."""
+        if self._lazy:
+            return sum(a.nbytes for blocks in self._planes.values()
+                       for a in blocks.values())
+        return sum(a.nbytes for a in self._planes.values())
+
+    def touched_buckets(self) -> int:
+        if self._lazy:
+            return max((len(b) for b in self._planes.values()), default=0)
+        return self.n_buckets
+
+
+class TieredStore:
+    """Residency/staging manager for the hot tier over a :class:`ColdStore`.
+
+    The HOT ARRAYS themselves are owned by the training loop (they are
+    donated through the jit step every batch); this class owns the
+    metadata — bucket→slot map, dirty mask, LRU stamps, staged prefetch
+    buffers, per-bucket versions — and every piece of it is touched
+    under ONE lock, because the prefetch producer thread mutates the
+    staging side concurrently with the consumer's install/evict path
+    (the fmlint ``thread-lock-discipline`` rule holds this class to
+    that; tests/test_embed_faults.py runs it).
+    """
+
+    def __init__(self, cold: ColdStore, hot_buckets: int):
+        if hot_buckets <= 0:
+            raise ValueError(f"hot_buckets must be > 0, got {hot_buckets}")
+        self.cold = cold
+        self.hot_buckets = int(hot_buckets)
+        self.hot_rows = self.hot_buckets * cold.bucket_rows
+        self._lock = threading.Lock()
+        # All shared mutable state below is read/written under _lock.
+        self._slot_of: dict[int, int] = {}      # bucket -> slot
+        self._bucket_in: list = [None] * self.hot_buckets
+        self._dirty = [False] * self.hot_buckets
+        self._stamp = [-1] * self.hot_buckets   # last-used batch index
+        self._free = list(range(self.hot_buckets - 1, -1, -1))
+        self._staged: dict[int, tuple] = {}     # bucket -> (version, bufs)
+        self._version: dict[int, int] = {}      # bumped per cold flush
+        self._batch = 0
+        self._stats = {"lookups": 0, "hot_hits": 0, "staged_hits": 0,
+                       "misses": 0, "evictions": 0, "stall_ms": 0.0,
+                       "prefetch_issued": 0, "prefetch_stale": 0,
+                       "bytes_h2d": 0, "bytes_d2h": 0}
+
+    # ------------------------------------------------------------ hot init
+
+    def init_hot(self):
+        """Zero hot tables, one per cold plane: ``[hot_rows, ...]`` on
+        device. Content is irrelevant until a bucket installs over it —
+        no id ever maps into a non-resident slot."""
+        import jax.numpy as jnp
+
+        return {
+            p: jnp.zeros((self.hot_rows, *self.cold.row_shape(p)),
+                         self.cold.dtype(p))
+            for p in self.cold.plane_names
+        }
+
+    # ------------------------------------------------------- prefetch side
+
+    def stage(self, ids: np.ndarray) -> int:
+        """PRODUCER-thread half of the pipeline: inspect a future
+        batch's global ids and ``device_put`` every bucket that is
+        neither resident nor already staged. Returns the number of
+        buckets staged. The ``embed_prefetch`` fault point fires once
+        per staging attempt (device loss mid-prefetch is the chaos
+        drill's scenario)."""
+        import jax
+
+        buckets = np.unique(
+            np.asarray(ids, np.int64).ravel() // self.cold.bucket_rows)
+        todo = []
+        with self._lock:
+            for b in buckets.tolist():
+                if b in self._slot_of or b in self._staged:
+                    continue
+                todo.append((b, self._version.get(b, 0)))
+        staged = 0
+        for b, ver in todo:
+            faults.inject("embed_prefetch")
+            with self._lock:
+                src = {p: self.cold.read_bucket(p, b)
+                       for p in self.cold.plane_names}
+            bufs = {p: jax.device_put(a) for p, a in src.items()}
+            for buf in bufs.values():
+                buf.block_until_ready()
+            with self._lock:
+                if b in self._slot_of or self._version.get(b, 0) != ver:
+                    # Lost the race with an install or an eviction
+                    # flush — a stale buffer must never land.
+                    self._stats["prefetch_stale"] += 1
+                    continue
+                self._staged[b] = (ver, bufs)
+                self._stats["prefetch_issued"] += 1
+                self._stats["bytes_h2d"] += sum(
+                    a.nbytes for a in src.values())
+                staged += 1
+        return staged
+
+    # ------------------------------------------------------- consumer side
+
+    def begin_batch(self, ids: np.ndarray, hot: dict) -> tuple:
+        """Make every bucket of ``ids`` resident; translate to hot-local
+        ids. Returns ``(local_ids, hot)`` with the (possibly updated)
+        hot arrays. Evicts LRU-by-batch buckets when capacity forces it
+        (flushing dirty rows to cold first); a needed bucket that is
+        neither resident nor validly staged is a counted, timed MISS —
+        loaded blocking, never hidden."""
+        ids = np.asarray(ids)
+        flat = ids.ravel().astype(np.int64)
+        buckets, inv = np.unique(flat // self.cold.bucket_rows,
+                                 return_inverse=True)
+        offsets = flat % self.cold.bucket_rows
+        if buckets.size > self.hot_buckets:
+            raise ValueError(
+                f"batch touches {buckets.size} bucket(s) but the hot "
+                f"tier holds {self.hot_buckets}; raise hot_rows (or "
+                f"bucket_rows granularity) — hot capacity must cover "
+                "one batch's working set")
+
+        needed = set(buckets.tolist())
+        evict: list[tuple[int, int, bool]] = []
+        installs: list[tuple[int, int]] = []
+        with self._lock:
+            self._batch += 1
+            stamp = self._batch
+            self._stats["lookups"] += buckets.size
+            missing = []
+            for b in buckets.tolist():
+                s = self._slot_of.get(b)
+                if s is not None:
+                    self._stats["hot_hits"] += 1
+                    self._stamp[s] = stamp
+                else:
+                    missing.append(b)
+            # Victim selection is deterministic: free slots first, then
+            # lowest (stamp, bucket) among residents not needed by THIS
+            # batch — LRU-by-batch with a stable tie-break, so a resumed
+            # run replays the same residency sequence.
+            victims = sorted(
+                (self._stamp[s], self._bucket_in[s], s)
+                for s in range(self.hot_buckets)
+                if self._bucket_in[s] is not None
+                and self._bucket_in[s] not in needed)
+            vi = 0
+            for b in missing:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    if vi >= len(victims):
+                        raise RuntimeError(
+                            "no evictable slot (every resident bucket "
+                            "is needed by this batch) — hot capacity "
+                            "must exceed the batch working set")
+                    _, old_b, slot = victims[vi]
+                    vi += 1
+                    evict.append((slot, old_b, self._dirty[slot]))
+                    del self._slot_of[old_b]
+                    self._bucket_in[slot] = None
+                    self._dirty[slot] = False
+                installs.append((slot, b))
+                self._slot_of[b] = slot
+                self._bucket_in[slot] = b
+                self._stamp[slot] = stamp
+                # The step will update every gathered bucket in place.
+                self._dirty[slot] = True
+            for b in buckets.tolist():
+                s = self._slot_of[b]
+                self._dirty[s] = True
+            slot_arr = np.fromiter(
+                (self._slot_of[b] for b in buckets.tolist()),
+                np.int64, count=buckets.size)
+
+        # Flush evicted dirty buckets to cold (d2h), then install the
+        # new residents (staged device buffers when the prefetcher won
+        # the race; blocking host loads otherwise).
+        for slot, old_b, dirty in evict:
+            hot = self._flush_slot(hot, slot, old_b, dirty)
+        for slot, b in installs:
+            hot = self._install(hot, slot, b)
+
+        local = (slot_arr[inv] * self.cold.bucket_rows + offsets).astype(
+            ids.dtype if ids.dtype.kind == "i" else np.int32)
+        self._publish_gauges()
+        return local.reshape(ids.shape), hot
+
+    def _flush_slot(self, hot: dict, slot: int, bucket: int,
+                    dirty: bool) -> dict:
+        """Evict one bucket: fault point first (the mid-eviction crash
+        window — cold still holds the PRE-update rows, the merged
+        checkpoint view never depended on this flush), then the dirty
+        write-back + version bump."""
+        faults.inject("embed_evict")
+        with self._lock:
+            self._stats["evictions"] += 1
+        if not dirty:
+            return hot
+        rows = {p: np.asarray(self._hot_slice(hot[p], slot))
+                for p in self.cold.plane_names}
+        with self._lock:
+            for p, a in rows.items():
+                self.cold.write_bucket(p, bucket, a)
+            self._version[bucket] = self._version.get(bucket, 0) + 1
+            self._staged.pop(bucket, None)  # now stale by construction
+            self._stats["bytes_d2h"] += sum(a.nbytes for a in rows.values())
+        return hot
+
+    def _install(self, hot: dict, slot: int, bucket: int) -> dict:
+        with self._lock:
+            entry = self._staged.pop(bucket, None)
+            ver = self._version.get(bucket, 0)
+        if entry is not None and entry[0] == ver:
+            self._stats["staged_hits"] += 1
+            bufs = entry[1]
+        else:
+            # The miss the pipeline could not hide — count it, time it.
+            if entry is not None:
+                self._stats["prefetch_stale"] += 1
+            t0 = time.perf_counter()
+            import jax
+
+            with self._lock:
+                src = {p: self.cold.read_bucket(p, bucket)
+                       for p in self.cold.plane_names}
+            bufs = {p: jax.device_put(a) for p, a in src.items()}
+            for buf in bufs.values():
+                buf.block_until_ready()
+            with self._lock:
+                self._stats["misses"] += 1
+                self._stats["stall_ms"] += (time.perf_counter() - t0) * 1e3
+                self._stats["bytes_h2d"] += sum(
+                    a.nbytes for a in src.values())
+        for p in self.cold.plane_names:
+            hot = dict(hot, **{p: self._hot_update(hot[p], bufs[p], slot)})
+        return hot
+
+    # ------------------------------------------------------- device slices
+
+    def _hot_slice(self, table, slot: int):
+        import jax
+        import jax.numpy as jnp
+
+        start = (jnp.int32(slot * self.cold.bucket_rows),) + (
+            jnp.int32(0),) * (table.ndim - 1)
+        size = (self.cold.bucket_rows, *table.shape[1:])
+        return jax.lax.dynamic_slice(table, start, size)
+
+    def _hot_update(self, table, buf, slot: int):
+        import jax
+        import jax.numpy as jnp
+
+        start = (jnp.int32(slot * self.cold.bucket_rows),) + (
+            jnp.int32(0),) * (table.ndim - 1)
+        return jax.lax.dynamic_update_slice(
+            table, buf.astype(table.dtype), start)
+
+    # ----------------------------------------------------- merged view etc
+
+    def merged_planes(self, hot: dict) -> dict:
+        """The checkpointable MERGED view: cold copied, every dirty
+        resident bucket overwritten from hot. Pure — live cold arrays,
+        versions, and the dirty mask are untouched, so a crash at any
+        point during/after the save leaves the protocol state exactly
+        as the next batch expects it (dense cold mode only)."""
+        with self._lock:
+            resident = [(self._bucket_in[s], s) for s in
+                        range(self.hot_buckets)
+                        if self._bucket_in[s] is not None and
+                        self._dirty[s]]
+        out = {p: self.cold.dense_plane(p).copy()
+               for p in self.cold.plane_names}
+        for bucket, slot in resident:
+            for p in self.cold.plane_names:
+                out[p][bucket * self.cold.bucket_rows:
+                       (bucket + 1) * self.cold.bucket_rows] = np.asarray(
+                    self._hot_slice(hot[p], slot))
+        return out
+
+    def restore_cold(self, planes: dict) -> None:
+        """Load a restored merged view into the cold tier and reset
+        every residency/staging structure — the resumed run re-faults
+        its working set from the restored rows (bit-identical replay:
+        values are position-independent)."""
+        with self._lock:
+            for p, a in planes.items():
+                if self.cold.is_lazy:
+                    for b in range(self.cold.n_buckets):
+                        self.cold.write_bucket(
+                            p, b, a[b * self.cold.bucket_rows:
+                                    (b + 1) * self.cold.bucket_rows])
+                else:
+                    self.cold.dense_plane(p)[...] = np.asarray(a)
+            self._slot_of.clear()
+            self._bucket_in = [None] * self.hot_buckets
+            self._dirty = [False] * self.hot_buckets
+            self._stamp = [-1] * self.hot_buckets
+            self._free = list(range(self.hot_buckets - 1, -1, -1))
+            self._staged.clear()
+            self._version = {b: v + 1 for b, v in self._version.items()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        hits = out["hot_hits"] + out["staged_hits"]
+        out["hit_rate"] = hits / out["lookups"] if out["lookups"] else 1.0
+        return out
+
+    def _publish_gauges(self) -> None:
+        st = self.stats()
+        obs.gauge("embed/hit_rate").set(round(st["hit_rate"], 6))
+        obs.gauge("embed/evictions").set(st["evictions"])
+        obs.gauge("embed/stall_ms").set(round(st["stall_ms"], 3))

@@ -1,0 +1,371 @@
+"""Tiered flat-FM trainer: stock sparse steps over a hot-bucket window.
+
+The composition that makes billion-row tables trainable without
+touching the device code: :class:`TieredTrainer` owns a
+:class:`~fm_spark_tpu.embed.store.TieredStore` whose hot tier is sized
+``config.hot_rows``, builds the UNMODIFIED flat-FM step against a spec
+re-dimensioned to the hot tier (``dataclasses.replace(spec,
+num_features=hot_rows)``), and per batch: (1) makes the batch's buckets
+resident + translates global→hot-local ids on the host, (2) runs the
+stock jitted step on the hot tables with local ids. Because FM scores
+and the analytic per-row updates depend only on gathered row VALUES,
+and both scatter paths (SGD's add-mode and the adaptive dedup's
+stable-sort + ``segment_sum``) are invariant under an injective id
+relabeling, the tiered loss/param trajectory is BITWISE the untiered
+one — asserted, not assumed (tests/test_embed_tier.py).
+
+The FTRL/AdaGrad slot tables (z/n) ride the SAME residency map as the
+params: one extra hot plane per slot table, evicted/flushed/prefetched
+together, so PR-13's online path scales with the feature axis.
+
+Checkpointing goes through the MERGED view
+(:meth:`TieredStore.merged_planes`): params and slots are saved at full
+feature-axis shape, independent of which buckets happened to be hot at
+save time, so save/restore round-trips bitwise and a restored run can
+use a different ``hot_rows`` than the killed one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from fm_spark_tpu.embed.store import ColdStore, TieredStore
+
+__all__ = ["TieredTrainer", "lazy_init_fn"]
+
+#: Planes whose hot rows are fp32 optimizer slots, keyed by
+#: (optimizer, use_linear) — the slot tables tier WITH the params.
+_SLOT_PLANES = {
+    ("ftrl", True): ("v_z", "v_n", "w_z", "w_n"),
+    ("ftrl", False): ("v_z", "v_n"),
+    ("adagrad", True): ("v_n", "w_n"),
+    ("adagrad", False): ("v_n",),
+    ("sgd", True): (),
+    ("sgd", False): (),
+}
+
+
+def lazy_init_fn(spec, seed: int, *, ftrl_seed: tuple | None = None):
+    """Deterministic per-(plane, bucket) cold-row initializer for
+    :meth:`ColdStore.lazy` — the 100M/1B rungs, where materializing the
+    full axis up front would defeat the tiering.
+
+    ``v`` buckets draw N(0, init_std²) from a counter-based stream
+    keyed by (seed, plane, bucket) — deterministic and
+    re-materialization-safe, but NOT the same stream as ``spec.init``
+    (one global normal draw over the full table); only the DENSE cold
+    mode carries the bitwise-parity contract. ``w`` and slot-``n``
+    buckets are zero; FTRL ``z`` buckets are seeded from the bucket's
+    ``v``/``w`` rows via the same closed form as
+    :func:`fm_spark_tpu.optim.seed_ftrl_slots` (``ftrl_seed`` =
+    ``(alpha, beta)``).
+    """
+    init_std = float(spec.init_std)
+
+    def init(plane: str, bucket: int, shape: tuple, dtype) -> np.ndarray:
+        if plane == "v":
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed, 0xE0, bucket]))
+            return (rng.standard_normal(shape, np.float32)
+                    * init_std).astype(dtype)
+        if plane == "v_z":
+            alpha, beta = ftrl_seed
+            return (-init("v", bucket, shape, np.float32)
+                    * (beta / alpha)).astype(dtype)
+        # w starts at zero, so its FTRL z seed and every n slot are zero.
+        return np.zeros(shape, dtype)
+
+    return init
+
+
+class TieredTrainer:
+    """Flat-FM training over the two-tier store.
+
+    ``TrainConfig`` contract: ``embed_tier`` in ("auto", "require"),
+    ``hot_rows`` > 0 and a multiple of ``embed_bucket_rows``,
+    ``optimizer`` in ("sgd", "ftrl", "adagrad"). The inner step factory
+    receives ``embed_tier="off"`` — the trainer IS the thing the reject
+    lever points at.
+
+    ``cold="dense"`` materializes the full feature axis on host (the
+    differential/bitwise mode); ``cold="lazy"`` materializes buckets on
+    first touch (host RSS tracks the touched set — the bench ladder's
+    100M/1B mode, with the documented init-stream caveat).
+    """
+
+    def __init__(self, spec, config, *, cold: str = "dense",
+                 beta: float = 1.0, l1: float = 0.0, l2: float = 0.0):
+        import jax
+
+        from fm_spark_tpu import optim, sparse
+        from fm_spark_tpu.models.fm import FMSpec
+
+        if type(spec) is not FMSpec:
+            raise ValueError(
+                "the tiered embedding store serves the flat FM family "
+                "only (the fused field families reject embed_tier="
+                "'require' for the same reason they reject fused_embed)")
+        if config.embed_tier not in ("auto", "require"):
+            raise ValueError(
+                f"TieredTrainer expects embed_tier 'auto'|'require', "
+                f"got {config.embed_tier!r}")
+        if config.optimizer not in ("sgd",) + optim.ADAPTIVE_OPTIMIZERS:
+            raise ValueError(
+                f"the tiered store tiers the sparse step families only "
+                f"(sgd/ftrl/adagrad); optimizer={config.optimizer!r}")
+        bucket_rows = int(config.embed_bucket_rows)
+        hot_rows = int(config.hot_rows)
+        if hot_rows <= 0:
+            raise ValueError(
+                "embed_tier needs hot_rows > 0 (the HBM hot-tier "
+                "capacity in rows)")
+        if hot_rows % bucket_rows:
+            raise ValueError(
+                f"hot_rows={hot_rows} must divide by embed_bucket_rows="
+                f"{bucket_rows} (the hot tier is managed in buckets)")
+        if spec.num_features % bucket_rows:
+            raise ValueError(
+                f"num_features={spec.num_features} must divide by "
+                f"embed_bucket_rows={bucket_rows}; pad the feature axis "
+                "(hashed spaces are free to round up)")
+        if hot_rows >= spec.num_features:
+            raise ValueError(
+                f"hot_rows={hot_rows} >= num_features="
+                f"{spec.num_features}: nothing to tier — run the plain "
+                "in-HBM trainer (embed_tier='off')")
+
+        self.spec = spec
+        self.config = config
+        self.step_count = 0
+        self.loss_history: list[float] = []
+        opt = config.optimizer
+        self._slot_planes = _SLOT_PLANES[(opt, spec.use_linear)]
+
+        # Inner step over the hot-tier window: the spec re-dimensioned
+        # to hot_rows, the config with the tier lever neutralized (this
+        # trainer is what 'require' demands; the inner factory must not
+        # re-reject it).
+        hot_spec = dataclasses.replace(spec, num_features=hot_rows)
+        inner_cfg = dataclasses.replace(config, embed_tier="off")
+        if opt == "sgd":
+            self._step = sparse.make_sparse_sgd_step(hot_spec, inner_cfg)
+        else:
+            self._step = optim.make_sparse_adaptive_step(
+                hot_spec, inner_cfg, beta=beta, l1=l1, l2=l2)
+
+        # Cold tier: plane metadata shared by both modes.
+        meta = {"v": ((spec.rank,), spec.pdtype),
+                "w": ((), spec.pdtype)}
+        for p in self._slot_planes:
+            meta[p] = ((spec.rank,) if p.startswith("v") else (),
+                       np.dtype(np.float32))
+        if cold == "dense":
+            params = spec.init(jax.random.key(config.seed))
+            # np.asarray over a jax array is a read-only view; the cold
+            # tier takes eviction write-backs, so own the bytes.
+            planes = {"v": np.array(params["v"]),
+                      "w": np.array(params["w"])}
+            if opt != "sgd":
+                slots = optim.init_adaptive_slots(opt, spec, params)
+                if opt == "ftrl":
+                    slots = optim.seed_ftrl_slots(
+                        slots, params, float(config.learning_rate), beta)
+                for p in self._slot_planes:
+                    table, slot = p.split("_")
+                    planes[p] = np.array(slots[table][slot])
+            self._cold = ColdStore.dense(planes, bucket_rows)
+            self._w0 = params["w0"]
+        else:
+            self._cold = ColdStore.lazy(
+                meta, bucket_rows, spec.num_features,
+                lazy_init_fn(spec, config.seed,
+                             ftrl_seed=(float(config.learning_rate),
+                                        beta)))
+            import jax.numpy as jnp
+
+            self._w0 = jnp.zeros((), jnp.float32)
+        self.store = TieredStore(self._cold, hot_rows // bucket_rows)
+        self.hot = self.store.init_hot()
+
+    # ------------------------------------------------------------ step/fit
+
+    def _pack(self):
+        """Hot planes → the (params, slots) trees the stock steps take."""
+        params = {"w0": self._w0, "w": self.hot["w"], "v": self.hot["v"]}
+        if not self._slot_planes:
+            return params, None
+        slots: dict = {}
+        for p in self._slot_planes:
+            table, slot = p.split("_")
+            slots.setdefault(table, {})[slot] = self.hot[p]
+        return params, slots
+
+    def _unpack(self, params, slots) -> None:
+        self._w0 = params["w0"]
+        self.hot["w"] = params["w"]
+        self.hot["v"] = params["v"]
+        if slots is not None:
+            for p in self._slot_planes:
+                table, slot = p.split("_")
+                self.hot[p] = slots[table][slot]
+
+    def step_batch(self, ids, vals, labels, weights) -> float:
+        """One training step: residency + id translation on host, then
+        the stock donated jit step on the hot tables."""
+        local_ids, self.hot = self.store.begin_batch(
+            np.asarray(ids), self.hot)
+        params, slots = self._pack()
+        if slots is None:
+            params, loss = self._step(
+                params, self.step_count, local_ids, vals, labels, weights)
+            self._unpack(params, None)
+        else:
+            params, slots, loss = self._step(
+                params, slots, local_ids, vals, labels, weights)
+            self._unpack(params, slots)
+        self.step_count += 1
+        loss = float(loss)
+        self.loss_history.append(loss)
+        return loss
+
+    def fit(self, batches, num_steps: int | None = None,
+            checkpointer=None, prefetch: int = 0):
+        """The tiered training loop; ``batches`` yields
+        ``(ids, vals, labels, weights)``.
+
+        With a checkpointer, state saves on its cadence as the MERGED
+        full-axis view (plus the pipeline cursor via
+        ``batches.state()``), and a prior run's latest checkpoint is
+        restored first — the kill-and-resume contract matches
+        ``FMTrainer.fit``. ``prefetch >= 2`` wraps the source in a
+        :class:`~fm_spark_tpu.embed.prefetch.BucketPrefetcher` AFTER
+        resume (the producer must see the restored cursor).
+        """
+        from fm_spark_tpu.embed.prefetch import BucketPrefetcher
+
+        total = (num_steps if num_steps is not None
+                 else self.config.num_steps)
+        if checkpointer is not None:
+            if not (hasattr(batches, "state")
+                    and hasattr(batches, "restore")):
+                raise ValueError(
+                    "checkpointed tiered training needs a resumable "
+                    "batch source with state()/restore()")
+            restored = self.restore_from(checkpointer)
+            if restored is not None and restored.get("pipeline"):
+                batches.restore(restored["pipeline"])
+        source = batches
+        pf = None
+        if prefetch >= 2:
+            pf = BucketPrefetcher(source, self.store, depth=prefetch)
+            source = pf
+        # The checkpointable cursor comes from SOURCE, not batches: the
+        # prefetch producer runs ahead of training, and saving the
+        # upstream's live cursor would skip the read-ahead batches on
+        # resume (the prefetcher reports its last-CONSUMED snapshot).
+        cursor = (source.state if hasattr(source, "state")
+                  else batches.state)
+        try:
+            for batch in source:
+                if self.step_count >= total:
+                    break
+                self.step_batch(*batch)
+                if checkpointer is not None and \
+                        checkpointer.due(self.step_count):
+                    self.save_to(checkpointer, cursor())
+            if checkpointer is not None:
+                self.save_to(checkpointer, cursor(), force=True)
+                checkpointer.wait()
+        finally:
+            if pf is not None:
+                pf.close()
+        # The merged full-axis view exists only for dense cold storage;
+        # a lazy (bench-ladder) run reads results via store.stats().
+        return None if self._cold.is_lazy else self.merged_params()
+
+    # ----------------------------------------------------- merged view I/O
+
+    def merged_params(self) -> dict:
+        """Full-axis ``{"w0","w","v"}`` — the checkpoint/eval view
+        (dense cold mode only)."""
+        merged = self.store.merged_planes(self.hot)
+        return {"w0": np.asarray(self._w0),
+                "w": merged["w"], "v": merged["v"]}
+
+    def merged_slots(self) -> dict | None:
+        if not self._slot_planes:
+            return None
+        merged = self.store.merged_planes(self.hot)
+        slots: dict = {}
+        for p in self._slot_planes:
+            table, slot = p.split("_")
+            slots.setdefault(table, {})[slot] = merged[p]
+        return slots
+
+    def save_to(self, checkpointer, pipeline_state=None,
+                force: bool = False) -> None:
+        merged = self.store.merged_planes(self.hot)
+        params = {"w0": np.asarray(self._w0),
+                  "w": merged["w"], "v": merged["v"]}
+        slots = None
+        if self._slot_planes:
+            slots = {}
+            for p in self._slot_planes:
+                table, slot = p.split("_")
+                slots.setdefault(table, {})[slot] = merged[p]
+        extra = {"loss_history": list(self.loss_history)}
+        if force:
+            checkpointer.save(self.step_count, params, slots,
+                              pipeline_state, extra=extra, force=True)
+        else:
+            checkpointer.save(self.step_count, params, slots,
+                              pipeline_state, extra=extra)
+
+    def restore_from(self, checkpointer) -> dict | None:
+        """Load the latest checkpoint's merged view into the cold tier
+        and reset residency; returns the restore dict or None."""
+        params_ex = {
+            "w0": np.zeros((), np.float32),
+            "w": np.zeros((self.spec.num_features,),
+                          self._cold.dtype("w")),
+            "v": np.zeros((self.spec.num_features, self.spec.rank),
+                          self._cold.dtype("v")),
+        }
+        slots_ex = None
+        if self._slot_planes:
+            slots_ex = {}
+            for p in self._slot_planes:
+                table, slot = p.split("_")
+                slots_ex.setdefault(table, {})[slot] = np.zeros(
+                    (self.spec.num_features,)
+                    + self._cold.row_shape(p), np.float32)
+        restored = checkpointer.restore(params_ex, slots_ex)
+        if restored is None:
+            return None
+        params = restored["params"]
+        planes = {"v": np.asarray(params["v"]),
+                  "w": np.asarray(params["w"])}
+        if self._slot_planes:
+            slots = restored["opt_state"]
+            for p in self._slot_planes:
+                table, slot = p.split("_")
+                planes[p] = np.asarray(slots[table][slot])
+        self.store.restore_cold(planes)
+        self.hot = self.store.init_hot()
+        self._w0 = np.asarray(params["w0"])
+        import jax.numpy as jnp
+
+        self._w0 = jnp.asarray(self._w0)
+        self.step_count = int(restored["step"])
+        extra = restored.get("extra") or {}
+        self.loss_history = list(extra.get("loss_history", []))
+        return restored
+
+    def predict(self, ids, vals):
+        """Merged-view prediction (eval convenience; not the serving
+        path — serving keeps its own in-HBM generations)."""
+        merged = self.merged_params()
+        return self.spec.predict(
+            {k: np.asarray(v) for k, v in merged.items()}, ids, vals)

@@ -25,7 +25,14 @@ becomes a query. Three record kinds share one stream:
   full metric dict alongside. Quality legs live in their own
   ``quality/<config>/<optimizer>`` namespace, so model-quality cohorts
   never share a trailing band with any throughput cohort — an AUC
-  series judged by the same sentinel machinery, separately.
+  series judged by the same sentinel machinery, separately;
+- ``embed_bench`` — one bench_embed.py ladder rung (ISSUE 16): the
+  tiered embedding store's gathered-rows/s as the higher-is-better
+  ``value``, with hit rate, eviction count, blocking-stall ms, HBM
+  watermark, and host RSS alongside. Tiered legs carry their own
+  ``embed_rows_<decade>`` leg names — their cohorts NEVER mix with
+  in-HBM training legs, because a tiered rows/s and an in-HBM rows/s
+  price different memory hierarchies (PERF.md round 20).
 
 Every record carries a **measurement fingerprint**
 (:func:`measurement_fingerprint`): the lever-config hash, chip type +

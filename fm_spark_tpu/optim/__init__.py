@@ -274,6 +274,13 @@ def make_sparse_adaptive_step(spec, config, *, beta: float = 1.0,
             "the adaptive step rejects the reg_* triple: FTRL carries "
             "its own proximal l1/l2 and AdaGrad pairs with explicit "
             "weight decay, not lazy L2 — configure l1/l2 here instead")
+    from fm_spark_tpu.sparse import _reject_embed_tier_require
+
+    # TieredTrainer builds THIS step over its hot-tier window with
+    # embed_tier neutralized to 'off'; a bare 'require' here means the
+    # caller skipped the tiered trainer.
+    _reject_embed_tier_require(config, "the bare sparse adaptive step "
+                               "(drive it through embed.TieredTrainer)")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
     alpha = float(config.learning_rate)

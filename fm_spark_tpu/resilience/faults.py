@@ -80,7 +80,16 @@ ENV_STATE = "FM_SPARK_FAULTS_STATE"
 #: fires INSIDE checkpoint.Checkpointer's demotion window — after the
 #: durable tombstone write, before the ``last_good`` republish — so an
 #: ``exit`` there is the SIGKILL-mid-demotion drill and an ``error``
-#: exercises the stale-pointer-but-vetoed recovery path.
+#: exercises the stale-pointer-but-vetoed recovery path. Tiered
+#: embedding store (ISSUE 16): ``embed_prefetch`` fires once per bucket
+#: staging attempt on the prefetch PRODUCER thread
+#: (embed/store.TieredStore.stage) — a ``device_loss`` there is the
+#: device-dies-mid-prefetch chaos drill, and the auditor's contract is
+#: that the dirty-mask flush keeps a post-restore run bit-identical to
+#: a clean one; ``embed_evict`` fires at the START of each eviction's
+#: dirty-bucket flush window (before the cold write-back and version
+#: bump), so an ``exit`` there is the kill-mid-eviction drill — the
+#: merged checkpoint view never depended on the in-flight flush.
 KNOWN_POINTS = (
     "backend_init",
     "sweep_leg",
@@ -92,6 +101,8 @@ KNOWN_POINTS = (
     "serve_reload",
     "online_eval",
     "ckpt_demote",
+    "embed_prefetch",
+    "embed_evict",
 )
 
 #: The action vocabulary (public since ISSUE 10: the chaos schedule

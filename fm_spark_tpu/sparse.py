@@ -489,6 +489,24 @@ def _reject_fused_embed_require(config: TrainConfig, what: str):
             "semantics")
 
 
+def _reject_embed_tier_require(config: TrainConfig, what: str):
+    """Guard for step factories that keep their tables fully
+    HBM-resident: ``embed_tier='auto'`` falls back to in-HBM tables
+    there — queryably, via :func:`fm_spark_tpu.embed.tier_plan` — but
+    an explicit ``'require'`` must hard-fail instead of silently
+    training without the tiered store (the ``fused_embed`` lever's
+    no-silent-fallback rule, applied to the memory hierarchy)."""
+    if config.embed_tier not in ("off", "auto", "require"):
+        raise ValueError(
+            f"unknown embed_tier {config.embed_tier!r} "
+            "(expected 'off', 'auto', or 'require')")
+    if config.embed_tier == "require":
+        raise ValueError(
+            f"embed_tier='require' is served by the tiered flat-FM "
+            f"trainer (fm_spark_tpu.embed.TieredTrainer), not {what}; "
+            "use 'auto' for fallback-to-in-HBM semantics")
+
+
 def _fused_compact_updates(tables, urows, aux, s, dscores, vals_c,
                            touched, config: TrainConfig, sr_base_key,
                            step_idx, lr, k, cd, use_linear: bool):
@@ -613,6 +631,7 @@ def make_field_sparse_sgd_body(spec, config: TrainConfig):
         raise ValueError("dedup/dedup_sr modes require fused_linear=True")
     if config.use_pallas and not spec.fused_linear:
         raise ValueError("use_pallas requires fused_linear=True")
+    _reject_embed_tier_require(config, "the single-chip FieldFM body")
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     if compact and not spec.fused_linear:
@@ -857,6 +876,7 @@ def make_field_ffm_sparse_sgd_body(spec, config: TrainConfig):
     if config.optimizer != "sgd":
         raise ValueError("sparse step implements plain SGD only")
     _reject_gfull(config, "the FieldFFM body")
+    _reject_embed_tier_require(config, "the single-chip FieldFFM body")
     _reject_collective_dtype(config, "the single-chip FieldFFM body")
     _reject_score_sharded(config, "the single-chip FieldFFM body")
     _reject_deep_sharded(config, "the single-chip FieldFFM body")
@@ -1037,6 +1057,7 @@ def make_field_deepfm_sparse_body(spec, config: TrainConfig):
     _reject_sel_blocked(config, "the single-chip FieldDeepFM body")
     _reject_deep_sharded(config, "the single-chip FieldDeepFM body")
     _reject_fused_embed_require(config, "the single-chip FieldDeepFM body")
+    _reject_embed_tier_require(config, "the single-chip FieldDeepFM body")
     _check_host_dedup(config, spec.loss)
     compact = config.compact_cap > 0
     per_example_loss = losses_lib.loss_fn(spec.loss)
@@ -1238,6 +1259,10 @@ def make_sparse_sgd_step(spec, config: TrainConfig):
     _reject_sel_blocked(config, "the single-chip flat-table FM step")
     _reject_deep_sharded(config, "the single-chip flat-table FM step")
     _reject_fused_embed_require(config, "the single-chip flat-table FM step")
+    # NOT the tiered trainer itself: TieredTrainer builds THIS step over
+    # its hot-tier window with embed_tier neutralized to 'off'.
+    _reject_embed_tier_require(config, "the bare flat-table FM step "
+                               "(drive it through embed.TieredTrainer)")
     per_example_loss = losses_lib.loss_fn(spec.loss)
     cd = spec.cdtype
 

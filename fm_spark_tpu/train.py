@@ -194,6 +194,23 @@ class TrainConfig:
     # reference bodies (tests/test_pallas_fused.py); priced per kernel
     # by bench_kernels.py and through the bench.py sweep legs.
     fused_embed: str = "off"
+    # Tiered embedding store (fm_spark_tpu/embed; ROADMAP item 2):
+    #  'off'     — tables fully HBM-resident (default).
+    #  'auto'    — tier when the tiered flat-FM trainer serves this
+    #              (spec, config, strategy) — embed.tier_plan returns
+    #              the verdict and the reason — else fall back to the
+    #              in-HBM path, SAYING so (cli surfaces the reason).
+    #  'require' — hard-fail when the tiered trainer cannot serve
+    #              (fused field families, sharded strategies, non-sparse
+    #              optimizers) — same discipline as fused_embed.
+    # The hot tier holds ``hot_rows`` HBM rows managed as buckets of
+    # ``embed_bucket_rows`` contiguous rows (the residency/eviction/
+    # prefetch unit); all planes — v, w, and the FTRL/AdaGrad z/n slot
+    # tables — share one residency map. Misses that block the step are
+    # counted and timed (embed/stall_ms), never hidden.
+    embed_tier: str = "off"
+    hot_rows: int = 0
+    embed_bucket_rows: int = 512
 
 
 def _group_reg(config: TrainConfig):
@@ -298,6 +315,10 @@ def make_train_step(spec, config: TrainConfig, optimizer=None):
     _reject_deep_sharded(config, "the dense single-device train step")
     _reject_sel_blocked(config, "the dense single-device train step")
     _reject_fused_embed_require(
+        config, "the dense single-device train step")
+    from fm_spark_tpu.sparse import _reject_embed_tier_require
+
+    _reject_embed_tier_require(
         config, "the dense single-device train step")
     optimizer = optimizer or make_optimizer(config)
     per_example_loss = losses_lib.loss_fn(spec.loss)

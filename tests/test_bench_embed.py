@@ -1,0 +1,94 @@
+"""Acceptance: the bench_embed ladder's tiny CPU smoke, end to end.
+
+ISSUE 16's CI wiring: ``bench_embed.py --scale tiny`` runs the REAL
+ladder code path (tiered trainer + prefetcher + eviction churn + the
+bitwise parity differential + ledger/sentinel/cost records) over a
+small feature axis, so tier-1 exercises everything but the scale. One
+subprocess run, then structural asserts over its JSON result and the
+ledger rows it appended.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_run(tmp_path_factory):
+    art = tmp_path_factory.mktemp("embed_art")
+    out = art / "result.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_embed.py"),
+         "--scale", "tiny", "--art-dir", str(art), "--out", str(out)],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"bench_embed tiny smoke failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")
+    result = json.loads(out.read_text())
+    return art, result
+
+
+def test_tiny_ladder_measures_every_rung(tiny_run):
+    _, result = tiny_run
+    assert result["bench"] == "embed"
+    assert len(result["rungs"]) == len(result["decades"]) >= 2
+    for rung in result["rungs"]:
+        assert rung["leg"].startswith("embed_rows_")
+        assert rung["rows_per_sec"] > 0
+        assert 0.0 < rung["hit_rate"] <= 1.0
+        # The tiny smoke is sized to cross hot capacity: the evict/flush
+        # path runs, it is not just an install benchmark.
+        assert rung["evictions"] > 0
+        assert rung["host_rss_bytes"] > 0
+
+
+def test_tiny_ladder_asserts_bitwise_parity(tiny_run):
+    _, result = tiny_run
+    assert result["parity_checked"] and result["parity_ok"]
+    checked = [r for r in result["rungs"] if r["parity_checked"]]
+    assert checked and all(r["parity_ok"] for r in checked)
+
+
+def test_tiny_ladder_bounds_host_rss_via_lazy_cold(tiny_run):
+    """Rungs above --parity-max run the lazy cold store: materialized
+    cold bytes must track the TOUCHED buckets, not the feature axis."""
+    _, result = tiny_run
+    lazy = [r for r in result["rungs"] if r["cold_mode"] == "lazy"]
+    assert lazy, "tiny ladder must include a lazy (beyond-parity) rung"
+    for rung in lazy:
+        full_axis = rung["num_features"] * 4  # >= 4 bytes/row just for w
+        assert rung["cold_host_bytes"] < full_axis
+        assert rung["touched_buckets"] < rung["num_features"] // result[
+            "bucket_rows"]
+
+
+def test_tiny_ladder_writes_embed_bench_and_cost_records(tiny_run):
+    art, result = tiny_run
+    ledger = os.path.join(str(art), "obs", "ledger.jsonl")
+    records = []
+    with open(ledger) as f:
+        for line in f:
+            records.append(json.loads(line))
+    embed = [r for r in records if r["kind"] == "embed_bench"]
+    cost = [r for r in records if r["kind"] == "cost_attribution"]
+    assert {r["leg"] for r in embed} == {
+        r["leg"] for r in result["rungs"]}
+    for r in embed:
+        # The embed_bench cohort contract: own leg namespace, full
+        # provenance, rows/s as the higher-is-better value.
+        assert r["leg"].startswith("embed_rows_")
+        assert r["fingerprint"]["key"]
+        assert r["value"] > 0 and r["unit"] == "rows/s"
+        assert "hit_rate" in r and "stall_ms" in r
+    assert {r["leg"] for r in cost} == {
+        f"cost/{r['leg']}" for r in result["rungs"]}
+    for r in cost:
+        fams = r["families"]
+        assert fams["h2d_bucket_install"] > 0
+        assert r["bytes_per_step"] > 0 and r["assumptions"]

@@ -1,0 +1,310 @@
+"""Unit tests for the two-tier embedding store (ISSUE 16).
+
+ColdStore (dense + lazy materialization), TieredStore's residency
+protocol (install / LRU-by-batch evict / dirty flush / version-checked
+staging / capacity guard / pure merged view), and the BucketPrefetcher
+producer contract. The trainer-level bitwise differentials live in
+tests/test_embed_tier.py; this file holds the protocol to its contract
+one transition at a time.
+"""
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu.embed import BucketPrefetcher, ColdStore, TieredStore
+
+R = 4          # bucket_rows
+N_ROWS = 32    # 8 buckets
+HOT = 2        # hot-tier capacity in buckets
+
+
+def make_dense(n_rows=N_ROWS, bucket_rows=R):
+    """One rank-2 plane ('v') + one rank-1 plane ('w') with
+    row-identifying values, so any aliasing or misplaced install is
+    visible in the bytes."""
+    v = (np.arange(n_rows, dtype=np.float32)[:, None]
+         + np.array([0.0, 0.25], np.float32)[None, :])
+    w = np.arange(n_rows, dtype=np.float32) * 10.0
+    return ColdStore.dense({"v": v.copy(), "w": w.copy()}, bucket_rows)
+
+
+def gather_hot(store, hot, local_ids):
+    return np.asarray(hot["v"])[np.asarray(local_ids).ravel()]
+
+
+# --------------------------------------------------------------- ColdStore
+
+
+def test_cold_dense_bucket_roundtrip_and_copy_semantics():
+    cold = make_dense()
+    blk = cold.read_bucket("v", 2)
+    assert blk.shape == (R, 2)
+    assert np.array_equal(blk[:, 0], np.arange(8, 12, dtype=np.float32))
+    # read_bucket hands out a COPY: mutating it must not reach the store.
+    blk[...] = -1.0
+    assert cold.read_bucket("v", 2)[0, 0] == 8.0
+    cold.write_bucket("v", 2, blk)
+    assert np.all(cold.read_bucket("v", 2) == -1.0)
+    # Other buckets untouched by the write.
+    assert cold.read_bucket("v", 3)[0, 0] == 12.0
+
+
+def test_cold_dense_rejects_ragged_axis():
+    with pytest.raises(ValueError, match="must divide"):
+        ColdStore.dense({"v": np.zeros((30, 2), np.float32)}, R)
+    with pytest.raises(ValueError, match="rows"):
+        ColdStore({"v": np.zeros((32, 2), np.float32),
+                   "w": np.zeros((28,), np.float32)}, R, 32)
+
+
+def test_cold_lazy_materializes_on_touch_deterministically():
+    calls = []
+
+    def init(plane, bucket, shape, dtype):
+        calls.append((plane, bucket))
+        return np.full(shape, float(bucket), dtype)
+
+    cold = ColdStore.lazy({"v": ((2,), np.dtype(np.float32))}, R, N_ROWS,
+                          init)
+    assert cold.is_lazy
+    assert cold.host_bytes() == 0 and cold.touched_buckets() == 0
+    a = cold.read_bucket("v", 3)
+    b = cold.read_bucket("v", 3)
+    assert np.array_equal(a, b) and np.all(a == 3.0)
+    # Materialized once; the second read served from the held block.
+    assert calls == [("v", 3)]
+    assert cold.touched_buckets() == 1
+    assert cold.host_bytes() == R * 2 * 4
+    # Host RSS tracks the TOUCHED set, and the full axis never exists:
+    with pytest.raises(ValueError, match="lazy"):
+        cold.dense_plane("v")
+
+
+def test_cold_lazy_write_back_overrides_init():
+    cold = ColdStore.lazy({"v": ((2,), np.dtype(np.float32))}, R, N_ROWS,
+                          lambda p, b, s, d: np.zeros(s, d))
+    cold.write_bucket("v", 5, np.full((R, 2), 7.0, np.float32))
+    assert np.all(cold.read_bucket("v", 5) == 7.0)
+
+
+# -------------------------------------------------------------- TieredStore
+
+
+def test_begin_batch_installs_and_translates_ids():
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    ids = np.array([[0, 5], [6, 1]], np.int32)  # buckets {0, 1}
+    local, hot = store.begin_batch(ids, hot)
+    assert local.shape == ids.shape
+    # The gathered hot rows are exactly the cold rows of the global ids.
+    want = np.stack([cold.read_bucket("v", g // R)[g % R]
+                     for g in ids.ravel()])
+    assert np.array_equal(gather_hot(store, hot, local), want)
+    st = store.stats()
+    assert st["misses"] == 2 and st["evictions"] == 0
+    assert st["stall_ms"] > 0.0  # blocking misses are timed, not hidden
+
+
+def test_capacity_guard_names_the_working_set():
+    store = TieredStore(make_dense(), HOT)
+    hot = store.init_hot()
+    ids = np.array([0, 4, 8], np.int64)  # 3 buckets > HOT=2
+    with pytest.raises(ValueError, match="working set"):
+        store.begin_batch(ids, hot)
+
+
+def test_lru_eviction_flushes_dirty_rows_to_cold():
+    import jax.numpy as jnp
+
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    _, hot = store.begin_batch(np.array([0, 4], np.int64), hot)  # b0, b1
+    # Simulate the train step's write-through: hot rows change in place.
+    hot = dict(hot, v=jnp.asarray(hot["v"]) + 100.0)
+    # Touch bucket 1 again so bucket 0 is strictly least-recent.
+    _, hot = store.begin_batch(np.array([4], np.int64), hot)
+    before = cold.read_bucket("v", 0).copy()
+    _, hot = store.begin_batch(np.array([8], np.int64), hot)  # forces evict
+    st = store.stats()
+    assert st["evictions"] == 1 and st["bytes_d2h"] > 0
+    after = cold.read_bucket("v", 0)
+    # Bucket 0 (the LRU victim) took the +100 write-back; bucket 1 is
+    # still resident so its cold rows are untouched.
+    assert np.array_equal(after, before + 100.0)
+    assert cold.read_bucket("v", 1)[0, 0] == 4.0
+
+
+def test_stage_then_install_is_a_staged_hit():
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    assert store.stage(np.array([8, 9], np.int64)) == 1  # bucket 2
+    assert store.stage(np.array([8], np.int64)) == 0     # already staged
+    local, hot = store.begin_batch(np.array([8], np.int64), hot)
+    st = store.stats()
+    assert st["staged_hits"] == 1 and st["misses"] == 0
+    assert st["hit_rate"] == 1.0
+    assert gather_hot(store, hot, local)[0, 0] == 8.0
+
+
+def test_stage_skips_resident_buckets():
+    store = TieredStore(make_dense(), HOT)
+    hot = store.init_hot()
+    _, hot = store.begin_batch(np.array([0], np.int64), hot)
+    assert store.stage(np.array([0, 1, 2], np.int64)) == 0
+
+
+def test_stale_staged_buffer_is_discarded_not_installed():
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    store.stage(np.array([12], np.int64))  # bucket 3 staged at version 0
+    # Simulate the race the version check exists for: bucket 3's cold
+    # block advances (an eviction flush elsewhere would bump it) after
+    # the producer's read but before install.
+    cold.write_bucket("v", 3, np.full((R, 2), -5.0, np.float32))
+    with store._lock:
+        store._version[3] = store._version.get(3, 0) + 1
+    local, hot = store.begin_batch(np.array([12], np.int64), hot)
+    st = store.stats()
+    assert st["prefetch_stale"] == 1 and st["misses"] == 1
+    # The fresh post-bump rows landed, not the stale staged buffer.
+    assert gather_hot(store, hot, local)[0, 0] == -5.0
+
+
+def test_eviction_invalidates_staged_buffer_by_construction():
+    import jax.numpy as jnp
+
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    _, hot = store.begin_batch(np.array([0, 4], np.int64), hot)
+    hot = dict(hot, v=jnp.asarray(hot["v"]) + 1.0)
+    store.stage(np.array([8], np.int64))          # bucket 2 staged
+    _, hot = store.begin_batch(np.array([8], np.int64), hot)  # evicts b0
+    assert store.stats()["staged_hits"] == 1
+    # Bucket 0 was flushed (version bumped); restaging reads the
+    # post-flush rows, so the next install round-trips the update.
+    store.stage(np.array([0], np.int64))
+    local, hot = store.begin_batch(np.array([0], np.int64), hot)
+    assert gather_hot(store, hot, local)[0, 0] == 1.0
+
+
+def test_merged_planes_is_pure_and_residency_independent():
+    import jax.numpy as jnp
+
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    _, hot = store.begin_batch(np.array([0, 4], np.int64), hot)
+    hot = dict(hot, v=jnp.asarray(hot["v"]) + 100.0,
+               w=jnp.asarray(hot["w"]) + 1.0)
+    cold_v_before = cold.dense_plane("v").copy()
+    merged = store.merged_planes(hot)
+    # Dirty resident buckets come from hot; the rest from cold.
+    assert np.array_equal(merged["v"][:R], cold_v_before[:R] + 100.0)
+    assert np.array_equal(merged["v"][2 * R:], cold_v_before[2 * R:])
+    assert np.array_equal(merged["w"][:R],
+                          np.arange(R, dtype=np.float32) * 10.0 + 1.0)
+    # PURE: the live cold arrays and the dirty mask are untouched, so a
+    # checkpoint save never perturbs the protocol state.
+    assert np.array_equal(cold.dense_plane("v"), cold_v_before)
+    merged2 = store.merged_planes(hot)
+    assert np.array_equal(merged["v"], merged2["v"])
+
+
+def test_restore_cold_resets_residency_and_invalidates_staging():
+    cold = make_dense()
+    store = TieredStore(cold, HOT)
+    hot = store.init_hot()
+    _, hot = store.begin_batch(np.array([0, 4], np.int64), hot)
+    store.stage(np.array([8], np.int64))
+    new_v = np.full((N_ROWS, 2), 9.0, np.float32)
+    new_w = np.full((N_ROWS,), 9.0, np.float32)
+    store.restore_cold({"v": new_v, "w": new_w})
+    hot = store.init_hot()
+    local, hot = store.begin_batch(np.array([0, 8], np.int64), hot)
+    # Both the formerly-resident and the formerly-staged bucket re-fault
+    # from the RESTORED rows, never from pre-restore buffers.
+    assert np.all(gather_hot(store, hot, local) == 9.0)
+
+
+def test_tiered_store_rejects_zero_capacity():
+    with pytest.raises(ValueError, match="hot_buckets"):
+        TieredStore(make_dense(), 0)
+
+
+# ---------------------------------------------------------- BucketPrefetcher
+
+
+class _ListBatches:
+    """Finite (ids, vals, labels, weights) source for prefetcher tests."""
+
+    def __init__(self, batches):
+        self._batches = batches
+
+    def __iter__(self):
+        return iter(self._batches)
+
+
+def _batch(ids):
+    ids = np.asarray(ids, np.int32)
+    return (ids, np.ones_like(ids, np.float32),
+            np.zeros(len(ids), np.float32), np.ones(len(ids), np.float32))
+
+
+def test_prefetcher_yields_batches_in_order_and_stages_ahead():
+    store = TieredStore(make_dense(), HOT)
+    hot = store.init_hot()
+    batches = [_batch([0, 1]), _batch([4, 5]), _batch([4, 0])]
+    pf = BucketPrefetcher(_ListBatches(batches), store, depth=2)
+    seen = []
+    for b in pf:
+        local, hot = store.begin_batch(b[0], hot)
+        seen.append(b[0])
+    pf.close()
+    assert [tuple(s) for s in seen] == [(0, 1), (4, 5), (4, 0)]
+    st = store.stats()
+    # Every install was producer-staged: zero blocking misses.
+    assert st["misses"] == 0 and st["staged_hits"] == 2
+    assert st["hit_rate"] == 1.0
+
+
+def test_prefetcher_reraises_producer_exception():
+    class Boom(Exception):
+        pass
+
+    def gen():
+        yield _batch([0])
+        raise Boom("upstream died")
+
+    store = TieredStore(make_dense(), HOT)
+    pf = BucketPrefetcher(gen(), store, depth=2)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(Boom):
+        next(it)
+    pf.close()
+
+
+def test_prefetcher_close_is_idempotent_and_unblocks_producer():
+    def gen():
+        i = 0
+        while True:  # infinite upstream — close() must still return
+            yield _batch([i % N_ROWS])
+            i += 1
+
+    store = TieredStore(make_dense(), HOT)
+    pf = BucketPrefetcher(gen(), store, depth=2)
+    next(iter(pf))
+    pf.close()
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_rejects_zero_depth():
+    with pytest.raises(ValueError, match="depth"):
+        BucketPrefetcher(_ListBatches([]), TieredStore(make_dense(), HOT),
+                         depth=0)

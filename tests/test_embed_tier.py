@@ -1,0 +1,353 @@
+"""Tiered flat-FM trainer: bitwise differentials, crash drills, levers.
+
+The ISSUE-16 acceptance tests. The tiered path's whole claim is that it
+changes WHERE rows live, never what the step computes — so every
+differential here asserts ``np.array_equal`` (bitwise), not allclose:
+
+- tiered == untiered when the hot tier fits the entire working set
+  (zero evictions — the cache is pure overhead accounting);
+- tiered == untiered under eviction CHURN (a drifting id window forces
+  dirty flushes and re-installs mid-run), for SGD and for the
+  FTRL/AdaGrad slot-table planes riding the same residency map;
+- a run killed mid-eviction (``embed_evict`` fault) resumes from its
+  checkpoint bit-identical to the uninterrupted run — the merged
+  checkpoint view never depends on an in-flight flush;
+- a device loss mid-prefetch (``embed_prefetch`` fault on the producer
+  thread) surfaces, and the restart is bit-identical too.
+
+Plus the lever plumbing: ``tier_plan`` verdicts and the
+``embed_tier='require'`` reject discipline on every non-tiered factory.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from fm_spark_tpu import models, optim, sparse
+from fm_spark_tpu.checkpoint import Checkpointer
+from fm_spark_tpu.embed import TIERABLE_OPTIMIZERS, TieredTrainer, tier_plan
+from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.train import TrainConfig, make_train_step
+
+N_FEATURES = 2048
+BUCKET_ROWS = 128            # 16 buckets
+N_BUCKETS = N_FEATURES // BUCKET_ROWS
+NNZ = 4
+BATCH = 32
+
+
+def make_spec():
+    return models.FMSpec(num_features=N_FEATURES, rank=4, init_std=0.05)
+
+
+def make_config(optimizer="sgd", hot_buckets=4, num_steps=12,
+                embed_tier="require"):
+    return TrainConfig(
+        num_steps=num_steps, batch_size=BATCH, learning_rate=0.1,
+        optimizer=optimizer, lr_schedule="constant", log_every=1000,
+        embed_tier=embed_tier, hot_rows=hot_buckets * BUCKET_ROWS,
+        embed_bucket_rows=BUCKET_ROWS, seed=0,
+    )
+
+
+class SkewedBatches:
+    """Deterministic, resumable batch source with a bucket-local window.
+
+    Each batch's ids land in ``window`` consecutive buckets; the window
+    drifts one bucket every ``drift_every`` batches. Batch ``i`` is a
+    pure function of ``(seed, i)``, so a restored cursor replays the
+    exact stream — the property the kill/resume drills lean on. The
+    window (not uniform ids) is what keeps a batch's working set inside
+    the hot tier: ``begin_batch`` hard-fails otherwise, by design.
+    """
+
+    def __init__(self, window=3, drift_every=2, seed=11):
+        self.window = window
+        self.drift_every = drift_every
+        self.seed = seed
+        self.i = 0
+
+    def state(self):
+        return {"i": self.i}
+
+    def restore(self, st):
+        self.i = int(st["i"])
+
+    def _batch(self, i):
+        rng = np.random.default_rng(np.random.SeedSequence([self.seed, i]))
+        base = (i // self.drift_every) % (N_BUCKETS - self.window)
+        buckets = rng.integers(base, base + self.window, (BATCH, NNZ))
+        offs = rng.integers(0, BUCKET_ROWS, (BATCH, NNZ))
+        ids = (buckets * BUCKET_ROWS + offs).astype(np.int32)
+        vals = rng.normal(0.0, 1.0, (BATCH, NNZ)).astype(np.float32)
+        labels = (rng.random(BATCH) < 0.4).astype(np.float32)
+        weights = np.ones(BATCH, np.float32)
+        return ids, vals, labels, weights
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        b = self._batch(self.i)
+        self.i += 1
+        return b
+
+
+def untiered_run(spec, config, num_steps, **adaptive_kw):
+    """The stock in-HBM trajectory over the same stream — the bitwise
+    reference every tiered run is held to."""
+    import jax
+
+    cfg_off = dataclasses.replace(config, embed_tier="off")
+    params = spec.init(jax.random.key(config.seed))
+    src = SkewedBatches()
+    losses = []
+    if config.optimizer == "sgd":
+        step = sparse.make_sparse_sgd_step(spec, cfg_off)
+        for i in range(num_steps):
+            ids, vals, labels, w = next(src)
+            params, loss = step(params, i, ids, vals, labels, w)
+            losses.append(float(loss))
+        return params, None, losses
+    slots = optim.init_adaptive_slots(config.optimizer, spec, params)
+    if config.optimizer == "ftrl":
+        slots = optim.seed_ftrl_slots(
+            slots, params, float(config.learning_rate),
+            adaptive_kw.get("beta", 1.0))
+    step = optim.make_sparse_adaptive_step(spec, cfg_off, **adaptive_kw)
+    for _ in range(num_steps):
+        ids, vals, labels, w = next(src)
+        params, slots, loss = step(params, slots, ids, vals, labels, w)
+        losses.append(float(loss))
+    return params, slots, losses
+
+
+def assert_params_equal(tiered, reference):
+    for k in ("w0", "w", "v"):
+        assert np.array_equal(np.asarray(tiered[k]),
+                              np.asarray(reference[k])), (
+            f"tiered plane {k!r} diverged from the in-HBM reference")
+
+
+def assert_slots_equal(tiered, reference):
+    for table in reference:
+        for slot in reference[table]:
+            assert np.array_equal(np.asarray(tiered[table][slot]),
+                                  np.asarray(reference[table][slot])), (
+                f"slot plane {table}.{slot} diverged")
+
+
+# ------------------------------------------------------ bitwise differentials
+
+
+def test_tiered_sgd_bitwise_when_hot_fits_working_set():
+    """Hot tier sized over the whole touched set: zero evictions, and
+    the trajectory is bitwise the untiered one."""
+    spec = make_spec()
+    config = make_config("sgd", hot_buckets=6, num_steps=8)
+    trainer = TieredTrainer(spec, config)
+    src = SkewedBatches(drift_every=10 ** 9)  # static 3-bucket window
+    for _ in range(8):
+        trainer.step_batch(*next(src))
+    assert trainer.store.stats()["evictions"] == 0
+
+    import jax
+
+    ref = spec.init(jax.random.key(config.seed))
+    step = sparse.make_sparse_sgd_step(
+        spec, dataclasses.replace(config, embed_tier="off"))
+    ref_src = SkewedBatches(drift_every=10 ** 9)
+    for i in range(8):
+        ids, vals, labels, w = next(ref_src)
+        ref, _ = step(ref, i, ids, vals, labels, w)
+    assert_params_equal(trainer.merged_params(), ref)
+
+
+def test_tiered_sgd_bitwise_under_eviction_churn():
+    """Hot tier sized to FORCE churn (4 buckets vs a drifting window):
+    evictions/flushes/re-installs happen mid-run and the result is
+    still bitwise identical — with the async prefetcher in the loop."""
+    spec = make_spec()
+    config = make_config("sgd", hot_buckets=4, num_steps=12)
+    trainer = TieredTrainer(spec, config)
+    trainer.fit(SkewedBatches(), num_steps=12, prefetch=3)
+    st = trainer.store.stats()
+    assert st["evictions"] > 0, "churn sizing failed to force evictions"
+    # The prefetcher staged re-installs ahead (staged hits, not
+    # blocking misses) — that is the point of the pipeline.
+    assert st["staged_hits"] > 0 and st["hit_rate"] > 0.0
+
+    ref_params, _, ref_losses = untiered_run(spec, config, 12)
+    assert_params_equal(trainer.merged_params(), ref_params)
+    assert trainer.loss_history == ref_losses
+
+
+@pytest.mark.parametrize("optimizer", ["ftrl", "adagrad"])
+def test_tiered_adaptive_bitwise_under_churn(optimizer):
+    """The FTRL/AdaGrad slot tables (z/n) ride the SAME residency map:
+    params AND slots bitwise-match the untiered run under churn."""
+    spec = make_spec()
+    config = make_config(optimizer, hot_buckets=4, num_steps=10)
+    src = SkewedBatches()
+    trainer = TieredTrainer(spec, config, beta=1.0)
+    for _ in range(10):
+        trainer.step_batch(*next(src))
+    assert trainer.store.stats()["evictions"] > 0
+
+    ref_params, ref_slots, ref_losses = untiered_run(
+        spec, config, 10, beta=1.0)
+    assert_params_equal(trainer.merged_params(), ref_params)
+    assert_slots_equal(trainer.merged_slots(), ref_slots)
+    assert trainer.loss_history == ref_losses
+
+
+# ------------------------------------------------------------- crash drills
+
+
+def test_kill_mid_eviction_resumes_bitwise(tmp_path):
+    """The ``embed_evict`` fault fires BEFORE an eviction's dirty
+    write-back — the kill-mid-eviction window. A resumed run must land
+    bitwise on the uninterrupted trajectory: the merged checkpoint view
+    never depended on the in-flight flush."""
+    spec = make_spec()
+    config = make_config("ftrl", hot_buckets=4, num_steps=14)
+    golden_params, golden_slots, golden_losses = untiered_run(
+        spec, config, 14, beta=1.0)
+
+    ckdir = str(tmp_path / "ck")
+    t1 = TieredTrainer(spec, config, beta=1.0)
+    ck1 = Checkpointer(ckdir, save_every=4, async_save=False)
+    faults.activate("embed_evict@5=error")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            t1.fit(SkewedBatches(), num_steps=14, checkpointer=ck1)
+    finally:
+        faults.clear()
+    killed_at = t1.step_count
+    assert 0 < killed_at < 14, "fault must interrupt mid-run"
+    ck1.close()
+    assert os.listdir(ckdir), "no checkpoint survived the kill"
+    del t1
+
+    t2 = TieredTrainer(spec, config, beta=1.0)
+    ck2 = Checkpointer(ckdir, save_every=4, async_save=False)
+    t2.fit(SkewedBatches(), num_steps=14, checkpointer=ck2)
+    ck2.close()
+    assert t2.step_count == 14
+    assert_params_equal(t2.merged_params(), golden_params)
+    assert_slots_equal(t2.merged_slots(), golden_slots)
+    assert t2.loss_history[-1] == golden_losses[-1]
+
+
+def test_device_loss_mid_prefetch_restarts_bitwise(tmp_path):
+    """Chaos drill: the ``embed_prefetch`` fault point kills the device
+    on the producer thread mid-staging. The loss surfaces at the
+    consumer (never swallowed), and the dirty-mask flush discipline
+    keeps the restored run bit-identical to a clean one."""
+    spec = make_spec()
+    config = make_config("sgd", hot_buckets=4, num_steps=14)
+    golden_params, _, golden_losses = untiered_run(spec, config, 14)
+
+    ckdir = str(tmp_path / "ck")
+    t1 = TieredTrainer(spec, config)
+    ck1 = Checkpointer(ckdir, save_every=4, async_save=False)
+    faults.activate("embed_prefetch@7=device_loss")
+    try:
+        with pytest.raises(faults.FaultInjected) as ei:
+            t1.fit(SkewedBatches(), num_steps=14, checkpointer=ck1,
+                   prefetch=2)
+    finally:
+        faults.clear()
+    assert faults.is_device_loss(ei.value)
+    assert 0 < t1.step_count < 14
+    ck1.close()
+    del t1
+
+    t2 = TieredTrainer(spec, config)
+    ck2 = Checkpointer(ckdir, save_every=4, async_save=False)
+    t2.fit(SkewedBatches(), num_steps=14, checkpointer=ck2, prefetch=2)
+    ck2.close()
+    assert t2.step_count == 14
+    assert_params_equal(t2.merged_params(), golden_params)
+    assert t2.loss_history[-1] == golden_losses[-1]
+
+
+def test_embed_fault_points_registered():
+    """Both tier fault points are first-class registry members (the
+    fmlint registry-coverage rule requires every point to be exercised
+    by name in tests/ — this file is that exercise)."""
+    assert {"embed_prefetch", "embed_evict"} <= set(faults.KNOWN_POINTS)
+
+
+# ------------------------------------------------------------ lever plumbing
+
+
+def test_tier_plan_verdicts():
+    spec = make_spec()
+    mode, reason = tier_plan(spec, make_config("sgd"), "single")
+    assert mode == "tiered" and "hot" in reason
+    # Every refusal names its reason — the no-silent-fallback contract.
+    for config, strategy, frag in [
+        (make_config("sgd", embed_tier="off"), "single", "does not ask"),
+        (make_config("adam"), "single", "no tiered sparse step"),
+        (make_config("sgd"), "sharded", "single-attachment"),
+        (make_config("sgd", hot_buckets=0), "single", "unset"),
+        (make_config("sgd", hot_buckets=N_BUCKETS), "single",
+         "nothing to tier"),
+    ]:
+        mode, reason = tier_plan(spec, config, strategy)
+        assert mode is None and frag in reason
+    mode, reason = tier_plan(
+        dataclasses.replace(make_config("sgd"), hot_rows=100),
+        make_config("sgd"), "single")  # wrong spec type
+    assert mode is None
+
+
+def test_tierable_optimizers_are_the_sparse_step_families():
+    assert TIERABLE_OPTIMIZERS == ("sgd", "ftrl", "adagrad")
+
+
+def test_require_rejected_by_every_non_tiered_factory():
+    """embed_tier='require' must fail LOUDLY everywhere except the
+    tiered trainer itself — same discipline as fused_embed."""
+    spec = make_spec()
+    config = make_config("sgd")
+    with pytest.raises(ValueError, match="TieredTrainer"):
+        make_train_step(spec, config)
+    with pytest.raises(ValueError, match="TieredTrainer"):
+        sparse.make_sparse_sgd_step(spec, config)
+    with pytest.raises(ValueError, match="TieredTrainer"):
+        optim.make_sparse_adaptive_step(spec, make_config("ftrl"))
+    fspec = models.FieldFMSpec(
+        num_features=768, num_fields=3, bucket=256, rank=4, init_std=0.05)
+    with pytest.raises(ValueError, match="TieredTrainer"):
+        sparse.make_field_sparse_sgd_body(
+            fspec, dataclasses.replace(config, hot_rows=256))
+
+
+def test_trainer_validates_its_config():
+    spec = make_spec()
+    with pytest.raises(ValueError, match="auto.*require"):
+        TieredTrainer(spec, make_config("sgd", embed_tier="off"))
+    with pytest.raises(ValueError, match="sparse step"):
+        TieredTrainer(spec, make_config("adam"))
+    with pytest.raises(ValueError, match="hot_rows > 0"):
+        TieredTrainer(spec, make_config("sgd", hot_buckets=0))
+    with pytest.raises(ValueError, match="divide"):
+        TieredTrainer(spec, dataclasses.replace(
+            make_config("sgd"), hot_rows=BUCKET_ROWS + 1))
+    with pytest.raises(ValueError, match="nothing to tier"):
+        TieredTrainer(spec, make_config("sgd", hot_buckets=N_BUCKETS))
+    fspec = models.FieldFMSpec(
+        num_features=768, num_fields=3, bucket=256, rank=4, init_std=0.05)
+    with pytest.raises(ValueError, match="flat FM"):
+        TieredTrainer(fspec, make_config("sgd"))
+
+
+def test_invalid_embed_tier_value_rejected():
+    spec = make_spec()
+    config = dataclasses.replace(make_config("sgd"), embed_tier="maybe")
+    with pytest.raises(ValueError, match="embed_tier"):
+        sparse.make_sparse_sgd_step(spec, config)

@@ -1,0 +1,89 @@
+"""Pin the bucket hash's collision curve to the uniform expectation.
+
+ISSUE 16's hash-audit satellite: the bench_embed ladder's quality claim
+rests on ``murmur3_u64(token) % m`` behaving like a uniform hash at
+every decade of the feature axis. ``tools/hash_audit.py`` measures the
+per-decade collision rate; this test pins the measurement to the
+analytic expected curve so the claim is continuously CHECKED — a hash
+regression (or a broken murmur re-implementation) fails tier-1, it
+does not quietly degrade the 1B rung.
+"""
+
+import importlib.util
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "hash_audit_tool", os.path.join(REPO, "tools", "hash_audit.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tool():
+    return _load_tool()
+
+
+def test_expected_curve_matches_closed_form(tool):
+    # n=2 tokens, m=4 buckets: the second token collides w.p. 1/4, so
+    # the expected colliding fraction is exactly 0.25/2 = 0.125.
+    assert tool.expected_collision_fraction(2, 4) == pytest.approx(0.125)
+
+
+def test_expected_curve_matches_poisson_model_at_ladder_decades(tool):
+    """Independent derivation: with Poisson(n/m) bucket occupancy the
+    expected colliding fraction is ``1 − (m/n)(1 − e^{−n/m})``. The
+    tool's exact binomial curve must agree at every ladder decade."""
+    n = 1_000_000
+    for m in tool.DECADES:
+        poisson = 1.0 - (m / n) * (1.0 - math.exp(-n / m))
+        assert tool.expected_collision_fraction(n, m) == pytest.approx(
+            poisson, rel=1e-3)
+    # And the small-load approximation n/(2m) anchors the magnitudes
+    # the PERF.md round-20 note quotes: ~5% at 10M, ~0.05% at 1B.
+    assert tool.expected_collision_fraction(n, 10 ** 7) == pytest.approx(
+        0.05, rel=0.05)
+    assert tool.expected_collision_fraction(n, 10 ** 9) == pytest.approx(
+        5e-4, rel=0.05)
+
+
+def test_measured_collisions_track_expectation_per_decade(tool):
+    """The pinned curve: the PRODUCTION hash's measured collision rate
+    sits on the uniform expectation (ratio ≈ 1) at scaled-down decades
+    spanning two orders of magnitude. Tokens-per-decade is sized so the
+    expected collision count is in the hundreds — tight enough that a
+    biased hash shows up, large enough that Poisson noise does not."""
+    for m in (100_000, 1_000_000):
+        row = tool.audit_decade(n_tokens=50_000, m=m, seed=0)
+        assert row["colliding_tokens"] > 0
+        assert 0.7 < row["ratio_vs_uniform"] < 1.3, row
+
+
+def test_cli_gate_passes_on_production_hash(tool, capsys):
+    rc = tool.main(["--tokens", "30000", "--decades", "60000,600000"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"]
+    assert [r["buckets"] for r in out["rows"]] == [60000, 600000]
+    assert out["worst_ratio_vs_uniform"] <= 1.25
+
+
+def test_cli_gate_fails_on_a_broken_hash(tool, capsys, monkeypatch):
+    """A clustering hash (mod a small prime) must blow the gate — the
+    auditor detects a broken hash, it does not just restate one."""
+    import fm_spark_tpu.data.hashing as hashing
+
+    monkeypatch.setattr(hashing, "murmur3_u64",
+                        lambda tokens: np.asarray(tokens) % np.uint64(97))
+    rc = tool.main(["--tokens", "20000", "--decades", "100000"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not out["ok"]
+    assert out["worst_ratio_vs_uniform"] > 1.25

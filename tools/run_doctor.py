@@ -118,6 +118,64 @@ def _quality_rows(ledger_path: str, run_id: str) -> list[dict]:
                                               run_id=run_id)
 
 
+def _embed_rows(ledger_path: str, run_id: str) -> list[dict]:
+    """This run's embed_bench ledger records (ISSUE 16): the tiered
+    embedding store's ladder rungs."""
+    lg = _load_file(os.path.join(_REPO, "fm_spark_tpu", "obs",
+                                 "ledger.py"), "_doctor_ledger")
+    return lg.PerfLedger(ledger_path).records(kind="embed_bench",
+                                              run_id=run_id)
+
+
+def embed_diagnose(run: dict, embed_rows: list[dict]) -> dict | None:
+    """The tiered-embedding view of a run (ISSUE 16): hot-tier hit
+    rate / eviction / blocking-stall gauges plus this run's
+    ``embed_bench`` ladder rungs. ``None`` when the run has no
+    embedding-tier footprint (the gauges only exist once a
+    TieredStore served a batch)."""
+    snap = run.get("snapshot") or {}
+    gauges = snap.get("gauges") or {}
+    has_embed = bool(embed_rows or "embed/hit_rate" in gauges)
+    if not has_embed:
+        return None
+    return {
+        "hit_rate": gauges.get("embed/hit_rate"),
+        "evictions": gauges.get("embed/evictions"),
+        "stall_ms": gauges.get("embed/stall_ms"),
+        "rows": embed_rows,
+    }
+
+
+def embed_findings(embed: dict | None) -> list[str]:
+    if embed is None:
+        return []
+    out = []
+    hr = embed.get("hit_rate")
+    if hr is not None and hr < 0.5:
+        out.append(
+            f"embed-tier hit rate {hr:.3f} — the hot tier is thrashing "
+            "(working set or drift outruns capacity); raise --hot-rows "
+            "or shrink --embed-bucket-rows")
+    stall = embed.get("stall_ms")
+    if stall is not None and stall > 0:
+        out.append(
+            f"embed-tier blocking stalls {stall:.1f} ms — misses the "
+            "prefetcher did not hide (counted, never hidden); deepen "
+            "--prefetch or slow the working-set drift")
+    for r in embed.get("rows") or []:
+        if r.get("parity_ok") is False:
+            out.append(
+                f"embed_bench {r.get('leg')}: tiered/untiered parity "
+                "FAILED — the merged view diverged from the in-HBM "
+                "trajectory (file this; never bench over it)")
+        v = (r.get("sentinel") or {}).get("verdict")
+        if v == "regressed":
+            out.append(
+                f"embed_bench {r.get('leg')}: sentinel verdict "
+                "regressed vs its own tiered cohort")
+    return out
+
+
 def _cost_rows(ledger_path: str, run_id: str) -> list[dict]:
     """This run's cost_attribution ledger records (ISSUE 14): measured
     step time paired with the bytes-moved model per leg/kernel."""
@@ -509,7 +567,8 @@ def render(run: dict, diag: dict, legs: list[dict],
            serve_legs: list[dict] | None = None,
            online: dict | None = None,
            cost_rows: list[dict] | None = None,
-           fmlint_rep: dict | None = None) -> str:
+           fmlint_rep: dict | None = None,
+           embed: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -639,6 +698,37 @@ def render(run: dict, diag: dict, legs: list[dict],
             f"{str(serve['degraded']).lower()}")
         out.append("")
 
+    if embed is not None:
+        out.append("## Embedding tier")
+        hr = embed.get("hit_rate")
+        ev = embed.get("evictions")
+        stall = embed.get("stall_ms")
+        out.append(
+            "  hot-tier hit rate "
+            + (f"{hr:.4f}" if isinstance(hr, (int, float)) else "-")
+            + f"  evictions {ev if ev is not None else '-'}"
+            + "  blocking stalls "
+            + (f"{stall:.1f} ms" if isinstance(stall, (int, float))
+               else "-"))
+        if embed["rows"]:
+            out.append(f"  {'ladder rung':22} {'rows/s':>12} "
+                       f"{'hit':>7} {'stall_ms':>9} {'host RSS':>10} "
+                       f"{'parity':>7} {'verdict':>22}")
+            for r in embed["rows"]:
+                v = r.get("value")
+                rhr = r.get("hit_rate")
+                rss = r.get("host_rss_bytes")
+                par = r.get("parity_ok")
+                out.append(
+                    f"  {str(r.get('leg'))[:22]:22} "
+                    f"{(f'{v:,.0f}' if isinstance(v, (int, float)) else '-'):>12} "
+                    f"{(f'{rhr:.3f}' if isinstance(rhr, (int, float)) else '-'):>7} "
+                    f"{r.get('stall_ms', '-'):>9} "
+                    f"{(f'{rss / 1e9:.2f}GB' if isinstance(rss, (int, float)) else '-'):>10} "
+                    f"{('-' if par is None else 'OK' if par else 'FAIL'):>7} "
+                    f"{((r.get('sentinel') or {}).get('verdict') or '?'):>22}")
+        out.append("")
+
     if online is not None:
         out.append("## Continuous learning")
         if online["quality_rows"]:
@@ -675,6 +765,7 @@ def render(run: dict, diag: dict, legs: list[dict],
     for line in (findings(diag, legs) + chaos_findings(chaos)
                  + serve_findings(serve, serve_legs)
                  + online_findings(online)
+                 + embed_findings(embed)
                  + capture_findings(run.get("captures"))
                  + fmlint_findings(fmlint_rep)):
         out.append(f"  - {line}")
@@ -716,13 +807,15 @@ def main(argv=None) -> int:
                            serve_legs)
     online = online_diagnose(run, obs_report.online_timeline(flight_events),
                              _quality_rows(ledger_path, run["run_id"]))
+    embed = embed_diagnose(run, _embed_rows(ledger_path, run["run_id"]))
     sys.stdout.write(render(run, diag, legs,
                             chaos=load_chaos_verdict(obs_dir),
                             serve=serve, serve_legs=serve_legs,
                             online=online,
                             cost_rows=_cost_rows(ledger_path,
                                                  run["run_id"]),
-                            fmlint_rep=load_fmlint_report(obs_dir)))
+                            fmlint_rep=load_fmlint_report(obs_dir),
+                            embed=embed))
     return 0
 
 
