@@ -2052,7 +2052,12 @@ def main():
                 _log("[parent] identical consecutive failures -- "
                      "skipping backoff (suspected permanent fault)")
                 continue
-            backoff = min(10 * attempt, max(0, deadline - time.perf_counter() - 90))
+            from fm_spark_tpu.utils.sleeps import scaled as _sleep_scaled
+
+            # Designed sleep (FM_SPARK_TEST_SLEEP_SCALE shrinks it in
+            # the fault suite); the deadline guard is NOT scaled.
+            backoff = min(_sleep_scaled(10 * attempt),
+                          max(0, deadline - time.perf_counter() - 90))
             if backoff > 0:
                 _log(f"[parent] backing off {backoff:.1f}s before retry "
                      "(flaky TPU attachment)")

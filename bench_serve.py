@@ -205,6 +205,176 @@ def _reload_drill(args, spec, params, engine, run_dir, journal_path
     }
 
 
+def _fleet_stats_delta(before: dict, after: dict) -> dict:
+    return {k: int(after.get(k) or 0) - int(before.get(k) or 0)
+            for k in ("accepted", "answered", "shed", "shed_queue",
+                      "shed_deadline", "rejected", "timeout",
+                      "failed", "retries")}
+
+
+def _fleet_ladder(args, run_dir: str, cache_dir
+                  ) -> tuple[list[dict], list[dict]]:
+    """Fleet rungs (ISSUE 17): aggregate QPS, p99 under shed, and
+    replica-loss recovery time for an ``--fleet N`` replica fleet
+    behind the production front door, driven by the seeded traffic
+    replayer. Each rung is its own ``serve_bench`` leg — its own
+    sentinel cohort, never compared against the single-engine ladder
+    (a fleet multiplies processes, not chips) — and fleet rungs NEVER
+    promote into MEASURED.json. Every rung's tap + counter delta is
+    held to :func:`chaos.audit_fleet` (exactly-once, closed books,
+    shed accounting)."""
+    import jax
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.resilience import chaos
+    from fm_spark_tpu.serve import loadgen
+    from fm_spark_tpu.serve.fleet import Fleet
+    from fm_spark_tpu.serve.frontdoor import (
+        AdmissionController,
+        FrontDoor,
+    )
+    from fm_spark_tpu.utils.logging import EventLog, read_events
+
+    n = args.fleet
+    fleet_dir = os.path.join(run_dir, "fleet")
+    spec = models.FieldFMSpec(
+        num_features=args.fields * args.bucket, rank=args.rank,
+        num_fields=args.fields, bucket=args.bucket, init_std=0.05)
+    params = spec.init(jax.random.key(0))
+    model_dir = os.path.join(fleet_dir, "model")
+    models.save_model(model_dir, spec, params)
+    fleet = Fleet(
+        model_dir, n_replicas=n,
+        work_dir=os.path.join(fleet_dir, "work"),
+        journal=EventLog(os.path.join(run_dir, "fleet_health.jsonl")),
+        buckets=args.fleet_buckets,
+        latency_budget_ms=args.latency_budget_ms,
+        compile_cache_dir=cache_dir)
+    fleet.start()
+    door = FrontDoor(fleet,
+                     admission=AdmissionController(
+                         service_est_ms=2.0)).start()
+    rows = max(int(b) for b in args.fleet_buckets.split(","))
+    kw = dict(nnz=args.fields, num_features=spec.num_features)
+    rungs: list[dict] = []
+    violations: list[dict] = []
+    try:
+        # ---- rung 1: aggregate QPS (comfortable deadlines, no shed)
+        sched = loadgen.make_schedule(
+            "diurnal", 0, duration_s=args.fleet_duration_s,
+            base_rps=args.fleet_rps, rows=rows, deadline_ms=8000.0)
+        tap = os.path.join(fleet_dir, "tap_qps.jsonl")
+        before = door.stats()
+        t0 = time.perf_counter()
+        loadgen.run_loadgen("127.0.0.1", door.port, sched, tap,
+                            threads=16, **kw)
+        elapsed = time.perf_counter() - t0
+        counters = _fleet_stats_delta(before, door.stats())
+        violations += chaos.audit_fleet(
+            read_events(tap), counters,
+            expected_requests=sched.n_requests)
+        s = loadgen.summarize_tap(tap)
+        n_ok = s["by_outcome"].get("ok", 0)
+        rungs.append({
+            "leg": f"fleet_qps_n{n}",
+            "requests": sched.n_requests, "ok": n_ok,
+            "value": round(n_ok * rows / elapsed, 2),
+            "qps": round(n_ok / elapsed, 2),
+            "p50_ms": s["ok_p50_ms"], "p99_ms": s["ok_p99_ms"],
+            "counters": counters,
+        })
+
+        # ---- rung 2: p99 under shed — a retry storm with an
+        # unpayable SLO, so admission sheds BEFORE the coalescer;
+        # the rung is only honest if the clients' observed sheds
+        # match the door's books (audit_fleet's shed_accounting).
+        sched = loadgen.make_schedule(
+            "retry_storm", 1, duration_s=args.fleet_duration_s,
+            base_rps=args.fleet_rps * 2, rows=rows,
+            deadline_ms=args.fleet_shed_deadline_ms)
+        tap = os.path.join(fleet_dir, "tap_shed.jsonl")
+        before = door.stats()
+        loadgen.run_loadgen("127.0.0.1", door.port, sched, tap,
+                            threads=16, **kw)
+        counters = _fleet_stats_delta(before, door.stats())
+        violations += chaos.audit_fleet(
+            read_events(tap), counters,
+            expected_requests=sched.n_requests)
+        s = loadgen.summarize_tap(tap)
+        p99 = s["ok_p99_ms"]
+        rungs.append({
+            "leg": f"fleet_p99_shed_n{n}",
+            "requests": sched.n_requests,
+            "ok": s["by_outcome"].get("ok", 0),
+            # Sentinel semantics: lower value = regressed, so the
+            # rung's value is answers-per-second at p99 (faster p99
+            # under shed pressure = better).
+            "value": round(1e3 / p99, 2) if p99 == p99 and p99 > 0
+            else 0.0,
+            "p99_ms": p99,
+            "shed": counters["shed"],
+            "shed_fired": counters["shed"] > 0,
+            "counters": counters,
+        })
+
+        # ---- rung 3: recovery time after a replica SIGKILL under
+        # load — kill to every live replica back through the
+        # readiness gate.
+        sched = loadgen.make_schedule(
+            "diurnal", 2, duration_s=max(1.0, args.fleet_duration_s),
+            base_rps=args.fleet_rps, rows=rows, deadline_ms=8000.0)
+        tap = os.path.join(fleet_dir, "tap_recovery.jsonl")
+        before = door.stats()
+        lg = threading.Thread(
+            target=loadgen.run_loadgen,
+            args=("127.0.0.1", door.port, sched, tap),
+            kwargs=dict(threads=8, **kw), daemon=True)
+        lg.start()
+        time.sleep(0.3 * sched.duration_s)
+        with fleet._lock:
+            ready = [r for r in fleet.replicas
+                     if r.state == "ready" and r.proc is not None]
+        killed = None
+        t_kill = time.monotonic()
+        if ready:
+            killed = ready[0].idx
+            os.kill(ready[0].proc.pid, 9)
+        lg.join()
+        recovery_s = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            h = fleet.healthz()
+            live = [r for r in h["replicas"]
+                    if r["state"] != "retired"]
+            if live and all(r["state"] == "ready" for r in live):
+                recovery_s = round(time.monotonic() - t_kill, 3)
+                break
+            time.sleep(0.05)
+        counters = _fleet_stats_delta(before, door.stats())
+        violations += chaos.audit_fleet(
+            read_events(tap), counters,
+            expected_requests=sched.n_requests)
+        if recovery_s is None:
+            violations.append({
+                "invariant": "staleness_bounded",
+                "detail": "fleet never re-admitted a ready replica "
+                          "set after the SIGKILL drill"})
+        rungs.append({
+            "leg": f"fleet_recovery_n{n}",
+            "requests": sched.n_requests,
+            "killed_replica": killed,
+            "recovery_s": recovery_s,
+            # 1/recovery so the sentinel's lower-is-regressed rule
+            # reads correctly (slower recovery = lower value).
+            "value": (round(1.0 / recovery_s, 4)
+                      if recovery_s else 0.0),
+            "counters": counters,
+        })
+    finally:
+        door.stop()
+    return rungs, violations
+
+
 def _promote(headline: dict, rate_per_chip: float, device: str,
              args, run_ok: bool) -> tuple[bool, str]:
     """The serving keep-best gate (mirrors bench.py's _emit_final
@@ -278,6 +448,24 @@ def main(argv=None) -> int:
     ap.add_argument("--poll-s", type=float, default=0.05, dest="poll_s")
     ap.add_argument("--skip-reload-drill", action="store_true",
                     dest="skip_reload_drill")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="also run the N-replica fleet rungs "
+                         "(aggregate QPS, p99 under shed, replica-"
+                         "loss recovery) behind the front door")
+    ap.add_argument("--fleet-buckets", default="1,8",
+                    dest="fleet_buckets",
+                    help="padded-batch buckets for fleet replicas "
+                         "(kept small: replica warmup is per-process)")
+    ap.add_argument("--fleet-rps", type=float, default=80.0,
+                    dest="fleet_rps",
+                    help="base offered load for the fleet rungs")
+    ap.add_argument("--fleet-duration-s", type=float, default=1.5,
+                    dest="fleet_duration_s")
+    ap.add_argument("--fleet-shed-deadline-ms", type=float,
+                    default=120.0, dest="fleet_shed_deadline_ms",
+                    help="base deadline for the shed rung (the retry-"
+                         "storm shape tightens it 4x — unpayable by "
+                         "construction)")
     ap.add_argument("--slo-ms", type=float, default=None, dest="slo_ms",
                     help="arm the serve_request watchdog at this "
                          "deadline (overrun = structured HangDetected)")
@@ -307,6 +495,8 @@ def main(argv=None) -> int:
         args.rank = min(args.rank, 8)
         args.reload_gens = min(args.reload_gens, 3)
         args.reload_write_gap_s = min(args.reload_write_gap_s, 0.2)
+        args.fleet_duration_s = min(args.fleet_duration_s, 1.0)
+        args.fleet_rps = min(args.fleet_rps, 50.0)
     args.bucket_list = tuple(sorted(
         {int(b) for b in args.buckets.split(",") if b}))
 
@@ -353,6 +543,12 @@ def main(argv=None) -> int:
                                      run_dir, journal_path)
     engine.close()
 
+    fleet_rungs: list[dict] = []
+    fleet_violations: list[dict] = []
+    if args.fleet > 0:
+        fleet_rungs, fleet_violations = _fleet_ladder(
+            args, run_dir, cache_dir)
+
     # ------------------------------------------------- ledger + sentinel
     from fm_spark_tpu.obs import (
         PerfLedger,
@@ -395,10 +591,42 @@ def main(argv=None) -> int:
             "fresh_compiles_after_warmup": fresh_after_warmup,
         })
 
+    # Fleet rungs: own leg names = own sentinel cohorts. They ride
+    # the same ledger kind but are NEVER candidates for promotion —
+    # the promotion gate below only ever sees the single-engine
+    # headline.
+    for rung in fleet_rungs:
+        variant = (f"serve/fleet{args.fleet}/{model_variant}"
+                   f"/{rung['leg']}")
+        rung["variant"] = variant
+        fingerprint = measurement_fingerprint(
+            variant=variant, model="field_fm",
+            batch=max(int(b) for b in args.fleet_buckets.split(",")),
+            rank=args.rank,
+            extra={"n_replicas": args.fleet,
+                   "fleet_buckets": args.fleet_buckets,
+                   "latency_budget_ms": args.latency_budget_ms,
+                   "nnz": args.fields},
+            device_kind=device, n_chips=n_chips,
+            jax_version=versions["jax_version"],
+            libtpu_version=versions["libtpu_version"],
+        )
+        rung["sentinel"] = sentinel.observe({
+            "kind": "serve_bench",
+            "leg": rung["leg"],
+            "run_id": run_id,
+            "fingerprint": fingerprint,
+            "value": rung["value"],
+            "variant": variant,
+            **{k: rung[k] for k in ("p99_ms", "recovery_s", "shed")
+               if k in rung},
+        })
+
     headline = rungs[-1]  # bucket-max rung = the throughput headline
     rate_per_chip = round(headline["rows_per_sec"] / n_chips, 2)
-    run_ok = fresh_after_warmup == 0 and not (
-        reload_drill and reload_drill["violations"])
+    run_ok = (fresh_after_warmup == 0
+              and not (reload_drill and reload_drill["violations"])
+              and not fleet_violations)
     promoted, promote_reason = _promote(headline, rate_per_chip,
                                         device, args, run_ok)
 
@@ -417,6 +645,9 @@ def main(argv=None) -> int:
         "fresh_compiles_at_warmup": warm["fresh_compiles"],
         "fresh_compiles_after_warmup": fresh_after_warmup,
         "rungs": rungs,
+        "fleet": ({"n_replicas": args.fleet, "rungs": fleet_rungs,
+                   "violations": fleet_violations}
+                  if args.fleet > 0 else None),
         "reload_drill": reload_drill,
         "headline_rows_per_sec_per_chip": rate_per_chip,
         "measured_updated": promoted,

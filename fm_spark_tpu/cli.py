@@ -1955,6 +1955,90 @@ def _serve_opt_example(spec, cfg):
     return make_optimizer(cfg.train_config()).init(canonical)
 
 
+def _serve_fleet(args, journal, cache_dir) -> int:
+    """The production front door (ISSUE 17): ``--fleet N`` stands up N
+    replica processes (each its own engine + read-only chain follower)
+    behind one HTTP front door with deadline-aware admission control,
+    and serves until SIGINT/SIGTERM (or ``--serve-seconds``). Emits
+    the front door's URL up front and one summary JSON line (admission
+    counters + per-replica health) on shutdown."""
+    import os as _os
+    import signal as _signal
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from fm_spark_tpu import obs
+    from fm_spark_tpu.serve.fleet import Fleet
+    from fm_spark_tpu.serve.frontdoor import (
+        AdmissionController,
+        FrontDoor,
+    )
+
+    if not args.model:
+        raise SystemExit(
+            "--fleet needs --model DIR: each replica loads the saved "
+            "model, then (with --checkpoint-dir) hot-follows the "
+            "chain through its own read-only follower")
+    work_dir = (_os.path.join(obs.run_dir(), "fleet")
+                if obs.run_dir()
+                else _tempfile.mkdtemp(prefix="fm_fleet_"))
+    if obs.run_dir():
+        # The fleet gets its OWN journal stream — the file
+        # tools/run_doctor.py's "Serving fleet" section reads —
+        # keeping replica lifecycle events out of the single-engine
+        # serve_health stream.
+        from fm_spark_tpu.utils.logging import EventLog as _EventLog
+
+        journal = _EventLog(
+            _os.path.join(obs.run_dir(), "fleet_health.jsonl"),
+            mirror_to_flight=True)
+    fleet = Fleet(
+        args.model, n_replicas=args.fleet,
+        chain_dir=args.checkpoint_dir, work_dir=work_dir,
+        journal=journal, buckets=args.buckets,
+        latency_budget_ms=args.latency_budget_ms,
+        reload_poll_s=args.reload_poll_s,
+        compile_cache_dir=cache_dir)
+    fleet.start()
+    admission = (AdmissionController(args.classes)
+                 if args.classes else AdmissionController())
+    door = FrontDoor(fleet, admission=admission,
+                     port=args.frontdoor_port or 0,
+                     journal=journal).start()
+    print(json.dumps({"frontdoor": {
+        "url": door.url, "replicas": args.fleet,
+        "work_dir": work_dir,
+        "classes": [dataclasses.asdict(c)
+                    for c in admission.classes],
+    }}), flush=True)
+
+    stop = _threading.Event()
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        _signal.signal(sig, lambda *_: stop.set())
+    try:
+        if args.serve_seconds > 0:
+            stop.wait(args.serve_seconds)
+        else:
+            while not stop.wait(0.5):
+                pass
+    finally:
+        stats = door.stats()
+        health = fleet.healthz()
+        door.stop()
+    print(json.dumps({"serve_summary": {
+        "frontdoor": stats,
+        "fleet": {k: health[k] for k in
+                  ("ready", "n_replicas", "capacity")},
+        "replicas": health["replicas"],
+    }}), flush=True)
+    if obs.enabled():
+        obs.export_snapshot()
+        print(json.dumps({
+            "run_doctor": f"python tools/run_doctor.py {obs.run_dir()}",
+        }), flush=True)
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Online serving loop (ISSUE 12): the AOT micro-batched engine +
     hot reload from the checkpoint chain, driven by a bounded request
@@ -1969,9 +2053,9 @@ def cmd_serve(args) -> int:
     from fm_spark_tpu.utils.logging import EventLog
 
     if args.compile_cache is not None:
-        compile_cache.enable(args.compile_cache or None)
+        cache_dir = compile_cache.enable(args.compile_cache or None)
     else:
-        compile_cache.enable_from_env()
+        cache_dir = compile_cache.enable_from_env()
 
     _obs_dir = getattr(args, "obs_dir", None)
     if _obs_dir and _obs_dir.lower() != "none":
@@ -2026,6 +2110,9 @@ def cmd_serve(args) -> int:
         journal = EventLog(
             _os.path.join(obs.run_dir(), "serve_health.jsonl"),
             mirror_to_flight=True)
+
+    if args.fleet > 0:
+        return _serve_fleet(args, journal, cache_dir)
 
     step0 = 0
     opt_example = None  # built once; FieldDeepFM's costs a full init
@@ -2561,6 +2648,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="arm the serve_request watchdog phase at this "
                          "deadline: an overrun becomes a structured "
                          "HangDetected + flight dump")
+    sv.add_argument("--fleet", type=int, default=0,
+                    help="production front door (ISSUE 17): run N "
+                         "replica processes behind one HTTP front "
+                         "door with deadline-aware admission control "
+                         "(requires --model; --checkpoint-dir adds "
+                         "per-replica hot reload)")
+    sv.add_argument("--frontdoor-port", type=int, default=0,
+                    dest="frontdoor_port", metavar="PORT",
+                    help="front door listen port (default: ephemeral, "
+                         "printed at startup)")
+    sv.add_argument("--classes", default=None,
+                    help="admission classes as "
+                         "'name:queue_cap:deadline_ms,...' in "
+                         "priority order (default: "
+                         "interactive:64:500,batch:64:2000,"
+                         "background:32:8000)")
+    sv.add_argument("--serve-seconds", type=float, default=0.0,
+                    dest="serve_seconds",
+                    help="with --fleet: serve for this long then "
+                         "exit cleanly (default 0 = until "
+                         "SIGINT/SIGTERM)")
     sv.add_argument("--repeat", type=int, default=1,
                     help="passes over the request stream (reload drills "
                          "keep serving while a trainer advances the "

@@ -56,6 +56,7 @@ import os
 import random
 import subprocess
 import sys
+import threading
 import time
 import zlib
 
@@ -68,12 +69,16 @@ __all__ = [
     "Schedule",
     "ScheduleGenerator",
     "audit",
+    "audit_fleet",
     "audit_serve_events",
     "build_shards",
+    "fleet_schedule",
     "golden_run",
     "minimize",
     "oracle_tap",
     "run_campaign",
+    "run_fleet_campaign",
+    "run_fleet_schedule",
     "run_schedule",
     "serve_schedule",
     "write_worker",
@@ -1832,10 +1837,379 @@ def run_drift_campaign(seeds=DRIFT_TIER1_SEEDS,
     return entries
 
 
+# ------------------------------------------- serving fleet (ISSUE 17)
+
+#: Fleet/traffic drills: seeded compositions of millions-of-users
+#: traffic SHAPES (serve/loadgen.py) with replica kills, dispatch
+#: faults, and publish/demote races, run against a REAL multi-process
+#: fleet (serve/fleet.py behind serve/frontdoor.py) and graded from
+#: artifacts alone by :func:`chaos_audit.audit_fleet`.
+
+#: Tier-1 fleet drill seeds (tools/chaos_drill.py folds the same three
+#: into its default bounded campaign; soak runs five).
+FLEET_TIER1_SEEDS = (0, 1, 2)
+FLEET_SOAK_SEEDS = (0, 1, 2, 3, 4)
+
+_FLEET_SCENARIOS = ("kill_flash_crowd", "retry_storm_demote",
+                    "slow_client_shed", "dispatch_fault", "compound")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSchedule:
+    """One seeded fleet/traffic drill: a loadgen shape composed with
+    parent-side fault rules, an optional mid-burst replica SIGKILL
+    (fired after ``kill_after_ok`` answered requests), and an optional
+    publish+demote race pressed against the replicas' reload pollers.
+    Pure function of the seed, like every schedule here."""
+
+    seed: int
+    scenario: str
+    shape: str
+    rules: tuple = ()
+    kill_after_ok: "int | None" = None
+    demote_race: bool = False
+    expects: str = "completed"
+
+    @property
+    def plan(self) -> str:
+        return ";".join(self.rules)
+
+    def validate(self) -> "FleetSchedule":
+        faults.FaultPlan.from_spec(self.plan)
+        from fm_spark_tpu.serve import loadgen
+
+        if self.shape not in loadgen.SHAPES:
+            raise ValueError(f"unknown traffic shape {self.shape!r}")
+        return self
+
+
+def fleet_schedule(seed: int) -> FleetSchedule:
+    """Seeded fleet/traffic schedule — scenario chosen by ``seed % 5``
+    so the tier-1 seeds cover the class, parameters drawn from the
+    seeded rng.
+
+    ``kill_flash_crowd``    SIGKILL a replica mid-flash-crowd: every
+                            accepted request still answered exactly
+                            once (retry-once against a live replica)
+    ``retry_storm_demote``  a retry storm while the trainer publishes
+                            AND demotes a generation under the
+                            replicas' reload pollers: the demoted
+                            generation never scores
+    ``slow_client_shed``    slow clients hold handler threads while
+                            interactive traffic keeps its deadline —
+                            the deadline shed fires before the
+                            coalescer
+    ``dispatch_fault``      injected ``fleet_dispatch`` errors: the
+                            retry-once path answers the request
+                            elsewhere
+    ``compound``            flash crowd + dispatch fault + replica
+                            kill + demote race at once
+    """
+    rng = random.Random(int(seed))
+    scenario = _FLEET_SCENARIOS[int(seed) % len(_FLEET_SCENARIOS)]
+    shape, rules, kill, demote = "diurnal", [], None, False
+    if scenario == "kill_flash_crowd":
+        shape = "flash_crowd"
+        kill = rng.randint(4, 12)
+    elif scenario == "retry_storm_demote":
+        shape = "retry_storm"
+        demote = True
+    elif scenario == "slow_client_shed":
+        shape = "slow_clients"
+        if rng.random() < 0.5:
+            rules.append(
+                f"frontdoor_accept@{rng.randint(2, 8)}=error")
+    elif scenario == "dispatch_fault":
+        shape = "diurnal"
+        rules.append(f"fleet_dispatch@{rng.randint(1, 6)}=error")
+    else:  # compound
+        shape = "flash_crowd"
+        rules.append(f"fleet_dispatch@{rng.randint(2, 8)}=error")
+        kill = rng.randint(6, 14)
+        demote = rng.random() < 0.7
+    return FleetSchedule(int(seed), f"fleet_{scenario}", shape,
+                         tuple(rules), kill_after_ok=kill,
+                         demote_race=demote).validate()
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetDrillConfig:
+    """Fleet drill shape: small enough that a campaign over one shared
+    two-replica fleet fits tier-1, hot enough that shed/kill/retry
+    paths actually fire."""
+
+    n_replicas: int = 2
+    num_features: int = 256
+    num_fields: int = 4
+    bucket: int = 64
+    rank: int = 4
+    init_std: float = 0.1
+    buckets: str = "1,4"
+    latency_budget_ms: float = 2.0
+    reload_poll_s: float = 0.15
+    duration_s: float = 1.2
+    base_rps: float = 50.0
+    rows: int = 2
+    deadline_ms: float = 2500.0
+    classes: str = ("interactive:32:2500,batch:16:4000,"
+                    "background:8:8000")
+    threads: int = 8
+    spawn_timeout_s: float = 300.0
+    converge_timeout_s: float = 30.0
+
+
+def build_fleet_stack(cfg: FleetDrillConfig, base_dir: str) -> dict:
+    """Build the shared drill stack: model dir, checkpoint chain (one
+    verified step), a running N-replica fleet behind a front door.
+    Returns the context dict the schedule runner mutates (chain step
+    counter, tombstones). Caller owns ``ctx['door'].stop()``."""
+    import jax
+
+    from fm_spark_tpu import models
+    from fm_spark_tpu.checkpoint import Checkpointer
+    from fm_spark_tpu.serve.fleet import Fleet
+    from fm_spark_tpu.serve.frontdoor import (AdmissionController,
+                                              FrontDoor)
+
+    os.makedirs(base_dir, exist_ok=True)
+    spec = models.FieldFMSpec(
+        num_features=cfg.num_features, num_fields=cfg.num_fields,
+        bucket=cfg.bucket, rank=cfg.rank, init_std=cfg.init_std)
+    params = spec.init(jax.random.key(0))
+    model_dir = os.path.join(base_dir, "model")
+    models.save_model(model_dir, spec, params)
+    chain_dir = os.path.join(base_dir, "chain")
+    ck = Checkpointer(chain_dir, save_every=1, async_save=False)
+    ck.save(1, params, {}, None, force=True)
+    ck.wait()
+    journal = EventLog(os.path.join(base_dir, "fleet_health.jsonl"))
+    fleet = Fleet(
+        model_dir, n_replicas=cfg.n_replicas, chain_dir=chain_dir,
+        work_dir=os.path.join(base_dir, "work"), journal=journal,
+        buckets=cfg.buckets, latency_budget_ms=cfg.latency_budget_ms,
+        reload_poll_s=cfg.reload_poll_s,
+        compile_cache_dir=os.path.join(base_dir, "compile_cache"),
+        spawn_timeout_s=cfg.spawn_timeout_s)
+    fleet.start()
+    door = FrontDoor(
+        fleet, admission=AdmissionController(cfg.classes),
+        journal=journal).start()
+    return {"spec": spec, "params": params, "ck": ck,
+            "chain_dir": chain_dir, "model_dir": model_dir,
+            "fleet": fleet, "door": door, "journal": journal,
+            "base_dir": base_dir, "step": 1, "tombstones": set()}
+
+
+def _fleet_stats_delta(before: dict, after: dict) -> dict:
+    return {k: int(after.get(k) or 0) - int(before.get(k) or 0)
+            for k in ("accepted", "answered", "shed", "shed_queue",
+                      "shed_deadline", "rejected", "timeout",
+                      "failed", "retries")}
+
+
+def _sigstop_publish_demote(ctx) -> int:
+    """The demote race, made deterministic: SIGSTOP every replica (the
+    reload pollers cannot observe the intermediate state), publish a
+    new generation, demote it immediately, SIGCONT. Every poller then
+    sees the tombstone before it could possibly swap — the veto path
+    is exercised on every schedule instead of winning a wall-clock
+    race."""
+    import signal as _signal
+
+    ck = ctx["ck"]
+    fleet = ctx["fleet"]
+    step = ctx["step"] + 1
+    stopped = []
+    for rep in fleet.replicas:
+        if rep.proc is not None and rep.proc.poll() is None:
+            try:
+                os.kill(rep.proc.pid, _signal.SIGSTOP)
+                stopped.append(rep.proc.pid)
+            except OSError:
+                pass
+    try:
+        ck.save(step, ctx["params"], {}, None, force=True)
+        ck.wait()
+        ck.demote(step, reason="fleet drill demote race")
+    finally:
+        for pid in stopped:
+            try:
+                os.kill(pid, _signal.SIGCONT)
+            except OSError:
+                pass
+    ctx["step"] = step
+    ctx["tombstones"].add(step)
+    return step
+
+
+def run_fleet_schedule(sched: FleetSchedule, cfg: FleetDrillConfig,
+                       ctx: dict, out_dir: str) -> dict:
+    """Run one fleet schedule against the shared stack and audit it
+    from artifacts alone. Returns a chaos_verdict-style entry."""
+    from fm_spark_tpu import obs as _obs
+    from fm_spark_tpu.serve import loadgen
+
+    os.makedirs(out_dir, exist_ok=True)
+    door = ctx["door"]
+    fleet = ctx["fleet"]
+    schedule = loadgen.make_schedule(
+        sched.shape, sched.seed, duration_s=cfg.duration_s,
+        base_rps=cfg.base_rps, rows=cfg.rows,
+        deadline_ms=cfg.deadline_ms)
+    tap_path = os.path.join(out_dir, "tap.jsonl")
+    before = door.stats()
+    killed = None
+    stop_watch = threading.Event()
+
+    def kill_watcher():
+        """SIGKILL a ready replica once ``kill_after_ok`` answers have
+        landed — mid-burst by construction."""
+        reg = _obs.registry()
+        base = int(reg.peek("frontdoor.answered_total") or 0)
+        while not stop_watch.wait(0.01):
+            done = int(reg.peek("frontdoor.answered_total") or 0)
+            if done - base >= sched.kill_after_ok:
+                with fleet._lock:
+                    ready = [r for r in fleet.replicas
+                             if r.state == "ready"
+                             and r.proc is not None]
+                if ready:
+                    rep = ready[sched.seed % len(ready)]
+                    try:
+                        os.kill(rep.proc.pid, 9)
+                        nonlocal killed
+                        killed = rep.idx
+                    except OSError:
+                        pass
+                return
+
+    watcher = None
+    if sched.kill_after_ok is not None:
+        watcher = threading.Thread(target=kill_watcher,
+                                   name="fleet-kill-watcher",
+                                   daemon=True)
+        watcher.start()
+    demoted_step = None
+    t0 = time.perf_counter()
+    if sched.plan:
+        faults.activate(sched.plan)
+    try:
+        if sched.demote_race:
+            # Fire the race ~mid-replay from a timer so traffic is in
+            # flight when the publish+demote lands.
+            race_timer = threading.Timer(
+                0.4 * cfg.duration_s,
+                lambda: ctx.update(
+                    _race_step=_sigstop_publish_demote(ctx)))
+            race_timer.start()
+        loadgen.run_loadgen(
+            "127.0.0.1", door.port, schedule, tap_path,
+            nnz=cfg.num_fields, num_features=cfg.num_features,
+            threads=cfg.threads)
+        if sched.demote_race:
+            race_timer.join()
+            demoted_step = ctx.pop("_race_step", None)
+    finally:
+        faults.clear()
+        stop_watch.set()
+        if watcher is not None:
+            watcher.join(timeout=5.0)
+    # Close the books: every admitted request must reach a terminal
+    # outcome before the counter snapshot is meaningful.
+    deadline = time.monotonic() + cfg.converge_timeout_s
+    while time.monotonic() < deadline:
+        snap = door.admission.snapshot()
+        if not any(snap["inflight"].values()):
+            break
+        time.sleep(0.05)
+    violations = []
+    # Recovery + convergence: after a kill, the fleet must re-admit a
+    # respawned replica through the readiness gate, and every live
+    # replica must converge to the same non-tombstoned tip.
+    tip = ctx["step"] if not ctx["tombstones"] else max(
+        s for s in range(1, ctx["step"] + 1)
+        if s not in ctx["tombstones"])
+    recovered_s = None
+    t_rec = time.monotonic()
+    while time.monotonic() - t_rec < cfg.converge_timeout_s:
+        h = fleet.healthz()
+        live = [r for r in h["replicas"] if r["state"] != "retired"]
+        if (live and all(r["state"] == "ready" for r in live)
+                and all(r["generation_step"] == tip for r in live)):
+            recovered_s = time.monotonic() - t_rec
+            break
+        time.sleep(0.05)
+    if recovered_s is None:
+        h = fleet.healthz()
+        states = [(r.get("replica"), r.get("state"),
+                   r.get("generation_step")) for r in h["replicas"]]
+        violations.append({
+            "invariant": "staleness_bounded",
+            "detail": f"fleet did not converge to tip {tip} within "
+                      f"{cfg.converge_timeout_s:.0f}s: {states}"})
+    counters = _fleet_stats_delta(before, door.stats())
+    tap_events = read_events(tap_path)
+    replica_events = {}
+    for rep in fleet.replicas:
+        jpath = os.path.join(fleet.work_dir,
+                             f"replica_{rep.idx}.jsonl")
+        if os.path.exists(jpath):
+            replica_events[rep.idx] = read_events(jpath)
+    violations.extend(audit_fleet(
+        tap_events, counters,
+        expected_requests=schedule.n_requests,
+        tombstoned_steps=ctx["tombstones"],
+        replica_events=replica_events))
+    summary = loadgen.summarize_tap(tap_path)
+    return {
+        "seed": sched.seed, "scenario": sched.scenario,
+        "plan": sched.plan, "expects": sched.expects,
+        "outcome": "completed",
+        "verdict": "green" if not violations else "failed",
+        "violations": violations,
+        "duration_s": round(time.perf_counter() - t0, 3),
+        "traffic": {"shape": sched.shape,
+                    "requests": schedule.n_requests,
+                    **{k: summary["by_outcome"].get(k, 0)
+                       for k in ("ok", "shed", "error", "timeout")}},
+        "killed_replica": killed,
+        "demoted_step": demoted_step,
+        "recovery_s": (round(recovered_s, 3)
+                       if recovered_s is not None else None),
+        "counters": counters,
+    }
+
+
+def run_fleet_campaign(seeds=FLEET_TIER1_SEEDS,
+                       cfg: "FleetDrillConfig | None" = None,
+                       base_dir: "str | None" = None) -> list[dict]:
+    """The fleet/traffic half of the chaos campaign: one shared
+    two-replica fleet, every seed's schedule replayed against it
+    (faults cleared between schedules; counter deltas audited per
+    schedule). Returns chaos_verdict-style entries."""
+    import tempfile
+
+    cfg = cfg or FleetDrillConfig()
+    base_dir = base_dir or tempfile.mkdtemp(prefix="fleet_drill_")
+    ctx = build_fleet_stack(cfg, base_dir)
+    entries = []
+    try:
+        for seed in seeds:
+            sched = fleet_schedule(seed)
+            entries.append(run_fleet_schedule(
+                sched, cfg, ctx,
+                os.path.join(base_dir, f"f{int(seed)}")))
+    finally:
+        ctx["door"].stop()
+        ctx["ck"].close()
+    return entries
+
+
 #: Re-export: the auditor lives in the standalone, import-free
 #: :mod:`fm_spark_tpu.resilience.chaos_audit` so jax-light tools
 #: (tools/run_doctor.py) can load it BY PATH without importing the
 #: package; the chaos API keeps its name here.
 from fm_spark_tpu.resilience.chaos_audit import (  # noqa: E402
+    audit_fleet,
     audit_serve_events,
 )

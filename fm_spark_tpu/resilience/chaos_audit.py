@@ -9,7 +9,7 @@ sentinel live in standalone-loadable modules too.
 
 from __future__ import annotations
 
-__all__ = ["audit_serve_events"]
+__all__ = ["audit_fleet", "audit_serve_events"]
 
 
 def _violation(invariant: str, detail: str) -> dict:
@@ -106,4 +106,126 @@ def audit_serve_events(events: list[dict], *,
             "rc_discipline",
             f"serving process exited rc={rc}; expected one of "
             f"{tuple(allowed_rcs)}"))
+    return v
+
+
+def audit_fleet(tap_events: list[dict], counters: dict, *,
+                expected_requests: int | None = None,
+                tombstoned_steps=(),
+                replica_events: "dict[int, list[dict]] | None" = None,
+                staleness_bound: int = 0) -> list[dict]:
+    """Fleet/traffic invariants over a load-replay run (ISSUE 17),
+    graded from artifacts alone: the loadgen **tap** (one record per
+    attempt: ``req_id``/``attempt``/``outcome``/``gen_step``), the
+    front door's counter snapshot (its ``frontdoor_summary`` journal
+    event / ``FrontDoor.stats()``), and — optionally — each replica's
+    serve journal (re-audited via :func:`audit_serve_events`).
+    Empty list = green. The contracts:
+
+    - **exactly_once_responses** — every scheduled request reached a
+      terminal outcome at least once, no (req_id, attempt) was
+      answered twice, and no req_id got more than one ``ok`` (a
+      client only retries failures, so a double-ok means a dead
+      replica's in-flight request was BOTH replayed and delivered);
+    - **accepted_accounting** — the door's books close:
+      ``accepted == answered + timeout + failed`` (an admitted
+      request that vanished from the counters was silently dropped);
+    - **shed_accounting** — the tap's observed ``shed`` outcomes
+      equal the admission controller's ``shed`` counter, and
+      ``shed == shed_queue + shed_deadline`` (the backpressure the
+      clients experienced IS the backpressure the door accounted);
+    - **no_tombstoned_generation** — no attempt was ever answered by
+      a demoted generation (the tap carries the scoring generation);
+      replica journals are additionally held to the full serve
+      invariants (torn swaps, staleness after recovery).
+    """
+    v: list[dict] = []
+    stones = {int(s) for s in tombstoned_steps}
+    attempts = [e for e in tap_events
+                if (e.get("event") or e.get("kind")) == "attempt"]
+    seen: dict = {}
+    ok_by_req: dict = {}
+    n_shed = 0
+    for e in attempts:
+        rid, att = e.get("req_id"), e.get("attempt")
+        out = e.get("outcome")
+        key = (rid, att)
+        if key in seen:
+            v.append(_violation(
+                "exactly_once_responses",
+                f"request {rid} attempt {att} recorded twice — an "
+                "in-flight request was answered more than once"))
+        seen[key] = out
+        if out == "ok":
+            ok_by_req[rid] = ok_by_req.get(rid, 0) + 1
+        elif out == "shed":
+            n_shed += 1
+        gs = e.get("gen_step")
+        if gs is not None and int(gs) in stones:
+            v.append(_violation(
+                "no_tombstoned_generation",
+                f"request {rid} was scored by demoted generation "
+                f"{gs}"))
+    for rid, n_ok in ok_by_req.items():
+        if n_ok > 1:
+            v.append(_violation(
+                "exactly_once_responses",
+                f"request {rid} answered ok {n_ok} times — retried "
+                "after a success (double-scored to the client)"))
+    if expected_requests is not None:
+        got = len({rid for rid, _ in seen})
+        if got != int(expected_requests):
+            v.append(_violation(
+                "exactly_once_responses",
+                f"{got} of {expected_requests} scheduled requests "
+                "reached a terminal outcome — the rest were "
+                "silently dropped"))
+    acc = int(counters.get("accepted") or 0)
+    closed = (int(counters.get("answered") or 0)
+              + int(counters.get("timeout") or 0)
+              + int(counters.get("failed") or 0))
+    if acc != closed:
+        v.append(_violation(
+            "accepted_accounting",
+            f"accepted={acc} but answered+timeout+failed={closed} — "
+            f"{acc - closed} admitted request(s) have no terminal "
+            "outcome on the door's books"))
+    shed = int(counters.get("shed") or 0)
+    shed_split = (int(counters.get("shed_queue") or 0)
+                  + int(counters.get("shed_deadline") or 0))
+    if shed != shed_split:
+        v.append(_violation(
+            "shed_accounting",
+            f"shed={shed} != shed_queue+shed_deadline={shed_split}"))
+    if n_shed != shed:
+        v.append(_violation(
+            "shed_accounting",
+            f"clients observed {n_shed} shed response(s) but the "
+            f"admission controller counted {shed}"))
+    for idx, events in (replica_events or {}).items():
+        staleness = None
+        for e in events:
+            if (e.get("event") or e.get("kind")) == "replica_state":
+                staleness = e.get("staleness_steps", staleness)
+        # A SIGKILLed replica's respawn restarts the generation
+        # sequence from the base model, so the monotonic-swap audit
+        # holds WITHIN an incarnation, not across the journal: split
+        # at each ``replica_start``. Tombstone/degraded contracts hold
+        # for every segment regardless.
+        segments: list[list[dict]] = [[]]
+        for e in events:
+            if (e.get("event") or e.get("kind")) == "replica_start":
+                segments.append([])
+            segments[-1].append(e)
+        live = [s for s in segments if s]
+        for inc, seg in enumerate(live):
+            for viol in audit_serve_events(
+                    seg, tombstoned_steps=stones,
+                    final_staleness=(staleness
+                                     if inc == len(live) - 1 else None),
+                    staleness_bound=staleness_bound):
+                viol = dict(viol)
+                viol["detail"] = (f"replica {idx} incarnation {inc}: "
+                                  f"{viol['detail']}")
+                v.append(viol)
     return v

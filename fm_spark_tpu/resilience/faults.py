@@ -90,6 +90,16 @@ ENV_STATE = "FM_SPARK_FAULTS_STATE"
 #: dirty-bucket flush window (before the cold write-back and version
 #: bump), so an ``exit`` there is the kill-mid-eviction drill — the
 #: merged checkpoint view never depended on the in-flight flush.
+#: Serving fleet (ISSUE 17): ``frontdoor_accept`` fires once per
+#: inbound request in serve/frontdoor.py BEFORE admission control (an
+#: ``error`` there is a transport-layer failure the client sees as an
+#: explicit 500 — never a silent drop), ``fleet_dispatch`` fires in
+#: the front door's fleet backend before each replica dispatch (an
+#: ``error`` exercises the retry-once-on-a-live-replica path), and
+#: ``replica_kill`` fires inside each REPLICA process per scored
+#: request (serve/fleet.py) — an ``exit`` there is the
+#: SIGKILL-mid-burst drill: the parent sees the connection die and
+#: must answer the in-flight request exactly once elsewhere.
 KNOWN_POINTS = (
     "backend_init",
     "sweep_leg",
@@ -103,6 +113,9 @@ KNOWN_POINTS = (
     "ckpt_demote",
     "embed_prefetch",
     "embed_evict",
+    "frontdoor_accept",
+    "replica_kill",
+    "fleet_dispatch",
 )
 
 #: The action vocabulary (public since ISSUE 10: the chaos schedule

@@ -62,10 +62,12 @@ class BackoffPolicy:
 
     def delay(self, failure_index: int, rng: random.Random | None = None
               ) -> float:
+        from fm_spark_tpu.utils.sleeps import sleep_scale
+
         d = min(
             self.initial * self.multiplier ** max(failure_index - 1, 0),
             self.max_delay,
-        )
+        ) * sleep_scale()  # designed sleep: FM_SPARK_TEST_SLEEP_SCALE
         if self.jitter and rng is not None:
             d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
         return max(d, 0.0)

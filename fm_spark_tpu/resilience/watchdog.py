@@ -79,9 +79,14 @@ NEAR_MISS_DUMP_INTERVAL_S = 30.0
 #: (serve/engine.py, ISSUE 12), and one day's time-ordered eval pass
 #: in the continuous-learning loop (online.py, ISSUE 13) — a hang
 #: there would silently stall the drift sentry while training keeps
-#: publishing generations.
+#: publishing generations. ``frontdoor_request`` (ISSUE 17) guards one
+#: ADMITTED request end-to-end through the serving front door
+#: (serve/frontdoor.py): admission → dispatch → response write;
+#: deadline = the front door's worst acceptable response time, so a
+#: wedged replica or a stuck backend surfaces as a structured hang
+#: instead of a silently open socket.
 KNOWN_PHASES = ("ingest_chunk", "ckpt_commit", "step_window",
-                "serve_request", "online_eval")
+                "serve_request", "online_eval", "frontdoor_request")
 
 _ACTIONS = ("raise", "exit")
 

@@ -13,9 +13,22 @@ pieces other PRs battle-tested:
   publish point through the read-only
   :class:`~fm_spark_tpu.checkpoint.ChainFollower`, with degraded mode
   (keep serving the old generation) and a bounded-staleness gauge;
+- :mod:`~fm_spark_tpu.serve.frontdoor` — the production front door
+  (ISSUE 17): stdlib HTTP transport + deadline-aware admission
+  control (priority classes, bounded per-class queues, shed BEFORE
+  the coalescer, Retry-After backpressure);
+- :mod:`~fm_spark_tpu.serve.fleet` — the multi-process replica fleet:
+  N engines behind one door, each hot-following the chain via its own
+  read-only ``ChainFollower``, health-checked/drained/re-admitted by
+  the parent, with the PR-3 elastic controller as the scale-down
+  primitive;
+- :mod:`~fm_spark_tpu.serve.loadgen` — the seeded traffic-replay load
+  generator (diurnal ramps, flash crowds, slow clients, retry storms)
+  the chaos engine composes with fault plans;
 - ``bench_serve.py`` (repo root) — the latency/throughput ladder that
   stamps p50/p99 + QPS/chip into the PR-9 ledger as ``serve_bench``
-  records, sentinel-gated exactly like training legs.
+  records, sentinel-gated exactly like training legs (fleet rungs are
+  their own cohorts).
 """
 
 from fm_spark_tpu.serve.engine import (
@@ -24,12 +37,24 @@ from fm_spark_tpu.serve.engine import (
     PredictEngine,
     ServeFuture,
 )
+from fm_spark_tpu.serve.frontdoor import (
+    AdmissionController,
+    BackendError,
+    FrontDoor,
+    LocalBackend,
+    parse_classes,
+)
 from fm_spark_tpu.serve.reload import ReloadFollower
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "AdmissionController",
+    "BackendError",
+    "FrontDoor",
     "Generation",
+    "LocalBackend",
     "PredictEngine",
     "ReloadFollower",
     "ServeFuture",
+    "parse_classes",
 ]

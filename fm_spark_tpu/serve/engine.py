@@ -102,14 +102,19 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("ids", "vals", "n", "future", "t_submit")
+    __slots__ = ("ids", "vals", "n", "future", "t_submit", "deadline")
 
-    def __init__(self, ids, vals):
+    def __init__(self, ids, vals, deadline=None):
         self.ids = ids
         self.vals = vals
         self.n = int(ids.shape[0])
         self.future = ServeFuture()
         self.t_submit = time.perf_counter()
+        #: Absolute ``time.monotonic()`` deadline (None = unbounded).
+        #: Propagated by the front door (ISSUE 17) so the coalescer
+        #: never HOLDS a request past its SLO waiting for batch-mates,
+        #: and never SCORES one that already expired in the queue.
+        self.deadline = deadline
 
 
 _STOP = object()
@@ -317,16 +322,21 @@ class PredictEngine:
                     daemon=True)
                 self._worker.start()
 
-    def submit(self, ids, vals) -> ServeFuture:
+    def submit(self, ids, vals,
+               deadline: float | None = None) -> ServeFuture:
         """Enqueue one request (<= bucket-max rows) for coalescing;
-        returns its :class:`ServeFuture`."""
+        returns its :class:`ServeFuture`. ``deadline`` is an absolute
+        ``time.monotonic()`` timestamp: the coalescer stops gathering
+        at the batch's earliest deadline, and a request that expires
+        while still queued is answered with :class:`TimeoutError`
+        (exactly once, never scored, never silently dropped)."""
         ids, vals = self._coerce(ids, vals)
         if ids.shape[0] > self.buckets[-1]:
             raise ValueError(
                 f"submit() takes at most bucket-max ({self.buckets[-1]}) "
                 "rows per request; use predict() to auto-chunk")
         self._ensure_worker()
-        req = _Request(ids, vals)
+        req = _Request(ids, vals, deadline=deadline)
         obs.counter("serve.requests_total").add(1)
         self._queue.put(req)
         return req.future
@@ -354,6 +364,8 @@ class PredictEngine:
         rows = first.n
         cap = self.buckets[-1]
         deadline = time.monotonic() + self.latency_budget_s
+        if first.deadline is not None:
+            deadline = min(deadline, first.deadline)
         while rows < cap:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -372,6 +384,8 @@ class PredictEngine:
                 break
             batch.append(nxt)
             rows += nxt.n
+            if nxt.deadline is not None:
+                deadline = min(deadline, nxt.deadline)
         return batch
 
     def _run(self) -> None:
@@ -379,6 +393,24 @@ class PredictEngine:
             batch = self._gather()
             if batch is None:
                 return
+            # A request whose deadline passed while it sat in the
+            # queue is answered with TimeoutError NOW — scoring it
+            # would spend batch capacity on an answer the client has
+            # already abandoned (the front door's admission estimate
+            # stays honest because expired work never reaches the
+            # device).
+            now = time.monotonic()
+            expired = [r for r in batch
+                       if r.deadline is not None and r.deadline < now]
+            if expired:
+                obs.counter("serve.deadline_expired_total").add(
+                    len(expired))
+                for r in expired:
+                    r.future._set_exception(TimeoutError(
+                        "request deadline expired before dispatch"))
+                batch = [r for r in batch if r not in expired]
+                if not batch:
+                    continue
             # ONE generation read per micro-batch: every row in this
             # dispatch — and every response split from it — scores on
             # the same params (the no-torn-swap contract).

@@ -1,0 +1,688 @@
+"""Multi-process replica fleet behind the serving front door
+(ISSUE 17).
+
+Topology: N replica PROCESSES, each running the full PR-11/12 serving
+stack — AOT :class:`PredictEngine`, its own read-only
+:class:`~fm_spark_tpu.checkpoint.ChainFollower` polling the trainer's
+chain, the shared persistent compile cache (first replica compiles,
+the rest deserialize) — behind one in-parent :class:`Fleet` backend the
+:class:`~fm_spark_tpu.serve.frontdoor.FrontDoor` dispatches through.
+
+Replica lifecycle (all transitions journaled by the parent):
+
+``starting``   spawned; parent waits for the atomic port file, then
+               for ``/healthz`` to report ready (warmup complete —
+               readiness is gated on the engine actually being able
+               to serve, not on the socket existing)
+``ready``      in the dispatch rotation
+``suspect``    drained: failed a health check or a dispatch — no new
+               traffic; re-admitted the moment ``/healthz`` goes
+               green again
+``dead``       process exited (SIGKILL mid-burst is the drill) —
+               respawned, then re-admitted through the same
+               readiness gate
+``retired``    permanently failed (the PR-3 elastic controller
+               classified the respawn failures permanent and shrank
+               the fleet's capacity — scale-down, not a crash loop)
+
+Dispatch is round-robin over ready replicas; an in-flight request on a
+replica that dies mid-burst is retried ONCE against a live replica
+(``frontdoor.retries_total``) or failed with an explicit
+:class:`~fm_spark_tpu.serve.frontdoor.BackendError` — never silently
+dropped. The ``fleet_dispatch`` fault point fires per dispatch attempt
+in the parent; ``replica_kill`` fires per scored request inside the
+replica process (an ``exit`` action IS the kill-mid-burst drill, with
+cross-process occurrence counting via ``FM_SPARK_FAULTS_STATE``).
+
+Run one replica: ``python -m fm_spark_tpu.serve.fleet --replica-id 0
+--model DIR --port-file P [--chain-dir C]`` — it announces its port by
+atomically writing the port file (never stdout: a replica's narrative
+belongs to its journal).
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+from fm_spark_tpu import obs
+from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience.elastic import ElasticController
+from fm_spark_tpu.utils.logging import EventLog
+
+__all__ = ["Fleet", "ReplicaHandle", "replica_main"]
+
+#: Parent-side health cadence and thresholds.
+DEFAULT_HEALTH_POLL_S = 0.25
+SUSPECT_AFTER_FAILURES = 2
+SPAWN_TIMEOUT_S = 120.0
+
+
+def _json_body(doc) -> bytes:
+    # HTTP wire format / port-file payload — the sanctioned json.dumps
+    # seam (journal writes go through EventLog).
+    return (json.dumps(doc) + "\n").encode()
+
+
+def _write_port_file(path: str, port: int) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(_json_body({"port": int(port), "pid": os.getpid()}))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _http_json(host, port, method, path, body=None, timeout_s=2.0):
+    """One JSON request to a replica; returns (status, doc)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        payload = _json_body(body) if body is not None else None
+        headers = ({"Content-Type": "application/json"}
+                   if payload else {})
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            doc = json.loads(raw.decode() or "{}")
+        except ValueError:
+            doc = {}
+        return resp.status, doc
+    finally:
+        conn.close()
+
+
+# =================================================== parent-side fleet
+
+
+class ReplicaHandle:
+    """One replica slot: the process, its port, and its health state.
+    All mutation happens under the owning :class:`Fleet`'s lock."""
+
+    def __init__(self, idx: int):
+        self.idx = int(idx)
+        self.proc = None
+        self.port = None
+        self.state = "starting"
+        self.health_failures = 0
+        self.last_doc: dict = {}
+        self.spawned_at = None
+        self.incarnations = 0
+
+    def doc(self) -> dict:
+        return {
+            "replica": self.idx, "state": self.state,
+            "pid": (self.proc.pid if self.proc is not None else None),
+            "port": self.port,
+            "incarnations": self.incarnations,
+            "generation_step": self.last_doc.get("generation_step"),
+            "staleness_steps": self.last_doc.get("staleness_steps"),
+            "degraded": self.last_doc.get("degraded"),
+        }
+
+
+class Fleet:
+    """N replica processes + health monitoring + retry-once dispatch.
+    A :class:`FrontDoor` backend (``score/healthz/close``)."""
+
+    def __init__(self, model_dir: str, *, n_replicas: int = 2,
+                 chain_dir: "str | None" = None,
+                 work_dir: str, journal=None,
+                 buckets: str = "1,4", latency_budget_ms: float = 2.0,
+                 reload_poll_s: float = 0.2,
+                 compile_cache_dir: "str | None" = None,
+                 health_poll_s: float = DEFAULT_HEALTH_POLL_S,
+                 spawn_timeout_s: float = SPAWN_TIMEOUT_S,
+                 replica_env: "dict | None" = None,
+                 max_shrinks: "int | None" = None):
+        if n_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        self.model_dir = model_dir
+        self.chain_dir = chain_dir
+        self.work_dir = work_dir
+        self.journal = journal
+        self.buckets = buckets
+        self.latency_budget_ms = float(latency_budget_ms)
+        self.reload_poll_s = float(reload_poll_s)
+        self.compile_cache_dir = compile_cache_dir
+        self.health_poll_s = float(health_poll_s)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.replica_env = dict(replica_env or {})
+        os.makedirs(work_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._stopping = False
+        self.replicas = [ReplicaHandle(i) for i in range(n_replicas)]
+        #: Scale-down primitive (PR 3): replica slots are the
+        #: "devices"; a permanently crash-looping slot shrinks the
+        #: fleet's capacity target instead of respawning forever.
+        self.elastic = ElasticController(
+            devices=list(range(n_replicas)),
+            max_shrinks=(n_replicas - 1 if max_shrinks is None
+                         else max_shrinks),
+            journal=journal)
+        self._capacity = n_replicas
+        self._monitor = None
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self, wait_ready: bool = True) -> "Fleet":
+        for rep in self.replicas:
+            self._spawn(rep)
+        self._monitor = threading.Thread(
+            target=self._health_loop, name="fm-spark-fleet-health",
+            daemon=True)
+        self._monitor.start()
+        if wait_ready:
+            self.wait_ready()
+        return self
+
+    def wait_ready(self, min_ready: "int | None" = None,
+                   timeout_s: "float | None" = None) -> None:
+        """Block until ``min_ready`` replicas (default: all live
+        slots) pass the readiness gate."""
+        deadline = time.monotonic() + (timeout_s
+                                       or self.spawn_timeout_s)
+        while True:
+            with self._lock:
+                live = [r for r in self.replicas
+                        if r.state != "retired"]
+                ready = sum(r.state == "ready" for r in live)
+                want = (len(live) if min_ready is None
+                        else min(min_ready, len(live)))
+            if ready >= want and want > 0:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet not ready: {ready}/{want} replicas after "
+                    f"{self.spawn_timeout_s:.0f}s")
+            time.sleep(0.05)
+
+    def _journal(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.emit(event, **fields)
+
+    def _spawn(self, rep: ReplicaHandle) -> None:
+        port_file = os.path.join(self.work_dir,
+                                 f"replica_{rep.idx}.port")
+        try:
+            os.unlink(port_file)
+        except FileNotFoundError:
+            pass
+        cmd = [sys.executable, "-m", "fm_spark_tpu.serve.fleet",
+               "--replica-id", str(rep.idx),
+               "--model", self.model_dir,
+               "--port-file", port_file,
+               "--buckets", self.buckets,
+               "--latency-budget-ms", str(self.latency_budget_ms),
+               "--journal", os.path.join(
+                   self.work_dir, f"replica_{rep.idx}.jsonl")]
+        if self.chain_dir:
+            cmd += ["--chain-dir", self.chain_dir,
+                    "--reload-poll-s", str(self.reload_poll_s)]
+        if self.compile_cache_dir:
+            cmd += ["--compile-cache", self.compile_cache_dir]
+        env = dict(os.environ)
+        # The child must import this very package even when the parent
+        # runs from an arbitrary cwd.
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else repo_root)
+        env.update(self.replica_env)
+        # stderr lands next to the journal (append across
+        # incarnations): a crash-looping replica must leave evidence.
+        stderr_path = os.path.join(self.work_dir,
+                                   f"replica_{rep.idx}.stderr")
+        with open(stderr_path, "ab") as errf:
+            rep.proc = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.DEVNULL, stderr=errf)
+        rep.port = None
+        rep.state = "starting"
+        rep.health_failures = 0
+        rep.spawned_at = time.monotonic()
+        rep.incarnations += 1
+        self._journal("replica_spawn", replica=rep.idx,
+                      pid=rep.proc.pid,
+                      incarnation=rep.incarnations)
+
+    def _read_port(self, rep: ReplicaHandle) -> "int | None":
+        path = os.path.join(self.work_dir,
+                            f"replica_{rep.idx}.port")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        # Stale port file from a previous incarnation is not ours.
+        if (rep.proc is not None
+                and doc.get("pid") != rep.proc.pid):
+            return None
+        return int(doc["port"])
+
+    # ---------------------------------------------------- health loop
+
+    def _health_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+                reps = list(self.replicas)
+            for rep in reps:
+                try:
+                    self._check_one(rep)
+                except Exception:  # noqa: BLE001 — the monitor must
+                    # outlive any single replica's weirdness
+                    pass
+            time.sleep(self.health_poll_s)
+
+    def _check_one(self, rep: ReplicaHandle) -> None:
+        with self._lock:
+            if self._stopping or rep.state == "retired":
+                return
+            proc = rep.proc
+        rc = proc.poll() if proc is not None else None
+        if rc is not None:
+            self._on_death(rep, rc)
+            return
+        if rep.port is None:
+            port = self._read_port(rep)
+            if port is None:
+                if (time.monotonic() - rep.spawned_at
+                        > self.spawn_timeout_s):
+                    self._on_death(rep, None, reason="spawn_timeout")
+                return
+            with self._lock:
+                rep.port = port
+        try:
+            status, doc = _http_json("127.0.0.1", rep.port, "GET",
+                                     "/healthz", timeout_s=2.0)
+        except OSError:
+            status, doc = None, {}
+        with self._lock:
+            was = rep.state
+            if status == 200 and doc.get("ready"):
+                changed = (doc.get("generation_step")
+                           != rep.last_doc.get("generation_step")
+                           or was != "ready")
+                rep.last_doc = doc
+                rep.health_failures = 0
+                if was in ("starting", "suspect"):
+                    rep.state = "ready"
+                    self.elastic.note_success()
+                    self._journal(
+                        "replica_ready", replica=rep.idx,
+                        incarnation=rep.incarnations,
+                        generation_step=doc.get("generation_step"))
+                elif changed:
+                    self._journal(
+                        "replica_state", replica=rep.idx,
+                        state=rep.state,
+                        generation_step=doc.get("generation_step"),
+                        staleness_steps=doc.get("staleness_steps"))
+            else:
+                rep.health_failures += 1
+                if (was == "ready" and rep.health_failures
+                        >= SUSPECT_AFTER_FAILURES):
+                    # Drain: out of the rotation until /healthz goes
+                    # green again (re-admission is the same gate as
+                    # first admission).
+                    rep.state = "suspect"
+                    self._journal("replica_drained", replica=rep.idx,
+                                  health_failures=rep.health_failures)
+
+    def _on_death(self, rep: ReplicaHandle, rc,
+                  reason: str = "exited") -> None:
+        with self._lock:
+            if self._stopping or rep.state == "retired":
+                return
+            rep.state = "dead"
+            self._journal("replica_down", replica=rep.idx, rc=rc,
+                          reason=reason,
+                          incarnation=rep.incarnations)
+            verdict = self.elastic.note_failure(
+                "replica_respawn",
+                f"replica {rep.idx} {reason} rc={rc}")
+            if verdict == "permanent" and self.elastic.can_shrink():
+                survivors = self.elastic.shrink("fleet")
+                self._capacity = len(survivors)
+                rep.state = "retired"
+                if rep.proc is not None:
+                    try:
+                        rep.proc.kill()
+                    except OSError:
+                        pass
+                self._journal("fleet_shrink", replica=rep.idx,
+                              capacity=self._capacity)
+                return
+            live = [r for r in self.replicas
+                    if r.state not in ("retired", "dead")]
+            if len(live) >= self._capacity:
+                # Over capacity after an elastic shrink: the dead
+                # slot retires instead of respawning.
+                rep.state = "retired"
+                self._journal("replica_retired", replica=rep.idx)
+                return
+        self._spawn(rep)
+
+    # ------------------------------------------- drain / re-admission
+
+    def drain(self, idx: int) -> None:
+        """Administratively take a replica out of the rotation (it
+        keeps running; ``readmit`` or a green health check restores
+        it)."""
+        with self._lock:
+            rep = self.replicas[idx]
+            if rep.state == "ready":
+                rep.state = "suspect"
+                rep.health_failures = SUSPECT_AFTER_FAILURES
+                self._journal("replica_drained", replica=idx,
+                              health_failures=-1)
+
+    def readmit(self, idx: int) -> None:
+        with self._lock:
+            rep = self.replicas[idx]
+            if rep.state == "suspect":
+                rep.health_failures = 0
+        # The health loop re-admits on its next green poll.
+
+    # ------------------------------------------------------- dispatch
+
+    def _pick(self, exclude=()) -> "ReplicaHandle | None":
+        with self._lock:
+            ready = [r for r in self.replicas
+                     if r.state == "ready"
+                     and r.idx not in exclude]
+            if not ready:
+                return None
+            rep = ready[self._rr % len(ready)]
+            self._rr += 1
+            return rep
+
+    def score(self, ids, vals, deadline: float):
+        """Dispatch one admitted request; retry ONCE on a different
+        live replica if the first dies/fails mid-flight."""
+        tried: list[int] = []
+        last_error = "no ready replica"
+        for attempt in (1, 2):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("deadline expired in dispatch")
+            rep = self._pick(exclude=tried)
+            if rep is None and tried:
+                # Nothing else is ready: the retry may land on the
+                # original (it might have merely hiccuped).
+                rep = self._pick()
+            if rep is None:
+                raise frontdoor.BackendError("no ready replica")
+            tried.append(rep.idx)
+            try:
+                faults.inject("fleet_dispatch")
+                status, doc = _http_json(
+                    "127.0.0.1", rep.port, "POST", "/predict",
+                    body={"ids": ids, "vals": vals,
+                          "deadline_ms": remaining * 1e3},
+                    timeout_s=remaining + 0.25)
+            except Exception as e:  # noqa: BLE001 — connection died
+                # (replica killed mid-burst) or injected dispatch
+                # fault: mark suspect, retry once elsewhere
+                last_error = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    if rep.state == "ready":
+                        rep.state = "suspect"
+                        rep.health_failures = SUSPECT_AFTER_FAILURES
+                self._journal("replica_dispatch_failed",
+                              replica=rep.idx, attempt=attempt,
+                              error=type(e).__name__)
+                if attempt == 1:
+                    obs.counter("frontdoor.retries_total").add(1)
+                continue
+            if status == 200:
+                doc["replica"] = rep.idx
+                return doc["scores"], doc
+            if status == 504:
+                raise TimeoutError("replica deadline expired")
+            last_error = f"replica {rep.idx} status {status}"
+            if attempt == 1:
+                obs.counter("frontdoor.retries_total").add(1)
+        raise frontdoor.BackendError(
+            f"dispatch failed after retry: {last_error}")
+
+    # -------------------------------------------------------- healthz
+
+    def healthz(self) -> dict:
+        with self._lock:
+            docs = [r.doc() for r in self.replicas]
+            live = [d for d in docs if d["state"] != "retired"]
+        return {
+            "ready": any(d["state"] == "ready" for d in docs),
+            "n_replicas": len(live),
+            "capacity": self._capacity,
+            "elastic": self.elastic.summary(),
+            "replicas": docs,
+        }
+
+    # ---------------------------------------------------------- close
+
+    def close(self) -> None:
+        with self._lock:
+            self._stopping = True
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+        for rep in self.replicas:
+            proc = rep.proc
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        for rep in self.replicas:
+            proc = rep.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+        self._journal("fleet_summary",
+                      capacity=self._capacity,
+                      elastic=self.elastic.summary(),
+                      replicas=[r.doc() for r in self.replicas])
+
+
+# The circular half-import: Fleet raises frontdoor.BackendError so the
+# door maps it to a 503; imported late to keep module import cheap for
+# the replica child (which never builds a Fleet).
+from fm_spark_tpu.serve import frontdoor  # noqa: E402
+
+
+# ================================================== replica child main
+
+
+def replica_main(argv=None) -> int:
+    """One replica process: engine + read-only chain follower + HTTP
+    ``/predict`` + ``/healthz``, port announced via the atomic port
+    file."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="fm_spark_tpu serving fleet replica")
+    ap.add_argument("--replica-id", type=int, required=True)
+    ap.add_argument("--model", required=True,
+                    help="models.save_model directory (spec + params)")
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--chain-dir", default=None,
+                    help="checkpoint chain to hot-follow (read-only)")
+    ap.add_argument("--reload-poll-s", type=float, default=0.2)
+    ap.add_argument("--buckets", default="1,4")
+    ap.add_argument("--latency-budget-ms", type=float, default=2.0)
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--compile-cache", default=None)
+    ap.add_argument("--nnz", type=int, default=None,
+                    help="request width (default: spec.num_fields)")
+    args = ap.parse_args(argv)
+
+    from fm_spark_tpu.models import load_model
+    from fm_spark_tpu.serve.engine import PredictEngine
+    from fm_spark_tpu.serve.reload import ReloadFollower
+    from fm_spark_tpu.utils import compile_cache
+
+    if args.compile_cache:
+        compile_cache.enable(args.compile_cache)
+    else:
+        compile_cache.enable_from_env()
+
+    journal = (EventLog(args.journal) if args.journal else None)
+
+    def jlog(event, **fields):
+        if journal is not None:
+            journal.emit(event, replica=args.replica_id, **fields)
+
+    spec, params = load_model(args.model)
+    step0 = 0
+    follower = None
+    buckets = tuple(sorted({int(b) for b in args.buckets.split(",")
+                            if b}))
+    engine = PredictEngine(
+        spec, params,
+        nnz=(args.nnz if args.nnz
+             else getattr(spec, "num_fields", None)),
+        step=step0, buckets=buckets,
+        latency_budget_ms=args.latency_budget_ms, journal=journal)
+    if args.chain_dir:
+        follower = ReloadFollower(
+            engine, args.chain_dir, poll_s=args.reload_poll_s,
+            journal=journal, opt_state_example={})
+        # One synchronous poll BEFORE readiness: a replica that joins
+        # behind an advanced chain must not serve generation 0 to its
+        # first request.
+        follower.poll_once()
+        follower.start()
+    wstats = engine.warmup()
+    jlog("replica_start", pid=os.getpid(),
+         generation_step=engine.generation().step,
+         warmup_s=round(wstats["seconds"], 3),
+         fresh_compiles=wstats["fresh_compiles"])
+
+    ready = threading.Event()
+    reg = obs.registry()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        server_version = "fm-spark-replica/1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _reply(self, status, doc):
+            body = _json_body(doc)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            try:
+                if self.path.split("?", 1)[0] != "/healthz":
+                    self.send_error(404, "want /healthz or /predict")
+                    return
+                self._reply(200, {
+                    "ready": ready.is_set(),
+                    "replica": args.replica_id,
+                    "pid": os.getpid(),
+                    "generation_step": engine.generation().step,
+                    "staleness_steps": reg.peek(
+                        "serve/staleness_steps"),
+                    "degraded": bool(reg.peek("serve/degraded") or 0),
+                    "reloads": (follower.reloads
+                                if follower is not None else 0),
+                    "reload_failures": (follower.failures
+                                        if follower is not None
+                                        else 0),
+                })
+            except Exception:  # noqa: BLE001 — scrape socket died
+                pass
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            try:
+                if self.path.split("?", 1)[0] != "/predict":
+                    self.send_error(404, "want /predict")
+                    return
+                # The kill-mid-burst drill point: an ``exit`` action
+                # here is os._exit — the parent sees this very
+                # connection die and must answer the request exactly
+                # once elsewhere.
+                faults.inject("replica_kill")
+                n = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(n).decode() or "{}")
+                dl_ms = req.get("deadline_ms")
+                deadline = (time.monotonic() + float(dl_ms) / 1e3
+                            if dl_ms is not None else None)
+                fut = engine.submit(req["ids"], req["vals"],
+                                    deadline=deadline)
+                wait = (max(deadline - time.monotonic(), 0.001)
+                        if deadline is not None else 30.0)
+                try:
+                    out = fut.result(wait)
+                except TimeoutError:
+                    self._reply(504, {"error": "deadline expired"})
+                    return
+                self._reply(200, {
+                    "scores": [float(x) for x in out],
+                    "generation_step": engine.generation().step,
+                    "replica": args.replica_id,
+                })
+            except Exception as e:  # noqa: BLE001 — answer the
+                # client explicitly (injected faults land here too);
+                # a broken reply socket is the parent's signal
+                try:
+                    self._reply(500, {"error": type(e).__name__})
+                except Exception:
+                    pass
+
+    class Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+        request_queue_size = 128
+
+    server = Server(("127.0.0.1", args.port), Handler)
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    serve_thread = threading.Thread(
+        target=server.serve_forever, name="fm-spark-replica-http",
+        daemon=True)
+    serve_thread.start()
+    _write_port_file(args.port_file, server.server_address[1])
+    ready.set()
+    jlog("replica_ready", port=server.server_address[1],
+         generation_step=engine.generation().step)
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        ready.clear()
+        server.shutdown()
+        server.server_close()
+        if follower is not None:
+            follower.stop()
+        engine.close()
+        jlog("replica_stop", reason="sigterm")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(replica_main())

@@ -1,0 +1,41 @@
+"""Designed-sleep scaling (ISSUE 17 satellite).
+
+Fault drills deliberately SLEEP — supervisor retry backoffs, the bench
+parent's between-attempt backoff — and those sleeps dominate the
+wall-clock of the fault-injection suite (tests/test_bench_faults.py)
+while proving nothing by themselves: the assertions are about
+*behavior* (events journaled, retries counted, verdicts classified),
+never about how long the process waited. ``FM_SPARK_TEST_SLEEP_SCALE``
+scales every designed sleep multiplicatively (the fault tests set
+0.25; unset = 1.0 = production timing).
+
+Scope discipline: the knob scales ONLY sleeps that are design choices.
+It must never scale measured durations, deadlines a test asserts on,
+or the watchdog's hang-detection windows — shrinking those would change
+the behavior under test, not just the wait for it.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV = "FM_SPARK_TEST_SLEEP_SCALE"
+
+
+def sleep_scale(default: float = 1.0) -> float:
+    """The designed-sleep multiplier: ``FM_SPARK_TEST_SLEEP_SCALE``
+    parsed as a float, clamped to [0, 1] — scaling sleeps UP is never
+    what a test wants, and production leaves the env unset."""
+    val = os.environ.get(ENV, "").strip()
+    if not val:
+        return float(default)
+    try:
+        scale = float(val)
+    except ValueError:
+        return float(default)
+    return min(max(scale, 0.0), 1.0)
+
+
+def scaled(seconds: float) -> float:
+    """``seconds * sleep_scale()`` — for designed-sleep call sites."""
+    return float(seconds) * sleep_scale()

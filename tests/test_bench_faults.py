@@ -28,7 +28,17 @@ def _run_bench(args, env, timeout):
     return subprocess.run(
         [sys.executable, BENCH] + args,
         capture_output=True, text=True, cwd=REPO, timeout=timeout,
-        env={**os.environ, "JAX_PLATFORMS": "cpu", **env},
+        # Designed sleeps (parent/supervisor backoffs) shrink 4x by
+        # default here — every assertion below is about BEHAVIOR
+        # (events journaled, retries counted, verdicts classified),
+        # never about how long a backoff waited. Deadlines, watchdog
+        # windows, and measured durations are NOT scaled
+        # (fm_spark_tpu/utils/sleeps.py). Override the env to rehearse
+        # production timing.
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "FM_SPARK_TEST_SLEEP_SCALE": os.environ.get(
+                 "FM_SPARK_TEST_SLEEP_SCALE", "0.25"),
+             **env},
     )
 
 

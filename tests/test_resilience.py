@@ -202,16 +202,38 @@ def test_ingest_fault_points_wired_into_stream_layer(tmp_path):
 # --------------------------------------------------------- BackoffPolicy
 
 
-def test_backoff_delay_is_bounded_exponential():
+def test_backoff_delay_is_bounded_exponential(monkeypatch):
+    # Pin the designed-sleep knob off: this test asserts EXACT delays.
+    monkeypatch.delenv("FM_SPARK_TEST_SLEEP_SCALE", raising=False)
     p = BackoffPolicy(initial=2.0, multiplier=2.0, max_delay=30.0,
                       jitter=0.0, max_attempts=8)
     assert [p.delay(k) for k in (1, 2, 3, 4, 5, 6)] == [
         2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
 
 
-def test_backoff_jitter_is_seeded_deterministic():
+def test_backoff_delay_respects_test_sleep_scale(monkeypatch):
+    """ISSUE 17 satellite: FM_SPARK_TEST_SLEEP_SCALE shrinks every
+    designed backoff multiplicatively (the fault suite asserts
+    behavior, not wall-clock), clamps to [0, 1], and ignores junk."""
+    p = BackoffPolicy(initial=8.0, multiplier=2.0, max_delay=30.0,
+                      jitter=0.0)
+    monkeypatch.setenv("FM_SPARK_TEST_SLEEP_SCALE", "0.25")
+    assert [p.delay(k) for k in (1, 2, 3)] == [2.0, 4.0, 7.5]
+    monkeypatch.setenv("FM_SPARK_TEST_SLEEP_SCALE", "5.0")
+    assert p.delay(1) == 8.0  # clamped: never scales sleeps UP
+    monkeypatch.setenv("FM_SPARK_TEST_SLEEP_SCALE", "not-a-number")
+    assert p.delay(1) == 8.0
+    from fm_spark_tpu.utils.sleeps import scaled, sleep_scale
+
+    monkeypatch.setenv("FM_SPARK_TEST_SLEEP_SCALE", "0.5")
+    assert sleep_scale() == 0.5
+    assert scaled(10.0) == 5.0
+
+
+def test_backoff_jitter_is_seeded_deterministic(monkeypatch):
     import random
 
+    monkeypatch.delenv("FM_SPARK_TEST_SLEEP_SCALE", raising=False)
     p = BackoffPolicy(initial=10.0, jitter=0.1)
     a = [p.delay(1, random.Random(7)) for _ in range(3)]
     b = [p.delay(1, random.Random(7)) for _ in range(3)]

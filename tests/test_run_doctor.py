@@ -430,3 +430,77 @@ def test_doctor_renders_static_analysis_section(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "OK" in out
     assert "3 baselined finding(s) still burning down" in out
+
+
+def test_doctor_renders_serving_fleet_section(tmp_path, capsys):
+    """ISSUE 17: a run dir holding a fleet health journal
+    (``fleet_health.jsonl``) gets a Serving fleet section — the
+    admission counters, the per-replica lifecycle table, and the
+    replica-loss -> recovery timeline as a finding."""
+    doctor = _load_doctor()
+    run_dir = tmp_path / "r17"
+    run_dir.mkdir()
+    (run_dir / "trace.jsonl").write_text("")
+    events = [
+        {"event": "replica_spawn", "replica": 0, "ts": 100.0},
+        {"event": "replica_ready", "replica": 0, "ts": 101.2,
+         "generation_step": 3},
+        {"event": "replica_spawn", "replica": 1, "ts": 100.0},
+        {"event": "replica_ready", "replica": 1, "ts": 101.4,
+         "generation_step": 3},
+        {"event": "replica_down", "replica": 1, "ts": 105.0,
+         "rc": 9, "reason": "process died", "incarnation": 1},
+        {"event": "replica_spawn", "replica": 1, "ts": 105.1},
+        {"event": "replica_ready", "replica": 1, "ts": 106.5,
+         "generation_step": 3},
+        {"event": "frontdoor_summary", "ts": 110.0, "accepted": 40,
+         "answered": 39, "timeout": 1, "failed": 0, "shed": 3,
+         "shed_queue": 1, "shed_deadline": 2, "rejected": 0,
+         "retries": 2},
+    ]
+    (run_dir / "fleet_health.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in events))
+    assert doctor.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "## Serving fleet" in out
+    assert "accepted 40  answered 39" in out
+    assert "shed 3 (queue 1 / deadline 2)" in out
+    assert "replica-loss -> recovery timeline" in out
+    assert "replica 1 down (rc=9) -> ready after 1.500s" in out
+    assert "replica 1 lost (rc=9) and re-admitted after 1.500s" in out
+
+
+def test_fleet_diagnose_unit_contracts():
+    """The fleet view's edge cases: no footprint -> None; open books
+    and generation skew -> loud findings; a crash-looping replica is
+    called out by name."""
+    doctor = _load_doctor()
+    assert doctor.fleet_diagnose({"snapshot": {}}, []) is None
+    # Snapshot-counter fallback when the door died before its summary.
+    run = {"snapshot": {"counters": {"frontdoor.accepted_total": 5,
+                                     "frontdoor.answered_total": 3}}}
+    fleet = doctor.fleet_diagnose(run, [])
+    assert fleet["counters"]["accepted"] == 5
+    finds = doctor.fleet_findings(fleet)
+    assert any("FLEET BOOKS OPEN" in f for f in finds)
+    # Generation skew across ready replicas + a crash-looper.
+    events = []
+    for _ in range(3):
+        events += [{"event": "replica_spawn", "replica": 0},
+                   {"event": "replica_down", "replica": 0, "rc": 1}]
+    events += [
+        {"event": "replica_spawn", "replica": 0},
+        {"event": "replica_ready", "replica": 0,
+         "generation_step": 7},
+        {"event": "replica_spawn", "replica": 1},
+        {"event": "replica_ready", "replica": 1,
+         "generation_step": 5},
+        {"event": "frontdoor_summary", "accepted": 2, "answered": 2,
+         "shed": 0, "shed_queue": 0, "shed_deadline": 0,
+         "rejected": 0, "timeout": 0, "failed": 0, "retries": 0},
+    ]
+    fleet = doctor.fleet_diagnose({"snapshot": {}}, events)
+    assert fleet["generation_skew"] == 2
+    finds = doctor.fleet_findings(fleet)
+    assert any("GENERATION SKEW" in f for f in finds)
+    assert any("CRASH-LOOPING" in f for f in finds)

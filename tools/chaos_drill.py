@@ -187,6 +187,20 @@ def _drift_entries(base_dir: str, soak: bool) -> list[dict]:
     return entries
 
 
+def _fleet_entries(base_dir: str, soak: bool) -> list[dict]:
+    """The serving-fleet half of the campaign (ISSUE 17): seeded
+    traffic-replay schedules (flash crowds, retry storms, slow
+    clients) against a REAL two-replica fleet behind the front door,
+    with mid-burst replica SIGKILLs, injected dispatch faults, and
+    publish+demote races — audited from artifacts alone
+    (:func:`chaos_audit.audit_fleet`)."""
+    from fm_spark_tpu.resilience import chaos
+
+    seeds = chaos.FLEET_SOAK_SEEDS if soak else chaos.FLEET_TIER1_SEEDS
+    return chaos.run_fleet_campaign(
+        seeds, base_dir=os.path.join(base_dir, "fleet"))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos campaigns over the resilience stack")
@@ -251,8 +265,10 @@ def main(argv=None) -> int:
         # campaign (ISSUE 13); an explicit --seeds/--schedules run is
         # a targeted replay and drills exactly what it names, and the
         # canary's broken-restore hook has no business in the online
-        # loop.
+        # loop. Fleet/traffic schedules (ISSUE 17) ride along under
+        # the same rule.
         extra.extend(_drift_entries(base_dir, soak=args.soak))
+        extra.extend(_fleet_entries(base_dir, soak=args.soak))
     if args.soak:
         extra.extend(_soak_subprocess_drills(
             dataclasses.replace(cfg, break_restore=False), base_dir))

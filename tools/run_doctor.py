@@ -325,6 +325,131 @@ def serve_findings(serve: dict | None, serve_legs: list[dict]
     return out
 
 
+def fleet_diagnose(run: dict, fleet_events: list[dict]
+                   ) -> dict | None:
+    """The serving-fleet view of a run (ISSUE 17): per-replica
+    lifecycle/generation state from the fleet health journal
+    (``fleet_health.jsonl``), the front door's admission accounting
+    (its ``frontdoor_summary`` journal event, falling back to the
+    snapshot's ``frontdoor.*`` counters), and the replica-loss ->
+    recovery timeline (each ``replica_down`` paired with that
+    replica's next ``replica_ready``). ``None`` when the run has no
+    fleet footprint."""
+    snap = run.get("snapshot") or {}
+    snap_counters = snap.get("counters") or {}
+    has_fd = any(k.startswith("frontdoor.")
+                 for k in snap_counters)
+    if not fleet_events and not has_fd:
+        return None
+    stats = None
+    replicas: dict[int, dict] = {}
+    recoveries: list[dict] = []
+    for e in fleet_events:
+        kind = e.get("event") or e.get("kind")
+        rep = e.get("replica")
+        r = None
+        if rep is not None:
+            r = replicas.setdefault(int(rep), {
+                "replica": int(rep), "spawns": 0, "downs": 0,
+                "state": "?", "generation_step": None,
+                "staleness_steps": None, "last_rc": None,
+                "_down_ts": None})
+        if kind == "replica_spawn" and r is not None:
+            r["spawns"] += 1
+            r["state"] = "starting"
+        elif kind == "replica_ready" and r is not None:
+            r["state"] = "ready"
+            if r.get("generation_step") is None:
+                r["generation_step"] = e.get("generation_step")
+            if r["_down_ts"] is not None and e.get("ts") is not None:
+                recoveries.append({
+                    "replica": int(rep), "down_ts": r["_down_ts"],
+                    "rc": r["last_rc"],
+                    "recovery_s": round(e["ts"] - r["_down_ts"], 3)})
+                r["_down_ts"] = None
+        elif kind == "replica_state" and r is not None:
+            if e.get("generation_step") is not None:
+                r["generation_step"] = e["generation_step"]
+            if e.get("staleness_steps") is not None:
+                r["staleness_steps"] = e["staleness_steps"]
+        elif kind == "replica_down" and r is not None:
+            r["downs"] += 1
+            r["state"] = "dead"
+            r["last_rc"] = e.get("rc")
+            if e.get("ts") is not None:
+                r["_down_ts"] = e["ts"]
+        elif kind == "replica_drained" and r is not None:
+            r["state"] = "suspect"
+        elif kind in ("fleet_shrink", "replica_retired"):
+            if r is not None:
+                r["state"] = "retired"
+        elif kind == "frontdoor_summary":
+            stats = e  # the door's closing books (flattened stats())
+    if stats is None and has_fd:
+        stats = {k.split(".", 1)[1].rsplit("_total", 1)[0]: v
+                 for k, v in snap_counters.items()
+                 if k.startswith("frontdoor.") and k.count(".") == 1}
+    counters = {k: int((stats or {}).get(k) or 0)
+                for k in ("accepted", "answered", "shed",
+                          "shed_queue", "shed_deadline", "rejected",
+                          "timeout", "failed", "retries")}
+    for r in replicas.values():
+        r.pop("_down_ts", None)
+    gens = [r["generation_step"] for r in replicas.values()
+            if r["generation_step"] is not None
+            and r["state"] == "ready"]
+    return {
+        "replicas": [replicas[i] for i in sorted(replicas)],
+        "counters": counters,
+        "recoveries": recoveries,
+        "generation_skew": (max(gens) - min(gens)) if gens else 0,
+    }
+
+
+def fleet_findings(fleet: dict | None) -> list[str]:
+    """Serving-fleet one-liners for the diagnosis section."""
+    if fleet is None:
+        return []
+    out = []
+    c = fleet["counters"]
+    offered = c["accepted"] + c["shed"] + c["rejected"]
+    if c["shed"] and offered and c["shed"] / offered > 0.25:
+        out.append(
+            f"FRONT DOOR SHEDDING {c['shed'] / offered:.0%} of "
+            f"offered load ({c['shed']} of {offered}) — unbounded "
+            "shed growth means the fleet is undersized for the "
+            "offered SLO (add replicas or loosen deadlines)")
+    if fleet["generation_skew"] > 0:
+        out.append(
+            f"GENERATION SKEW across ready replicas: "
+            f"{fleet['generation_skew']} step(s) — identical "
+            "requests score differently depending on the replica "
+            "drawn; check the lagging replica's reload journal")
+    closed = c["answered"] + c["timeout"] + c["failed"]
+    if c["accepted"] != closed:
+        out.append(
+            f"FLEET BOOKS OPEN: accepted={c['accepted']} but "
+            f"answered+timeout+failed={closed} — admitted request(s) "
+            "without a terminal outcome")
+    for rec in fleet["recoveries"]:
+        out.append(
+            f"replica {rec['replica']} lost (rc={rec['rc']}) and "
+            f"re-admitted after {rec['recovery_s']:.3f}s")
+    flapping = [r for r in fleet["replicas"] if r["downs"] >= 3]
+    for r in flapping:
+        out.append(
+            f"replica {r['replica']} CRASH-LOOPING: {r['downs']} "
+            f"death(s) over {r['spawns']} spawn(s) — check "
+            "fleet/replica_*.stderr")
+    if not out and (c["accepted"] or fleet["replicas"]):
+        out.append(
+            f"fleet clean: {c['accepted']} accepted / "
+            f"{c['answered']} answered, {c['shed']} shed, "
+            f"{c['retries']} retried, {len(fleet['replicas'])} "
+            "replica(s)")
+    return out
+
+
 def diagnose(run: dict, legs: list[dict],
              flight_events: list[dict]) -> dict:
     """The attribution numbers (testable separately from rendering)."""
@@ -568,7 +693,8 @@ def render(run: dict, diag: dict, legs: list[dict],
            online: dict | None = None,
            cost_rows: list[dict] | None = None,
            fmlint_rep: dict | None = None,
-           embed: dict | None = None) -> str:
+           embed: dict | None = None,
+           fleet: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -698,6 +824,35 @@ def render(run: dict, diag: dict, legs: list[dict],
             f"{str(serve['degraded']).lower()}")
         out.append("")
 
+    if fleet is not None:
+        out.append("## Serving fleet")
+        c = fleet["counters"]
+        out.append(
+            f"  accepted {c['accepted']}  answered {c['answered']}  "
+            f"shed {c['shed']} (queue {c['shed_queue']} / deadline "
+            f"{c['shed_deadline']})  rejected {c['rejected']}  "
+            f"timeout {c['timeout']}  failed {c['failed']}  retries "
+            f"{c['retries']}")
+        if fleet["replicas"]:
+            out.append(f"  {'replica':>8} {'state':>9} {'spawns':>7} "
+                       f"{'downs':>6} {'generation':>11} "
+                       f"{'staleness':>10}")
+            for r in fleet["replicas"]:
+                out.append(
+                    f"  {r['replica']:>8} {r['state']:>9} "
+                    f"{r['spawns']:>7} {r['downs']:>6} "
+                    f"{str(r['generation_step'] if r['generation_step'] is not None else '-'):>11} "
+                    f"{str(r['staleness_steps'] if r['staleness_steps'] is not None else '-'):>10}")
+        if fleet["recoveries"]:
+            out.append("  replica-loss -> recovery timeline:")
+            t0 = fleet["recoveries"][0]["down_ts"]
+            for rec in fleet["recoveries"]:
+                out.append(
+                    f"    +{rec['down_ts'] - t0:>8.3f}s replica "
+                    f"{rec['replica']} down (rc={rec['rc']}) -> "
+                    f"ready after {rec['recovery_s']:.3f}s")
+        out.append("")
+
     if embed is not None:
         out.append("## Embedding tier")
         hr = embed.get("hit_rate")
@@ -764,6 +919,7 @@ def render(run: dict, diag: dict, legs: list[dict],
     out.append("## Diagnosis")
     for line in (findings(diag, legs) + chaos_findings(chaos)
                  + serve_findings(serve, serve_legs)
+                 + fleet_findings(fleet)
                  + online_findings(online)
                  + embed_findings(embed)
                  + capture_findings(run.get("captures"))
@@ -808,6 +964,8 @@ def main(argv=None) -> int:
     online = online_diagnose(run, obs_report.online_timeline(flight_events),
                              _quality_rows(ledger_path, run["run_id"]))
     embed = embed_diagnose(run, _embed_rows(ledger_path, run["run_id"]))
+    fleet = fleet_diagnose(run, obs_report._read_jsonl(
+        os.path.join(obs_dir, "fleet_health.jsonl")))
     sys.stdout.write(render(run, diag, legs,
                             chaos=load_chaos_verdict(obs_dir),
                             serve=serve, serve_legs=serve_legs,
@@ -815,7 +973,7 @@ def main(argv=None) -> int:
                             cost_rows=_cost_rows(ledger_path,
                                                  run["run_id"]),
                             fmlint_rep=load_fmlint_report(obs_dir),
-                            embed=embed))
+                            embed=embed, fleet=fleet))
     return 0
 
 
