@@ -15,6 +15,8 @@ rules (ids are what ``# fmlint: disable=`` names):
 ``leg-provenance``       bench.py's leg_record carries run_id+fingerprint
 ``registry-coverage``    every fault point / watchdog phase / introspect
                          trigger appears in at least one tier-1 test
+``trace-propagation``    outbound HTTP requests from serve/ carry the
+                         X-FM-Trace context header (ISSUE 18)
 ``parse-error``          every scanned source must parse
 
 Plus the framework's own meta-rule, ``suppression-hygiene``: a
@@ -331,6 +333,57 @@ def registry_coverage(ctx):
                     f"{kind} {entry!r} ({literal}) is exercised by no "
                     "test under tests/ — a new entry must ship with "
                     "at least one tier-1 test that names it"))
+    return out
+
+
+#: The distributed-trace context header (ISSUE 18) and the
+#: ``http.client`` methods that put a request on the wire. The rule is
+#: scoped to ``fm_spark_tpu/serve/`` — the only package that makes
+#: process-to-process HTTP calls on the request path.
+TRACE_HEADER_NAME = "X-FM-Trace"
+TRACE_CLIENT_METHODS = ("request", "putrequest")
+
+
+@rule("trace-propagation",
+      "every outbound HTTP request from fm_spark_tpu/serve/ "
+      "(http.client .request()/.putrequest()) must carry the "
+      "X-FM-Trace context header — an unpropagated hop tears the "
+      "distributed trace in half (ISSUE 18)")
+def trace_propagation(ctx):
+    out = []
+    for sf in ctx.files_under("fm_spark_tpu/serve", recursive=False):
+        tree = sf.tree
+        if tree is None:
+            continue
+        # Per innermost enclosing function: does it reference the
+        # header (the literal, or obs.TRACE_HEADER by name)? Collect
+        # first, judge after — walk order is not source order.
+        refs: set = set()
+        calls: list = []
+        for node, func in walk_with_func(tree):
+            key = func or ""
+            if (isinstance(node, ast.Constant)
+                    and node.value == TRACE_HEADER_NAME):
+                refs.add(key)
+            elif ((isinstance(node, ast.Name)
+                   and node.id == "TRACE_HEADER")
+                  or (isinstance(node, ast.Attribute)
+                      and node.attr == "TRACE_HEADER")):
+                refs.add(key)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in TRACE_CLIENT_METHODS):
+                calls.append((node, key))
+        for node, key in calls:
+            if key not in refs:
+                out.append(Finding(
+                    "trace-propagation", sf.rel, node.lineno,
+                    f".{node.func.attr}() puts an HTTP request on "
+                    f"the wire with no {TRACE_HEADER_NAME} reference "
+                    "in the enclosing function — forward the trace "
+                    "context (obs.TRACE_HEADER) so the hop stitches, "
+                    "or suppress with the reason this call sits on a "
+                    "trust boundary", key))
     return out
 
 

@@ -1992,19 +1992,27 @@ def _serve_fleet(args, journal, cache_dir) -> int:
         journal = _EventLog(
             _os.path.join(obs.run_dir(), "fleet_health.jsonl"),
             mirror_to_flight=True)
+    # Replicas write their own obs run dirs under the SAME root as the
+    # parent's (the per-process span files tools/trace_report.py
+    # merges); no obs plane -> no replica tracing either.
+    obs_root = (_os.path.dirname(obs.run_dir()) if obs.run_dir()
+                else None)
     fleet = Fleet(
         args.model, n_replicas=args.fleet,
         chain_dir=args.checkpoint_dir, work_dir=work_dir,
         journal=journal, buckets=args.buckets,
         latency_budget_ms=args.latency_budget_ms,
         reload_poll_s=args.reload_poll_s,
-        compile_cache_dir=cache_dir)
+        compile_cache_dir=cache_dir,
+        obs_root=obs_root)
     fleet.start()
     admission = (AdmissionController(args.classes)
                  if args.classes else AdmissionController())
     door = FrontDoor(fleet, admission=admission,
                      port=args.frontdoor_port or 0,
-                     journal=journal).start()
+                     journal=journal,
+                     trace_sample=getattr(args, "trace_sample",
+                                          1.0)).start()
     print(json.dumps({"frontdoor": {
         "url": door.url, "replicas": args.fleet,
         "work_dir": work_dir,
@@ -2669,6 +2677,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="with --fleet: serve for this long then "
                          "exit cleanly (default 0 = until "
                          "SIGINT/SIGTERM)")
+    sv.add_argument("--trace-sample", type=float, default=1.0,
+                    dest="trace_sample", metavar="FRAC",
+                    help="fraction of accepted requests that get a "
+                         "distributed trace (ISSUE 18; default 1.0 — "
+                         "production fleets at high QPS should sample, "
+                         "e.g. 0.01: spans cost one JSONL write per "
+                         "hop)")
     sv.add_argument("--repeat", type=int, default=1,
                     help="passes over the request stream (reload drills "
                          "keep serving while a trainer advances the "

@@ -48,7 +48,14 @@ from fm_spark_tpu.obs.sentinel import (
     SentinelPolicy,
     keepbest_allowed,
 )
-from fm_spark_tpu.obs.trace import NOOP_SPAN, Span, Tracer
+from fm_spark_tpu.obs.trace import (
+    NOOP_SPAN,
+    Span,
+    TRACE_HEADER,
+    TraceContext,
+    Tracer,
+)
+from fm_spark_tpu.obs import trace as _trace_mod
 
 __all__ = [
     "FAULT_KINDS",
@@ -58,6 +65,8 @@ __all__ = [
     "Sentinel",
     "SentinelPolicy",
     "Span",
+    "TRACE_HEADER",
+    "TraceContext",
     "Tracer",
     "configure",
     "counter",
@@ -75,6 +84,7 @@ __all__ = [
     "introspect",
     "keepbest_allowed",
     "measurement_fingerprint",
+    "mint_trace",
     "new_run_id",
     "read_spool",
     "registry",
@@ -224,6 +234,18 @@ def emit_span(name: str, t_start: float, dur_s: float, **attrs) -> None:
     tr = _state["tracer"]
     if tr is not None:
         tr.emit_span(name, t_start, dur_s, **attrs)
+
+
+def mint_trace(sample: float = 1.0) -> TraceContext | None:
+    """Mint a per-request :class:`TraceContext` (the distributed-trace
+    front door hook, ISSUE 18), or None when tracing is off or the
+    request is sampled out. Disabled-path contract: one tracer check —
+    an unconfigured process never pays the urandom/random cost (held to
+    the ≤1% bound in tests/test_obs_overhead.py)."""
+    tr = _state["tracer"]
+    if tr is None or not tr.enabled:
+        return None
+    return _trace_mod.mint_trace(sample)
 
 
 def traced(name: str | None = None):

@@ -223,6 +223,11 @@ class CaptureEngine:
             "profiler": profiler,
             "files": sorted(os.listdir(bundle)),
         }
+        if context.get("traces"):
+            # Top-level pointer for report/tooling: the distributed
+            # trace ids (ISSUE 18) this bundle is the evidence for —
+            # resolvable via tools/trace_report.py.
+            manifest["trace_ids"] = list(context["traces"])
         # Manifest LAST and atomically: a bundle directory without a
         # parseable capture.json is a torn capture, and every reader
         # (obs_report/run_doctor) treats it as such.

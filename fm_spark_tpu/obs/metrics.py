@@ -107,7 +107,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "bounds", "_lock", "_counts", "count", "sum",
-                 "min", "max")
+                 "min", "max", "_exemplars")
 
     def __init__(self, name: str, buckets=None):
         self.name = name
@@ -121,8 +121,12 @@ class Histogram:
         self.sum = 0.0
         self.min: float | None = None
         self.max: float | None = None
+        # bucket index -> (value, exemplar id): the LAST exemplar-tagged
+        # observation to land in each bucket (ISSUE 18 — tail buckets
+        # remember the trace_ids that put them there).
+        self._exemplars: dict[int, tuple[float, str]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
         i = bisect.bisect_left(self.bounds, v)
         with self._lock:
@@ -133,6 +137,22 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if exemplar is not None:
+                self._exemplars[i] = (v, str(exemplar))
+
+    def exemplars(self) -> dict[str, dict]:
+        """Per-bucket exemplars keyed by the bucket's upper edge
+        (``"+Inf"`` for overflow): ``{le: {"value", "trace_id"}}``.
+        :func:`tail_exemplar` picks the slowest one — the id that
+        resolves a p99 figure to one concrete merged request trace."""
+        with self._lock:
+            items = dict(self._exemplars)
+        out = {}
+        for i, (v, ex) in sorted(items.items()):
+            le = (f"{self.bounds[i]:g}" if i < len(self.bounds)
+                  else "+Inf")
+            out[le] = {"value": round(v, 6), "trace_id": ex}
+        return out
 
     def percentile(self, p: float) -> float | None:
         """Interpolated p-quantile (``p`` in [0, 1]); None when empty."""
@@ -173,7 +193,7 @@ class Histogram:
         if count == 0:
             return {"count": 0, "sum": 0.0, "mean": None, "min": None,
                     "max": None, "p50": None, "p95": None, "p99": None}
-        return {
+        out = {
             "count": count,
             "sum": round(total, 6),
             "mean": round(total / count, 6),
@@ -183,6 +203,10 @@ class Histogram:
             "p95": round(self.percentile(0.95), 6),
             "p99": round(self.percentile(0.99), 6),
         }
+        exemplars = self.exemplars()
+        if exemplars:
+            out["exemplars"] = exemplars
+        return out
 
 
 class MetricsRegistry:
@@ -322,16 +346,51 @@ class MetricsRegistry:
                 bounds, counts, count, total = item.bucket_counts()
                 if not count:
                     continue
+                exemplars = item.exemplars()
                 lines.append(f"# TYPE {m} histogram")
                 cum = 0
                 for b, c in zip(bounds, counts):
                     cum += c
-                    lines.append(
-                        f'{m}_bucket{lab({"le": f"{b:g}"})} {cum}')
-                lines.append(f'{m}_bucket{lab({"le": "+Inf"})} {count}')
+                    line = f'{m}_bucket{lab({"le": f"{b:g}"})} {cum}'
+                    ex = exemplars.get(f"{b:g}")
+                    if ex:
+                        # OpenMetrics exemplar suffix: the trace_id
+                        # that landed in this bucket last (tail
+                        # buckets -> the p99's concrete request).
+                        line += (f' # {{trace_id="{esc(ex["trace_id"])}"'
+                                 f'}} {num(ex["value"])}')
+                    lines.append(line)
+                line = f'{m}_bucket{lab({"le": "+Inf"})} {count}'
+                ex = exemplars.get("+Inf")
+                if ex:
+                    line += (f' # {{trace_id="{esc(ex["trace_id"])}"}} '
+                             f'{num(ex["value"])}')
+                lines.append(line)
                 lines.append(f"{m}_sum{lab()} {num(total)}")
                 lines.append(f"{m}_count{lab()} {count}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def bucket_snapshot(self) -> dict:
+        """Raw per-histogram bucket counts + exemplars — the fleet
+        metrics rollup's wire format (``/metrics.json`` on a replica):
+        summaries cannot be aggregated across processes, raw bucket
+        counts can (element-wise sum over identical bounds)."""
+        with self._lock:
+            items = dict(self._items)
+        out = {}
+        for name in sorted(items):
+            item = items[name]
+            if not isinstance(item, Histogram):
+                continue
+            bounds, counts, count, total = item.bucket_counts()
+            out[name] = {
+                "bounds": list(bounds),
+                "counts": counts,
+                "count": count,
+                "sum": round(total, 6),
+                "exemplars": item.exemplars(),
+            }
+        return out
 
 
 _GLOBAL = MetricsRegistry()

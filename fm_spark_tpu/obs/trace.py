@@ -30,13 +30,87 @@ from __future__ import annotations
 import functools
 import itertools
 import os
+import random
+import re
 import threading
 import time
 
-__all__ = ["NOOP_SPAN", "Span", "Tracer"]
+__all__ = ["NOOP_SPAN", "Span", "TraceContext", "TRACE_HEADER",
+           "Tracer", "mint_trace"]
 
 _SEQ = itertools.count(1)
 _TLS = threading.local()
+
+#: The cross-process propagation header (ISSUE 18): every HTTP hop
+#: inside the serving fleet carries ``X-FM-Trace: <trace_id>;<parent
+#: span_id>`` so spans minted in different processes stitch into one
+#: request timeline. fmlint's ``trace-propagation`` rule holds
+#: ``fm_spark_tpu/serve/`` to it.
+TRACE_HEADER = "X-FM-Trace"
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9_\-]{0,63}$")
+
+
+class TraceContext:
+    """Cross-process trace identity: the request's ``trace_id`` plus the
+    span_id of the hop that handed it over (the remote parent).
+
+    Stdlib-only and deliberately tiny — two string slots and a header
+    codec. A context is minted ONCE per accepted request at the front
+    door (:func:`mint_trace`) and re-derived at every hop via
+    :meth:`child`, so each process's spans carry the same ``trace``
+    attribute and a ``remote_parent`` link into the upstream process.
+    """
+
+    __slots__ = ("trace_id", "parent_span_id")
+
+    def __init__(self, trace_id: str, parent_span_id: str | None = None):
+        self.trace_id = str(trace_id)
+        self.parent_span_id = parent_span_id
+
+    def child(self, span_id: str | None) -> "TraceContext":
+        """The context to hand DOWNSTREAM from a hop whose span is
+        ``span_id`` (None — e.g. tracing disabled locally — keeps the
+        current parent so the chain degrades, never breaks)."""
+        if span_id is None:
+            return self
+        return TraceContext(self.trace_id, str(span_id))
+
+    def to_header(self) -> str:
+        return f"{self.trace_id};{self.parent_span_id or ''}"
+
+    @classmethod
+    def from_header(cls, value) -> "TraceContext | None":
+        """Parse an ``X-FM-Trace`` header value; junk (None, empty,
+        malformed, oversized tokens) returns None — an untrusted peer
+        must never crash the replica's request path."""
+        if not value or not isinstance(value, str):
+            return None
+        trace_id, _, parent = value.partition(";")
+        trace_id = trace_id.strip()
+        parent = parent.strip()
+        if not _TOKEN_RE.match(trace_id):
+            return None
+        if parent and not _TOKEN_RE.match(parent):
+            parent = ""
+        return cls(trace_id, parent or None)
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, "
+                f"{self.parent_span_id!r})")
+
+
+def mint_trace(sample: float = 1.0) -> TraceContext | None:
+    """Mint a fresh request trace, or None when sampled out.
+
+    ``sample`` is the kept fraction (the ``--trace-sample`` knob):
+    1.0 traces every request (the test default), 0.0 none. The id is
+    ``os.urandom`` hex — unique across the fleet's processes without
+    any coordination.
+    """
+    if sample < 1.0 and random.random() >= sample:
+        return None
+    return TraceContext(os.urandom(8).hex())
 
 
 def _stack() -> list:
@@ -171,7 +245,7 @@ class Tracer:
             "name": span.name,
             "span_id": span.span_id,
             "parent_id": span.parent_id,
-            "t_start": round(span.ts, 3),
+            "t_start": round(span.ts, 6),
             "dur_ms": round(span.dur_s * 1e3, 3),
             "thread": threading.get_ident(),
         }

@@ -102,19 +102,28 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("ids", "vals", "n", "future", "t_submit", "deadline")
+    __slots__ = ("ids", "vals", "n", "future", "t_submit", "t_wall",
+                 "deadline", "trace")
 
-    def __init__(self, ids, vals, deadline=None):
+    def __init__(self, ids, vals, deadline=None, trace=None):
         self.ids = ids
         self.vals = vals
         self.n = int(ids.shape[0])
         self.future = ServeFuture()
         self.t_submit = time.perf_counter()
+        #: Wall-clock twin of ``t_submit`` — the start stamp of the
+        #: request's retroactive ``serve/coalesce`` link span (stored,
+        #: never subtracted; durations stay monotonic).
+        self.t_wall = time.time()
         #: Absolute ``time.monotonic()`` deadline (None = unbounded).
         #: Propagated by the front door (ISSUE 17) so the coalescer
         #: never HOLDS a request past its SLO waiting for batch-mates,
         #: and never SCORES one that already expired in the queue.
         self.deadline = deadline
+        #: Distributed-trace context (ISSUE 18) or None: tags the
+        #: request's link span + latency exemplar, and rides into the
+        #: SLO-overrun capture context.
+        self.trace = trace
 
 
 _STOP = object()
@@ -266,10 +275,14 @@ class PredictEngine:
                 vals.astype(self._vals_dtype, copy=False))
 
     def _execute(self, gen: Generation, ids: np.ndarray,
-                 vals: np.ndarray) -> np.ndarray:
+                 vals: np.ndarray,
+                 exec_info: "dict | None" = None) -> np.ndarray:
         """One padded-bucket dispatch on ``gen``; returns the first
         ``n`` scores as host floats. The ONLY dispatch path — spans,
-        SLO watchdog, and the zero-compile property all live here."""
+        SLO watchdog, and the zero-compile property all live here.
+        ``exec_info`` (out-param) receives the shared batch span's id
+        + perf-clock bounds so the coalescer's per-request link spans
+        can decompose wait/execute/split."""
         n = ids.shape[0]
         bucket = self._bucket_for(n)
         compiled = self._compiled.get(bucket)
@@ -285,11 +298,14 @@ class PredictEngine:
                 [vals, np.zeros((pad, self.nnz), self._vals_dtype)])
         t0 = time.perf_counter()
         with obs.span("serve/batch", rows=n, bucket=bucket,
-                      gen_step=gen.step):
+                      gen_step=gen.step) as bsp:
             with watchdog.phase("serve_request"):
                 out = np.asarray(compiled(gen.params, ids, vals))
-        obs.histogram("serve/batch_ms").observe(
-            (time.perf_counter() - t0) * 1e3)
+        t1 = time.perf_counter()
+        if exec_info is not None:
+            exec_info.update(span_id=getattr(bsp, "span_id", None),
+                             t0=t0, t1=t1)
+        obs.histogram("serve/batch_ms").observe((t1 - t0) * 1e3)
         obs.counter("serve.batches_total").add(1)
         obs.counter("serve.rows_total").add(n)
         if pad:
@@ -322,21 +338,24 @@ class PredictEngine:
                     daemon=True)
                 self._worker.start()
 
-    def submit(self, ids, vals,
-               deadline: float | None = None) -> ServeFuture:
+    def submit(self, ids, vals, deadline: float | None = None,
+               trace=None) -> ServeFuture:
         """Enqueue one request (<= bucket-max rows) for coalescing;
         returns its :class:`ServeFuture`. ``deadline`` is an absolute
         ``time.monotonic()`` timestamp: the coalescer stops gathering
         at the batch's earliest deadline, and a request that expires
         while still queued is answered with :class:`TimeoutError`
-        (exactly once, never scored, never silently dropped)."""
+        (exactly once, never scored, never silently dropped).
+        ``trace`` (a :class:`~fm_spark_tpu.obs.trace.TraceContext`)
+        yields one ``serve/coalesce`` link span joining this request
+        to the shared micro-batch execute span."""
         ids, vals = self._coerce(ids, vals)
         if ids.shape[0] > self.buckets[-1]:
             raise ValueError(
                 f"submit() takes at most bucket-max ({self.buckets[-1]}) "
                 "rows per request; use predict() to auto-chunk")
         self._ensure_worker()
-        req = _Request(ids, vals, deadline=deadline)
+        req = _Request(ids, vals, deadline=deadline, trace=trace)
         obs.counter("serve.requests_total").add(1)
         self._queue.put(req)
         return req.future
@@ -419,8 +438,10 @@ class PredictEngine:
                    np.concatenate([r.ids for r in batch]))
             vals = (batch[0].vals if len(batch) == 1 else
                     np.concatenate([r.vals for r in batch]))
+            exec_info: dict = {}
             try:
-                out = self._execute(gen, ids, vals)
+                out = self._execute(gen, ids, vals,
+                                    exec_info=exec_info)
             except BaseException as e:  # noqa: BLE001 — every queued
                 # caller must be answered (exactly once), even by the
                 # failure; HangDetected and injected faults land here.
@@ -439,6 +460,13 @@ class PredictEngine:
                                    elapsed_s=round(e.elapsed_s, 3),
                                    rows=int(ids.shape[0]),  # fmlint: disable=jax-host-sync -- ids is a host np.ndarray (coalesced request rows), not a traced value
                                    gen_step=gen.step)
+                    # The offending requests' trace ids ride the
+                    # capture context verbatim into capture.json —
+                    # the bundle names the traces it explains.
+                    traces = [r.trace.trace_id for r in batch
+                              if r.trace is not None][:8]
+                    if traces:
+                        overrun["traces"] = traces
                     obs.counter("serve.slo_overruns_total").add(1)
                     armed = False
                     bundle = None
@@ -474,10 +502,34 @@ class PredictEngine:
             off = 0
             t_done = time.perf_counter()
             hist = obs.histogram("serve/request_ms")
+            exec_sid = exec_info.get("span_id")
+            t_exec0 = exec_info.get("t0", t_done)
+            t_exec1 = exec_info.get("t1", t_done)
             for r in batch:
                 r.future._set(out[off:off + r.n])
                 off += r.n
-                hist.observe((t_done - r.t_submit) * 1e3)
+                lat_ms = (t_done - r.t_submit) * 1e3
+                hist.observe(lat_ms,
+                             exemplar=(r.trace.trace_id
+                                       if r.trace is not None
+                                       else None))
+                if r.trace is not None:
+                    # One link span per coalesced request: the
+                    # request's queue-to-split window, joined to the
+                    # SHARED ``serve/batch`` span via ``exec_span``
+                    # (N requests, one execute — the coalescing
+                    # topology stays visible in the merged trace).
+                    obs.emit_span(
+                        "serve/coalesce", r.t_wall,
+                        t_done - r.t_submit,
+                        trace=r.trace.trace_id,
+                        remote_parent=r.trace.parent_span_id,
+                        exec_span=exec_sid,
+                        queue_ms=round(
+                            (t_exec0 - r.t_submit) * 1e3, 3),
+                        exec_ms=round((t_exec1 - t_exec0) * 1e3, 3),
+                        split_ms=round((t_done - t_exec1) * 1e3, 3),
+                        rows=r.n)
 
     def close(self) -> None:
         """Stop the coalescer after answering everything queued."""

@@ -58,7 +58,7 @@ from fm_spark_tpu.resilience import faults
 from fm_spark_tpu.resilience.elastic import ElasticController
 from fm_spark_tpu.utils.logging import EventLog
 
-__all__ = ["Fleet", "ReplicaHandle", "replica_main"]
+__all__ = ["ConnectionPool", "Fleet", "ReplicaHandle", "replica_main"]
 
 #: Parent-side health cadence and thresholds.
 DEFAULT_HEALTH_POLL_S = 0.25
@@ -81,13 +81,85 @@ def _write_port_file(path: str, port: int) -> None:
     os.replace(tmp, path)
 
 
-def _http_json(host, port, method, path, body=None, timeout_s=2.0):
-    """One JSON request to a replica; returns (status, doc)."""
-    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
-    try:
-        payload = _json_body(body) if body is not None else None
-        headers = ({"Content-Type": "application/json"}
-                   if payload else {})
+class ConnectionPool:
+    """Bounded keep-alive pool of :class:`http.client.HTTPConnection`
+    to ONE replica (ISSUE 18 — ROADMAP item 3's dispatch remainder).
+
+    A fresh TCP connect per dispatch was pure transport tax; replicas
+    speak HTTP/1.1, so the parent parks the connection after each
+    response and the next dispatch to the same replica reuses it
+    (``fleet.dispatch_reused_connection_total`` counts the wins —
+    visible next to the transport hop in the trace report). Stale
+    sockets (replica died, restarted, or idled out) surface as an
+    exception on first use; :func:`_http_json` retries ONCE on a fresh
+    connection before failing upward. Thread-safe; the pool never
+    blocks — an empty pool just dials.
+    """
+
+    def __init__(self, host: str, port: int, max_idle: int = 4):
+        self.host, self.port = host, int(port)
+        self.max_idle = int(max_idle)
+        self._lock = threading.Lock()
+        self._idle: list = []
+        self._closed = False
+
+    def fresh(self):
+        return http.client.HTTPConnection(self.host, self.port)
+
+    def take(self):
+        """(connection, reused) — a parked connection when one exists,
+        else a fresh dial."""
+        with self._lock:
+            if self._idle:
+                return self._idle.pop(), True
+        return self.fresh(), False
+
+    def give(self, conn) -> None:
+        """Park a connection whose response was fully read."""
+        with self._lock:
+            if not self._closed and len(self._idle) < self.max_idle:
+                self._idle.append(conn)
+                return
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001 — closing is best-effort
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _http_json(host, port, method, path, body=None, timeout_s=2.0,
+               trace=None, pool=None):
+    """One JSON request to a replica; returns (status, doc).
+
+    ``trace`` (a :class:`~fm_spark_tpu.obs.trace.TraceContext`) rides
+    the ``X-FM-Trace`` header so the replica's spans join the caller's
+    timeline. ``pool`` enables keep-alive: take/give through it, with
+    one fresh-connection retry when a REUSED socket turns out stale
+    (a fresh socket's failure is real and propagates).
+    """
+    payload = _json_body(body) if body is not None else None
+
+    def _attempt(conn):
+        # The one serve-side seam that puts dispatch bytes on the
+        # wire; fmlint's trace-propagation rule anchors on the header
+        # reference below.
+        conn.timeout = timeout_s
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout_s)
+        headers = {}
+        if payload is not None:
+            headers["Content-Type"] = "application/json"
+        if trace is not None:
+            headers[obs.TRACE_HEADER] = trace.to_header()
         conn.request(method, path, body=payload, headers=headers)
         resp = conn.getresponse()
         raw = resp.read()
@@ -95,9 +167,42 @@ def _http_json(host, port, method, path, body=None, timeout_s=2.0):
             doc = json.loads(raw.decode() or "{}")
         except ValueError:
             doc = {}
-        return resp.status, doc
-    finally:
+        return resp.status, doc, bool(resp.will_close)
+
+    if pool is None:
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=timeout_s)
+        try:
+            status, doc, _ = _attempt(conn)
+            return status, doc
+        finally:
+            conn.close()
+
+    conn, reused = pool.take()
+    try:
+        try:
+            status, doc, will_close = _attempt(conn)
+        except (http.client.HTTPException, OSError):
+            conn.close()
+            if not reused:
+                raise
+            # Parked socket went stale between dispatches: one retry
+            # on a fresh dial before the failure goes upward.
+            conn, reused = pool.fresh(), False
+            status, doc, will_close = _attempt(conn)
+    except BaseException:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        raise
+    if reused:
+        obs.counter("fleet.dispatch_reused_connection_total").add(1)
+    if will_close:
         conn.close()
+    else:
+        pool.give(conn)
+    return status, doc
 
 
 # =================================================== parent-side fleet
@@ -116,6 +221,14 @@ class ReplicaHandle:
         self.last_doc: dict = {}
         self.spawned_at = None
         self.incarnations = 0
+        self.pool: "ConnectionPool | None" = None
+        self.metrics_doc: dict = {}
+        self.scrape_tick = 0
+
+    def drop_pool(self) -> None:
+        pool, self.pool = self.pool, None
+        if pool is not None:
+            pool.close()
 
     def doc(self) -> dict:
         return {
@@ -142,7 +255,8 @@ class Fleet:
                  health_poll_s: float = DEFAULT_HEALTH_POLL_S,
                  spawn_timeout_s: float = SPAWN_TIMEOUT_S,
                  replica_env: "dict | None" = None,
-                 max_shrinks: "int | None" = None):
+                 max_shrinks: "int | None" = None,
+                 obs_root: "str | None" = None):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
         self.model_dir = model_dir
@@ -156,6 +270,10 @@ class Fleet:
         self.health_poll_s = float(health_poll_s)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.replica_env = dict(replica_env or {})
+        #: When set, each replica gets ``--obs-dir`` here and opens its
+        #: own run dir under it — the per-process span files
+        #: ``tools/trace_report.py`` merges into one request timeline.
+        self.obs_root = obs_root
         os.makedirs(work_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._rr = 0
@@ -230,6 +348,8 @@ class Fleet:
                     "--reload-poll-s", str(self.reload_poll_s)]
         if self.compile_cache_dir:
             cmd += ["--compile-cache", self.compile_cache_dir]
+        if self.obs_root:
+            cmd += ["--obs-dir", self.obs_root]
         env = dict(os.environ)
         # The child must import this very package even when the parent
         # runs from an arbitrary cwd.
@@ -246,6 +366,7 @@ class Fleet:
             rep.proc = subprocess.Popen(
                 cmd, env=env, stdout=subprocess.DEVNULL, stderr=errf)
         rep.port = None
+        rep.drop_pool()  # the old incarnation's sockets are dead
         rep.state = "starting"
         rep.health_failures = 0
         rep.spawned_at = time.monotonic()
@@ -302,11 +423,16 @@ class Fleet:
                 return
             with self._lock:
                 rep.port = port
+                rep.pool = ConnectionPool("127.0.0.1", port)
         try:
             status, doc = _http_json("127.0.0.1", rep.port, "GET",
                                      "/healthz", timeout_s=2.0)
         except OSError:
             status, doc = None, {}
+        if status == 200:
+            rep.scrape_tick += 1
+            if rep.scrape_tick % 4 == 1:
+                self._scrape_metrics(rep)
         with self._lock:
             was = rep.state
             if status == 200 and doc.get("ready"):
@@ -345,6 +471,7 @@ class Fleet:
             if self._stopping or rep.state == "retired":
                 return
             rep.state = "dead"
+            rep.drop_pool()
             self._journal("replica_down", replica=rep.idx, rc=rc,
                           reason=reason,
                           incarnation=rep.incarnations)
@@ -407,9 +534,11 @@ class Fleet:
             self._rr += 1
             return rep
 
-    def score(self, ids, vals, deadline: float):
+    def score(self, ids, vals, deadline: float, trace=None):
         """Dispatch one admitted request; retry ONCE on a different
-        live replica if the first dies/fails mid-flight."""
+        live replica if the first dies/fails mid-flight. ``trace``
+        propagates cross-process: the dispatch hop gets its own span
+        and the replica receives a context parented to it."""
         tried: list[int] = []
         last_error = "no ready replica"
         for attempt in (1, 2):
@@ -424,13 +553,21 @@ class Fleet:
             if rep is None:
                 raise frontdoor.BackendError("no ready replica")
             tried.append(rep.idx)
+            sp = (obs.span("fleet/dispatch", trace=trace.trace_id,
+                           replica=rep.idx, attempt=attempt)
+                  if trace is not None else obs.NOOP_SPAN)
             try:
-                faults.inject("fleet_dispatch")
-                status, doc = _http_json(
-                    "127.0.0.1", rep.port, "POST", "/predict",
-                    body={"ids": ids, "vals": vals,
-                          "deadline_ms": remaining * 1e3},
-                    timeout_s=remaining + 0.25)
+                with sp as dsp:
+                    faults.inject("fleet_dispatch")
+                    child = (trace.child(getattr(dsp, "span_id",
+                                                 None))
+                             if trace is not None else None)
+                    status, doc = _http_json(
+                        "127.0.0.1", rep.port, "POST", "/predict",
+                        body={"ids": ids, "vals": vals,
+                              "deadline_ms": remaining * 1e3},
+                        timeout_s=remaining + 0.25,
+                        trace=child, pool=rep.pool)
             except Exception as e:  # noqa: BLE001 — connection died
                 # (replica killed mid-burst) or injected dispatch
                 # fault: mark suspect, retry once elsewhere
@@ -456,6 +593,31 @@ class Fleet:
         raise frontdoor.BackendError(
             f"dispatch failed after retry: {last_error}")
 
+    # ----------------------------------------------- metrics rollup
+
+    def _scrape_metrics(self, rep: ReplicaHandle) -> None:
+        """Pull one ``/metrics.json`` doc from a healthy replica (best
+        effort, off the dispatch path — runs on the health thread)."""
+        try:
+            status, doc = _http_json("127.0.0.1", rep.port, "GET",
+                                     "/metrics.json", timeout_s=2.0)
+        except OSError:
+            return
+        if status == 200 and isinstance(doc, dict):
+            with self._lock:
+                rep.metrics_doc = doc
+
+    def metrics_rollup(self) -> dict:
+        """The fleet-level observability rollup (ISSUE 18): last
+        scraped per-replica registry snapshot + RAW histogram bucket
+        counts, keyed by replica index —
+        :func:`fm_spark_tpu.obs.export.render_fleet_metrics` renders it
+        onto the front door's ``/metrics`` with ``replica`` labels."""
+        with self._lock:
+            reps = {r.idx: r.metrics_doc for r in self.replicas
+                    if r.metrics_doc}
+        return {"replicas": reps}
+
     # -------------------------------------------------------- healthz
 
     def healthz(self) -> dict:
@@ -477,6 +639,8 @@ class Fleet:
             self._stopping = True
         if self._monitor is not None:
             self._monitor.join(timeout=10.0)
+        for rep in self.replicas:
+            rep.drop_pool()
         for rep in self.replicas:
             proc = rep.proc
             if proc is None or proc.poll() is not None:
@@ -531,7 +695,16 @@ def replica_main(argv=None) -> int:
     ap.add_argument("--compile-cache", default=None)
     ap.add_argument("--nnz", type=int, default=None,
                     help="request width (default: spec.num_fields)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="obs ROOT: the replica opens its own run dir "
+                         "under it (per-process span files for the "
+                         "merged request trace)")
     args = ap.parse_args(argv)
+
+    if args.obs_dir:
+        # Own run dir, same root as the parent's: trace_report merges
+        # every process's trace.jsonl under the root into one timeline.
+        obs.configure(os.path.join(args.obs_dir, obs.new_run_id()))
 
     from fm_spark_tpu.models import load_model
     from fm_spark_tpu.serve.engine import PredictEngine
@@ -580,6 +753,10 @@ def replica_main(argv=None) -> int:
 
     class Handler(http.server.BaseHTTPRequestHandler):
         server_version = "fm-spark-replica/1"
+        # Keep-alive: the parent's per-replica ConnectionPool parks
+        # and reuses this very connection across dispatches; HTTP/1.0
+        # would close it after every reply.
+        protocol_version = "HTTP/1.1"
 
         def log_message(self, fmt, *args):
             pass
@@ -594,23 +771,48 @@ def replica_main(argv=None) -> int:
 
         def do_GET(self):  # noqa: N802 — http.server API
             try:
-                if self.path.split("?", 1)[0] != "/healthz":
-                    self.send_error(404, "want /healthz or /predict")
-                    return
-                self._reply(200, {
-                    "ready": ready.is_set(),
-                    "replica": args.replica_id,
-                    "pid": os.getpid(),
-                    "generation_step": engine.generation().step,
-                    "staleness_steps": reg.peek(
-                        "serve/staleness_steps"),
-                    "degraded": bool(reg.peek("serve/degraded") or 0),
-                    "reloads": (follower.reloads
-                                if follower is not None else 0),
-                    "reload_failures": (follower.failures
-                                        if follower is not None
-                                        else 0),
-                })
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    self._reply(200, {
+                        "ready": ready.is_set(),
+                        "replica": args.replica_id,
+                        "pid": os.getpid(),
+                        "generation_step": engine.generation().step,
+                        "staleness_steps": reg.peek(
+                            "serve/staleness_steps"),
+                        "degraded": bool(reg.peek("serve/degraded")
+                                         or 0),
+                        "reloads": (follower.reloads
+                                    if follower is not None else 0),
+                        "reload_failures": (follower.failures
+                                            if follower is not None
+                                            else 0),
+                    })
+                elif path == "/metrics":
+                    body = reg.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/metrics.json":
+                    # The fleet parent's rollup scrape: a snapshot
+                    # (counters/gauges/summaries) plus RAW histogram
+                    # buckets — summaries don't aggregate across
+                    # processes, bucket counts do.
+                    self._reply(200, {
+                        "replica": args.replica_id,
+                        "pid": os.getpid(),
+                        "snapshot": reg.snapshot(),
+                        "buckets": reg.bucket_snapshot(),
+                    })
+                else:
+                    self.send_error(
+                        404, "want /healthz, /metrics, "
+                             "/metrics.json or /predict")
             except Exception:  # noqa: BLE001 — scrape socket died
                 pass
 
@@ -626,23 +828,40 @@ def replica_main(argv=None) -> int:
                 faults.inject("replica_kill")
                 n = int(self.headers.get("Content-Length") or 0)
                 req = json.loads(self.rfile.read(n).decode() or "{}")
+                # Junk/absent header -> None -> the untraced path;
+                # an untrusted peer never crashes the request.
+                ctx = obs.TraceContext.from_header(
+                    self.headers.get(obs.TRACE_HEADER))
                 dl_ms = req.get("deadline_ms")
                 deadline = (time.monotonic() + float(dl_ms) / 1e3
                             if dl_ms is not None else None)
-                fut = engine.submit(req["ids"], req["vals"],
-                                    deadline=deadline)
-                wait = (max(deadline - time.monotonic(), 0.001)
-                        if deadline is not None else 30.0)
-                try:
-                    out = fut.result(wait)
-                except TimeoutError:
-                    self._reply(504, {"error": "deadline expired"})
-                    return
-                self._reply(200, {
+                sp = (obs.span("replica/handle",
+                               trace=ctx.trace_id,
+                               remote_parent=ctx.parent_span_id,
+                               replica=args.replica_id)
+                      if ctx is not None else obs.NOOP_SPAN)
+                with sp as hsp:
+                    child = (ctx.child(getattr(hsp, "span_id", None))
+                             if ctx is not None else None)
+                    fut = engine.submit(req["ids"], req["vals"],
+                                        deadline=deadline,
+                                        trace=child)
+                    wait = (max(deadline - time.monotonic(), 0.001)
+                            if deadline is not None else 30.0)
+                    try:
+                        out = fut.result(wait)
+                    except TimeoutError:
+                        self._reply(504,
+                                    {"error": "deadline expired"})
+                        return
+                doc = {
                     "scores": [float(x) for x in out],
                     "generation_step": engine.generation().step,
                     "replica": args.replica_id,
-                })
+                }
+                if ctx is not None:
+                    doc["trace"] = ctx.trace_id
+                self._reply(200, doc)
             except Exception as e:  # noqa: BLE001 — answer the
                 # client explicitly (injected faults land here too);
                 # a broken reply socket is the parent's signal
@@ -681,6 +900,8 @@ def replica_main(argv=None) -> int:
             follower.stop()
         engine.close()
         jlog("replica_stop", reason="sigterm")
+        if args.obs_dir:
+            obs.shutdown()
     return 0
 
 

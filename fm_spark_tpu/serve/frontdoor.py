@@ -43,12 +43,14 @@ from __future__ import annotations
 
 import dataclasses
 import http.server
+import inspect
 import json
 import socketserver
 import threading
 import time
 
 from fm_spark_tpu import obs
+from fm_spark_tpu.obs import export as obs_export
 from fm_spark_tpu.resilience import faults, watchdog
 
 __all__ = [
@@ -213,8 +215,9 @@ class LocalBackend:
         self.engine = engine
         self.follower = follower
 
-    def score(self, ids, vals, deadline: float):
-        fut = self.engine.submit(ids, vals, deadline=deadline)
+    def score(self, ids, vals, deadline: float, trace=None):
+        fut = self.engine.submit(ids, vals, deadline=deadline,
+                                 trace=trace)
         out = fut.result(max(deadline - time.monotonic(), 0.001))
         return out, {"generation_step": self.engine.generation().step,
                      "replica": 0}
@@ -251,10 +254,19 @@ class FrontDoor:
 
     def __init__(self, backend, *, admission=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 journal=None):
+                 journal=None, trace_sample: float = 1.0):
         self.backend = backend
         self.admission = admission or AdmissionController()
         self.journal = journal
+        self.trace_sample = float(trace_sample)
+        # Backends predate tracing; only thread the context through
+        # score() when the signature takes it (computed once, not per
+        # request).
+        try:
+            self._score_takes_trace = ("trace" in inspect.signature(
+                backend.score).parameters)
+        except (TypeError, ValueError):
+            self._score_takes_trace = False
         self._host, self._want_port = host, int(port)
         self._server = None
         self._thread = None
@@ -280,8 +292,19 @@ class FrontDoor:
                         if path == "/healthz":
                             self._reply(200, door._healthz_doc())
                         elif path == "/metrics":
-                            body = obs.registry().prometheus_text(
-                            ).encode()
+                            text = obs.registry().prometheus_text()
+                            rollup = getattr(door.backend,
+                                             "metrics_rollup", None)
+                            if rollup is not None:
+                                try:
+                                    text += (obs_export
+                                             .render_fleet_metrics(
+                                                 rollup()))
+                                except Exception:  # noqa: BLE001 —
+                                    # a torn replica scrape must not
+                                    # fail the front door's own dump
+                                    pass
+                            body = text.encode()
                             self.send_response(200)
                             self.send_header(
                                 "Content-Type",
@@ -380,6 +403,7 @@ class FrontDoor:
             "shed_deadline": c("frontdoor.shed_deadline_total"),
             "rejected": c("frontdoor.rejected_total"),
             "timeout": c("frontdoor.timeout_total"),
+            "slo_burn": c("frontdoor.slo_burn_total"),
             "failed": c("frontdoor.failed_total"),
             "retries": c("frontdoor.retries_total"),
             "admission": self.admission.snapshot(),
@@ -422,7 +446,17 @@ class FrontDoor:
                   or self.admission.classes[0].name)
         deadline_ms = req.get("deadline_ms")
 
-        v = self.admission.admit(cls, deadline_ms)
+        # One TraceContext per sampled request, minted HERE — the
+        # front door is the trust boundary; inbound X-FM-Trace headers
+        # from clients are ignored. ctx None = sampled out (or tracing
+        # disabled): the request runs the exact pre-trace path.
+        ctx = obs.mint_trace(self.trace_sample)
+        if ctx is not None:
+            with obs.span("frontdoor/admit", trace=ctx.trace_id,
+                          cls=cls, req_id=req_id):
+                v = self.admission.admit(cls, deadline_ms)
+        else:
+            v = self.admission.admit(cls, deadline_ms)
         if v.decision == "rejected":
             return 400, {"id": req_id,
                          "error": f"unknown class {cls!r}"}, None
@@ -437,12 +471,23 @@ class FrontDoor:
                       else spec.default_deadline_ms)
         t_in = time.monotonic()
         deadline = t_in + dl_ms / 1e3
+        sp_req = (obs.span("frontdoor/request", trace=ctx.trace_id,
+                           cls=cls, req_id=req_id)
+                  if ctx is not None else obs.NOOP_SPAN)
         try:
-            with watchdog.phase("frontdoor_request"):
-                out, meta = self.backend.score(ids, vals, deadline)
+            with watchdog.phase("frontdoor_request"), sp_req as sp:
+                trace_kw = {}
+                if ctx is not None and self._score_takes_trace:
+                    # Hand downstream a context parented to THIS hop's
+                    # span — the cross-process stitch point.
+                    trace_kw["trace"] = ctx.child(
+                        getattr(sp, "span_id", None))
+                out, meta = self.backend.score(ids, vals, deadline,
+                                               **trace_kw)
         except TimeoutError:
             self.admission.release(cls)
             obs.counter("frontdoor.timeout_total").add(1)
+            self._count_slo_burn(cls)
             return 504, {"id": req_id,
                          "error": "deadline expired"}, None
         except Exception as e:  # noqa: BLE001 — backend failed the
@@ -460,8 +505,22 @@ class FrontDoor:
         service_ms = (time.monotonic() - t_in) * 1e3
         self.admission.release(cls, service_ms=service_ms)
         obs.counter("frontdoor.answered_total").add(1)
-        obs.histogram("frontdoor/request_ms").observe(service_ms)
+        if service_ms > dl_ms:
+            # Answered, but late: SLO budget burned all the same.
+            self._count_slo_burn(cls)
+        obs.histogram("frontdoor/request_ms").observe(
+            service_ms, exemplar=ctx.trace_id if ctx else None)
         doc = {"id": req_id, "scores": [float(x) for x in out],
                "generation_step": meta.get("generation_step"),
                "replica": meta.get("replica")}
+        if ctx is not None:
+            doc["trace"] = ctx.trace_id
         return 200, doc, None
+
+    @staticmethod
+    def _count_slo_burn(cls: str) -> None:
+        """SLO burn-rate feed (ISSUE 18): one tick per request that
+        missed its deadline (504, or answered late) — burn rate =
+        rate(slo_burn_total) / rate(accepted_total) on any scraper."""
+        obs.counter("frontdoor.slo_burn_total").add(1)
+        obs.counter(f"frontdoor.slo_burn_total.{cls}").add(1)

@@ -26,6 +26,7 @@ import random
 import threading
 import time
 
+from fm_spark_tpu import obs
 from fm_spark_tpu.utils.logging import EventLog, read_events
 
 __all__ = [
@@ -189,7 +190,7 @@ def _post_predict(host: str, port: int, body: bytes, *,
     mobile uplink does."""
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     try:
-        conn.putrequest("POST", "/predict")
+        conn.putrequest("POST", "/predict")  # fmlint: disable=trace-propagation -- client side of the trust boundary: traces are MINTED at the front door (inbound X-FM-Trace is ignored there); the response's trace id tags the tap instead
         conn.putheader("Content-Type", "application/json")
         conn.putheader("Content-Length", str(len(body)))
         conn.endheaders()
@@ -243,6 +244,7 @@ def run_loadgen(host: str, port: int, schedule: TrafficSchedule,
                          (time.monotonic() - t_send) * 1e3, 3),
                      gen_step=doc.get("generation_step"),
                      replica=doc.get("replica"),
+                     trace=doc.get("trace"),
                      retry_after_ms=doc.get("retry_after_ms"))
 
     def one_event(ev):
@@ -258,6 +260,7 @@ def run_loadgen(host: str, port: int, schedule: TrafficSchedule,
         }).encode()
         for attempt in range(1, ev.max_retries + 2):
             t_send = time.monotonic()
+            t_send_wall = time.time()
             try:
                 status, doc = _post_predict(
                     host, port, body,
@@ -272,6 +275,16 @@ def run_loadgen(host: str, port: int, schedule: TrafficSchedule,
                 # client-visible failure, eligible for retry.
                 status, doc, outcome = None, {}, "error"
             emit(ev, attempt, status, outcome, t_send, doc)
+            if outcome == "ok" and doc.get("trace"):
+                # Retroactive client-side hop: when the loadgen runs
+                # in an obs-configured process, the request's full
+                # round trip joins the merged trace (wall start,
+                # monotonic duration).
+                obs.emit_span(
+                    "client/request", t_send_wall,
+                    time.monotonic() - t_send,
+                    trace=doc["trace"], req_id=ev.req_id,
+                    attempt=attempt, cls=ev.cls)
             if outcome == "ok" or attempt > ev.max_retries:
                 return
             if outcome == "rejected":

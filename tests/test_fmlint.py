@@ -160,6 +160,37 @@ FIXTURES = {
         },
         "expect": 1,
     },
+    "trace-propagation": {
+        "positive": {"fm_spark_tpu/serve/bad.py": """\
+            import http.client
+            def dispatch(port, body):
+                conn = http.client.HTTPConnection('127.0.0.1', port)
+                conn.request('POST', '/predict', body=body)
+                return conn.getresponse()
+        """},
+        "negative": {
+            "fm_spark_tpu/serve/good.py": """\
+                import http.client
+                def dispatch(port, body, trace):
+                    conn = http.client.HTTPConnection('127.0.0.1', port)
+                    headers = {'X-FM-Trace': trace.to_header()}
+                    conn.request('POST', '/predict', body=body,
+                                 headers=headers)
+                    return conn.getresponse()
+                def dispatch_by_name(port, body, trace, obs):
+                    conn = http.client.HTTPConnection('127.0.0.1', port)
+                    conn.request('POST', '/x', body=body,
+                                 headers={obs.TRACE_HEADER: trace})
+                    return conn.getresponse()
+            """,
+            # Off the serve/ request path: out of scope.
+            "fm_spark_tpu/other.py": """\
+                def fetch(conn):
+                    conn.request('GET', '/healthz')
+            """,
+        },
+        "expect": 1,
+    },
     "suppression-hygiene": {
         "positive": {"fm_spark_tpu/mod.py": """\
             def f():

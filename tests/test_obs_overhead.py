@@ -59,19 +59,24 @@ def _loop_instrumented(steps: int, step_s: float) -> float:
     ISSUE 14 introspection hooks — ``observe_step_time`` (the
     trainer's window feed) and ``fire`` (the sentinel/watchdog/serve
     hook) — both one module-global None check when no capture engine
-    is armed. (The live endpoint, obs/export.py, is pull-model: an
-    un-scraped process runs NO export code on any hot path, so there
-    is nothing of it to time here.)"""
+    is armed — plus the ISSUE 18 request-trace hooks: ``mint_trace``
+    (the front door's per-request mint, a no-op returning None when
+    unconfigured) and the trace-aware exemplar observe. (The live
+    endpoint, obs/export.py, is pull-model: an un-scraped process
+    runs NO export code on any hot path, so there is nothing of it
+    to time here.)"""
     from fm_spark_tpu.obs import introspect
 
     obs_on = obs.enabled()
     hist = obs.histogram("overhead_test_ms") if obs_on else None
     t0 = time.perf_counter()
     for _ in range(steps):
+        ctx = obs.mint_trace()
         with obs.span("overhead/step"):
             _spin(step_s)
         if obs_on:
-            hist.observe(0.0)
+            hist.observe(0.0, exemplar=(ctx.trace_id
+                                        if ctx is not None else None))
         introspect.observe_step_time(step_s * 1e3)
         introspect.fire("step_time_spike")
     return time.perf_counter() - t0

@@ -238,6 +238,14 @@ def render(run: dict) -> str:
                        f"{share:>6.1%}")
     else:
         out.append("(no span trace — pre-obs layout or tracing disabled)")
+    traced = {str(s.get("trace")) for s in spans if s.get("trace")}
+    if traced:
+        # Request-scoped spans (ISSUE 18): this run dir holds one
+        # process's slice — cross-process stitching lives elsewhere.
+        out.append(
+            f"{len(traced)} distinct request trace id(s) in this "
+            "process's spans — merge the fleet's view with "
+            "tools/trace_report.py <obs root>")
     out.append("")
 
     snap = run["snapshot"]

@@ -28,6 +28,11 @@ turns the run's streams into ONE screen a human can act on:
   finding counts, unbaselined (build-failing) findings, reasoned
   suppressions, and the baseline burn-down — analysis regressions
   render next to perf ones;
+- **Request tracing** (ISSUE 18) — the top-k slowest distributed
+  traces merged from every process's span file under the obs root,
+  with each trace's dominant hop named and torn/incomplete traces
+  flagged (the write side lives in ``fm_spark_tpu/obs/trace.py``;
+  the merge logic in ``tools/trace_report.py``);
 - **Diagnosis** — the doctor's findings: cold-cache compile domination,
   attachment weather, ingest-bound execution, degraded/fallback legs,
   statistically-regressed legs, stale/degraded/regressed serving,
@@ -450,6 +455,68 @@ def fleet_findings(fleet: dict | None) -> list[str]:
     return out
 
 
+def tracing_diagnose(obs_dir: str) -> dict | None:
+    """The distributed-tracing view of a run (ISSUE 18): merge every
+    process's span file under the shared obs ROOT (the run dir's
+    parent — front door, fleet parent, replicas and the client each
+    keep their own run dir there), rank traces by end-to-end wall,
+    and name the dominant hop of each. ``None`` when nothing under
+    the root carries a ``trace`` id."""
+    tr = _load_tool("trace_report")
+    root = os.path.dirname(os.path.normpath(obs_dir))
+    merged = tr.merge(root)
+    if not merged:
+        return None
+    ranked = sorted(merged.values(), key=lambda t: -t["total_ms"])
+    rows = []
+    for t in ranked[:5]:
+        bd = tr.breakdown(t)
+        rows.append({
+            "trace_id": t["trace_id"], "total_ms": t["total_ms"],
+            "hops": t["hops"], "pids": len(t["pids"]),
+            "dominant": bd.get("dominant"),
+            "incomplete": t["incomplete"],
+        })
+    ex = tr.tail_exemplar(root)
+    if ex is not None:
+        ex = dict(ex)
+        ex["resolved"] = ex["trace_id"] in merged
+    return {
+        "n_traces": len(merged),
+        "incomplete": sum(t["incomplete"] for t in merged.values()),
+        "top": rows,
+        "exemplar": ex,
+        "root": root,
+    }
+
+
+def tracing_findings(tracing: dict | None) -> list[str]:
+    """Distributed-tracing one-liners for the diagnosis section."""
+    if tracing is None:
+        return []
+    out = []
+    if tracing["top"]:
+        t = tracing["top"][0]
+        out.append(
+            f"slowest trace {t['trace_id']}: {t['total_ms']:.2f} ms "
+            f"end-to-end across {t['pids']} process(es) — dominant "
+            f"hop {t['dominant'] or '?'}")
+    if tracing["incomplete"]:
+        out.append(
+            f"{tracing['incomplete']} of {tracing['n_traces']} "
+            "trace(s) INCOMPLETE (torn span file, or a replica lost "
+            "mid-request) — the surviving hops still render; "
+            "tools/trace_report.py --trace <id> shows the hole")
+    ex = tracing.get("exemplar")
+    if ex is not None and not ex["resolved"]:
+        out.append(
+            f"tail exemplar trace {ex['trace_id']} does NOT resolve "
+            "to a merged trace — a process's trace.jsonl is missing "
+            "from the obs root (sampled out, or the writer died "
+            "before its first flush)")
+    return out
+
+
 def diagnose(run: dict, legs: list[dict],
              flight_events: list[dict]) -> dict:
     """The attribution numbers (testable separately from rendering)."""
@@ -694,7 +761,8 @@ def render(run: dict, diag: dict, legs: list[dict],
            cost_rows: list[dict] | None = None,
            fmlint_rep: dict | None = None,
            embed: dict | None = None,
-           fleet: dict | None = None) -> str:
+           fleet: dict | None = None,
+           tracing: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -853,6 +921,29 @@ def render(run: dict, diag: dict, legs: list[dict],
                     f"ready after {rec['recovery_s']:.3f}s")
         out.append("")
 
+    if tracing is not None:
+        out.append(
+            f"## Request tracing ({tracing['n_traces']} merged "
+            f"trace(s), {tracing['incomplete']} incomplete)")
+        out.append(f"  {'trace':>18} {'total_ms':>10} {'hops':>5} "
+                   f"{'pids':>5}  dominant hop")
+        for t in tracing["top"]:
+            flag = "  INCOMPLETE" if t["incomplete"] else ""
+            out.append(
+                f"  {str(t['trace_id'])[:18]:>18} "
+                f"{t['total_ms']:>10.2f} {t['hops']:>5} "
+                f"{t['pids']:>5}  {t['dominant'] or '?'}{flag}")
+        ex = tracing.get("exemplar")
+        if ex is not None:
+            out.append(
+                f"  tail exemplar: trace {ex['trace_id']} at "
+                f"{ex['value']:.2f} ms — "
+                + ("resolves to a merged trace" if ex["resolved"]
+                   else "NOT in the merged set"))
+        out.append("  full hop tables: python tools/trace_report.py "
+                   f"{tracing['root']}")
+        out.append("")
+
     if embed is not None:
         out.append("## Embedding tier")
         hr = embed.get("hit_rate")
@@ -921,6 +1012,7 @@ def render(run: dict, diag: dict, legs: list[dict],
                  + serve_findings(serve, serve_legs)
                  + fleet_findings(fleet)
                  + online_findings(online)
+                 + tracing_findings(tracing)
                  + embed_findings(embed)
                  + capture_findings(run.get("captures"))
                  + fmlint_findings(fmlint_rep)):
@@ -973,7 +1065,8 @@ def main(argv=None) -> int:
                             cost_rows=_cost_rows(ledger_path,
                                                  run["run_id"]),
                             fmlint_rep=load_fmlint_report(obs_dir),
-                            embed=embed, fleet=fleet))
+                            embed=embed, fleet=fleet,
+                            tracing=tracing_diagnose(obs_dir)))
     return 0
 
 
