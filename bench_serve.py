@@ -345,7 +345,7 @@ def _fleet_ladder(args, run_dir: str, cache_dir
         while time.monotonic() < deadline:
             h = fleet.healthz()
             live = [r for r in h["replicas"]
-                    if r["state"] != "retired"]
+                    if r["state"] not in ("retired", "parked")]
             if live and all(r["state"] == "ready" for r in live):
                 recovery_s = round(time.monotonic() - t_kill, 3)
                 break

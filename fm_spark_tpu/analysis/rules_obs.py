@@ -17,6 +17,9 @@ rules (ids are what ``# fmlint: disable=`` names):
                          trigger appears in at least one tier-1 test
 ``trace-propagation``    outbound HTTP requests from serve/ carry the
                          X-FM-Trace context header (ISSUE 18)
+``fleet-transport-discipline`` serve/ opens replica connections only
+                         through the netfault-aware transport, never
+                         raw http.client/socket (ISSUE 19)
 ``parse-error``          every scanned source must parse
 
 Plus the framework's own meta-rule, ``suppression-hygiene``: a
@@ -384,6 +387,47 @@ def trace_propagation(ctx):
                     "context (obs.TRACE_HEADER) so the hop stitches, "
                     "or suppress with the reason this call sits on a "
                     "trust boundary", key))
+    return out
+
+
+#: Raw-transport constructors banned in ``fm_spark_tpu/serve/``
+#: (ISSUE 19): a connection opened outside the netfault-aware seam
+#: (resilience/netfaults.FaultyHTTPConnection via ConnectionPool /
+#: ``_http_json``) is a transport path no partition schedule can
+#: reach — chaos coverage silently shrinks. The loadgen's client-side
+#: connection sits OUTSIDE the fleet's transport boundary and carries
+#: a reasoned suppression.
+TRANSPORT_BANNED = (
+    "http.client.HTTPConnection", "HTTPConnection",
+    "http.client.HTTPSConnection", "HTTPSConnection",
+    "socket.create_connection", "socket.socket",
+)
+
+
+@rule("fleet-transport-discipline",
+      "fm_spark_tpu/serve/ must open replica connections through the "
+      "netfault-aware transport (netfaults.FaultyHTTPConnection via "
+      "ConnectionPool/_http_json) — raw http.client/socket connects "
+      "bypass the fault plane, so partition chaos cannot reach them "
+      "(ISSUE 19)")
+def fleet_transport_discipline(ctx):
+    out = []
+    for sf in ctx.files_under("fm_spark_tpu/serve", recursive=False):
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node, func in walk_with_func(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in TRANSPORT_BANNED:
+                out.append(Finding(
+                    "fleet-transport-discipline", sf.rel, node.lineno,
+                    f"raw {name}() — the netfault plane cannot "
+                    "intercept this connection; route it through "
+                    "ConnectionPool/_http_json (or suppress with the "
+                    "reason this path sits outside the fleet's "
+                    "transport boundary)", func or ""))
     return out
 
 

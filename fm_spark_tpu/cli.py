@@ -1997,6 +1997,13 @@ def _serve_fleet(args, journal, cache_dir) -> int:
     # merges); no obs plane -> no replica tracing either.
     obs_root = (_os.path.dirname(obs.run_dir()) if obs.run_dir()
                 else None)
+    autoscaler = None
+    if getattr(args, "autoscale_max", 0):
+        from fm_spark_tpu.serve.autoscale import Autoscaler
+
+        autoscaler = Autoscaler(
+            min_replicas=1,
+            max_replicas=max(args.autoscale_max, args.fleet))
     fleet = Fleet(
         args.model, n_replicas=args.fleet,
         chain_dir=args.checkpoint_dir, work_dir=work_dir,
@@ -2004,7 +2011,8 @@ def _serve_fleet(args, journal, cache_dir) -> int:
         latency_budget_ms=args.latency_budget_ms,
         reload_poll_s=args.reload_poll_s,
         compile_cache_dir=cache_dir,
-        obs_root=obs_root)
+        obs_root=obs_root,
+        autoscaler=autoscaler)
     fleet.start()
     admission = (AdmissionController(args.classes)
                  if args.classes else AdmissionController())
@@ -2033,12 +2041,15 @@ def _serve_fleet(args, journal, cache_dir) -> int:
         stats = door.stats()
         health = fleet.healthz()
         door.stop()
-    print(json.dumps({"serve_summary": {
+    summary = {
         "frontdoor": stats,
         "fleet": {k: health[k] for k in
                   ("ready", "n_replicas", "capacity")},
         "replicas": health["replicas"],
-    }}), flush=True)
+    }
+    if fleet.autoscaler is not None:
+        summary["autoscale"] = fleet.autoscaler.summary()
+    print(json.dumps({"serve_summary": summary}), flush=True)
     if obs.enabled():
         obs.export_snapshot()
         print(json.dumps({
@@ -2662,6 +2673,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "door with deadline-aware admission control "
                          "(requires --model; --checkpoint-dir adds "
                          "per-replica hot reload)")
+    sv.add_argument("--autoscale-max", type=int, default=0,
+                    dest="autoscale_max", metavar="N",
+                    help="with --fleet: enable the bidirectional "
+                         "autoscaler (ISSUE 19) with this replica "
+                         "ceiling — grows on sustained front-door "
+                         "shed, parks idle replicas on low coalescer "
+                         "fill; decisions journal as "
+                         "autoscale_decision events (default 0 = "
+                         "fixed-size fleet)")
     sv.add_argument("--frontdoor-port", type=int, default=0,
                     dest="frontdoor_port", metavar="PORT",
                     help="front door listen port (default: ephemeral, "

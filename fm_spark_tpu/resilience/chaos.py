@@ -76,9 +76,12 @@ __all__ = [
     "golden_run",
     "minimize",
     "oracle_tap",
+    "partition_schedule",
     "run_campaign",
     "run_fleet_campaign",
     "run_fleet_schedule",
+    "run_partition_campaign",
+    "run_partition_schedule",
     "run_schedule",
     "serve_schedule",
     "write_worker",
@@ -1956,6 +1959,11 @@ class FleetDrillConfig:
     threads: int = 8
     spawn_timeout_s: float = 300.0
     converge_timeout_s: float = 30.0
+    #: > 0 arms the bidirectional autoscaler (serve/autoscale.py)
+    #: with this replica ceiling — the partition campaign runs with
+    #: it on so scale-up can race a partition; the plain fleet
+    #: campaign keeps it off (fixed-size fleet, PR-17 semantics).
+    autoscale_max: int = 0
 
 
 def build_fleet_stack(cfg: FleetDrillConfig, base_dir: str) -> dict:
@@ -1983,13 +1991,26 @@ def build_fleet_stack(cfg: FleetDrillConfig, base_dir: str) -> dict:
     ck.save(1, params, {}, None, force=True)
     ck.wait()
     journal = EventLog(os.path.join(base_dir, "fleet_health.jsonl"))
+    autoscaler = None
+    if cfg.autoscale_max:
+        from fm_spark_tpu.serve.autoscale import Autoscaler
+
+        # Drill-tempo policy: the health poll is 0.25s, so 2 sustain
+        # ticks = 0.5s of sustained shed before a grow, and a 24-tick
+        # cooldown (~6s) guarantees the bounded-decision audit even
+        # over a converge window.
+        autoscaler = Autoscaler(
+            min_replicas=cfg.n_replicas,
+            max_replicas=max(cfg.autoscale_max, cfg.n_replicas),
+            sustain_ticks=2, cooldown_ticks=24, journal=journal)
     fleet = Fleet(
         model_dir, n_replicas=cfg.n_replicas, chain_dir=chain_dir,
         work_dir=os.path.join(base_dir, "work"), journal=journal,
         buckets=cfg.buckets, latency_budget_ms=cfg.latency_budget_ms,
         reload_poll_s=cfg.reload_poll_s,
         compile_cache_dir=os.path.join(base_dir, "compile_cache"),
-        spawn_timeout_s=cfg.spawn_timeout_s)
+        spawn_timeout_s=cfg.spawn_timeout_s,
+        autoscaler=autoscaler)
     fleet.start()
     door = FrontDoor(
         fleet, admission=AdmissionController(cfg.classes),
@@ -2199,6 +2220,269 @@ def run_fleet_campaign(seeds=FLEET_TIER1_SEEDS,
             entries.append(run_fleet_schedule(
                 sched, cfg, ctx,
                 os.path.join(base_dir, f"f{int(seed)}")))
+    finally:
+        ctx["door"].stop()
+        ctx["ck"].close()
+    return entries
+
+
+# ------------------------------------ partition chaos (ISSUE 19)
+
+#: Partition drills: the network-fault plane
+#: (resilience/netfaults.py) composed with traffic shapes — the
+#: scenario the process-kill model cannot express: the parent loses
+#: the LINK to a replica whose process stays perfectly healthy.
+#: Graded by the partition extensions of :func:`audit_fleet`
+#: (partition_not_a_crash, autoscale_converged) on top of the usual
+#: exactly-once/closed-books contracts.
+
+PARTITION_TIER1_SEEDS = (0, 1, 2)
+
+_PARTITION_SCENARIOS = ("partition_flash_crowd", "slow_link_reload",
+                        "truncate_retry_storm",
+                        "scaleup_race_partition")
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionSchedule:
+    """One seeded partition drill: net-fault rules (peer-scoped
+    occurrence windows over ``net_connect``/``net_send``/``net_recv``)
+    composed with a loadgen shape, optionally with a mid-replay chain
+    publish pressed through the slow link. ``victim`` names the
+    replica the parent is partitioned from (None: the fault is
+    fleet-wide, not a partition). Pure function of the seed."""
+
+    seed: int
+    scenario: str
+    shape: str
+    rules: tuple = ()
+    victim: "int | None" = None
+    publish_mid_replay: bool = False
+    expects: str = "completed"
+
+    @property
+    def plan(self) -> str:
+        return ";".join(self.rules)
+
+    def validate(self) -> "PartitionSchedule":
+        faults.FaultPlan.from_spec(self.plan)
+        from fm_spark_tpu.serve import loadgen
+
+        if self.shape not in loadgen.SHAPES:
+            raise ValueError(f"unknown traffic shape {self.shape!r}")
+        return self
+
+
+def partition_schedule(seed: int,
+                       n_replicas: int = 2) -> PartitionSchedule:
+    """Seeded partition drill — scenario by ``seed % 4``, parameters
+    from the seeded rng (same purity contract as every schedule: the
+    failing entry IS its repro).
+
+    ``partition_flash_crowd``   the parent loses one replica's link
+                                (dials refused, writes reset) right as
+                                a flash crowd lands: accepted traffic
+                                retries onto the surviving replica,
+                                the victim is drained then readmitted
+                                after heal — never respawned
+    ``slow_link_reload``        one replica's response reads gain tens
+                                of ms of injected latency while the
+                                trainer publishes a new generation:
+                                the fleet converges to the tip anyway
+    ``truncate_retry_storm``    fleet-wide response truncations under
+                                a retry storm: a truncated response is
+                                recv-phase — NEVER replayed on another
+                                replica (the 503 goes back to the
+                                client, whose own retry keeps the
+                                books exactly-once)
+    ``scaleup_race_partition``  a partition_storm sheds hard enough to
+                                wake the autoscaler while one replica
+                                is partitioned away: grow races drain,
+                                and the decision log must stay bounded
+    """
+    rng = random.Random(0x5EED ^ (int(seed) << 4))
+    scenario = _PARTITION_SCENARIOS[int(seed)
+                                    % len(_PARTITION_SCENARIOS)]
+    victim: "int | None" = rng.randrange(max(1, int(n_replicas)))
+    publish = False
+    if scenario == "partition_flash_crowd":
+        shape = "flash_crowd"
+        # Window sized in OCCURRENCES (each health poll consumes one
+        # dial, each dispatch write one send): wide enough that the
+        # victim is reliably drained mid-crowd; the runner's
+        # faults.clear() after replay is the heal.
+        k = rng.randint(20, 32)
+        rules = (f"net_connect.replica-{victim}@1-{k}=refuse",
+                 f"net_send.replica-{victim}@1-{k}=reset")
+    elif scenario == "slow_link_reload":
+        shape = "diurnal"
+        ms = rng.choice((20, 40, 60))
+        k = rng.randint(12, 24)
+        rules = (f"net_recv.replica-{victim}@1-{k}=slow_ms:{ms}",)
+        victim = None   # slow, not severed: no drain is required
+        publish = True
+    elif scenario == "truncate_retry_storm":
+        shape = "retry_storm"
+        cut = rng.choice((5, 16, 48))
+        occs = sorted(rng.sample(range(3, 40), 3))
+        rules = tuple(f"net_recv@{n}=truncate_after:{cut}"
+                      for n in occs)
+        victim = None   # fleet-wide recv faults, not a partition
+    else:  # scaleup_race_partition
+        shape = "partition_storm"
+        k = rng.randint(20, 32)
+        rules = (f"net_connect.replica-{victim}@1-{k}=refuse",
+                 f"net_send.replica-{victim}@1-{k}=reset")
+    return PartitionSchedule(int(seed), scenario, shape,
+                             tuple(rules), victim=victim,
+                             publish_mid_replay=publish).validate()
+
+
+def _publish_step(ctx) -> int:
+    """Publish one new (non-demoted) generation mid-replay: the
+    reload traffic a slow link must carry without wedging the
+    follower."""
+    ck = ctx["ck"]
+    step = ctx["step"] + 1
+    ck.save(step, ctx["params"], {}, None, force=True)
+    ck.wait()
+    ctx["step"] = step
+    return step
+
+
+def run_partition_schedule(sched: PartitionSchedule,
+                           cfg: FleetDrillConfig, ctx: dict,
+                           out_dir: str) -> dict:
+    """Run one partition schedule against the shared stack; grade it
+    from artifacts alone (tap + counters + the run's own slice of
+    ``fleet_health.jsonl``)."""
+    from fm_spark_tpu.serve import loadgen
+
+    os.makedirs(out_dir, exist_ok=True)
+    door = ctx["door"]
+    fleet = ctx["fleet"]
+    journal_path = os.path.join(ctx["base_dir"],
+                                "fleet_health.jsonl")
+    n_journal0 = len(read_events(journal_path))
+    schedule = loadgen.make_schedule(
+        sched.shape, sched.seed, duration_s=cfg.duration_s,
+        base_rps=cfg.base_rps, rows=cfg.rows,
+        deadline_ms=cfg.deadline_ms)
+    tap_path = os.path.join(out_dir, "tap.jsonl")
+    before = door.stats()
+    published_step = None
+    t0 = time.perf_counter()
+    faults.activate(sched.plan)
+    try:
+        pub_timer = None
+        if sched.publish_mid_replay:
+            pub_timer = threading.Timer(
+                0.4 * cfg.duration_s,
+                lambda: ctx.update(_pub_step=_publish_step(ctx)))
+            pub_timer.start()
+        loadgen.run_loadgen(
+            "127.0.0.1", door.port, schedule, tap_path,
+            nnz=cfg.num_fields, num_features=cfg.num_features,
+            threads=cfg.threads)
+        if pub_timer is not None:
+            pub_timer.join()
+            published_step = ctx.pop("_pub_step", None)
+    finally:
+        # The heal: whatever occurrence window is left, the plan
+        # clears here — readmission is graded below.
+        faults.clear()
+    deadline = time.monotonic() + cfg.converge_timeout_s
+    while time.monotonic() < deadline:
+        snap = door.admission.snapshot()
+        if not any(snap["inflight"].values()):
+            break
+        time.sleep(0.05)
+    violations = []
+    tip = ctx["step"] if not ctx["tombstones"] else max(
+        s for s in range(1, ctx["step"] + 1)
+        if s not in ctx["tombstones"])
+    healed_s = None
+    t_rec = time.monotonic()
+    while time.monotonic() - t_rec < cfg.converge_timeout_s:
+        h = fleet.healthz()
+        live = [r for r in h["replicas"]
+                if r["state"] not in ("retired", "parked")]
+        if (live and all(r["state"] == "ready" for r in live)
+                and all(r["generation_step"] == tip for r in live)):
+            healed_s = time.monotonic() - t_rec
+            break
+        time.sleep(0.05)
+    if healed_s is None:
+        h = fleet.healthz()
+        states = [(r.get("replica"), r.get("state"),
+                   r.get("generation_step")) for r in h["replicas"]]
+        violations.append({
+            "invariant": "partition_not_a_crash",
+            "detail": f"fleet did not heal to tip {tip} within "
+                      f"{cfg.converge_timeout_s:.0f}s of the plan "
+                      f"clearing: {states}"})
+    counters = _fleet_stats_delta(before, door.stats())
+    replica_events = {}
+    for rep in fleet.replicas:
+        jpath = os.path.join(fleet.work_dir,
+                             f"replica_{rep.idx}.jsonl")
+        if os.path.exists(jpath):
+            replica_events[rep.idx] = read_events(jpath)
+    fleet_events = read_events(journal_path)[n_journal0:]
+    violations.extend(audit_fleet(
+        read_events(tap_path), counters,
+        expected_requests=schedule.n_requests,
+        tombstoned_steps=ctx["tombstones"],
+        replica_events=replica_events,
+        fleet_events=fleet_events,
+        partition_victim=sched.victim,
+        max_autoscale_decisions=(3 if fleet.autoscaler is not None
+                                 else None)))
+    summary = loadgen.summarize_tap(tap_path)
+    n_decisions = sum(
+        1 for e in fleet_events
+        if (e.get("event") or e.get("kind")) == "autoscale_decision")
+    return {
+        "seed": sched.seed, "scenario": sched.scenario,
+        "plan": sched.plan, "expects": sched.expects,
+        "outcome": "completed",
+        "verdict": "green" if not violations else "failed",
+        "violations": violations,
+        "duration_s": round(time.perf_counter() - t0, 3),
+        "traffic": {"shape": sched.shape,
+                    "requests": schedule.n_requests,
+                    **{k: summary["by_outcome"].get(k, 0)
+                       for k in ("ok", "shed", "error", "timeout")}},
+        "victim": sched.victim,
+        "published_step": published_step,
+        "autoscale_decisions": n_decisions,
+        "healed_s": (round(healed_s, 3)
+                     if healed_s is not None else None),
+        "counters": counters,
+    }
+
+
+def run_partition_campaign(seeds=PARTITION_TIER1_SEEDS,
+                           cfg: "FleetDrillConfig | None" = None,
+                           base_dir: "str | None" = None
+                           ) -> list[dict]:
+    """The partition half of the fleet chaos campaign: one shared
+    fleet WITH the autoscaler armed (scale-up must be able to race a
+    partition), every seed's schedule replayed against it, faults
+    cleared between schedules."""
+    import tempfile
+
+    cfg = cfg or FleetDrillConfig(autoscale_max=3)
+    base_dir = base_dir or tempfile.mkdtemp(prefix="partition_drill_")
+    ctx = build_fleet_stack(cfg, base_dir)
+    entries = []
+    try:
+        for seed in seeds:
+            sched = partition_schedule(seed,
+                                       n_replicas=cfg.n_replicas)
+            entries.append(run_partition_schedule(
+                sched, cfg, ctx,
+                os.path.join(base_dir, f"p{int(seed)}")))
     finally:
         ctx["door"].stop()
         ctx["ck"].close()

@@ -113,7 +113,11 @@ def audit_fleet(tap_events: list[dict], counters: dict, *,
                 expected_requests: int | None = None,
                 tombstoned_steps=(),
                 replica_events: "dict[int, list[dict]] | None" = None,
-                staleness_bound: int = 0) -> list[dict]:
+                staleness_bound: int = 0,
+                fleet_events: "list[dict] | None" = None,
+                partition_victim: "int | None" = None,
+                max_autoscale_decisions: "int | None" = None,
+                max_direction_changes: int = 1) -> list[dict]:
     """Fleet/traffic invariants over a load-replay run (ISSUE 17),
     graded from artifacts alone: the loadgen **tap** (one record per
     attempt: ``req_id``/``attempt``/``outcome``/``gen_step``), the
@@ -138,6 +142,20 @@ def audit_fleet(tap_events: list[dict], counters: dict, *,
       a demoted generation (the tap carries the scoring generation);
       replica journals are additionally held to the full serve
       invariants (torn swaps, staleness after recovery).
+
+    Partition-chaos extensions (ISSUE 19), graded from the fleet's
+    own ``fleet_health.jsonl`` slice (``fleet_events``):
+
+    - **partition_not_a_crash** — a replica partitioned away from the
+      parent (``partition_victim``) was suspected -> drained ->
+      readmitted through the normal green-poll gate, and NEVER
+      respawn-killed: after its first ``replica_drained`` there is a
+      ``replica_ready`` with no ``replica_spawn``/``replica_down``
+      in between (the process stayed alive; only the LINK failed);
+    - **autoscale_converged** — the autoscaler's journaled
+      ``autoscale_decision`` events are bounded
+      (``max_autoscale_decisions``) and do not flap: at most
+      ``max_direction_changes`` grow<->shrink direction reversals.
     """
     v: list[dict] = []
     stones = {int(s) for s in tombstoned_steps}
@@ -228,4 +246,54 @@ def audit_fleet(tap_events: list[dict], counters: dict, *,
                 viol["detail"] = (f"replica {idx} incarnation {inc}: "
                                   f"{viol['detail']}")
                 v.append(viol)
+    fev = fleet_events or []
+    if partition_victim is not None:
+        vic = int(partition_victim)
+        timeline = [(e.get("event") or e.get("kind")) for e in fev
+                    if e.get("replica") == vic]
+        try:
+            first_drain = timeline.index("replica_drained")
+        except ValueError:
+            first_drain = None
+        if first_drain is None:
+            v.append(_violation(
+                "partition_not_a_crash",
+                f"replica {vic} was the partition victim but was "
+                "never drained — the fault plane did not reach the "
+                "health poller"))
+        else:
+            after = timeline[first_drain + 1:]
+            if "replica_ready" not in after:
+                v.append(_violation(
+                    "partition_not_a_crash",
+                    f"replica {vic} was drained but never readmitted "
+                    "after the partition healed"))
+            else:
+                upto = after[:after.index("replica_ready")]
+                bad = [k for k in upto
+                       if k in ("replica_spawn", "replica_down")]
+                if bad:
+                    v.append(_violation(
+                        "partition_not_a_crash",
+                        f"replica {vic} saw {bad} between drain and "
+                        "readmission — a partitioned-but-alive "
+                        "replica was treated as a crash"))
+    if max_autoscale_decisions is not None:
+        actions = [e.get("action") for e in fev
+                   if (e.get("event") or e.get("kind"))
+                   == "autoscale_decision"]
+        if len(actions) > int(max_autoscale_decisions):
+            v.append(_violation(
+                "autoscale_converged",
+                f"{len(actions)} autoscale decisions "
+                f"(bound {max_autoscale_decisions}) — the policy did "
+                "not converge"))
+        flips = sum(1 for a, b in zip(actions, actions[1:])
+                    if a != b)
+        if flips > int(max_direction_changes):
+            v.append(_violation(
+                "autoscale_converged",
+                f"autoscaler flapped: {flips} grow<->shrink "
+                f"reversals (bound {max_direction_changes}) in "
+                f"{actions}"))
     return v

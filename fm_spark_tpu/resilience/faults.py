@@ -49,11 +49,14 @@ __all__ = [
     "ENV_PLAN",
     "ENV_STATE",
     "KNOWN_POINTS",
+    "NET_ACTIONS",
+    "NET_POINTS",
     "FaultInjected",
     "FaultPlan",
     "InjectedDeviceLoss",
     "activate",
     "clear",
+    "current_plan",
     "inject",
     "is_device_loss",
 ]
@@ -100,6 +103,18 @@ ENV_STATE = "FM_SPARK_FAULTS_STATE"
 #: request (serve/fleet.py) — an ``exit`` there is the
 #: SIGKILL-mid-burst drill: the parent sees the connection die and
 #: must answer the in-flight request exactly once elsewhere.
+#: Network fault plane (ISSUE 19): ``net_connect`` / ``net_send`` /
+#: ``net_recv`` fire in the parent's replica transport
+#: (serve/fleet.py's ConnectionPool + ``_http_json`` — dispatch,
+#: health poller, and metrics scraper all route through it) and take
+#: the socket-level actions below (``refuse``, ``blackhole``,
+#: ``slow_ms``, ``truncate_after``, ``reset``). They are interpreted
+#: by :mod:`fm_spark_tpu.resilience.netfaults`, not :func:`inject`,
+#: and uniquely support PEER SCOPING (``net_connect.replica-1``) and
+#: occurrence RANGES (``@3-9=``) so a schedule can partition the
+#: parent away from ONE replica for a bounded window while that
+#: replica stays healthy — the failure the process-kill model cannot
+#: express.
 KNOWN_POINTS = (
     "backend_init",
     "sweep_leg",
@@ -116,12 +131,32 @@ KNOWN_POINTS = (
     "frontdoor_accept",
     "replica_kill",
     "fleet_dispatch",
+    "net_connect",
+    "net_send",
+    "net_recv",
 )
+
+#: The network points and their socket-level action vocabulary
+#: (ISSUE 19). Net actions are only valid on ``net_*`` points (and
+#: vice versa peer scoping is only valid there); they are interpreted
+#: by :mod:`fm_spark_tpu.resilience.netfaults` at the transport seam.
+NET_POINTS = ("net_connect", "net_send", "net_recv")
+NET_ACTIONS = ("refuse", "blackhole", "slow_ms", "truncate_after",
+               "reset")
 
 #: The action vocabulary (public since ISSUE 10: the chaos schedule
 #: generator samples from it, and the eager-validation error cites it).
-ACTIONS = ("hang", "sleep", "exit", "device_loss", "error", "sigterm")
+ACTIONS = ("hang", "sleep", "exit", "device_loss", "error", "sigterm",
+           *NET_ACTIONS)
 _ACTIONS = ACTIONS
+
+#: Net actions that must carry a numeric parameter (``slow_ms:N`` in
+#: milliseconds, ``truncate_after:K`` in bytes).
+_PARAM_REQUIRED = ("slow_ms", "truncate_after")
+
+#: Occurrence-range expansion bound: ``point@1-512=...`` is the widest
+#: window one rule may cover (a wider one is almost certainly a typo).
+_MAX_RANGE = 512
 
 
 class FaultInjected(RuntimeError):
@@ -184,36 +219,71 @@ class FaultPlan:
         point fired — a typo'd plan silently tested nothing. Both are
         rejected up front with the registry/action set in the error.
         ``points=None`` disables the registry check (harness-internal
-        plans over synthetic points)."""
+        plans over synthetic points).
+
+        ISSUE 19 grammar extensions, for the network fault plane:
+        ``net_*`` points accept a PEER SCOPE (``net_connect.replica-1``
+        — fires only on that peer's transport, with its own occurrence
+        counter), and any rule accepts an occurrence RANGE
+        (``point@3-9=action`` expands to one rule per occurrence) so a
+        bounded partition window is one rule, not seven.
+        """
         rules = []
         for entry in spec.split(";"):
             entry = entry.strip()
             if not entry:
                 continue
             m = re.fullmatch(
-                r"(?P<point>[\w.-]+)@(?P<n>\d+)="
+                r"(?P<point>[\w.-]+)@(?P<n>\d+)(?:-(?P<n2>\d+))?="
                 r"(?P<action>[a-z_]+)(?::(?P<param>[\w.+-]+))?",
                 entry,
             )
             if m is None:
                 raise ValueError(
                     f"bad fault rule {entry!r} (want "
-                    "point@occurrence=action[:param])"
+                    "point@occurrence[-occurrence]=action[:param])"
                 )
             if m["action"] not in _ACTIONS:
                 raise ValueError(
                     f"unknown fault action {m['action']!r} "
                     f"(know {_ACTIONS})"
                 )
-            if points is not None and m["point"] not in points:
+            point = m["point"]
+            base = point.split(".", 1)[0]
+            if points is not None and point not in points:
+                # A dotted point is a peer-scoped NET point
+                # (``net_connect.replica-1``); scoping any other
+                # point is as much a typo as an unknown one.
+                if not ("." in point and base in NET_POINTS
+                        and base in points):
+                    raise ValueError(
+                        f"unknown fault point {point!r} — a rule "
+                        "naming a point nothing injects would silently "
+                        f"never fire (known points: {tuple(points)}; "
+                        f"actions: {_ACTIONS})"
+                    )
+            if m["action"] in NET_ACTIONS and base not in NET_POINTS:
                 raise ValueError(
-                    f"unknown fault point {m['point']!r} — a rule "
-                    "naming a point nothing injects would silently "
-                    f"never fire (known points: {tuple(points)}; "
-                    f"actions: {_ACTIONS})"
+                    f"net action {m['action']!r} on non-network point "
+                    f"{point!r} — socket-level actions only make "
+                    f"sense at {NET_POINTS} (see resilience/netfaults)"
                 )
-            rules.append(_Rule(m["point"], int(m["n"]), m["action"],
-                               m["param"]))
+            if (m["action"] in _PARAM_REQUIRED
+                    and not (m["param"] or "").replace(".", "").isdigit()):
+                raise ValueError(
+                    f"action {m['action']!r} needs a numeric "
+                    f"parameter (got {m['param']!r}) — e.g. "
+                    "slow_ms:50 or truncate_after:64"
+                )
+            first, last = int(m["n"]), int(m["n2"] or m["n"])
+            if last < first or last - first >= _MAX_RANGE:
+                raise ValueError(
+                    f"bad occurrence range {first}-{last} in "
+                    f"{entry!r} (want first <= last, width < "
+                    f"{_MAX_RANGE})"
+                )
+            for n in range(first, last + 1):
+                rules.append(_Rule(point, n, m["action"], m["param"]))
         return cls(rules)
 
     @classmethod
@@ -273,21 +343,33 @@ def _next_count(point: str) -> int:
         return data[point]
 
 
+def current_plan() -> "FaultPlan | None":
+    """The active plan, loading the environment lazily on first use —
+    the same resolution :func:`inject` performs, exposed so the
+    network fault plane (:mod:`fm_spark_tpu.resilience.netfaults`) can
+    consult the SAME plan and occurrence counters from the transport
+    seam."""
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan.from_env() or False
+    return None if _plan is False else _plan
+
+
 def inject(point: str) -> None:
     """Fault point: a no-op without an active plan; with one, the
     matching rule for this point's Nth occurrence fires (sleep / raise /
     exit / signal). Call sites name the observable failure surface —
     see :data:`KNOWN_POINTS` for the registry (device/runtime faults
-    plus the streaming-ingest data faults)."""
-    global _plan
-    if _plan is None:
-        _plan = FaultPlan.from_env() or False
-    if _plan is False:
+    plus the streaming-ingest data faults). ``net_*`` points are NOT
+    injected here — :mod:`fm_spark_tpu.resilience.netfaults` interprets
+    their socket-level actions at the transport seam."""
+    plan = current_plan()
+    if plan is None:
         return
-    if point not in _plan.points:
+    if point not in plan.points:
         return
     count = _next_count(point)
-    rule = _plan.rule_for(point, count)
+    rule = plan.rule_for(point, count)
     if rule is not None:
         rule.fire(count)
 

@@ -42,6 +42,7 @@ belongs to its journal).
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
 import http.server
 import json
@@ -54,11 +55,16 @@ import threading
 import time
 
 from fm_spark_tpu import obs
-from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.resilience import faults, netfaults
 from fm_spark_tpu.resilience.elastic import ElasticController
 from fm_spark_tpu.utils.logging import EventLog
 
-__all__ = ["ConnectionPool", "Fleet", "ReplicaHandle", "replica_main"]
+#: Re-exported: the classified transport error ``_http_json`` raises
+#: (phase + bytes_received — the exactly-once retry gate, ISSUE 19).
+TransportFailure = netfaults.TransportFailure
+
+__all__ = ["ConnectionPool", "Fleet", "HostSpec", "ReplicaAddr",
+           "ReplicaHandle", "TransportFailure", "replica_main"]
 
 #: Parent-side health cadence and thresholds.
 DEFAULT_HEALTH_POLL_S = 0.25
@@ -81,6 +87,32 @@ def _write_port_file(path: str, port: int) -> None:
     os.replace(tmp, path)
 
 
+@dataclasses.dataclass(frozen=True)
+class ReplicaAddr:
+    """Where the parent dials one replica (ISSUE 19 — ROADMAP item
+    3's multi-host remainder): every transport path (dispatch, health
+    poll, metrics scrape) threads this instead of a hardcoded
+    loopback literal."""
+
+    host: str
+    port: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSpec:
+    """Where/how one replica launches: a multi-host fleet is a config
+    change, not a rewrite. ``connect_host`` is what the parent dials,
+    ``bind_host`` what the replica's HTTP server binds, and ``spawn``
+    an optional launch hook ``(cmd, env, stderr_path) -> Popen-like``
+    (an ssh/container wrapper; it must arrange the shared ``work_dir``
+    the port files and journals land in). ``spawn=None`` is the local
+    subprocess — the tested default, loopback end to end."""
+
+    connect_host: str = "127.0.0.1"
+    bind_host: str = "127.0.0.1"
+    spawn: "object | None" = None
+
+
 class ConnectionPool:
     """Bounded keep-alive pool of :class:`http.client.HTTPConnection`
     to ONE replica (ISSUE 18 — ROADMAP item 3's dispatch remainder).
@@ -92,19 +124,28 @@ class ConnectionPool:
     visible next to the transport hop in the trace report). Stale
     sockets (replica died, restarted, or idled out) surface as an
     exception on first use; :func:`_http_json` retries ONCE on a fresh
-    connection before failing upward. Thread-safe; the pool never
-    blocks — an empty pool just dials.
+    connection before failing upward — but only when the failure was
+    exactly-once safe (see :class:`TransportFailure`). Thread-safe; the
+    pool never blocks — an empty pool just dials.
+
+    Every dial routes through the network fault plane
+    (:mod:`fm_spark_tpu.resilience.netfaults`): ``peer`` is the
+    logical label (``replica-N``) a chaos schedule scopes partition
+    rules to.
     """
 
-    def __init__(self, host: str, port: int, max_idle: int = 4):
+    def __init__(self, host: str, port: int, max_idle: int = 4,
+                 peer: "str | None" = None):
         self.host, self.port = host, int(port)
         self.max_idle = int(max_idle)
+        self.peer = peer
         self._lock = threading.Lock()
         self._idle: list = []
         self._closed = False
 
     def fresh(self):
-        return http.client.HTTPConnection(self.host, self.port)
+        return netfaults.FaultyHTTPConnection(self.host, self.port,
+                                              peer=self.peer)
 
     def take(self):
         """(connection, reused) — a parked connection when one exists,
@@ -137,14 +178,24 @@ class ConnectionPool:
 
 
 def _http_json(host, port, method, path, body=None, timeout_s=2.0,
-               trace=None, pool=None):
+               trace=None, pool=None, peer=None):
     """One JSON request to a replica; returns (status, doc).
 
     ``trace`` (a :class:`~fm_spark_tpu.obs.trace.TraceContext`) rides
     the ``X-FM-Trace`` header so the replica's spans join the caller's
     timeline. ``pool`` enables keep-alive: take/give through it, with
     one fresh-connection retry when a REUSED socket turns out stale
-    (a fresh socket's failure is real and propagates).
+    (a fresh socket's failure is real and propagates). ``peer`` labels
+    the transport for the network fault plane (netfaults).
+
+    Every transport failure surfaces as a :class:`TransportFailure`
+    classifying WHERE it struck — ``connect`` (dial), ``send``
+    (request write), ``recv`` (response read) — and whether any
+    response bytes had arrived. That classification is the
+    exactly-once gate (ISSUE 19 satellite): the stale-reuse retry
+    below and the fleet's dispatch retry both replay a request ONLY
+    when the replica cannot have answered it — a recv failure after
+    response bytes arrived is never replayed.
     """
     payload = _json_body(body) if body is not None else None
 
@@ -160,9 +211,31 @@ def _http_json(host, port, method, path, body=None, timeout_s=2.0,
             headers["Content-Type"] = "application/json"
         if trace is not None:
             headers[obs.TRACE_HEADER] = trace.to_header()
-        conn.request(method, path, body=payload, headers=headers)
-        resp = conn.getresponse()
-        raw = resp.read()
+        phase, got_response = "connect", False
+        try:
+            if conn.sock is None:
+                conn.connect()
+            phase = "send"
+            netfaults.on_send(peer, timeout_s=timeout_s)
+            conn.request(method, path, body=payload, headers=headers)
+            phase = "recv"
+            trunc = netfaults.on_recv(peer, timeout_s=timeout_s)
+            resp = conn.getresponse()
+            got_response = True  # status line + headers arrived
+            raw = resp.read()
+            if trunc is not None and trunc < len(raw):
+                raise TransportFailure(
+                    f"[netfault] response truncated after {trunc} "
+                    f"of {len(raw)} body bytes",
+                    phase="recv", bytes_received=max(1, trunc))
+        except TransportFailure:
+            raise
+        except (http.client.HTTPException, OSError) as e:
+            nbytes = (1 if got_response
+                      else len(getattr(e, "partial", b"") or b""))
+            raise TransportFailure(
+                f"{type(e).__name__}: {e}", phase=phase,
+                bytes_received=nbytes) from e
         try:
             doc = json.loads(raw.decode() or "{}")
         except ValueError:
@@ -170,8 +243,8 @@ def _http_json(host, port, method, path, body=None, timeout_s=2.0,
         return resp.status, doc, bool(resp.will_close)
 
     if pool is None:
-        conn = http.client.HTTPConnection(host, port,
-                                          timeout=timeout_s)
+        conn = netfaults.FaultyHTTPConnection(host, port, peer=peer,
+                                              timeout=timeout_s)
         try:
             status, doc, _ = _attempt(conn)
             return status, doc
@@ -182,9 +255,13 @@ def _http_json(host, port, method, path, body=None, timeout_s=2.0,
     try:
         try:
             status, doc, will_close = _attempt(conn)
-        except (http.client.HTTPException, OSError):
+        except TransportFailure as e:
             conn.close()
-            if not reused:
+            if not reused or not e.retry_safe:
+                # A fresh socket's failure is real; a reused one that
+                # failed AFTER response bytes arrived must not be
+                # replayed — the replica may have executed (the
+                # exactly-once hazard the truncation faults expose).
                 raise
             # Parked socket went stale between dispatches: one retry
             # on a fresh dial before the failure goes upward.
@@ -212,8 +289,10 @@ class ReplicaHandle:
     """One replica slot: the process, its port, and its health state.
     All mutation happens under the owning :class:`Fleet`'s lock."""
 
-    def __init__(self, idx: int):
+    def __init__(self, idx: int, spec: "HostSpec | None" = None):
         self.idx = int(idx)
+        self.spec = spec or HostSpec()
+        self.host = self.spec.connect_host
         self.proc = None
         self.port = None
         self.state = "starting"
@@ -225,6 +304,16 @@ class ReplicaHandle:
         self.metrics_doc: dict = {}
         self.scrape_tick = 0
 
+    @property
+    def peer(self) -> str:
+        """The logical transport label netfault rules scope to."""
+        return f"replica-{self.idx}"
+
+    @property
+    def addr(self) -> "ReplicaAddr | None":
+        return (ReplicaAddr(self.host, self.port)
+                if self.port is not None else None)
+
     def drop_pool(self) -> None:
         pool, self.pool = self.pool, None
         if pool is not None:
@@ -234,7 +323,7 @@ class ReplicaHandle:
         return {
             "replica": self.idx, "state": self.state,
             "pid": (self.proc.pid if self.proc is not None else None),
-            "port": self.port,
+            "host": self.host, "port": self.port,
             "incarnations": self.incarnations,
             "generation_step": self.last_doc.get("generation_step"),
             "staleness_steps": self.last_doc.get("staleness_steps"),
@@ -256,7 +345,9 @@ class Fleet:
                  spawn_timeout_s: float = SPAWN_TIMEOUT_S,
                  replica_env: "dict | None" = None,
                  max_shrinks: "int | None" = None,
-                 obs_root: "str | None" = None):
+                 obs_root: "str | None" = None,
+                 hosts: "list | None" = None,
+                 autoscaler=None):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
         self.model_dir = model_dir
@@ -278,7 +369,21 @@ class Fleet:
         self._lock = threading.Lock()
         self._rr = 0
         self._stopping = False
-        self.replicas = [ReplicaHandle(i) for i in range(n_replicas)]
+        #: Launch placement (ISSUE 19): replica i runs on
+        #: hosts[i % len(hosts)] — default one loopback HostSpec, the
+        #: tested topology; a multi-host fleet passes real specs.
+        self.hosts = list(hosts) if hosts else [HostSpec()]
+        #: Optional bidirectional autoscaler (serve/autoscale.py):
+        #: ticked on the health-poll cadence; its grow/park decisions
+        #: extend — never replace — the elastic controller's
+        #: crash-loop retirement below.
+        self.autoscaler = autoscaler
+        if (autoscaler is not None
+                and getattr(autoscaler, "journal", None) is None):
+            autoscaler.journal = journal
+        self.replicas = [
+            ReplicaHandle(i, spec=self.hosts[i % len(self.hosts)])
+            for i in range(n_replicas)]
         #: Scale-down primitive (PR 3): replica slots are the
         #: "devices"; a permanently crash-looping slot shrinks the
         #: fleet's capacity target instead of respawning forever.
@@ -312,7 +417,7 @@ class Fleet:
         while True:
             with self._lock:
                 live = [r for r in self.replicas
-                        if r.state != "retired"]
+                        if r.state not in ("retired", "parked")]
                 ready = sum(r.state == "ready" for r in live)
                 want = (len(live) if min_ready is None
                         else min(min_ready, len(live)))
@@ -339,6 +444,7 @@ class Fleet:
                "--replica-id", str(rep.idx),
                "--model", self.model_dir,
                "--port-file", port_file,
+               "--bind-host", rep.spec.bind_host,
                "--buckets", self.buckets,
                "--latency-budget-ms", str(self.latency_budget_ms),
                "--journal", os.path.join(
@@ -362,9 +468,15 @@ class Fleet:
         # incarnations): a crash-looping replica must leave evidence.
         stderr_path = os.path.join(self.work_dir,
                                    f"replica_{rep.idx}.stderr")
-        with open(stderr_path, "ab") as errf:
-            rep.proc = subprocess.Popen(
-                cmd, env=env, stdout=subprocess.DEVNULL, stderr=errf)
+        if rep.spec.spawn is not None:
+            # The HostSpec launch hook (multi-host): whatever it
+            # returns must quack like Popen (pid/poll/terminate/...).
+            rep.proc = rep.spec.spawn(cmd, env, stderr_path)
+        else:
+            with open(stderr_path, "ab") as errf:
+                rep.proc = subprocess.Popen(
+                    cmd, env=env, stdout=subprocess.DEVNULL,
+                    stderr=errf)
         rep.port = None
         rep.drop_pool()  # the old incarnation's sockets are dead
         rep.state = "starting"
@@ -403,11 +515,17 @@ class Fleet:
                 except Exception:  # noqa: BLE001 — the monitor must
                     # outlive any single replica's weirdness
                     pass
+            if self.autoscaler is not None:
+                try:
+                    self._autoscale_tick()
+                except Exception:  # noqa: BLE001 — scaling policy
+                    # must never kill the health monitor
+                    pass
             time.sleep(self.health_poll_s)
 
     def _check_one(self, rep: ReplicaHandle) -> None:
         with self._lock:
-            if self._stopping or rep.state == "retired":
+            if self._stopping or rep.state in ("retired", "parked"):
                 return
             proc = rep.proc
         rc = proc.poll() if proc is not None else None
@@ -423,10 +541,12 @@ class Fleet:
                 return
             with self._lock:
                 rep.port = port
-                rep.pool = ConnectionPool("127.0.0.1", port)
+                rep.pool = ConnectionPool(rep.host, port,
+                                          peer=rep.peer)
         try:
-            status, doc = _http_json("127.0.0.1", rep.port, "GET",
-                                     "/healthz", timeout_s=2.0)
+            status, doc = _http_json(rep.host, rep.port, "GET",
+                                     "/healthz", timeout_s=2.0,
+                                     peer=rep.peer)
         except OSError:
             status, doc = None, {}
         if status == 200:
@@ -463,12 +583,13 @@ class Fleet:
                     # first admission).
                     rep.state = "suspect"
                     self._journal("replica_drained", replica=rep.idx,
-                                  health_failures=rep.health_failures)
+                                  health_failures=rep.health_failures,
+                                  via="health")
 
     def _on_death(self, rep: ReplicaHandle, rc,
                   reason: str = "exited") -> None:
         with self._lock:
-            if self._stopping or rep.state == "retired":
+            if self._stopping or rep.state in ("retired", "parked"):
                 return
             rep.state = "dead"
             rep.drop_pool()
@@ -491,7 +612,7 @@ class Fleet:
                               capacity=self._capacity)
                 return
             live = [r for r in self.replicas
-                    if r.state not in ("retired", "dead")]
+                    if r.state not in ("retired", "dead", "parked")]
             if len(live) >= self._capacity:
                 # Over capacity after an elastic shrink: the dead
                 # slot retires instead of respawning.
@@ -520,6 +641,87 @@ class Fleet:
             if rep.state == "suspect":
                 rep.health_failures = 0
         # The health loop re-admits on its next green poll.
+
+    # ---------------------------------------------------- autoscaling
+
+    def grow(self) -> "int | None":
+        """Add one replica: re-spawn the first ``parked`` slot if any,
+        else append a fresh slot (round-robin over host specs).
+        Returns the slot index, or None while stopping."""
+        with self._lock:
+            if self._stopping:
+                return None
+            parked = [r for r in self.replicas if r.state == "parked"]
+            if parked:
+                rep = parked[0]
+            else:
+                rep = ReplicaHandle(
+                    len(self.replicas),
+                    spec=self.hosts[len(self.replicas)
+                                    % len(self.hosts)])
+                self.replicas.append(rep)
+            self._capacity += 1
+            capacity = self._capacity
+        self._spawn(rep)
+        self._journal("fleet_grow", replica=rep.idx,
+                      capacity=capacity)
+        return rep.idx
+
+    def park(self) -> "int | None":
+        """Shrink by one: terminate the highest-index ready replica
+        and mark its slot ``parked`` — re-growable, distinct from the
+        elastic controller's permanent ``retired``. Refuses to park
+        the last ready replica."""
+        with self._lock:
+            if self._stopping:
+                return None
+            ready = [r for r in self.replicas if r.state == "ready"]
+            if len(ready) <= 1:
+                return None
+            rep = max(ready, key=lambda r: r.idx)
+            rep.state = "parked"
+            rep.drop_pool()
+            self._capacity -= 1
+            capacity = self._capacity
+            proc = rep.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        self._journal("replica_parked", replica=rep.idx,
+                      capacity=capacity)
+        return rep.idx
+
+    def _autoscale_tick(self) -> None:
+        """Feed the autoscaler one observation on the health-poll
+        cadence (health thread) and apply its verdict. Pressure
+        signals: the front door's closed-books shed/accepted counters
+        (parent registry) and the coalescer's padded-row occupancy
+        from the replicas' scraped snapshots."""
+        with self._lock:
+            reps = list(self.replicas)
+            n_ready = sum(r.state == "ready" for r in reps)
+            n_live = sum(r.state not in ("retired", "dead", "parked")
+                         for r in reps)
+            rows = padded = 0
+            for r in reps:
+                counters = ((r.metrics_doc or {})
+                            .get("snapshot", {}).get("counters", {}))
+                rows += int(counters.get("serve.rows_total") or 0)
+                padded += int(
+                    counters.get("serve.padded_rows_total") or 0)
+        reg = obs.registry()
+        decision = self.autoscaler.tick(
+            shed_total=int(reg.peek("frontdoor.shed_total") or 0),
+            accepted_total=int(
+                reg.peek("frontdoor.accepted_total") or 0),
+            rows_total=rows, padded_rows_total=padded,
+            n_ready=n_ready, n_live=n_live)
+        if decision == "grow":
+            self.grow()
+        elif decision == "shrink":
+            self.park()
 
     # ------------------------------------------------------- dispatch
 
@@ -563,22 +765,49 @@ class Fleet:
                                                  None))
                              if trace is not None else None)
                     status, doc = _http_json(
-                        "127.0.0.1", rep.port, "POST", "/predict",
+                        rep.host, rep.port, "POST", "/predict",
                         body={"ids": ids, "vals": vals,
                               "deadline_ms": remaining * 1e3},
                         timeout_s=remaining + 0.25,
-                        trace=child, pool=rep.pool)
+                        trace=child, pool=rep.pool, peer=rep.peer)
             except Exception as e:  # noqa: BLE001 — connection died
                 # (replica killed mid-burst) or injected dispatch
                 # fault: mark suspect, retry once elsewhere
                 last_error = f"{type(e).__name__}: {e}"
+                retry_safe = getattr(e, "retry_safe", True)
+                drained = False
                 with self._lock:
                     if rep.state == "ready":
                         rep.state = "suspect"
                         rep.health_failures = SUSPECT_AFTER_FAILURES
+                        drained = True
                 self._journal("replica_dispatch_failed",
                               replica=rep.idx, attempt=attempt,
-                              error=type(e).__name__)
+                              error=type(e).__name__,
+                              phase=getattr(e, "phase", None),
+                              retry_safe=retry_safe)
+                if drained:
+                    # The same drain the health poller performs, from
+                    # the dispatch seam — journaled under the same
+                    # event so the partition auditor and run_doctor's
+                    # crash-vs-partition classifier see it no matter
+                    # which path noticed the dead link first.
+                    self._journal(
+                        "replica_drained", replica=rep.idx,
+                        health_failures=SUSPECT_AFTER_FAILURES,
+                        via="dispatch")
+                if not retry_safe:
+                    # Response bytes had arrived when the link failed:
+                    # the replica executed and answered (ISSUE 19
+                    # satellite). Replaying the request elsewhere
+                    # could score it twice — exactly-once wins over
+                    # availability; fail upward and let the CLIENT
+                    # retry on its own books.
+                    obs.counter(
+                        "fleet.dispatch_recv_abandoned_total").add(1)
+                    raise frontdoor.BackendError(
+                        "recv-phase failure after response bytes — "
+                        f"not replayed: {last_error}")
                 if attempt == 1:
                     obs.counter("frontdoor.retries_total").add(1)
                 continue
@@ -599,8 +828,9 @@ class Fleet:
         """Pull one ``/metrics.json`` doc from a healthy replica (best
         effort, off the dispatch path — runs on the health thread)."""
         try:
-            status, doc = _http_json("127.0.0.1", rep.port, "GET",
-                                     "/metrics.json", timeout_s=2.0)
+            status, doc = _http_json(rep.host, rep.port, "GET",
+                                     "/metrics.json", timeout_s=2.0,
+                                     peer=rep.peer)
         except OSError:
             return
         if status == 200 and isinstance(doc, dict):
@@ -623,7 +853,8 @@ class Fleet:
     def healthz(self) -> dict:
         with self._lock:
             docs = [r.doc() for r in self.replicas]
-            live = [d for d in docs if d["state"] != "retired"]
+            live = [d for d in docs
+                    if d["state"] not in ("retired", "parked")]
         return {
             "ready": any(d["state"] == "ready" for d in docs),
             "n_replicas": len(live),
@@ -686,6 +917,9 @@ def replica_main(argv=None) -> int:
                     help="models.save_model directory (spec + params)")
     ap.add_argument("--port-file", required=True)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--bind-host", default="127.0.0.1",
+                    help="interface the replica HTTP server binds "
+                         "(HostSpec.bind_host; loopback default)")
     ap.add_argument("--chain-dir", default=None,
                     help="checkpoint chain to hot-follow (read-only)")
     ap.add_argument("--reload-poll-s", type=float, default=0.2)
@@ -874,7 +1108,7 @@ def replica_main(argv=None) -> int:
         daemon_threads = True
         request_queue_size = 128
 
-    server = Server(("127.0.0.1", args.port), Handler)
+    server = Server((args.bind_host, args.port), Handler)
     stop = threading.Event()
 
     def on_term(signum, frame):

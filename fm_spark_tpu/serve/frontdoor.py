@@ -45,6 +45,7 @@ import dataclasses
 import http.server
 import inspect
 import json
+import random
 import socketserver
 import threading
 import time
@@ -131,7 +132,9 @@ class AdmissionController:
 
     def __init__(self, classes: "str | tuple[ClassSpec, ...]"
                  = DEFAULT_CLASSES, *,
-                 service_est_ms: float = 5.0, ewma_alpha: float = 0.2):
+                 service_est_ms: float = 5.0, ewma_alpha: float = 0.2,
+                 retry_jitter_frac: float = 0.5,
+                 jitter_seed: int = 0):
         self.classes = (parse_classes(classes)
                         if isinstance(classes, str) else tuple(classes))
         self._by_name = {c.name: c for c in self.classes}
@@ -139,6 +142,17 @@ class AdmissionController:
         self._inflight = {c.name: 0 for c in self.classes}
         self._service_ms = float(service_est_ms)
         self._alpha = float(ewma_alpha)
+        if not 0.0 <= retry_jitter_frac <= 1.0:
+            raise ValueError(f"retry_jitter_frac in [0,1], "
+                             f"got {retry_jitter_frac}")
+        #: Seeded Retry-After jitter (ISSUE 19 satellite): shed
+        #: clients all backing off by the SAME deterministic hint
+        #: re-synchronize into the exact burst that got them shed;
+        #: each verdict's hint is stretched by a seeded factor in
+        #: [1, 1+frac] so the retry wave de-clumps — reproducibly,
+        #: since drills replay from seeds.
+        self._retry_jitter_frac = float(retry_jitter_frac)
+        self._retry_rng = random.Random(int(jitter_seed))
 
     def spec(self, cls: str) -> "ClassSpec | None":
         return self._by_name.get(cls)
@@ -183,6 +197,9 @@ class AdmissionController:
         obs.counter("frontdoor.shed_total").add(1)
         obs.counter(f"frontdoor.shed_total.{cls}").add(1)
         obs.counter(f"frontdoor.{decision}_total").add(1)
+        with self._lock:
+            retry_after *= (1.0 + self._retry_jitter_frac
+                            * self._retry_rng.random())
         return Verdict(decision, est, retry_after_ms=retry_after)
 
     def release(self, cls: str,

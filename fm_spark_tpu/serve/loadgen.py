@@ -40,7 +40,10 @@ __all__ = [
 ]
 
 #: The traffic-shape vocabulary (the chaos generator samples from it).
-SHAPES = ("diurnal", "flash_crowd", "slow_clients", "retry_storm")
+#: Append-only: ``SHAPES.index`` seeds each shape's rng, so reordering
+#: would silently re-roll every existing schedule.
+SHAPES = ("diurnal", "flash_crowd", "slow_clients", "retry_storm",
+          "partition_storm")
 
 #: Terminal attempt outcomes written to the tap. ``ok`` is the only
 #: success; everything else is an explicit failure the client SAW —
@@ -97,6 +100,13 @@ def make_schedule(shape: str, seed: int, *, duration_s: float = 1.5,
     ``retry_storm``   over-capacity rate with deadlines tight enough
                       to shed, and every client retrying — the storm
                       only converges because 429s carry Retry-After
+    ``partition_storm`` steady demand where EVERY client retries
+                      (a parent↔replica partition surfaces as 503s,
+                      and sheds as 429s — both retried, honoring the
+                      door's jittered Retry-After), plus a surge at
+                      ~55% of the window: the deferred traffic
+                      replaying just after a canonical partition
+                      window heals
     """
     if shape not in SHAPES:
         raise ValueError(f"unknown traffic shape {shape!r}; "
@@ -158,13 +168,27 @@ def make_schedule(shape: str, seed: int, *, duration_s: float = 1.5,
                     slow=0.15 + 0.25 * rng.random())
             else:
                 add(t, "interactive")
-    else:  # retry_storm
+    elif shape == "retry_storm":
         t = 0.0
         while t < duration_s:
             t += rng.expovariate(1.6 * base_rps)
             if t < duration_s:
                 add(t, cls_for(rng.random()),
                     dl=0.25 * deadline_ms, retries=2)
+    else:  # partition_storm
+        t = 0.0
+        while t < duration_s:
+            t += rng.expovariate(1.2 * base_rps)
+            if t < duration_s:
+                add(t, cls_for(rng.random()), retries=3)
+        t_surge = 0.55 * duration_s
+        n_surge = int(base_rps * (1.0 + rng.random()))
+        for _ in range(n_surge):
+            add(t_surge + rng.random() * 0.2, "interactive",
+                retries=3)
+        events.sort(key=lambda e: e.t_offset_s)
+        events[:] = [dataclasses.replace(e, idx=i)
+                     for i, e in enumerate(events)]
 
     return TrafficSchedule(shape=shape, seed=int(seed),
                            events=tuple(events),
@@ -188,7 +212,7 @@ def _post_predict(host: str, port: int, body: bytes, *,
     """One HTTP attempt. A slow client sends headers, stalls, then the
     body — holding a server handler thread exactly the way a congested
     mobile uplink does."""
-    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)  # fmlint: disable=fleet-transport-discipline -- the loadgen IS the client: it models user traffic arriving from outside the fleet's transport boundary, so the parent-side netfault plane must not intercept it (partitions sever the parent<->replica link, not the user<->door link)
     try:
         conn.putrequest("POST", "/predict")  # fmlint: disable=trace-propagation -- client side of the trust boundary: traces are MINTED at the front door (inbound X-FM-Trace is ignored there); the response's trace id tags the tap instead
         conn.putheader("Content-Type", "application/json")

@@ -191,6 +191,36 @@ FIXTURES = {
         },
         "expect": 1,
     },
+    "fleet-transport-discipline": {
+        "positive": {"fm_spark_tpu/serve/bad.py": """\
+            import http.client, socket
+            def dial(host, port):
+                c = http.client.HTTPConnection(host, port)
+                s = socket.create_connection((host, port))
+                return c, s
+        """},
+        "negative": {
+            "fm_spark_tpu/serve/good.py": """\
+                from fm_spark_tpu.resilience import netfaults
+                def dial(host, port, peer):
+                    return netfaults.FaultyHTTPConnection(
+                        host, port, peer=peer)
+            """,
+            # User-side of the trust boundary: reasoned suppression.
+            "fm_spark_tpu/serve/client.py": """\
+                import http.client
+                def attempt(host, port):
+                    return http.client.HTTPConnection(host, port)  # fmlint: disable=fleet-transport-discipline -- models a CLIENT outside the fleet transport boundary
+            """,
+            # Outside serve/: out of scope.
+            "fm_spark_tpu/resilience/nf.py": """\
+                import http.client
+                def dial(host, port):
+                    return http.client.HTTPConnection(host, port)
+            """,
+        },
+        "expect": 2,
+    },
     "suppression-hygiene": {
         "positive": {"fm_spark_tpu/mod.py": """\
             def f():

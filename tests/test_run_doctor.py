@@ -453,6 +453,15 @@ def test_doctor_renders_serving_fleet_section(tmp_path, capsys):
         {"event": "replica_spawn", "replica": 1, "ts": 105.1},
         {"event": "replica_ready", "replica": 1, "ts": 106.5,
          "generation_step": 3},
+        # ISSUE 19: replica 0 is partitioned (drained, no process
+        # death) and heals; the autoscaler grows once meanwhile.
+        {"event": "replica_drained", "replica": 0, "ts": 107.0,
+         "via": "dispatch"},
+        {"event": "autoscale_decision", "ts": 107.5, "action": "grow",
+         "reason": "shed_frac=0.300>0.05 for 2 ticks", "tick": 12,
+         "n_ready": 1, "to_n": 3, "shed_frac": 0.3, "fill": 0.0},
+        {"event": "replica_ready", "replica": 0, "ts": 108.2,
+         "generation_step": 3},
         {"event": "frontdoor_summary", "ts": 110.0, "accepted": 40,
          "answered": 39, "timeout": 1, "failed": 0, "shed": 3,
          "shed_queue": 1, "shed_deadline": 2, "rejected": 0,
@@ -465,9 +474,17 @@ def test_doctor_renders_serving_fleet_section(tmp_path, capsys):
     assert "## Serving fleet" in out
     assert "accepted 40  answered 39" in out
     assert "shed 3 (queue 1 / deadline 2)" in out
-    assert "replica-loss -> recovery timeline" in out
-    assert "replica 1 down (rc=9) -> ready after 1.500s" in out
+    assert "replica-loss timeline (crash vs partition)" in out
+    assert ("replica 1 down (rc=9) -> ready after 1.500s "
+            "[crash: respawned]") in out
     assert "replica 1 lost (rc=9) and re-admitted after 1.500s" in out
+    # The partition is classified apart from the crash: no respawn.
+    assert ("replica 0 drained -> readmitted after 1.200s "
+            "[partition: process stayed alive, no respawn]") in out
+    assert "replica 0 PARTITIONED" in out
+    assert ("autoscale decision log (1 grow / 0 shrink, "
+            "0 direction change(s)):") in out
+    assert "-> 3 replica(s)  [shed_frac=0.300>0.05 for 2 ticks]" in out
 
 
 def test_fleet_diagnose_unit_contracts():

@@ -201,6 +201,21 @@ def _fleet_entries(base_dir: str, soak: bool) -> list[dict]:
         seeds, base_dir=os.path.join(base_dir, "fleet"))
 
 
+def _partition_entries(base_dir: str, soak: bool) -> list[dict]:
+    """The partition half of the fleet campaign (ISSUE 19): seeded
+    network-fault schedules (peer-scoped connect/send windows, slow
+    links, response truncations) against a shared fleet with the
+    autoscaler armed — graded by ``audit_fleet``'s partition
+    extensions (partition_not_a_crash, autoscale_converged) on top of
+    exactly-once and closed books."""
+    from fm_spark_tpu.resilience import chaos
+
+    seeds = (chaos.PARTITION_TIER1_SEEDS if not soak
+             else tuple(range(4)))  # soak adds the 4th scenario class
+    return chaos.run_partition_campaign(
+        seeds, base_dir=os.path.join(base_dir, "partition"))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos campaigns over the resilience stack")
@@ -269,6 +284,7 @@ def main(argv=None) -> int:
         # the same rule.
         extra.extend(_drift_entries(base_dir, soak=args.soak))
         extra.extend(_fleet_entries(base_dir, soak=args.soak))
+        extra.extend(_partition_entries(base_dir, soak=args.soak))
     if args.soak:
         extra.extend(_soak_subprocess_drills(
             dataclasses.replace(cfg, break_restore=False), base_dir))

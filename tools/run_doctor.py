@@ -339,7 +339,16 @@ def fleet_diagnose(run: dict, fleet_events: list[dict]
     snapshot's ``frontdoor.*`` counters), and the replica-loss ->
     recovery timeline (each ``replica_down`` paired with that
     replica's next ``replica_ready``). ``None`` when the run has no
-    fleet footprint."""
+    fleet footprint.
+
+    ISSUE 19 extensions: each replica loss is CLASSIFIED — a
+    ``replica_drained`` healed by ``replica_ready`` with no
+    ``replica_down`` between is a PARTITION (the link failed, the
+    process lived; collected under ``partitions``), while a
+    ``replica_down`` -> ``replica_ready`` pair is a crash+respawn
+    (``recoveries``, as before) — and the autoscaler's journaled
+    ``autoscale_decision`` events roll up under ``autoscale``
+    (decision log, grow/shrink counts, direction changes)."""
     snap = run.get("snapshot") or {}
     snap_counters = snap.get("counters") or {}
     has_fd = any(k.startswith("frontdoor.")
@@ -349,6 +358,8 @@ def fleet_diagnose(run: dict, fleet_events: list[dict]
     stats = None
     replicas: dict[int, dict] = {}
     recoveries: list[dict] = []
+    partitions: list[dict] = []
+    decisions: list[dict] = []
     for e in fleet_events:
         kind = e.get("event") or e.get("kind")
         rep = e.get("replica")
@@ -356,9 +367,9 @@ def fleet_diagnose(run: dict, fleet_events: list[dict]
         if rep is not None:
             r = replicas.setdefault(int(rep), {
                 "replica": int(rep), "spawns": 0, "downs": 0,
-                "state": "?", "generation_step": None,
+                "drains": 0, "state": "?", "generation_step": None,
                 "staleness_steps": None, "last_rc": None,
-                "_down_ts": None})
+                "_down_ts": None, "_drain_ts": None})
         if kind == "replica_spawn" and r is not None:
             r["spawns"] += 1
             r["state"] = "starting"
@@ -372,6 +383,16 @@ def fleet_diagnose(run: dict, fleet_events: list[dict]
                     "rc": r["last_rc"],
                     "recovery_s": round(e["ts"] - r["_down_ts"], 3)})
                 r["_down_ts"] = None
+            elif (r["_drain_ts"] is not None
+                    and e.get("ts") is not None):
+                # Drained then readmitted with NO death between: the
+                # loss was a parent<->replica LINK failure, not a
+                # crash (ISSUE 19 partition classification).
+                partitions.append({
+                    "replica": int(rep),
+                    "drain_ts": r["_drain_ts"],
+                    "heal_s": round(e["ts"] - r["_drain_ts"], 3)})
+            r["_drain_ts"] = None
         elif kind == "replica_state" and r is not None:
             if e.get("generation_step") is not None:
                 r["generation_step"] = e["generation_step"]
@@ -383,11 +404,22 @@ def fleet_diagnose(run: dict, fleet_events: list[dict]
             r["last_rc"] = e.get("rc")
             if e.get("ts") is not None:
                 r["_down_ts"] = e["ts"]
+            r["_drain_ts"] = None  # it died: a crash, not a partition
         elif kind == "replica_drained" and r is not None:
             r["state"] = "suspect"
+            r["drains"] += 1
+            if r["_drain_ts"] is None:
+                r["_drain_ts"] = e.get("ts")
+        elif kind == "replica_parked" and r is not None:
+            r["state"] = "parked"
         elif kind in ("fleet_shrink", "replica_retired"):
             if r is not None:
                 r["state"] = "retired"
+        elif kind == "autoscale_decision":
+            decisions.append({k: e.get(k) for k in
+                              ("ts", "action", "reason", "tick",
+                               "n_ready", "to_n", "shed_frac",
+                               "fill")})
         elif kind == "frontdoor_summary":
             stats = e  # the door's closing books (flattened stats())
     if stats is None and has_fd:
@@ -400,13 +432,23 @@ def fleet_diagnose(run: dict, fleet_events: list[dict]
                           "timeout", "failed", "retries")}
     for r in replicas.values():
         r.pop("_down_ts", None)
+        r.pop("_drain_ts", None)
     gens = [r["generation_step"] for r in replicas.values()
             if r["generation_step"] is not None
             and r["state"] == "ready"]
+    actions = [d.get("action") for d in decisions]
     return {
         "replicas": [replicas[i] for i in sorted(replicas)],
         "counters": counters,
         "recoveries": recoveries,
+        "partitions": partitions,
+        "autoscale": {
+            "decisions": decisions,
+            "grows": actions.count("grow"),
+            "shrinks": actions.count("shrink"),
+            "direction_changes": sum(
+                1 for a, b in zip(actions, actions[1:]) if a != b),
+        },
         "generation_skew": (max(gens) - min(gens)) if gens else 0,
     }
 
@@ -439,7 +481,25 @@ def fleet_findings(fleet: dict | None) -> list[str]:
     for rec in fleet["recoveries"]:
         out.append(
             f"replica {rec['replica']} lost (rc={rec['rc']}) and "
-            f"re-admitted after {rec['recovery_s']:.3f}s")
+            f"re-admitted after {rec['recovery_s']:.3f}s — CRASH "
+            "(process died, respawned)")
+    for p in fleet.get("partitions", []):
+        out.append(
+            f"replica {p['replica']} PARTITIONED (drained with no "
+            f"process death) and readmitted after "
+            f"{p['heal_s']:.3f}s — link fault, not a crash; no "
+            "respawn was spent on it")
+    auto = fleet.get("autoscale") or {}
+    if auto.get("decisions"):
+        out.append(
+            f"autoscaler: {auto['grows']} grow / {auto['shrinks']} "
+            f"shrink decision(s), {auto['direction_changes']} "
+            "direction change(s)")
+        if auto["direction_changes"] > 1:
+            out.append(
+                "AUTOSCALER FLAPPING: more than one grow<->shrink "
+                "reversal — widen the hysteresis dead band or "
+                "lengthen the cooldown")
     flapping = [r for r in fleet["replicas"] if r["downs"] >= 3]
     for r in flapping:
         out.append(
@@ -911,14 +971,40 @@ def render(run: dict, diag: dict, legs: list[dict],
                     f"{r['spawns']:>7} {r['downs']:>6} "
                     f"{str(r['generation_step'] if r['generation_step'] is not None else '-'):>11} "
                     f"{str(r['staleness_steps'] if r['staleness_steps'] is not None else '-'):>10}")
-        if fleet["recoveries"]:
-            out.append("  replica-loss -> recovery timeline:")
-            t0 = fleet["recoveries"][0]["down_ts"]
-            for rec in fleet["recoveries"]:
+        if fleet["recoveries"] or fleet.get("partitions"):
+            out.append("  replica-loss timeline (crash vs "
+                       "partition):")
+            losses = ([dict(r, _t=r["down_ts"], _kind="crash")
+                       for r in fleet["recoveries"]]
+                      + [dict(p, _t=p["drain_ts"], _kind="partition")
+                         for p in fleet.get("partitions", [])])
+            losses.sort(key=lambda x: x["_t"])
+            t0 = losses[0]["_t"]
+            for x in losses:
+                if x["_kind"] == "crash":
+                    out.append(
+                        f"    +{x['_t'] - t0:>8.3f}s replica "
+                        f"{x['replica']} down (rc={x['rc']}) -> "
+                        f"ready after {x['recovery_s']:.3f}s "
+                        "[crash: respawned]")
+                else:
+                    out.append(
+                        f"    +{x['_t'] - t0:>8.3f}s replica "
+                        f"{x['replica']} drained -> readmitted "
+                        f"after {x['heal_s']:.3f}s [partition: "
+                        "process stayed alive, no respawn]")
+        auto = fleet.get("autoscale") or {}
+        if auto.get("decisions"):
+            out.append(
+                f"  autoscale decision log ({auto['grows']} grow / "
+                f"{auto['shrinks']} shrink, "
+                f"{auto['direction_changes']} direction change(s)):")
+            d0 = auto["decisions"][0].get("ts") or 0.0
+            for d in auto["decisions"]:
                 out.append(
-                    f"    +{rec['down_ts'] - t0:>8.3f}s replica "
-                    f"{rec['replica']} down (rc={rec['rc']}) -> "
-                    f"ready after {rec['recovery_s']:.3f}s")
+                    f"    +{(d.get('ts') or d0) - d0:>8.3f}s "
+                    f"{d.get('action'):6} -> {d.get('to_n')} "
+                    f"replica(s)  [{d.get('reason')}]"[:160])
         out.append("")
 
     if tracing is not None:
