@@ -20,6 +20,10 @@ rules (ids are what ``# fmlint: disable=`` names):
 ``fleet-transport-discipline`` serve/ opens replica connections only
                          through the netfault-aware transport, never
                          raw http.client/socket (ISSUE 19)
+``durable-write-discipline`` checkpoint.py / obs/ / embed/ write
+                         durable artifacts only through utils/durable,
+                         never raw open-for-write or os.rename
+                         (ISSUE 20)
 ``parse-error``          every scanned source must parse
 
 Plus the framework's own meta-rule, ``suppression-hygiene``: a
@@ -428,6 +432,85 @@ def fleet_transport_discipline(ctx):
                     "ConnectionPool/_http_json (or suppress with the "
                     "reason this path sits outside the fleet's "
                     "transport boundary)", func or ""))
+    return out
+
+
+#: The durable-artifact surface (ISSUE 20): every byte these trees
+#: promise to keep must be written through the injectable seam
+#: (:mod:`fm_spark_tpu.utils.durable`) — a raw ``open(.., "w")`` or
+#: ``os.rename``/``os.replace`` is a write no disk-fault schedule can
+#: reach, so crash-consistency coverage silently shrinks. Appends
+#: (mode ``"a"``) are allowed raw at open time: the seam wraps the
+#: per-line write (``durable.append_line``), not the handle.
+DURABLE_DIRS = ("fm_spark_tpu/obs", "fm_spark_tpu/embed")
+DURABLE_EXTRA_FILES = ("fm_spark_tpu/checkpoint.py",)
+DURABLE_BANNED_RENAMES = ("os.rename", "os.replace")
+
+
+def _durable_files(ctx):
+    out = []
+    for d in DURABLE_DIRS:
+        out.extend(ctx.files_under(d, recursive=True))
+    for rel in DURABLE_EXTRA_FILES:
+        sf = ctx.file(rel)
+        if sf is not None:
+            out.append(sf)
+    return out
+
+
+def _open_write_mode(node: ast.Call) -> "str | None":
+    """The literal mode of an ``open()`` call iff it opens for
+    (over)write — ``w``/``wb``/``w+``/``x`` variants. Appends and
+    reads return None; so does a non-literal mode (can't judge it
+    statically, and every in-scope call site uses literals)."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+            and mode.value.lstrip("br").startswith(("w", "x"))):
+        return mode.value
+    return None
+
+
+@rule("durable-write-discipline",
+      "checkpoint.py, fm_spark_tpu/obs/, and fm_spark_tpu/embed/ "
+      "write durable artifacts only through utils/durable "
+      "(atomic_write_*/append_line*) — raw open(.., 'w') and "
+      "os.rename/os.replace bypass the io-fault seam, so no disk "
+      "schedule can reach them (ISSUE 20)")
+def durable_write_discipline(ctx):
+    out = []
+    for sf in _durable_files(ctx):
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node, func in walk_with_func(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "open":
+                mode = _open_write_mode(node)
+                if mode is not None:
+                    out.append(Finding(
+                        "durable-write-discipline", sf.rel,
+                        node.lineno,
+                        f"raw open(.., {mode!r}) on the durable "
+                        "surface — write through utils/durable "
+                        "(atomic_write_bytes/text/json) so io-fault "
+                        "schedules can reach it, or suppress with "
+                        "the reason these bytes are not a durability "
+                        "promise", func or ""))
+            elif name in DURABLE_BANNED_RENAMES:
+                out.append(Finding(
+                    "durable-write-discipline", sf.rel, node.lineno,
+                    f"raw {name}() on the durable surface — the "
+                    "atomic publish belongs to utils/durable."
+                    "atomic_write_* (injectable at io_rename), or "
+                    "suppress with the reason this rename is not a "
+                    "durable publish", func or ""))
     return out
 
 

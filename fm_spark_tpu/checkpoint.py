@@ -57,6 +57,8 @@ from __future__ import annotations
 
 import json
 import os
+import errno
+import shutil
 import signal
 import threading
 import time
@@ -67,6 +69,7 @@ import orbax.checkpoint as ocp
 
 from fm_spark_tpu import obs
 from fm_spark_tpu.resilience import faults, watchdog
+from fm_spark_tpu.utils import durable, sleeps
 
 
 def _tree_checksums(state) -> dict | None:
@@ -108,12 +111,10 @@ def _meta_crc(meta: dict) -> str | None:
 
 
 def _atomic_write_json(path: str, obj: dict) -> None:
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """One chain-file write through the durable seam (ISSUE 20): the
+    ``ckpt`` path class, fail-loud — retry/GC policy belongs to
+    :meth:`Checkpointer._durable_json`, which wraps this."""
+    durable.atomic_write_json(path, obj, path_class="ckpt")
 
 
 def _step_json_names(directory: str) -> list[int]:
@@ -190,6 +191,33 @@ class CheckpointChainBroken(RuntimeError):
     """Checkpoints exist but NONE passed verification (every step torn
     or corrupt). Restarting from scratch silently would discard the
     operator's training budget without telling them — surface it."""
+
+
+class CheckpointIOError(RuntimeError):
+    """A checkpoint/tombstone durable write failed after bounded retry
+    (and, on ENOSPC, after emergency GC + one more attempt). Loud by
+    design — a chain write that silently failed would leave the pointer
+    lying about what is on disk. The underlying ``OSError`` rides as
+    ``__cause__``; ``errno`` mirrors it so the supervisor's
+    classification (``faults.is_device_loss`` → False → permanent, do
+    not retry the whole run) and the chaos outcome classifier can tell
+    disk-full from a flapping attachment."""
+
+    def __init__(self, path: str, exc: BaseException):
+        super().__init__(
+            f"checkpoint durable write failed: {path} "
+            f"({type(exc).__name__}: {exc})"
+        )
+        self.path = path
+        self.errno = getattr(exc, "errno", None)
+
+
+#: Bounded retry for checkpoint-tier writes (the fail-loud tier of the
+#: ISSUE 20 degradation policy): transient EIO gets supervisor-style
+#: backoff across these delays (scaled by FM_SPARK_TEST_SLEEP_SCALE);
+#: ENOSPC skips the backoff — waiting does not free bytes — and goes
+#: straight to journaled emergency GC, then exactly one more attempt.
+_IO_RETRY_BACKOFF_S = (0.05, 0.1, 0.2)
 
 
 def _restore_with(mgr, step: int, params_example, opt_state_example):
@@ -420,7 +448,7 @@ class Checkpointer:
             return False
         with obs.span("checkpoint/demote", step=step):
             os.makedirs(self._tombstone_dir, exist_ok=True)
-            _atomic_write_json(
+            self._durable_json(
                 os.path.join(self._tombstone_dir, f"{step}.json"),
                 {"step": step, "reason": str(reason)[:500],
                  "ts": round(time.time(), 3)})
@@ -465,7 +493,7 @@ class Checkpointer:
         tip = demoted[-1]
         with obs.span("checkpoint/demote", floor=floor, tip=tip):
             os.makedirs(self._tombstone_dir, exist_ok=True)
-            _atomic_write_json(
+            self._durable_json(
                 os.path.join(self._tombstone_dir,
                              f"range_{floor}_{tip}.json"),
                 {"newer_than": floor, "through": tip,
@@ -491,13 +519,13 @@ class Checkpointer:
                       reverse=True)
         prev = self.last_good_step()
         if good:
-            _atomic_write_json(self._last_good_path,
+            self._durable_json(self._last_good_path,
                                {"step": good[0],
                                 "ts": round(time.time(), 3)})
         else:
             # Every verified step is demoted: an empty pointer is the
             # honest state (readers fall back to walk-back/None).
-            _atomic_write_json(self._last_good_path,
+            self._durable_json(self._last_good_path,
                                {"step": None,
                                 "ts": round(time.time(), 3)})
         self._emit("last_good_republished", prev=prev,
@@ -515,9 +543,12 @@ class Checkpointer:
             return False
 
     def _read_manifest(self, step: int) -> dict | None:
+        # io_read rides the durable seam: an injected EIO or short
+        # (torn) read makes the manifest unreadable/unparseable, and
+        # the walk-back skips the step — never a crash loop.
         try:
-            with open(self._manifest_path(step)) as f:
-                return json.load(f)
+            return durable.read_json(self._manifest_path(step),
+                                     path_class="ckpt")
         except (OSError, ValueError):
             return None
 
@@ -525,11 +556,90 @@ class Checkpointer:
         """The persisted last VERIFIED step — advanced only after a
         save's data commit was observed and its manifest written."""
         try:
-            with open(self._last_good_path) as f:
-                step = json.load(f).get("step")
+            step = durable.read_json(self._last_good_path,
+                                     path_class="ckpt").get("step")
             return int(step) if step is not None else None
-        except (OSError, ValueError, TypeError):
+        except (OSError, ValueError, TypeError, AttributeError):
             return None
+
+    # ------------------------------------------ durable writes (ISSUE 20)
+
+    def _durable_json(self, path: str, obj: dict) -> None:
+        """One fail-loud chain write under the tiered degradation
+        policy: transient errors (EIO, EROFS flaps) retry with bounded
+        supervisor-style backoff; ENOSPC triggers journaled emergency
+        GC of demoted/superseded generations and then exactly one more
+        attempt; anything still failing raises a loud
+        :class:`CheckpointIOError` for the supervisor to classify."""
+        attempts = len(_IO_RETRY_BACKOFF_S)
+        for attempt in range(1, attempts + 1):
+            try:
+                _atomic_write_json(path, obj)
+                return
+            except OSError as e:
+                name = os.path.basename(path)
+                if getattr(e, "errno", None) == errno.ENOSPC:
+                    self._emergency_gc(trigger=name)
+                    try:
+                        _atomic_write_json(path, obj)
+                        return
+                    except OSError as e2:
+                        self._emit("checkpoint_io_error", path=name,
+                                   errno=getattr(e2, "errno", None))
+                        raise CheckpointIOError(path, e2) from e2
+                if attempt == attempts:
+                    self._emit("checkpoint_io_error", path=name,
+                               errno=getattr(e, "errno", None))
+                    raise CheckpointIOError(path, e) from e
+                delay = sleeps.scaled(_IO_RETRY_BACKOFF_S[attempt - 1])
+                self._emit("ckpt_io_retry", path=name, attempt=attempt,
+                           errno=getattr(e, "errno", None),
+                           delay_s=round(delay, 4))
+                obs.counter("checkpoint.io_retries_total").add(1)
+                time.sleep(delay)
+
+    def _emergency_gc(self, trigger: str = "") -> list[int]:
+        """ENOSPC last resort: delete the generations nothing may ever
+        load again — tombstoned (demoted) steps' data directories and
+        manifests, manifests for steps orbax already dropped, and stale
+        ``.tmp`` leftovers of torn publishes. JOURNALED first: the GC
+        intent is durable before anything is destroyed, so a kill
+        mid-GC (the ``ckpt_gc`` fault point below) is recoverable by
+        simply re-running — every victim was already unloadable by the
+        tombstone/manifest rules. ``last_good`` and its generation are
+        never candidates. Returns the demoted steps it collected."""
+        stones = self._stones()
+        committed = set(self._mgr.all_steps())
+        manifested = set(_manifest_steps(self._manifest_dir))
+        victims = sorted(s for s in committed | manifested
+                         if s in stones)
+        self._emit("ckpt_emergency_gc", trigger=trigger, steps=victims)
+        obs.counter("checkpoint.emergency_gc_total").add(1)
+        # The SIGKILL-during-emergency-GC drill window: intent
+        # journaled, deletions not yet complete.
+        faults.inject("ckpt_gc")
+        for s in victims:
+            step_dir = os.path.join(self.directory, str(s))
+            if os.path.isdir(step_dir):
+                shutil.rmtree(step_dir, ignore_errors=True)
+            try:
+                os.unlink(self._manifest_path(s))
+            except OSError:
+                pass
+        for fname in list(os.listdir(self.directory)):
+            if fname.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.directory, fname))
+                except OSError:
+                    pass
+        try:
+            # The manager's step list must track the deletions, or a
+            # later orbax GC pass trips over directories already gone.
+            self._mgr.reload()
+        except Exception:
+            pass
+        self._emit("ckpt_emergency_gc_done", steps=victims)
+        return victims
 
     def _flush_pending(self) -> None:
         """Commit manifests (then ``last_good``) for saves whose orbax
@@ -556,7 +666,7 @@ class Checkpointer:
                 faults.inject("ckpt_commit")
                 with obs.span("checkpoint/verify", step=int(step)):
                     os.makedirs(self._manifest_dir, exist_ok=True)
-                    _atomic_write_json(self._manifest_path(step),
+                    self._durable_json(self._manifest_path(step),
                                        manifest)
                     prev = self.last_good_step()
                     if self.is_tombstoned(step):
@@ -569,7 +679,7 @@ class Checkpointer:
                                    step=step)
                         continue
                     if prev is None or step > prev:
-                        _atomic_write_json(self._last_good_path,
+                        self._durable_json(self._last_good_path,
                                            {"step": step,
                                             "ts": round(time.time(), 3)})
             self._emit("checkpoint_verified", step=step,
@@ -820,11 +930,11 @@ class ChainFollower:
         torn (an atomic-replace reader never sees a partial write, but
         a copied/damaged chain can)."""
         try:
-            with open(os.path.join(self.directory,
-                                   "last_good.json")) as f:
-                step = json.load(f).get("step")
+            step = durable.read_json(
+                os.path.join(self.directory, "last_good.json"),
+                path_class="ckpt").get("step")
             return int(step) if step is not None else None
-        except (OSError, ValueError, TypeError):
+        except (OSError, ValueError, TypeError, AttributeError):
             return None
 
     def _manifest_steps(self) -> list[int]:
@@ -851,10 +961,12 @@ class ChainFollower:
         return int(step) in self._stones()
 
     def _read_manifest(self, step: int) -> dict | None:
+        # Same verify-then-walk-back contract as the writer: a torn or
+        # failing manifest read (io_read) skips the step.
         try:
-            with open(os.path.join(self._manifest_dir,
-                                   f"{int(step)}.json")) as f:
-                return json.load(f)
+            return durable.read_json(
+                os.path.join(self._manifest_dir, f"{int(step)}.json"),
+                path_class="ckpt")
         except (OSError, ValueError):
             return None
 

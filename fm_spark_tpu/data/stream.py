@@ -315,7 +315,8 @@ class RecordGuard:
             # Mirrored into the flight-recorder ring (ISSUE 7): the
             # last-N crash window carries the quarantine narrative.
             self._dead = EventLog(self.dead_letter_path,
-                                  mirror_to_flight=True)
+                                  mirror_to_flight=True,
+                                  path_class="quarantine")
         # Process-wide quarantine accounting (obs.metrics): counters
         # are always live; the registry aggregates across guards.
         self._c_ok = obs.counter("ingest.rows_ok_total")

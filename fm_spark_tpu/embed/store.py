@@ -49,6 +49,8 @@ registry (scraped by ``/metrics``; rendered by tools/run_doctor.py).
 
 from __future__ import annotations
 
+import io
+import os
 import threading
 import time
 
@@ -56,8 +58,15 @@ import numpy as np
 
 from fm_spark_tpu import obs
 from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.utils import durable
 
 __all__ = ["ColdStore", "TieredStore"]
+
+#: write_back()'s commit marker: the manifest is published LAST, so a
+#: directory with plane files but no manifest is an uncommitted (torn)
+#: write-back and read_back refuses it — callers walk back to the
+#: previous generation instead of restoring half a cold tier.
+COLD_MANIFEST = "cold_manifest.json"
 
 
 class ColdStore:
@@ -182,6 +191,119 @@ class ColdStore:
         if self._lazy:
             return max((len(b) for b in self._planes.values()), default=0)
         return self.n_buckets
+
+    # ---- durable write-back (ISSUE 20: the ``embed`` path class) ----
+
+    @staticmethod
+    def _npy_bytes(a: np.ndarray) -> bytes:
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(a), allow_pickle=False)
+        return buf.getvalue()
+
+    def write_back(self, directory: str) -> dict:
+        """Persist the cold tier to ``directory`` through the durable
+        seam (every byte injectable at ``io_write.embed`` etc.). Dense
+        mode writes one ``<plane>.npy`` per plane; lazy mode writes one
+        ``<plane>.<bucket>.npy`` per MATERIALIZED bucket (host RSS
+        discipline extends to disk). The manifest is published last —
+        manifest-absent means write-back-not-committed — and returned.
+        Fail-loud: the caller owns retry/walk-back policy, same tier as
+        checkpoint commits."""
+        os.makedirs(directory, exist_ok=True)
+        files: dict[str, list] = {}
+        for p in self.plane_names:
+            if self._lazy:
+                buckets = sorted(self._planes[p])
+                for b in buckets:
+                    durable.atomic_write_bytes(
+                        os.path.join(directory, f"{p}.{b}.npy"),
+                        self._npy_bytes(self._planes[p][b]),
+                        path_class="embed")
+                files[p] = [int(b) for b in buckets]
+            else:
+                durable.atomic_write_bytes(
+                    os.path.join(directory, f"{p}.npy"),
+                    self._npy_bytes(self._planes[p]),
+                    path_class="embed")
+                files[p] = []
+        manifest = {
+            "lazy": self._lazy,
+            "bucket_rows": self.bucket_rows,
+            "n_rows": self.n_rows,
+            "planes": {
+                p: {"row_shape": list(self.row_shape(p)),
+                    "dtype": np.dtype(self.dtype(p)).str,
+                    "buckets": files[p]}
+                for p in self.plane_names
+            },
+        }
+        durable.atomic_write_json(
+            os.path.join(directory, COLD_MANIFEST), manifest,
+            path_class="embed", sync_dir=True)
+        return manifest
+
+    @staticmethod
+    def _load_npy(path: str) -> np.ndarray:
+        return np.load(io.BytesIO(
+            durable.read_bytes(path, path_class="embed")),
+            allow_pickle=False)
+
+    @classmethod
+    def read_back(cls, directory: str) -> "ColdStore | None":
+        """Rebuild a cold store from a :meth:`write_back` directory, or
+        None when the directory holds no COMMITTED write-back (missing/
+        unreadable manifest, torn plane file, short read). The None is
+        the verify-then-walk-back contract: restore-side callers try
+        the previous generation rather than crash-looping on a torn
+        one. Lazy stores come back lazy (materialized buckets restored;
+        untouched buckets re-init on demand from the original
+        ``init_fn``, which callers re-attach via :meth:`reattach_init`).
+        """
+        try:
+            man = durable.read_json(
+                os.path.join(directory, COLD_MANIFEST),
+                path_class="embed")
+            bucket_rows = int(man["bucket_rows"])
+            n_rows = int(man["n_rows"])
+            if man["lazy"]:
+                meta = {p: (tuple(d["row_shape"]), np.dtype(d["dtype"]))
+                        for p, d in man["planes"].items()}
+                store = cls.lazy(meta, bucket_rows, n_rows,
+                                 init_fn=_unattached_init)
+                for p, d in man["planes"].items():
+                    for b in d["buckets"]:
+                        a = cls._load_npy(
+                            os.path.join(directory, f"{p}.{int(b)}.npy"))
+                        if a.shape[0] != bucket_rows:
+                            raise ValueError(
+                                f"short bucket {p}.{b}: {a.shape}")
+                        store.write_bucket(p, int(b), a)
+                return store
+            planes = {}
+            for p, d in man["planes"].items():
+                a = cls._load_npy(os.path.join(directory, f"{p}.npy"))
+                if (a.shape[0] != n_rows
+                        or tuple(a.shape[1:]) != tuple(d["row_shape"])):
+                    raise ValueError(f"short plane {p}: {a.shape}")
+                planes[p] = a
+            return cls.dense(planes, bucket_rows)
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def reattach_init(self, init_fn) -> None:
+        """Re-attach the deterministic ``init_fn`` to a lazy store that
+        came back from :meth:`read_back` (functions don't serialize;
+        determinism makes re-attachment sound)."""
+        if not self._lazy:
+            raise ValueError("reattach_init is for lazy stores")
+        self._init_fn = init_fn
+
+
+def _unattached_init(plane, bucket, shape, dtype):
+    raise RuntimeError(
+        "lazy ColdStore restored by read_back() has no init_fn — call "
+        "reattach_init(init_fn) with the run's deterministic "
+        "initializer before touching unmaterialized buckets")
 
 
 class TieredStore:

@@ -29,6 +29,8 @@ import os
 import threading
 import time
 
+from fm_spark_tpu.utils import durable
+
 __all__ = ["FlightRecorder", "read_spool"]
 
 
@@ -106,11 +108,18 @@ class FlightRecorder:
                     pass
             if self._spool is not None:
                 try:
-                    self._spool.write(json.dumps(rec) + "\n")
-                    self._spool.flush()
-                    self._spool_lines += 1
-                    if self._spool_lines >= 2 * self.capacity:
-                        self._compact_locked()
+                    # Durable seam, ``obs`` class, best-effort tier: a
+                    # failed append is counted + flagged by the seam
+                    # and the ring still advances. The except keeps
+                    # non-OSError surprises (unserializable fields)
+                    # equally non-fatal.
+                    if durable.append_line(self._spool,
+                                           json.dumps(rec),
+                                           path_class="obs",
+                                           best_effort=True):
+                        self._spool_lines += 1
+                        if self._spool_lines >= 2 * self.capacity:
+                            self._compact_locked()
                 except (OSError, TypeError, ValueError):
                     pass
         return rec
@@ -121,15 +130,12 @@ class FlightRecorder:
         (ENOSPC, a vanished mount) must leave the recorder APPENDING,
         never holding a closed handle that silently eats every later
         write — the append handle is re-established in ``finally``."""
-        tmp = f"{self.spool_path}.tmp"
         self._spool.close()
         try:
-            with open(tmp, "w") as f:
-                for rec in self._ring:
-                    f.write(json.dumps(rec) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.spool_path)
+            durable.atomic_write_lines(
+                self.spool_path,
+                [json.dumps(rec) for rec in self._ring],
+                path_class="obs", best_effort=True)
         finally:
             # Reset the counter even on failure: retrying the rewrite
             # on EVERY event would turn a full disk into a hot loop.
@@ -167,12 +173,10 @@ class FlightRecorder:
             }
             if extra:
                 doc.update(extra)
-            tmp = f"{path}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(doc, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            if not durable.atomic_write_json(path, doc,
+                                             path_class="obs",
+                                             best_effort=True):
+                return None
             return path
         except Exception:
             return None

@@ -57,6 +57,8 @@ import os
 import threading
 import time
 
+from fm_spark_tpu.utils import durable
+
 __all__ = [
     "CAPTURES_DIRNAME",
     "NEAR_MISS_FRACTION",
@@ -205,8 +207,10 @@ class CaptureEngine:
         # individually best-effort so a failed piece still leaves the
         # rest of the bundle.
         try:
-            with open(os.path.join(bundle, "metrics.json"), "w") as f:
-                json.dump(obs.registry().snapshot(), f)
+            durable.atomic_write_json(
+                os.path.join(bundle, "metrics.json"),
+                obs.registry().snapshot(),
+                path_class="obs", best_effort=True)
         except Exception:
             pass
         try:
@@ -230,13 +234,11 @@ class CaptureEngine:
             manifest["trace_ids"] = list(context["traces"])
         # Manifest LAST and atomically: a bundle directory without a
         # parseable capture.json is a torn capture, and every reader
-        # (obs_report/run_doctor) treats it as such.
-        tmp = os.path.join(bundle, MANIFEST_FILE + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f, default=str)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(bundle, MANIFEST_FILE))
+        # (obs_report/run_doctor) treats it as such. Routed through the
+        # durable seam (obs class) so a disk schedule can tear it.
+        durable.atomic_write_json(
+            os.path.join(bundle, MANIFEST_FILE), manifest,
+            path_class="obs", best_effort=True, default=str)
         with self._lock:
             self.captures.append(bundle)
         try:

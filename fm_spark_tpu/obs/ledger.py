@@ -68,6 +68,8 @@ import json
 import os
 import time
 
+from fm_spark_tpu.utils import durable
+
 __all__ = [
     "LEDGER_FILE",
     "PerfLedger",
@@ -216,10 +218,17 @@ class PerfLedger:
             )
         record = dict(record)
         record.setdefault("ts", round(time.time(), 3))
-        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
-                    exist_ok=True)
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                        exist_ok=True)
+        except OSError:
+            pass
+        # Observability tier (ISSUE 20): the append is best-effort
+        # through the durable seam — a failing disk degrades the
+        # history (counted: io.write_failed_total, obs/io_degraded),
+        # never the measurement run it narrates.
+        durable.append_line_path(self.path, json.dumps(record),
+                                 path_class="obs", best_effort=True)
         return record
 
     # ------------------------------------------------------------- read

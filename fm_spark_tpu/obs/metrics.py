@@ -35,6 +35,8 @@ import json
 import threading
 import time
 
+from fm_spark_tpu.utils import durable
+
 __all__ = [
     "DEFAULT_BUCKETS_MS",
     "Counter",
@@ -283,9 +285,10 @@ class MetricsRegistry:
         narrates). Returns the snapshot either way."""
         snap = self.snapshot()
         try:
-            with open(path, "a") as f:
-                f.write(json.dumps(snap) + "\n")
-        except (OSError, TypeError, ValueError):
+            durable.append_line_path(path, json.dumps(snap),
+                                     path_class="obs",
+                                     best_effort=True)
+        except (TypeError, ValueError):
             pass
         return snap
 

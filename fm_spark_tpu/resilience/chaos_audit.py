@@ -9,7 +9,7 @@ sentinel live in standalone-loadable modules too.
 
 from __future__ import annotations
 
-__all__ = ["audit_fleet", "audit_serve_events"]
+__all__ = ["audit_disk", "audit_fleet", "audit_serve_events"]
 
 
 def _violation(invariant: str, detail: str) -> dict:
@@ -106,6 +106,124 @@ def audit_serve_events(events: list[dict], *,
             "rc_discipline",
             f"serving process exited rc={rc}; expected one of "
             f"{tuple(allowed_rcs)}"))
+    return v
+
+
+def audit_disk(*, committed_steps=(), tombstoned_steps=(),
+               last_good_step: "int | None" = None,
+               restored_step: "int | None" = None,
+               expected_surviving=None,
+               io_failures: "dict | None" = None,
+               degraded_gauge: "float | None" = None,
+               params_match: "bool | None" = None,
+               spool_seqs=None,
+               events: "list[dict] | None" = None) -> list[dict]:
+    """Storage-fault invariants (ISSUE 20), graded from artifacts
+    alone: the chain reader's view (``committed_steps`` = manifest-
+    verified, ``tombstoned_steps``, ``last_good_step``, and
+    ``restored_step`` = where a FRESH reader actually landed after the
+    plan cleared), the durable seam's failure accounting
+    (``io_failures`` = :func:`fm_spark_tpu.utils.durable.
+    io_failure_counts` or the ``io.write_failed*`` counters,
+    ``degraded_gauge`` = ``obs/io_degraded``), the golden-vs-drilled
+    params fingerprint comparison (``params_match``), and the flight
+    spool's ``seq`` column. Empty list = green. The contracts:
+
+    - **last_good_loadable** — whenever any committed, non-demoted
+      generation exists, ``last_good_step`` names one of them: never
+      None, never a tombstoned step, never a step without a verified
+      manifest. Disk faults may stall the pointer, never corrupt it.
+    - **chain_never_broken** — after the fault plan clears, a fresh
+      reader walks the chain to the NEWEST committed non-demoted step
+      (torn/short reads walk back, they never crash-loop and never
+      land past a demotion).
+    - **demotion_atomic** — when the drill demoted (``expected_
+      surviving`` = steps that must outlive it), the tombstone set is
+      exactly the complement: no expected survivor demoted, no
+      condemned step left standing — a torn rename mid-demotion is
+      all-or-nothing.
+    - **degradation_signaled** — best-effort (obs-tier) write failures
+      leave a trail: the failure counts are nonzero AND the
+      ``obs/io_degraded`` gauge is raised. Silent telemetry loss is
+      the one degradation this plane forbids.
+    - **obs_degraded_harmless** — the drilled run's final params are
+      byte-identical to the golden run's (``params_match``): no obs
+      write failure ever leaked into training bytes.
+    - **spool_seq_continuous** — flight ``seq`` values on disk are
+      strictly increasing (gaps are legal — a failed best-effort
+      append loses that record from DISK, not from the ring — but a
+      regressed or duplicated seq means a restart forked the stream).
+    """
+    v: list[dict] = []
+    committed = {int(s) for s in committed_steps}
+    stones = {int(s) for s in tombstoned_steps}
+    good = committed - stones
+    if good:
+        if last_good_step is None:
+            v.append(_violation(
+                "last_good_loadable",
+                f"no last_good pointer while committed non-demoted "
+                f"steps {sorted(good)} exist"))
+        elif int(last_good_step) in stones:
+            v.append(_violation(
+                "last_good_loadable",
+                f"last_good names step {last_good_step}, which "
+                "carries a demotion tombstone"))
+        elif int(last_good_step) not in committed:
+            v.append(_violation(
+                "last_good_loadable",
+                f"last_good names step {last_good_step}, which has "
+                f"no verified manifest (committed: {sorted(committed)})"))
+        if restored_step is not None and int(restored_step) != max(good):
+            v.append(_violation(
+                "chain_never_broken",
+                f"fresh reader landed on step {restored_step} after "
+                f"the plan cleared; the newest committed non-demoted "
+                f"step is {max(good)}"))
+    elif restored_step is not None:
+        v.append(_violation(
+            "chain_never_broken",
+            f"fresh reader restored step {restored_step} but no "
+            "committed non-demoted step exists"))
+    if expected_surviving is not None:
+        keep = {int(s) for s in expected_surviving}
+        wrongly_demoted = sorted(keep & stones)
+        left_standing = sorted((committed - keep) - stones)
+        if wrongly_demoted:
+            v.append(_violation(
+                "demotion_atomic",
+                f"steps {wrongly_demoted} were expected to survive "
+                "the demotion but carry tombstones"))
+        if left_standing:
+            v.append(_violation(
+                "demotion_atomic",
+                f"condemned steps {left_standing} have no tombstone — "
+                "the demotion tore"))
+    fails = dict(io_failures or {})
+    # The gauge contract binds the BEST-EFFORT tier (swallowed
+    # failures); fail-loud failures surface to a caller who owns them
+    # and need no ambient flag.
+    n_fail = int(fails.get("best_effort") or 0)
+    if n_fail and (degraded_gauge is None or degraded_gauge < 1.0):
+        v.append(_violation(
+            "degradation_signaled",
+            f"{n_fail} best-effort write failure(s) swallowed but the "
+            f"obs/io_degraded gauge reads {degraded_gauge!r} — "
+            "telemetry loss must leave a visible mark"))
+    if params_match is False:
+        v.append(_violation(
+            "obs_degraded_harmless",
+            "drilled final params differ from the golden run's — an "
+            "obs-tier disk fault leaked into training bytes"))
+    if spool_seqs is not None:
+        seqs = [int(s) for s in spool_seqs]
+        for a, b in zip(seqs, seqs[1:]):
+            if b <= a:
+                v.append(_violation(
+                    "spool_seq_continuous",
+                    f"flight spool seq regressed {a} -> {b} — a "
+                    "restart forked the event stream"))
+                break
     return v
 
 

@@ -48,6 +48,9 @@ __all__ = [
     "ACTIONS",
     "ENV_PLAN",
     "ENV_STATE",
+    "IO_ACTIONS",
+    "IO_PATH_CLASSES",
+    "IO_POINTS",
     "KNOWN_POINTS",
     "NET_ACTIONS",
     "NET_POINTS",
@@ -115,6 +118,21 @@ ENV_STATE = "FM_SPARK_FAULTS_STATE"
 #: parent away from ONE replica for a bounded window while that
 #: replica stays healthy — the failure the process-kill model cannot
 #: express.
+#: Storage fault plane (ISSUE 20): ``io_write`` / ``io_fsync`` /
+#: ``io_rename`` / ``io_read`` fire at the durable-write seam
+#: (:mod:`fm_spark_tpu.utils.durable` — every checkpoint manifest,
+#: tombstone, obs ledger/spool/journal, embed cold-store write-back,
+#: and compile-cache breadcrumb routes through it) and take the
+#: disk-level actions below (``eio``, ``enospc``, ``torn_write:K``,
+#: ``readonly``, plus the shared ``slow_ms:N``). They are interpreted
+#: by :mod:`fm_spark_tpu.resilience.iofaults`, not :func:`inject`, and
+#: support PATH-CLASS scoping (``io_write.ckpt`` / ``io_write.obs``)
+#: analogous to net peer scoping, so a schedule can fail ONLY the
+#: checkpoint commits while observability keeps writing, or vice
+#: versa. ``ckpt_gc`` fires inside checkpoint.Checkpointer's
+#: emergency-GC window (after the journal entry, before deletions
+#: complete) — an ``exit`` there is the SIGKILL-during-emergency-GC
+#: drill; recovery must land on a loadable ``last_good``.
 KNOWN_POINTS = (
     "backend_init",
     "sweep_leg",
@@ -134,6 +152,11 @@ KNOWN_POINTS = (
     "net_connect",
     "net_send",
     "net_recv",
+    "io_write",
+    "io_fsync",
+    "io_rename",
+    "io_read",
+    "ckpt_gc",
 )
 
 #: The network points and their socket-level action vocabulary
@@ -144,15 +167,30 @@ NET_POINTS = ("net_connect", "net_send", "net_recv")
 NET_ACTIONS = ("refuse", "blackhole", "slow_ms", "truncate_after",
                "reset")
 
+#: The storage points and their disk-level action vocabulary
+#: (ISSUE 20). IO actions are only valid on ``io_*`` points;
+#: ``slow_ms`` is shared with the net plane (a slow fsync and a slow
+#: link are the same latency primitive). Interpreted by
+#: :mod:`fm_spark_tpu.resilience.iofaults` at the durable-write seam.
+IO_POINTS = ("io_write", "io_fsync", "io_rename", "io_read")
+IO_ACTIONS = ("eio", "enospc", "torn_write", "readonly")
+
+#: The path classes an ``io_*`` point may scope to (``io_write.ckpt``).
+#: Unlike net peer scopes (free-form replica names), path classes are a
+#: closed vocabulary — each names one durability tier declared at a
+#: :mod:`fm_spark_tpu.utils.durable` call site — so a typo'd class is a
+#: plan that silently never fires and is rejected eagerly.
+IO_PATH_CLASSES = ("ckpt", "obs", "embed", "cache", "quarantine")
+
 #: The action vocabulary (public since ISSUE 10: the chaos schedule
 #: generator samples from it, and the eager-validation error cites it).
 ACTIONS = ("hang", "sleep", "exit", "device_loss", "error", "sigterm",
-           *NET_ACTIONS)
+           *NET_ACTIONS, *IO_ACTIONS)
 _ACTIONS = ACTIONS
 
-#: Net actions that must carry a numeric parameter (``slow_ms:N`` in
-#: milliseconds, ``truncate_after:K`` in bytes).
-_PARAM_REQUIRED = ("slow_ms", "truncate_after")
+#: Actions that must carry a numeric parameter (``slow_ms:N`` in
+#: milliseconds, ``truncate_after:K`` / ``torn_write:K`` in bytes).
+_PARAM_REQUIRED = ("slow_ms", "truncate_after", "torn_write")
 
 #: Occurrence-range expansion bound: ``point@1-512=...`` is the widest
 #: window one rule may cover (a wider one is almost certainly a typo).
@@ -252,9 +290,11 @@ class FaultPlan:
             base = point.split(".", 1)[0]
             if points is not None and point not in points:
                 # A dotted point is a peer-scoped NET point
-                # (``net_connect.replica-1``); scoping any other
+                # (``net_connect.replica-1``) or a path-class-scoped
+                # IO point (``io_write.ckpt``); scoping any other
                 # point is as much a typo as an unknown one.
-                if not ("." in point and base in NET_POINTS
+                if not ("." in point
+                        and (base in NET_POINTS or base in IO_POINTS)
                         and base in points):
                     raise ValueError(
                         f"unknown fault point {point!r} — a rule "
@@ -262,11 +302,26 @@ class FaultPlan:
                         f"never fire (known points: {tuple(points)}; "
                         f"actions: {_ACTIONS})"
                     )
-            if m["action"] in NET_ACTIONS and base not in NET_POINTS:
+                if (base in IO_POINTS
+                        and point[len(base) + 1:] not in IO_PATH_CLASSES):
+                    raise ValueError(
+                        f"unknown io path class in {point!r} — io "
+                        "points scope to the durable-seam path classes "
+                        f"{IO_PATH_CLASSES}, not free-form names"
+                    )
+            if (m["action"] in NET_ACTIONS and base not in NET_POINTS
+                    and not (m["action"] == "slow_ms"
+                             and base in IO_POINTS)):
                 raise ValueError(
                     f"net action {m['action']!r} on non-network point "
                     f"{point!r} — socket-level actions only make "
                     f"sense at {NET_POINTS} (see resilience/netfaults)"
+                )
+            if m["action"] in IO_ACTIONS and base not in IO_POINTS:
+                raise ValueError(
+                    f"io action {m['action']!r} on non-storage point "
+                    f"{point!r} — disk-level actions only make sense "
+                    f"at {IO_POINTS} (see resilience/iofaults)"
                 )
             if (m["action"] in _PARAM_REQUIRED
                     and not (m["param"] or "").replace(".", "").isdigit()):

@@ -1,0 +1,184 @@
+"""Deterministic storage-fault plane for the durable-write seam (ISSUE 20).
+
+PR 19 made the network injectable; this module is its storage sibling.
+Every durability claim the repo audits — checkpoint chain walk-back,
+atomic tombstone demotion, ledger/sentinel history, flight-spool
+seq-continuity, the embed cold store, quarantine dead-letters — assumed
+the filesystem never fails. In production the disk fails MORE often
+than the network: ENOSPC mid-commit, EIO on an append, torn renames,
+multi-second fsync stalls. This module makes exactly those failures
+injectable, deterministically, at the single durable-write seam
+(:mod:`fm_spark_tpu.utils.durable`, which checkpoint manifests /
+tombstones / ``last_good``, the obs ledger + flight spool + EventLog
+journals, the embed cold-store write-back, the quarantine dead-letter
+path, and the compile-cache breadcrumb all route through), using the
+SAME plan grammar, env vars, and occurrence counters as
+:mod:`fm_spark_tpu.resilience.faults`.
+
+Points (registered in ``faults.KNOWN_POINTS``) and their actions::
+
+    io_write    per durable payload write    eio | enospc | readonly
+    io_fsync    per file/dir fsync           | torn_write:K | slow_ms:N
+    io_rename   per atomic rename publish
+    io_read     per durable read
+
+- ``eio``          OSError(EIO) — a failing append / write / read
+- ``enospc``       OSError(ENOSPC) — disk full at that phase
+- ``readonly``     OSError(EROFS) — the filesystem flipped read-only
+- ``torn_write:K`` write only the first K bytes, then EIO (the torn
+                   write/short read primitive; on ``io_read`` it is a
+                   short read — deliver K bytes then stop; on
+                   ``io_rename``/``io_fsync`` it degrades to ``eio``:
+                   a torn publish is a failed publish)
+- ``slow_ms:N``    add N ms of disk latency, then proceed (scaled by
+                   ``FM_SPARK_TEST_SLEEP_SCALE`` so slow-disk drills
+                   stay inside the tier-1 wall clock)
+
+Path-class scoping: ``io_write.ckpt@1-8=enospc`` fires only on writes
+whose durable call site declared the ``ckpt`` class (its own occurrence
+counter), so a schedule can fail ONLY checkpoint commits while the obs
+plane keeps writing — or fail ONLY observability and prove training
+bytes are unchanged. Unscoped rules count occurrences disk-wide.
+Classes in use: ``ckpt``, ``obs``, ``embed``, ``cache``, ``quarantine``
+(:data:`PATH_CLASSES`, canonically ``faults.IO_PATH_CLASSES``). Unlike
+net peer scopes (free-form replica names), the class vocabulary is
+CLOSED — a typo'd class would be a plan that silently never fires, so
+``faults.FaultPlan.from_spec`` rejects unknown classes eagerly.
+
+Tier discipline lives in :mod:`fm_spark_tpu.utils.durable`, not here:
+this module only decides WHETHER a given disk event fails and HOW; the
+seam decides what a failure means (best-effort obs degradation vs
+fail-loud checkpoint retry).
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+import time
+
+from fm_spark_tpu.resilience import faults
+from fm_spark_tpu.utils import sleeps
+
+__all__ = [
+    "PATH_CLASSES",
+    "check",
+    "on_fsync",
+    "on_read",
+    "on_rename",
+    "on_write",
+]
+
+#: The path-class vocabulary durable call sites declare (scoping keys
+#: like ``io_write.ckpt``). Closed set, validated eagerly by
+#: ``faults.FaultPlan.from_spec`` — see module docstring.
+PATH_CLASSES = faults.IO_PATH_CLASSES
+
+#: Occurrence counting is shared across the checkpoint writer thread,
+#: obs emitters, and any drill thread; faults' in-proc counter dict is
+#: not locked (its points fire from one thread each), so the storage
+#: plane serializes its own counter consumption — same policy as
+#: netfaults.
+_count_lock = threading.Lock()
+
+
+def check(point: str, path_class: "str | None" = None):
+    """The matching rule for this disk event, or None.
+
+    Consults the ACTIVE faults plan (env or ``faults.activate``).
+    A class-scoped rule set (``point.class``) is consulted first with
+    its own occurrence counter; the unscoped point counts disk-wide.
+    Both counters only advance when the plan names their key — an
+    inactive plane is one ``is None`` check, same as ``inject``.
+    """
+    plan = faults.current_plan()
+    if plan is None:
+        return None
+    scoped = unscoped = None
+    with _count_lock:
+        # Both counters advance on every event their key is planned
+        # for — "this class's Nth write" and "the disk's Nth write"
+        # stay independently meaningful; the class-scoped rule wins
+        # when both match.
+        if path_class is not None:
+            key = f"{point}.{path_class}"
+            if key in plan.points:
+                scoped = plan.rule_for(key, faults._next_count(key))
+        if point in plan.points:
+            unscoped = plan.rule_for(point, faults._next_count(point))
+    return scoped if scoped is not None else unscoped
+
+
+def _strike(rule, phase: str) -> "int | None":
+    """Take a rule's action at a disk phase. Raises the ``OSError`` the
+    action emulates, sleeps for latency actions, or returns a byte
+    budget for ``torn_write`` on write/read (the caller owns the bytes
+    to tear). Non-io actions (``sleep``/``error``/``exit``...) fall
+    through to the generic :meth:`faults._Rule.fire`."""
+    a = rule.action
+    where = f"{rule.point}#{rule.occurrence}"
+    if a == "eio":
+        raise OSError(errno.EIO,
+                      f"[iofault] I/O error during {phase} ({where})")
+    if a == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"[iofault] no space left during {phase} ({where})")
+    if a == "readonly":
+        raise OSError(errno.EROFS,
+                      f"[iofault] read-only file system at {phase} "
+                      f"({where})")
+    if a == "slow_ms":
+        # Designed sleep: a slow-disk drill proves latency TOLERANCE,
+        # not latency itself — FM_SPARK_TEST_SLEEP_SCALE applies
+        # (ISSUE 20 satellite).
+        time.sleep(sleeps.scaled(float(rule.param) / 1e3))
+        return None
+    if a == "torn_write":
+        if phase in ("write", "read"):
+            return int(rule.param)
+        # A torn rename/fsync has no partial-byte semantics: the
+        # publish simply failed.
+        raise OSError(errno.EIO,
+                      f"[iofault] {phase} torn ({where})")
+    rule.fire(rule.occurrence)
+    return None
+
+
+def on_write(path_class: "str | None" = None) -> "int | None":
+    """``io_write`` — fires per durable payload write. Returns a byte
+    budget when the rule is ``torn_write:K`` (the caller writes only
+    the first K bytes then raises EIO — the crash-consistency
+    primitive); raises the emulated ``OSError`` otherwise."""
+    rule = check("io_write", path_class)
+    if rule is None:
+        return None
+    return _strike(rule, "write")
+
+
+def on_fsync(path_class: "str | None" = None) -> None:
+    """``io_fsync`` — fires per file/directory fsync (the stall
+    point of real disks)."""
+    rule = check("io_fsync", path_class)
+    if rule is not None:
+        _strike(rule, "fsync")
+
+
+def on_rename(path_class: "str | None" = None) -> None:
+    """``io_rename`` — fires per atomic rename publish
+    (``os.replace`` of tmp onto final). A failure here strikes AFTER
+    the payload is durable but BEFORE it is visible — the exact window
+    torn-publish drills need."""
+    rule = check("io_rename", path_class)
+    if rule is not None:
+        _strike(rule, "rename")
+
+
+def on_read(path_class: "str | None" = None) -> "int | None":
+    """``io_read`` — fires per durable read. Returns a byte budget
+    when the rule is ``torn_write:K`` (deliver only K bytes — a short
+    read the verify-then-walk-back tier must survive); raises the
+    emulated ``OSError`` otherwise."""
+    rule = check("io_read", path_class)
+    if rule is None:
+        return None
+    return _strike(rule, "read")

@@ -111,6 +111,18 @@ def enable(cache_dir: str | None = None) -> str:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_enable_compilation_cache", True)
     os.makedirs(path, exist_ok=True)
+    # Provenance breadcrumb through the durable seam (ISSUE 20, the
+    # ``cache`` path class): which process last enabled the cache, and
+    # with which jax — the first thing to check when a "warm" start
+    # recompiles. Best-effort: a cache on a failing disk still works
+    # as a cache.
+    from fm_spark_tpu.utils import durable
+
+    durable.atomic_write_json(
+        os.path.join(path, "cache_meta.json"),
+        {"dir": path, "pid": os.getpid(),
+         "jax_version": getattr(jax, "__version__", None)},
+        path_class="cache", best_effort=True)
     try:
         # jax latches "is the cache used?" at the FIRST compile of the
         # process; a process that compiled anything before enable()

@@ -12,6 +12,8 @@ import json
 import sys
 import time
 
+from fm_spark_tpu.utils import durable
+
 
 class MetricsLogger:
     """Writes one JSON object per line; tracks wall-clock samples/sec.
@@ -70,8 +72,11 @@ class MetricsLogger:
         if self._stream is not None:
             print(line, file=self._stream, flush=True)
         if self._fh is not None:
-            self._fh.write(line + "\n")
-            self._fh.flush()
+            # Observability tier (ISSUE 20): best-effort through the
+            # durable seam — a dead metrics file degrades telemetry
+            # (counted), never the training step being logged.
+            durable.append_line(self._fh, line, path_class="obs",
+                                best_effort=True)
         return record
 
     def add_pause(self, seconds: float):
@@ -119,10 +124,15 @@ class EventLog:
     """
 
     def __init__(self, path: str | None = None, stream=None,
-                 mirror_to_flight: bool = False):
+                 mirror_to_flight: bool = False,
+                 path_class: str = "obs"):
         self._fh = open(path, "a") if path else None
         self._stream = stream
         self._mirror = bool(mirror_to_flight)
+        # The durable-seam scoping class (ISSUE 20): journals are
+        # ``obs`` by default; the quarantine dead-letter log declares
+        # ``quarantine`` so a schedule can fail it independently.
+        self._path_class = str(path_class)
 
     def emit(self, event: str, **fields) -> dict:
         record = {"ts": round(time.time(), 3), "event": event, **fields}
@@ -131,8 +141,12 @@ class EventLog:
             if self._stream is not None:
                 print(line, file=self._stream, flush=True)
             if self._fh is not None:
-                self._fh.write(line + "\n")
-                self._fh.flush()
+                # Best-effort through the durable seam (the
+                # observability tier of the ISSUE 20 degradation
+                # policy): failures are counted, never raised.
+                durable.append_line(self._fh, line,
+                                    path_class=self._path_class,
+                                    best_effort=True)
         except (OSError, TypeError, ValueError):
             # TypeError included: an unserializable field (a numpy/jax
             # scalar) must degrade to a dropped event, not abort the

@@ -392,6 +392,50 @@ FIXTURES = {
         """},
         "expect": 1,
     },
+    "durable-write-discipline": {
+        # Raw overwrite-opens and raw renames on the durable surface
+        # (obs/, embed/, checkpoint.py) bypass the io-fault seam.
+        "positive": {
+            "fm_spark_tpu/obs/sink.py": """\
+                import json, os
+                def publish(path, doc):
+                    with open(path + '.tmp', 'w') as f:
+                        json.dump(doc, f)
+                    os.replace(path + '.tmp', path)
+            """,
+            "fm_spark_tpu/checkpoint.py": """\
+                def stamp(path):
+                    with open(path, mode='wb') as f:
+                        f.write(b'x')
+            """,
+        },
+        "negative": {
+            # The seam itself, appends, reads, and non-literal modes
+            # are all legal — and the same raw write OUTSIDE the
+            # durable surface is out of scope.
+            "fm_spark_tpu/obs/sink.py": """\
+                from fm_spark_tpu.utils import durable
+                def publish(path, doc, line, mode):
+                    durable.atomic_write_json(path, doc,
+                                              path_class='obs')
+                    durable.append_line_path(path, line,
+                                             path_class='obs')
+                    with open(path) as f:
+                        body = f.read()
+                    with open(path, 'a') as f:
+                        f.write(line)
+                    with open(path, mode) as f:
+                        f.write(line)
+                    return body
+            """,
+            "fm_spark_tpu/tools_helper.py": """\
+                def scratch(path):
+                    with open(path, 'w') as f:
+                        f.write('not a durability promise')
+            """,
+        },
+        "expect": 3,
+    },
 }
 
 

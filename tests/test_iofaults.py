@@ -1,0 +1,511 @@
+"""Storage fault plane + durable-write seam tests (ISSUE 20).
+
+The load-bearing contracts:
+
+- **seeded io-fault grammar** — ``io_write``/``io_fsync``/
+  ``io_rename``/``io_read`` rules (path-class-scoped, occurrence-
+  ranged) parse eagerly, reject typos eagerly — including a typo'd
+  path class, which unlike a net peer scope is a CLOSED vocabulary —
+  and replay deterministically;
+- **one seam, three tiers** — the durable helpers publish atomically
+  (a torn tmp is never the published file), best-effort failures are
+  counted + flagged (``obs/io_degraded``) and swallowed, fail-loud
+  failures propagate to the checkpoint tier's bounded retry /
+  ENOSPC-triggered emergency GC / loud :class:`CheckpointIOError`;
+- **reads verify-then-walk-back** — a short ``io_read`` delivers a
+  torn payload; restore-side callers (embed cold store, chain reader)
+  refuse it and walk back, never crash-loop;
+- **the disk campaign is green** — seeded schedules (ENOSPC mid
+  checkpoint commit, torn rename mid-demotion racing a serve reload,
+  slow-disk day save, EIO burst on flight compaction, read-only obs
+  flip) graded by ``audit_disk`` from artifacts alone, plus the
+  SIGKILL-during-emergency-GC subprocess drill and the byte-identity
+  proof that an all-failing obs plane never touches training bytes.
+
+Arming ``io_write``, ``io_fsync``, ``io_rename``, ``io_read``, and
+``ckpt_gc`` here also satisfies fmlint's registry-coverage rule for
+the new points.
+"""
+
+import errno
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fm_spark_tpu import obs  # noqa: E402
+from fm_spark_tpu.checkpoint import (  # noqa: E402
+    ChainFollower,
+    Checkpointer,
+    CheckpointIOError,
+)
+from fm_spark_tpu.embed.store import ColdStore  # noqa: E402
+from fm_spark_tpu.resilience import chaos, faults, iofaults  # noqa: E402
+from fm_spark_tpu.resilience.chaos_audit import audit_disk  # noqa: E402
+from fm_spark_tpu.utils import durable, sleeps  # noqa: E402
+from fm_spark_tpu.utils.logging import EventLog, read_events  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults.clear()
+    durable.reset_failure_counts()
+    yield
+    faults.clear()
+    durable.reset_failure_counts()
+
+
+# ------------------------------------------------ the plan grammar
+
+
+def test_io_rules_expand_ranges_and_scope_path_classes():
+    plan = faults.FaultPlan.from_spec(
+        "io_write.ckpt@2-4=enospc;io_fsync@1=slow_ms:20;"
+        "io_read@1=torn_write:8")
+    for n in (2, 3, 4):
+        r = plan.rule_for("io_write.ckpt", n)
+        assert r is not None and r.action == "enospc"
+    assert plan.rule_for("io_write.ckpt", 1) is None
+    assert plan.rule_for("io_write.ckpt", 5) is None
+    # The scoped key is its own point: the unscoped base never fires.
+    assert plan.rule_for("io_write", 2) is None
+    assert plan.rule_for("io_read", 1).param == "8"
+
+
+@pytest.mark.parametrize("spec", [
+    "io_write.bogus@1=eio",        # path class outside the closed set
+    "io_read.replica-1@1=eio",     # net-style peer scope on an io point
+    "train_step.ckpt@1=eio",       # path-class scope off an io point
+    "train_step@1=enospc",         # io action off an io point
+    "io_write@1=refuse",           # net action on an io point
+    "io_fsync@1=slow_ms",          # missing required parameter
+    "io_write@1=torn_write:lots",  # non-numeric parameter
+    "io_write@9-3=eio",            # inverted range
+    "io_write@1-600=eio",          # window wider than _MAX_RANGE
+    "io_bogus@1=eio",              # unknown point
+])
+def test_io_grammar_rejects_typos_eagerly(spec):
+    with pytest.raises(ValueError):
+        faults.FaultPlan.from_spec(spec)
+
+
+def test_slow_ms_is_shared_with_the_net_plane_but_stays_bounded():
+    # A slow fsync and a slow link are the same latency primitive.
+    faults.FaultPlan.from_spec("io_fsync.ckpt@1-4=slow_ms:80")
+    # The SIGKILL-mid-GC drill's plan parses too (the ckpt_gc point).
+    faults.FaultPlan.from_spec("io_write.ckpt@1=enospc;ckpt_gc@1=exit:29")
+
+
+def test_check_advances_scoped_and_diskwide_counters():
+    """"This class's Nth write" and "the disk's Nth write" count
+    independently, and the class-scoped rule wins when both match."""
+    faults.activate("io_write.ckpt@2=enospc;io_write@1=eio")
+    # Event 1: unscoped occurrence 1 matches; scoped (occ 1) doesn't.
+    assert iofaults.check("io_write", "ckpt").action == "eio"
+    # Event 2: scoped occurrence 2 fires AND wins.
+    assert iofaults.check("io_write", "ckpt").action == "enospc"
+    assert iofaults.check("io_write", "ckpt") is None
+    # A different class never consumed ckpt's counter.
+    faults.activate("io_write.ckpt@1=eio")
+    assert iofaults.check("io_write", "obs") is None
+    assert iofaults.check("io_write", "ckpt").action == "eio"
+
+
+def test_io_actions_emulate_their_errnos(monkeypatch):
+    faults.activate("io_write@1=eio")
+    with pytest.raises(OSError) as ei:
+        iofaults.on_write()
+    assert ei.value.errno == errno.EIO
+    faults.activate("io_write@1=enospc")
+    with pytest.raises(OSError) as ei:
+        iofaults.on_write()
+    assert ei.value.errno == errno.ENOSPC
+    faults.activate("io_write@1=readonly")
+    with pytest.raises(OSError) as ei:
+        iofaults.on_write()
+    assert ei.value.errno == errno.EROFS
+    # torn_write returns a byte budget on write/read (the caller owns
+    # the bytes to tear)...
+    faults.activate("io_write@1=torn_write:7;io_read@1=torn_write:3")
+    assert iofaults.on_write() == 7
+    assert iofaults.on_read() == 3
+    # ...and degrades to EIO on rename/fsync (a torn publish is a
+    # failed publish).
+    faults.activate("io_rename@1=torn_write:7;io_fsync@1=torn_write:7")
+    with pytest.raises(OSError) as ei:
+        iofaults.on_rename()
+    assert ei.value.errno == errno.EIO
+    with pytest.raises(OSError):
+        iofaults.on_fsync()
+    # Non-io actions on an io point fall through to the generic fire.
+    faults.activate("io_write@1=error")
+    with pytest.raises(faults.FaultInjected):
+        iofaults.on_write()
+
+
+def test_slow_ms_honors_test_sleep_scale(monkeypatch):
+    """ISSUE 20 satellite: slow-disk drills prove latency TOLERANCE,
+    so the designed sleep scales with FM_SPARK_TEST_SLEEP_SCALE."""
+    monkeypatch.setenv(sleeps.ENV, "1.0")
+    faults.activate("io_fsync@1=slow_ms:60")
+    t0 = time.monotonic()
+    assert iofaults.on_fsync() is None
+    assert time.monotonic() - t0 >= 0.05
+    monkeypatch.setenv(sleeps.ENV, "0.0")
+    faults.activate("io_fsync@1=slow_ms:60")
+    t0 = time.monotonic()
+    assert iofaults.on_fsync() is None
+    assert time.monotonic() - t0 < 0.05
+
+
+# -------------------------------------------- the durable-write seam
+
+
+def test_atomic_write_never_publishes_torn_bytes(tmp_path):
+    path = str(tmp_path / "doc.json")
+    faults.activate("io_write@1=torn_write:4")
+    with pytest.raises(OSError):
+        durable.atomic_write_bytes(path, b"0123456789",
+                                   path_class="ckpt")
+    # The torn payload hit the TMP only; the final path never appeared.
+    assert not os.path.exists(path)
+    assert durable.io_failure_counts()["ckpt"] == 1
+    # The window exhausted: the same write now publishes whole.
+    assert durable.atomic_write_bytes(path, b"0123456789",
+                                      path_class="ckpt")
+    with open(path, "rb") as f:
+        assert f.read() == b"0123456789"
+
+
+def test_rename_fault_strikes_after_payload_before_visibility(tmp_path):
+    path = str(tmp_path / "doc.json")
+    faults.activate("io_rename.ckpt@1=eio")
+    with pytest.raises(OSError):
+        durable.atomic_write_json(path, {"step": 4}, path_class="ckpt")
+    assert not os.path.exists(path)
+
+
+def test_best_effort_failures_are_counted_flagged_and_swallowed(tmp_path):
+    path = str(tmp_path / "obs.json")
+    faults.activate("io_write.obs@1=eio")
+    assert durable.atomic_write_json(path, {"a": 1}, path_class="obs",
+                                     best_effort=True) is False
+    counts = durable.io_failure_counts()
+    assert counts["total"] == 1 and counts["obs"] == 1
+    assert counts["best_effort"] == 1
+    assert obs.counter("io.write_failed_total").value >= 1
+    assert obs.counter("io.write_failed.obs_total").value >= 1
+    # Sticky degradation flag: the record has holes, the doctor must
+    # see it even after the disk heals.
+    snap = obs.registry().snapshot()
+    assert snap["gauges"].get("obs/io_degraded") == 1.0
+    # Fail-loud failures do NOT count as degraded-swallowed.
+    faults.activate("io_write.ckpt@1=eio")
+    with pytest.raises(OSError):
+        durable.atomic_write_json(str(tmp_path / "m.json"), {},
+                                  path_class="ckpt")
+    assert durable.io_failure_counts()["best_effort"] == 1
+
+
+def test_torn_append_leaves_partial_line_readers_skip(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    durable.append_line_path(path, json.dumps({"seq": 0}),
+                             path_class="obs")
+    faults.activate("io_write.obs@1=torn_write:5")
+    assert durable.append_line_path(
+        path, json.dumps({"seq": 1, "pad": "x" * 40}),
+        path_class="obs", best_effort=True) is False
+    # The torn fragment has no newline: the NEXT append merges into
+    # the garbled line (both records lost from disk), and the one
+    # after lands on a fresh line — readers skip exactly the poisoned
+    # line, nothing more.
+    durable.append_line_path(path, json.dumps({"seq": 2}),
+                             path_class="obs")
+    durable.append_line_path(path, json.dumps({"seq": 3}),
+                             path_class="obs")
+    from fm_spark_tpu.obs.flight import read_spool
+    recs = read_spool(path)
+    assert [r["seq"] for r in recs] == [0, 3]
+
+
+def test_read_faults_short_read_and_eio(tmp_path):
+    path = str(tmp_path / "doc.json")
+    durable.atomic_write_json(path, {"step": 7}, path_class="ckpt")
+    faults.activate("io_read.ckpt@1=torn_write:2")
+    assert durable.read_bytes(path, path_class="ckpt") == b'{"'
+    with pytest.raises(ValueError):
+        faults.activate("io_read.ckpt@1=torn_write:2")
+        durable.read_json(path, path_class="ckpt")
+    faults.activate("io_read.ckpt@1=eio")
+    with pytest.raises(OSError):
+        durable.read_json(path, path_class="ckpt")
+    # Healed: the payload is intact underneath.
+    assert durable.read_json(path, path_class="ckpt") == {"step": 7}
+
+
+# --------------------------- the checkpoint tier (fail-loud + retry)
+
+
+def _ck(tmp_path, journal=None):
+    return Checkpointer(str(tmp_path / "ck"), save_every=1,
+                        max_to_keep=16, async_save=False,
+                        journal=journal)
+
+
+def test_checkpoint_absorbs_transient_eio_with_bounded_backoff(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(sleeps.ENV, "0.0")
+    journal = EventLog(str(tmp_path / "events.jsonl"))
+    ck = _ck(tmp_path, journal)
+    try:
+        faults.activate("io_write.ckpt@1=eio")
+        ck.save(1, {"w": np.arange(4, dtype=np.float32)}, {},
+                force=True)
+    finally:
+        faults.clear()
+        ck.close()
+    assert ck.last_good_step() == 1
+    kinds = [e.get("event") or e.get("kind")
+             for e in read_events(str(tmp_path / "events.jsonl"))]
+    assert "ckpt_io_retry" in kinds
+
+
+def test_enospc_triggers_journaled_emergency_gc_then_commit(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(sleeps.ENV, "0.0")
+    journal = EventLog(str(tmp_path / "events.jsonl"))
+    ck = _ck(tmp_path, journal)
+    try:
+        for s in (1, 2, 3):
+            ck.save(s, {"w": np.arange(4, dtype=np.float32) * s}, {},
+                    force=True)
+        ck.demote_newer_than(1, reason="drift verdict")
+        faults.activate("io_write.ckpt@1=enospc")
+        ck.save(4, {"w": np.arange(4, dtype=np.float32) * 4}, {},
+                force=True)
+    finally:
+        faults.clear()
+        ck.close()
+    events = read_events(str(tmp_path / "events.jsonl"))
+    gc = [e for e in events
+          if (e.get("event") or e.get("kind")) == "ckpt_emergency_gc"]
+    assert gc and sorted(gc[0]["steps"]) == [2, 3]
+    # The demoted generations' bytes are actually gone...
+    for s in (2, 3):
+        assert not os.path.isdir(str(tmp_path / "ck" / str(s)))
+    # ...and the SAME commit retried through.
+    follower = ChainFollower(str(tmp_path / "ck"))
+    try:
+        assert follower.last_good_step() == 4
+        restored = follower.restore(
+            {"w": np.zeros(4, np.float32)}, {})
+        assert int(restored["step"]) == 4
+    finally:
+        follower.close()
+
+
+def test_exhausted_retries_raise_loud_checkpoint_io_error(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(sleeps.ENV, "0.0")
+    ck = _ck(tmp_path)
+    try:
+        faults.activate("io_write.ckpt@1-8=eio")
+        with pytest.raises(CheckpointIOError):
+            ck.save(1, {"w": np.arange(4, dtype=np.float32)}, {},
+                    force=True)
+    finally:
+        faults.clear()
+        ck.close()
+
+
+# ------------------------------------ the embed cold-store write-back
+
+
+def test_cold_store_write_back_round_trips_dense_and_lazy(tmp_path):
+    planes = {"emb": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    cs = ColdStore.dense(planes, bucket_rows=2)
+    d = str(tmp_path / "cold")
+    os.makedirs(d)
+    man = cs.write_back(d)
+    assert man["lazy"] is False
+    cs2 = ColdStore.read_back(d)
+    np.testing.assert_array_equal(cs2.dense_plane("emb"),
+                                  planes["emb"])
+    # Lazy: only touched buckets persist; restore needs reattachment.
+    def init_fn(plane, bucket, shape, dtype):
+        return np.full(shape, bucket, dtype)
+
+    lz = ColdStore.lazy({"emb": ((4,), np.float32)}, bucket_rows=2,
+                        n_rows=8, init_fn=init_fn)
+    lz.read_bucket("emb", 1)
+    d2 = str(tmp_path / "cold_lazy")
+    os.makedirs(d2)
+    man2 = lz.write_back(d2)
+    assert man2["lazy"] is True
+    lz2 = ColdStore.read_back(d2)
+    assert lz2.is_lazy
+    np.testing.assert_array_equal(lz2.read_bucket("emb", 1),
+                                  np.full((2, 4), 1, np.float32))
+    # An untouched bucket needs the deterministic init back first.
+    with pytest.raises(RuntimeError):
+        lz2.read_bucket("emb", 3)
+    lz2.reattach_init(init_fn)
+    np.testing.assert_array_equal(lz2.read_bucket("emb", 3),
+                                  np.full((2, 4), 3, np.float32))
+
+
+def test_cold_store_manifest_last_commit_and_walk_back(tmp_path):
+    planes = {"emb": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    cs = ColdStore.dense(planes, bucket_rows=2)
+    d = str(tmp_path / "torn")
+    os.makedirs(d)
+    # ENOSPC mid write-back: fail-loud, and the manifest (published
+    # LAST) never appears — a torn write-back is not a restorable one.
+    faults.activate("io_write.embed@1=enospc")
+    with pytest.raises(OSError):
+        cs.write_back(d)
+    faults.clear()
+    assert not os.path.exists(os.path.join(d, "cold_manifest.json"))
+    assert ColdStore.read_back(d) is None
+    # A short read of a published store's manifest walks back too.
+    d2 = str(tmp_path / "ok")
+    os.makedirs(d2)
+    cs.write_back(d2)
+    faults.activate("io_read.embed@1=torn_write:9")
+    assert ColdStore.read_back(d2) is None
+    faults.clear()
+    assert ColdStore.read_back(d2) is not None
+
+
+# ------------------------------- the artifacts-only disk auditor
+
+
+def test_audit_disk_flags_each_broken_invariant():
+    assert audit_disk(committed_steps=[1, 4], tombstoned_steps=[2, 3],
+                      last_good_step=4, restored_step=4,
+                      expected_surviving={1, 4},
+                      io_failures={"total": 0},
+                      spool_seqs=[1, 2, 9]) == []
+    v = audit_disk(committed_steps=[1, 2], last_good_step=None,
+                   restored_step=1)
+    assert any(x["invariant"] == "last_good_loadable" for x in v)
+    assert any(x["invariant"] == "chain_never_broken" for x in v)
+    v = audit_disk(committed_steps=[1, 2], tombstoned_steps=[2],
+                   last_good_step=2, restored_step=1)
+    assert any("tombstone" in x["detail"] for x in v)
+    v = audit_disk(committed_steps=[1, 2, 3], tombstoned_steps=[3],
+                   last_good_step=1, restored_step=1,
+                   expected_surviving={1})
+    assert any(x["invariant"] == "demotion_atomic"
+               and "no tombstone" in x["detail"] for x in v)
+    # Swallowed best-effort failures demand the gauge; fail-loud
+    # failures alone do not.
+    v = audit_disk(io_failures={"total": 3, "best_effort": 3},
+                   degraded_gauge=None)
+    assert any(x["invariant"] == "degradation_signaled" for x in v)
+    assert audit_disk(io_failures={"total": 3, "ckpt": 3},
+                      degraded_gauge=None) == []
+    v = audit_disk(params_match=False)
+    assert any(x["invariant"] == "obs_degraded_harmless" for x in v)
+    v = audit_disk(spool_seqs=[1, 2, 2])
+    assert any(x["invariant"] == "spool_seq_continuous" for x in v)
+
+
+# ----------------------------------- seeded disk schedules + campaign
+
+
+def test_disk_schedule_is_pure_and_covers_scenarios():
+    seen = set()
+    for seed in range(10):
+        s = chaos.disk_schedule(seed)
+        assert s == chaos.disk_schedule(seed)
+        s.validate()
+        seen.add(s.scenario)
+    assert seen == set(chaos._DISK_SCENARIOS)
+    # Scenario semantics: the named acceptance scenarios target the
+    # path classes their invariants are about.
+    enospc = chaos.disk_schedule(0)
+    assert enospc.scenario == "enospc_ckpt_commit"
+    assert "io_write.ckpt" in enospc.plan and "enospc" in enospc.plan
+    assert enospc.demote_cut is not None
+    torn = chaos.disk_schedule(1)
+    assert torn.scenario == "torn_rename_demote"
+    assert "io_rename.ckpt" in torn.plan and torn.demote_armed
+    slow = chaos.disk_schedule(2)
+    assert "io_fsync.ckpt" in slow.plan and "slow_ms" in slow.plan
+    for seed in (3, 4):
+        s = chaos.disk_schedule(seed)
+        assert "io_write.obs" in s.plan and s.arm_at_start
+
+
+def test_obs_degraded_run_is_byte_identical_to_golden(
+        tmp_path, monkeypatch):
+    """THE best-effort-tier proof (ISSUE 20 acceptance): with EVERY
+    ``io_write.obs`` failing, the final params are byte-identical to
+    the golden run's, the failures are counted, and the degradation
+    gauge is raised — telemetry loss is visible, training bytes are
+    untouched."""
+    monkeypatch.setenv(sleeps.ENV, "0.0")
+    golden = chaos.run_disk_schedule(
+        chaos.DiskSchedule(-1, "golden", (), setup_saves=4,
+                           final_saves=0),
+        str(tmp_path / "golden"))
+    assert golden["verdict"] == "green", golden["violations"]
+    sched = chaos.DiskSchedule(
+        -2, "readonly_obs_flip", ("io_write.obs@1-512=eio",),
+        setup_saves=4, final_saves=0, arm_at_start=True)
+    entry = chaos.run_disk_schedule(
+        sched, str(tmp_path / "degraded"),
+        golden_sums=golden["params_sums"])
+    assert entry["verdict"] == "green", entry["violations"]
+    assert entry["params_sums"] == golden["params_sums"]
+    assert entry["io_failures"]["obs"] > 0
+    assert entry["io_failures"]["best_effort"] > 0
+    assert obs.counter("io.write_failed_total").value > 0
+    assert obs.registry().snapshot()["gauges"].get(
+        "obs/io_degraded") == 1.0
+
+
+def test_disk_campaign_tier1_seeds_green(tmp_path, monkeypatch):
+    """The storage half of the chaos campaign (ISSUE 20 acceptance):
+    golden + every tier-1 seed, >= 4 distinct scenarios including
+    ENOSPC-mid-commit and torn-rename-mid-demotion, every entry
+    graded green by ``audit_disk`` from artifacts alone."""
+    monkeypatch.setenv(sleeps.ENV, "0.25")
+    entries = chaos.run_disk_campaign(
+        base_dir=str(tmp_path), include_kill_drill=False)
+    assert [e["scenario"] for e in entries[:1]] == ["golden"]
+    assert [e["seed"] for e in entries[1:]] == list(
+        chaos.DISK_TIER1_SEEDS)
+    for e in entries:
+        assert e["verdict"] == "green", (e["scenario"],
+                                         e["violations"])
+    scenarios = {e["scenario"] for e in entries[1:]}
+    assert len(scenarios) >= 4
+    assert {"enospc_ckpt_commit", "torn_rename_demote"} <= scenarios
+    # The designed-loud variant (ENOSPC with the disk full of live
+    # data) is graded green BECAUSE it failed loud, when drawn.
+    for e in entries[1:]:
+        assert e["outcome"] == e["expects"]
+    # The torn-rename drill really raced a follower through the
+    # demotion window.
+    torn = next(e for e in entries
+                if e["scenario"] == "torn_rename_demote")
+    assert torn["follower_samples"]
+
+
+def test_gc_kill_drill_recovers_to_loadable_last_good(tmp_path):
+    """The SIGKILL-during-emergency-GC drill (ISSUE 20 acceptance):
+    killed between the journaled GC intent and the deletions, every
+    reader still lands on a loadable last_good, and a clean re-run
+    commits the next step."""
+    res = chaos.run_gc_kill_drill(str(tmp_path / "gc"), exit_rc=29)
+    assert res["rcs"] == [29, 0]
+    assert res["violations"] == [], res["violations"]

@@ -307,6 +307,48 @@ def test_read_spool_skips_torn_tail(tmp_path):
     assert len(recs) == 1 and recs[0]["kind"] == "a"
 
 
+def test_flight_compaction_enospc_reestablishes_append_and_seq(tmp_path):
+    """ISSUE 20 satellite: the compaction rewrite hits injected
+    ENOSPC. The recorder must come out APPENDING (handle
+    re-established, counter reset — never a closed handle silently
+    eating every later write), the failure must be counted as
+    best-effort degradation, and after a subsequent SIGKILL-style
+    abandonment the reopened spool's seq column is continuous —
+    strictly increasing, no fork."""
+    from fm_spark_tpu.resilience import faults
+    from fm_spark_tpu.utils import durable
+
+    spool = str(tmp_path / "flight.jsonl")
+    fr = FlightRecorder(capacity=4, spool_path=spool)
+    durable.reset_failure_counts()
+    try:
+        # Appends are obs-class occurrences 1..8; the 8th record
+        # crosses the 2N threshold, so the compaction's atomic
+        # rewrite is occurrence 9.
+        faults.activate("io_write.obs@9=enospc")
+        for i in range(8):
+            fr.record("tick", i=i)
+    finally:
+        faults.clear()
+    counts = durable.io_failure_counts()
+    assert counts["obs"] == 1 and counts["best_effort"] == 1
+    # The failed rewrite left the OLD spool intact and the recorder
+    # appending: later records land on disk.
+    assert fr._spool is not None
+    n_before = len(read_spool(spool))
+    fr.record("after_enospc", i=8)
+    assert len(read_spool(spool)) == n_before + 1
+    last_seq = fr.events()[-1]["seq"]
+    # SIGKILL-style ending: no close(), no dump — just gone.
+    del fr
+    fr2 = FlightRecorder(capacity=4, spool_path=spool)
+    rec = fr2.record("reborn")
+    assert rec["seq"] == last_seq + 1
+    seqs = [r["seq"] for r in read_spool(spool) if "seq" in r]
+    assert seqs == sorted(set(seqs)), "spool seq forked or regressed"
+    fr2.close()
+
+
 # ----------------------------------------------- module facade / EventLog
 
 

@@ -521,3 +521,70 @@ def test_fleet_diagnose_unit_contracts():
     finds = doctor.fleet_findings(fleet)
     assert any("GENERATION SKEW" in f for f in finds)
     assert any("CRASH-LOOPING" in f for f in finds)
+
+
+def test_doctor_renders_storage_health_section(tmp_path, capsys):
+    """ISSUE 20: a run whose durable seam took disk faults gets a
+    Storage health section — the failure counters by path class, the
+    degraded-obs window, the retry/backoff table, the emergency-GC
+    events, the io-fault timeline — and a DISK_DEGRADED finding."""
+    doctor = _load_doctor()
+    run_dir = tmp_path / "r20"
+    run_dir.mkdir()
+    (run_dir / "trace.jsonl").write_text("")
+    flight = [
+        {"kind": "io_write_failed", "ts": 10.0, "seq": 1,
+         "path_class": "obs", "phase": "append", "best_effort": True},
+        {"kind": "ckpt_io_retry", "ts": 10.5, "seq": 2,
+         "path": "manifest_4.json", "attempt": 1, "errno": 5,
+         "delay_s": 0.05},
+        {"kind": "ckpt_emergency_gc", "ts": 11.0, "seq": 3,
+         "trigger": "last_good.json", "steps": [2, 3]},
+        {"kind": "ckpt_emergency_gc_done", "ts": 11.2, "seq": 4,
+         "steps": [2, 3]},
+        {"kind": "io_write_failed", "ts": 12.0, "seq": 5,
+         "path_class": "obs", "phase": "atomic_write",
+         "best_effort": True},
+    ]
+    (run_dir / "flight.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in flight))
+    (run_dir / "metrics.jsonl").write_text(json.dumps({
+        "gauges": {"obs/io_degraded": 1.0},
+        "counters": {"io.write_failed_total": 3,
+                     "io.write_failed.obs_total": 2,
+                     "io.write_failed.ckpt_total": 1,
+                     "checkpoint.io_retries_total": 1,
+                     "checkpoint.emergency_gc_total": 1},
+    }) + "\n")
+    assert doctor.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "## Storage health" in out
+    assert "write failures 3 (ckpt 1 / obs 2)" in out
+    assert "obs degraded true" in out
+    assert "degraded-obs window: 2 swallowed" in out
+    assert "retry of" in out and "manifest_4.json" in out
+    assert "io-fault timeline:" in out
+    assert "ckpt_emergency_gc" in out
+    assert "DISK_DEGRADED" in out
+    assert "ENOSPC emergency GC" in out
+    assert "transient disk errors absorbed" in out
+
+
+def test_storage_diagnose_unit_contracts():
+    """No storage footprint -> None (a healthy disk renders no
+    section); a loud CheckpointIOError event outranks the degraded
+    finding; fail-loud-only failures do not claim DISK_DEGRADED."""
+    doctor = _load_doctor()
+    assert doctor.storage_diagnose({"snapshot": {}}, []) is None
+    assert doctor.storage_diagnose(
+        {"snapshot": {}}, [{"kind": "bench_leg_start"}]) is None
+    run = {"snapshot": {"counters": {"io.write_failed_total": 2,
+                                     "io.write_failed.ckpt_total": 2}}}
+    ev = [{"kind": "checkpoint_io_error", "ts": 1.0,
+           "path": "last_good.json", "errno": 28}]
+    st = doctor.storage_diagnose(run, ev)
+    assert st["by_class"] == {"ckpt": 2}
+    assert st["degraded_window"] is None
+    finds = doctor.storage_findings(st)
+    assert any("CHECKPOINT IO ERROR" in f for f in finds)
+    assert not any("DISK_DEGRADED" in f for f in finds)

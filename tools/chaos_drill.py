@@ -216,6 +216,23 @@ def _partition_entries(base_dir: str, soak: bool) -> list[dict]:
         seeds, base_dir=os.path.join(base_dir, "partition"))
 
 
+def _disk_entries(base_dir: str, soak: bool) -> list[dict]:
+    """The storage half of the campaign (ISSUE 20): seeded disk-fault
+    schedules (ENOSPC at a checkpoint commit over demoted
+    generations, torn tombstone renames racing a serve reload, fsync
+    stalls on the day-boundary save, EIO bursts on flight-spool
+    compaction, a read-only obs plane) through the durable-write
+    seam, graded by :func:`chaos_audit.audit_disk` — golden run first
+    for the byte-identity baseline. Soak adds the subprocess
+    SIGKILL-during-emergency-GC drill."""
+    from fm_spark_tpu.resilience import chaos
+
+    return chaos.run_disk_campaign(
+        chaos.DISK_TIER1_SEEDS,
+        base_dir=os.path.join(base_dir, "disk"),
+        include_kill_drill=soak)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos campaigns over the resilience stack")
@@ -285,6 +302,7 @@ def main(argv=None) -> int:
         extra.extend(_drift_entries(base_dir, soak=args.soak))
         extra.extend(_fleet_entries(base_dir, soak=args.soak))
         extra.extend(_partition_entries(base_dir, soak=args.soak))
+        extra.extend(_disk_entries(base_dir, soak=args.soak))
     if args.soak:
         extra.extend(_soak_subprocess_drills(
             dataclasses.replace(cfg, break_restore=False), base_dir))

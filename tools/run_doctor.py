@@ -33,6 +33,12 @@ turns the run's streams into ONE screen a human can act on:
   with each trace's dominant hop named and torn/incomplete traces
   flagged (the write side lives in ``fm_spark_tpu/obs/trace.py``;
   the merge logic in ``tools/trace_report.py``);
+- **Storage health** (ISSUE 20) — the durable-write seam's failure
+  counters by path class, the ``obs/io_degraded`` gauge + swallowed-
+  failure window, the checkpoint tier's retry/backoff table and
+  ENOSPC emergency-GC events, and the io-fault timeline; a
+  ``DISK_DEGRADED`` finding lands in the diagnosis when the obs tier
+  ran degraded (rendered only for runs that hit the fault surface);
 - **Diagnosis** — the doctor's findings: cold-cache compile domination,
   attachment weather, ingest-bound execution, degraded/fallback legs,
   statistically-regressed legs, stale/degraded/regressed serving,
@@ -178,6 +184,95 @@ def embed_findings(embed: dict | None) -> list[str]:
             out.append(
                 f"embed_bench {r.get('leg')}: sentinel verdict "
                 "regressed vs its own tiered cohort")
+    return out
+
+
+# The durable-seam event kinds (ISSUE 20): the obs-tier swallowed
+# failure, the checkpoint tier's bounded retry / ENOSPC emergency GC /
+# loud give-up.
+_STORAGE_KINDS = ("io_write_failed", "ckpt_io_retry",
+                  "ckpt_emergency_gc", "ckpt_emergency_gc_done",
+                  "checkpoint_io_error")
+
+
+def storage_diagnose(run: dict, flight_events: list[dict]) -> dict | None:
+    """The storage-health view of a run (ISSUE 20): the durable-write
+    seam's failure counters by path class, the ``obs/io_degraded``
+    gauge, the checkpoint tier's retry/backoff and emergency-GC
+    evidence, and the io-fault event timeline. ``None`` when the run
+    never hit the fault surface (counters/gauge unset, no io events) —
+    a healthy disk renders no section."""
+    snap = run.get("snapshot") or {}
+    gauges = snap.get("gauges") or {}
+    counters = snap.get("counters") or {}
+    events = [e for e in flight_events
+              if str(e.get("kind", "")) in _STORAGE_KINDS]
+    write_failed = counters.get("io.write_failed_total") or 0
+    retries = counters.get("checkpoint.io_retries_total") or 0
+    gcs = counters.get("checkpoint.emergency_gc_total") or 0
+    degraded = gauges.get("obs/io_degraded")
+    if not (events or write_failed or retries or gcs or degraded):
+        return None
+    prefix = "io.write_failed."
+    by_class = {k[len(prefix):-len("_total")]: v
+                for k, v in sorted(counters.items())
+                if k.startswith(prefix) and k.endswith("_total")}
+    # Degraded-obs window: the span of swallowed best-effort failures —
+    # the stretch of this run whose telemetry has holes on disk.
+    besteff = [e for e in events if e.get("kind") == "io_write_failed"
+               and e.get("best_effort")]
+    window = None
+    if besteff:
+        ts = [float(e.get("ts") or 0.0) for e in besteff]
+        window = {"first_ts": min(ts), "last_ts": max(ts),
+                  "n": len(besteff)}
+    return {
+        "degraded": degraded,
+        "write_failed_total": write_failed,
+        "by_class": by_class,
+        "retries": retries,
+        "retry_rows": [e for e in events
+                       if e.get("kind") == "ckpt_io_retry"],
+        "emergency_gcs": gcs,
+        "gc_rows": [e for e in events
+                    if e.get("kind") == "ckpt_emergency_gc"],
+        "io_errors": [e for e in events
+                      if e.get("kind") == "checkpoint_io_error"],
+        "degraded_window": window,
+        "events": events,
+    }
+
+
+def storage_findings(storage: dict | None) -> list[str]:
+    """Storage-health one-liners for the diagnosis section."""
+    if storage is None:
+        return []
+    out = []
+    for e in storage["io_errors"]:
+        out.append(
+            f"CHECKPOINT IO ERROR: durable write of {e.get('path')} "
+            f"failed loud (errno {e.get('errno')}) after bounded "
+            "retries/emergency GC — the chain stopped advancing; fix "
+            "the disk, then resume from last_good")
+    if storage["degraded"] or storage["degraded_window"]:
+        w = storage["degraded_window"] or {}
+        out.append(
+            f"DISK_DEGRADED: {w.get('n', '?')} obs-tier write "
+            "failure(s) swallowed (obs/io_degraded gauge set) — the "
+            "telemetry record on disk has holes; training/serving "
+            "bytes are unaffected by design (best-effort tier)")
+    if storage["emergency_gcs"]:
+        steps = sorted({s for e in storage["gc_rows"]
+                        for s in (e.get("steps") or [])})
+        out.append(
+            f"{storage['emergency_gcs']:.0f} ENOSPC emergency GC "
+            f"pass(es) collected demoted generation(s) {steps} — "
+            "journaled before deletion; last_good never a candidate")
+    if storage["retries"] and not storage["io_errors"]:
+        out.append(
+            f"transient disk errors absorbed: "
+            f"{storage['retries']:.0f} bounded checkpoint "
+            "retry/backoff(s), chain committed")
     return out
 
 
@@ -822,7 +917,8 @@ def render(run: dict, diag: dict, legs: list[dict],
            fmlint_rep: dict | None = None,
            embed: dict | None = None,
            fleet: dict | None = None,
-           tracing: dict | None = None) -> str:
+           tracing: dict | None = None,
+           storage: dict | None = None) -> str:
     out = [f"# fm_spark_tpu run doctor — {run['run_id']}",
            f"obs dir: {run['dir']}", ""]
 
@@ -1061,6 +1157,47 @@ def render(run: dict, diag: dict, legs: list[dict],
                     f"{((r.get('sentinel') or {}).get('verdict') or '?'):>22}")
         out.append("")
 
+    if storage is not None:
+        out.append("## Storage health")
+        cls = " / ".join(f"{k} {v:.0f}" for k, v in
+                         storage["by_class"].items())
+        out.append(
+            f"  write failures {storage['write_failed_total']:.0f}"
+            + (f" ({cls})" if cls else "")
+            + f"  ckpt retries {storage['retries']:.0f}"
+            + f"  emergency GCs {storage['emergency_gcs']:.0f}"
+            + "  obs degraded "
+            + str(bool(storage["degraded"])).lower())
+        w = storage["degraded_window"]
+        if w:
+            out.append(
+                f"  degraded-obs window: {w['n']} swallowed "
+                "best-effort failure(s) over "
+                f"{w['last_ts'] - w['first_ts']:.3f}s")
+        if storage["retry_rows"]:
+            out.append(f"  {'retry of':24} {'attempt':>8} "
+                       f"{'errno':>6} {'backoff_s':>10}")
+            for e in storage["retry_rows"]:
+                out.append(
+                    f"  {str(e.get('path'))[:24]:24} "
+                    f"{e.get('attempt', '-'):>8} "
+                    f"{str(e.get('errno', '-')):>6} "
+                    f"{str(e.get('delay_s', '-')):>10}")
+        if storage["events"]:
+            out.append("  io-fault timeline:")
+            t0 = storage["events"][0].get("ts") or 0.0
+            for e in storage["events"][:40]:
+                extras = {k: v for k, v in e.items()
+                          if k not in ("ts", "kind", "seq")}
+                detail = " ".join(f"{k}={v}" for k, v in
+                                  sorted(extras.items()))
+                out.append(f"    +{(e.get('ts') or t0) - t0:>8.3f}s "
+                           f"{e.get('kind'):22} {detail}"[:160])
+            if len(storage["events"]) > 40:
+                out.append(f"    ... {len(storage['events']) - 40} "
+                           "more io-fault event(s)")
+        out.append("")
+
     if online is not None:
         out.append("## Continuous learning")
         if online["quality_rows"]:
@@ -1100,6 +1237,7 @@ def render(run: dict, diag: dict, legs: list[dict],
                  + online_findings(online)
                  + tracing_findings(tracing)
                  + embed_findings(embed)
+                 + storage_findings(storage)
                  + capture_findings(run.get("captures"))
                  + fmlint_findings(fmlint_rep)):
         out.append(f"  - {line}")
@@ -1152,7 +1290,9 @@ def main(argv=None) -> int:
                                                  run["run_id"]),
                             fmlint_rep=load_fmlint_report(obs_dir),
                             embed=embed, fleet=fleet,
-                            tracing=tracing_diagnose(obs_dir)))
+                            tracing=tracing_diagnose(obs_dir),
+                            storage=storage_diagnose(run,
+                                                     flight_events)))
     return 0
 
 
